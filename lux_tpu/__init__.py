@@ -1,0 +1,37 @@
+"""lux_tpu — a TPU-native distributed graph-processing framework.
+
+A from-scratch reimplementation of the capabilities of Lux (Jia et al.,
+"A Distributed Multi-GPU System for Fast Graph Processing", PVLDB 11(3),
+2017; reference tree at /root/reference) designed for TPU hardware:
+
+- compute path: JAX/XLA (gathers + segmented reductions on the VPU/MXU),
+  with optional Pallas kernels for the hot edge loops;
+- distribution: ``jax.sharding.Mesh`` + ``shard_map`` over a ``parts``
+  axis, with the per-iteration vertex-state exchange expressed as
+  ``lax.all_gather`` over ICI (the reference's Legion/GASNet region
+  all-gather, see reference core/pull_model.inl:454-469);
+- convergence-driven apps compile the *entire* run into one XLA program
+  (``lax.while_loop`` + ``psum`` halt detection), replacing the
+  reference's SLIDING_WINDOW=4 host-pipelining trick
+  (reference sssp/sssp.cc:111-129) with zero host round-trips;
+- host-side native tooling (graph converter, partition-slice file
+  loader) implemented in C++ (lux_tpu/native/).
+
+Layout:
+  format.py     .lux binary CSC file format (read/write/inspect)
+  convert.py    edge-list <-> .lux conversion + synthetic generators (RMAT)
+  partition.py  edge-balanced contiguous vertex partitioner
+  graph.py      host Graph + padded device-resident ShardedGraph layout
+  ops/          segmented reductions (XLA + Pallas fast paths)
+  engine/       pull (dense gather-apply) and push (frontier) engines
+  parallel/     mesh construction and sharding helpers
+  apps/         PageRank, SSSP/BFS, ConnectedComponents, CollabFilter
+  check.py      fixed-point correctness audits (the reference's -check)
+  native/       C++ converter CLI and partition-slice loader
+"""
+
+__version__ = "0.1.0"
+
+from lux_tpu.format import LuxFileHeader, read_lux, write_lux, peek_lux
+from lux_tpu.graph import Graph, ShardedGraph
+from lux_tpu.partition import edge_balanced_bounds

@@ -1,0 +1,95 @@
+"""Edge-list <-> .lux conversion and synthetic graph generators.
+
+Python implementations of the reference's offline converter tool
+(reference tools/converter.cc:72-130: read `src dst` text pairs, sort by
+destination, emit binary CSC + trailing out-degrees).  A native C++ CLI
+with the same behavior lives in lux_tpu/native/ for billion-edge inputs;
+this module is the in-process path and the test oracle.
+
+Also provides an R-MAT generator (Chakrabarti et al., SDM'04 — the
+standard recursive-matrix power-law generator; the reference's RMAT27
+benchmark graph is such a graph, README.md:86) so benchmarks run without
+downloading datasets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lux_tpu import format as luxfmt
+
+
+def edges_to_csc(src, dst, nv: int, weights=None):
+    """Sort edges by destination and build CSC end-offset arrays.
+
+    Returns (row_ptrs[u8 nv], col_idx[u4 ne] = sources, sorted_weights,
+    out_degrees[u4 nv]).  Matches the reference converter's output
+    semantics (converter.cc:98-124) without replicating its code: we use
+    a vectorized stable argsort instead of a per-edge struct sort.
+    """
+    src = np.asarray(src, dtype=np.uint32)
+    dst = np.asarray(dst, dtype=np.uint32)
+    if src.size and (int(src.max()) >= nv or int(dst.max()) >= nv):
+        raise ValueError("edge endpoint out of range")
+    order = np.argsort(dst, kind="stable")
+    col_idx = src[order]
+    counts = np.bincount(dst, minlength=nv).astype(np.uint64)
+    row_ptrs = np.cumsum(counts, dtype=np.uint64)
+    out_degrees = np.bincount(src, minlength=nv).astype(np.uint32)
+    w_sorted = None
+    if weights is not None:
+        w_sorted = np.asarray(weights)[order]
+    return row_ptrs, col_idx, w_sorted, out_degrees
+
+
+def convert_edge_list(text_path: str, lux_path: str, nv: int,
+                      weighted: bool = False, weight_dtype=np.int32):
+    """Convert a text edge list (`src dst [weight]` per line) to .lux."""
+    ncols = 3 if weighted else 2
+    data = np.loadtxt(text_path, dtype=np.float64, ndmin=2)
+    if data.size == 0:
+        data = data.reshape(0, ncols)
+    src = data[:, 0].astype(np.uint32)
+    dst = data[:, 1].astype(np.uint32)
+    w = data[:, 2].astype(weight_dtype) if weighted else None
+    row_ptrs, col_idx, w_sorted, deg = edges_to_csc(src, dst, nv, w)
+    luxfmt.write_lux(lux_path, row_ptrs, col_idx, w_sorted, deg)
+    return row_ptrs, col_idx, w_sorted, deg
+
+
+def rmat_edges(scale: int, edge_factor: int = 16, seed: int = 0,
+               a: float = 0.57, b: float = 0.19, c: float = 0.19):
+    """Generate an R-MAT edge list: nv = 2**scale, ne = nv * edge_factor.
+
+    Vectorized: draws all `scale` quadrant choices for all edges at once.
+    Produces a skewed power-law degree distribution comparable to the
+    reference's RMAT27 benchmark graph.
+    """
+    nv = 1 << scale
+    ne = nv * edge_factor
+    rng = np.random.default_rng(seed)
+    src = np.zeros(ne, dtype=np.uint64)
+    dst = np.zeros(ne, dtype=np.uint64)
+    if not 0.0 < a + b + c <= 1.0:
+        raise ValueError("quadrant probabilities must satisfy 0 < a+b+c <= 1")
+    # Per bit level: pick quadrant with probs (a, b, c, 1-a-b-c).
+    for _ in range(scale):
+        r = rng.random(ne)
+        src_bit = (r >= a + b).astype(np.uint64)          # quadrants c,d
+        dst_bit = ((r >= a) & (r < a + b) | (r >= a + b + c)).astype(np.uint64)
+        src = (src << np.uint64(1)) | src_bit
+        dst = (dst << np.uint64(1)) | dst_bit
+    # Permute vertex ids so the skew is not correlated with id order.
+    perm = rng.permutation(nv).astype(np.uint32)
+    return perm[src.astype(np.uint32)], perm[dst.astype(np.uint32)], nv
+
+
+def uniform_random_edges(nv: int, ne: int, seed: int = 0, weighted=False):
+    """Erdos-Renyi-ish random edge list (test-sized graphs)."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, nv, size=ne, dtype=np.uint32)
+    dst = rng.integers(0, nv, size=ne, dtype=np.uint32)
+    if weighted:
+        w = rng.integers(1, 6, size=ne, dtype=np.int32)
+        return src, dst, w
+    return src, dst

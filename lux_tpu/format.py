@@ -1,0 +1,146 @@
+"""The .lux binary CSC graph file format.
+
+Layout (little-endian), exactly the reference's on-disk format
+(reference README.md:55-79, tools/converter.cc:108-124,
+core/pull_model.inl:288-319):
+
+    offset 0   : nv        uint32      number of vertices
+    offset 4   : ne        uint64      number of directed edges
+    offset 12  : row_ptrs  uint64[nv]  *end* offsets: in-edges of vertex v
+                                       occupy col_idx[row_ptrs[v-1] : row_ptrs[v]]
+                                       (row_ptrs[-1] implicitly 0)
+    ...        : col_idx   uint32[ne]  edge *sources*, sorted by destination
+    ...        : weights   w[ne]       optional; only if the graph is weighted
+                                       (reference WeightType is int32,
+                                       col_filter/app.h:24; we also accept f32)
+    ...        : degrees   uint32[nv]  optional trailing out-degrees
+                                       (written by the reference converter,
+                                       converter.cc:124, but recomputed at load
+                                       time by apps — see SURVEY.md §7 quirks)
+
+The file does not self-describe whether weights/degrees are present (the
+reference decides at compile time via the EDGE_WEIGHT macro); we infer
+from file size, with explicit overrides available.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+HEADER_SIZE = 12  # reference FILE_HEADER_SIZE: sizeof(V_ID) + sizeof(E_ID)
+
+V_DTYPE = np.dtype("<u4")  # V_ID
+E_DTYPE = np.dtype("<u8")  # E_ID
+
+
+@dataclasses.dataclass(frozen=True)
+class LuxFileHeader:
+    nv: int
+    ne: int
+    has_weights: bool
+    has_degrees: bool
+    weight_dtype: np.dtype
+
+
+def _infer_sections(path: str, nv: int, ne: int,
+                    weighted: bool | None, weight_dtype: np.dtype):
+    """Infer optional-section presence from total file size."""
+    size = os.path.getsize(path)
+    base = HEADER_SIZE + 8 * nv + 4 * ne
+    wbytes = int(np.dtype(weight_dtype).itemsize) * ne
+    candidates = {
+        (False, False): base,
+        (False, True): base + 4 * nv,
+        (True, False): base + wbytes,
+        (True, True): base + wbytes + 4 * nv,
+    }
+    matches = [k for k, v in candidates.items() if v == size]
+    if weighted is not None:
+        matches = [m for m in matches if m[0] == weighted]
+    if not matches:
+        raise ValueError(
+            f"{path}: size {size} does not match any .lux layout for "
+            f"nv={nv} ne={ne} (expected one of {sorted(candidates.values())})")
+    # Ambiguity (possible when 4*nv == wbytes i.e. nv == ne): prefer the
+    # weighted interpretation only if the caller asked for it.
+    matches.sort()
+    return matches[0]
+
+
+def peek_lux(path: str, weighted: bool | None = None,
+             weight_dtype=np.int32) -> LuxFileHeader:
+    """Read only the 12-byte header + infer section layout."""
+    with open(path, "rb") as f:
+        head = f.read(HEADER_SIZE)
+    if len(head) != HEADER_SIZE:
+        raise ValueError(f"{path}: too short for a .lux header")
+    nv = int(np.frombuffer(head, V_DTYPE, count=1, offset=0)[0])
+    ne = int(np.frombuffer(head, E_DTYPE, count=1, offset=4)[0])
+    has_w, has_d = _infer_sections(path, nv, ne, weighted, weight_dtype)
+    return LuxFileHeader(nv=nv, ne=ne, has_weights=has_w, has_degrees=has_d,
+                         weight_dtype=np.dtype(weight_dtype))
+
+
+def read_lux(path: str, weighted: bool | None = None, weight_dtype=np.int32,
+             mmap: bool = True):
+    """Read a .lux file.
+
+    Returns (header, row_ptrs[u8 nv], col_idx[u4 ne], weights|None,
+    degrees|None). With mmap=True (default) the big arrays are memory
+    mapped, so partition slicing downstream does not copy the whole file
+    through RAM (the analogue of the reference's per-partition
+    fseeko/fread loads, pull_model.inl:288-319; the real native path is
+    lux_tpu.native's C++ loader).
+    """
+    hdr = peek_lux(path, weighted, weight_dtype)
+    off = HEADER_SIZE
+    if mmap:
+        def arr(dtype, count, offset):
+            return np.memmap(path, dtype=dtype, mode="r", offset=offset,
+                             shape=(count,))
+    else:
+        buf = open(path, "rb").read()
+
+        def arr(dtype, count, offset):
+            return np.frombuffer(buf, dtype=dtype, count=count, offset=offset)
+
+    row_ptrs = arr(E_DTYPE, hdr.nv, off)
+    off += 8 * hdr.nv
+    col_idx = arr(V_DTYPE, hdr.ne, off)
+    off += 4 * hdr.ne
+    weights = None
+    if hdr.has_weights:
+        weights = arr(hdr.weight_dtype, hdr.ne, off)
+        off += hdr.weight_dtype.itemsize * hdr.ne
+    degrees = None
+    if hdr.has_degrees:
+        degrees = arr(V_DTYPE, hdr.nv, off)
+    return hdr, row_ptrs, col_idx, weights, degrees
+
+
+def write_lux(path: str, row_ptrs, col_idx, weights=None, degrees=None):
+    """Write a .lux file from CSC arrays (row_ptrs are END offsets)."""
+    row_ptrs = np.ascontiguousarray(row_ptrs, dtype=E_DTYPE)
+    col_idx = np.ascontiguousarray(col_idx, dtype=V_DTYPE)
+    nv = row_ptrs.shape[0]
+    ne = col_idx.shape[0]
+    if nv and int(row_ptrs[-1]) != ne:
+        raise ValueError(f"row_ptrs[-1]={row_ptrs[-1]} != ne={ne}")
+    with open(path, "wb") as f:
+        f.write(np.array([nv], V_DTYPE).tobytes())
+        f.write(np.array([ne], E_DTYPE).tobytes())
+        f.write(row_ptrs.tobytes())
+        f.write(col_idx.tobytes())
+        if weights is not None:
+            w = np.ascontiguousarray(weights)
+            if w.shape[0] != ne:
+                raise ValueError("weights length mismatch")
+            f.write(w.tobytes())
+        if degrees is not None:
+            d = np.ascontiguousarray(degrees, dtype=V_DTYPE)
+            if d.shape[0] != nv:
+                raise ValueError("degrees length mismatch")
+            f.write(d.tobytes())

@@ -1,0 +1,192 @@
+"""Host graph representation and the padded device layout.
+
+``Graph`` is the host-side CSC graph (what the reference keeps in
+zero-copy memory after its load tasks, reference pull_model.inl:253-320).
+
+``ShardedGraph`` is the TPU-native analogue of the reference's
+per-partition device build (init_kernel CSC construction,
+reference pagerank_gpu.cu:153-180): all index translation is done ONCE on
+the host so that the per-iteration device code is nothing but
+static-shape gathers and sorted segmented reductions:
+
+- Partitions are edge-balanced contiguous vertex ranges (partition.py).
+- Every per-part array is padded to the max across parts (vertex dim to
+  ``vpad``, edge dim to ``epad``) so arrays stack into rectangular
+  ``[num_parts, ...]`` tensors that shard cleanly over a mesh axis.
+- Vertex state lives in *padded part-major order*: global slot of vertex
+  v is ``part(v) * vpad + (v - starts[part(v)])``.  Edge sources are
+  pre-translated into these slots (``src_slot``), so the gather of
+  source state after an all-gather needs no arithmetic on device.
+- Edge destinations are pre-translated to part-local indices
+  (``dst_local``); padding edges point at a trash segment ``vpad`` and
+  their sources at slot 0.
+
+This replaces the reference's NodeStruct/EdgeStruct FB arrays and its
+atomicAdd scatter with a layout where XLA/Pallas see dst-sorted segments
+(SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from lux_tpu import format as luxfmt
+from lux_tpu.partition import edge_balanced_bounds, part_edge_counts
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@dataclasses.dataclass
+class Graph:
+    """Host CSC graph: row_ptrs are END offsets (see format.py)."""
+
+    nv: int
+    ne: int
+    row_ptrs: np.ndarray          # uint64 [nv], end offsets
+    col_idx: np.ndarray           # uint32 [ne], edge sources, dst-sorted
+    weights: np.ndarray | None    # [ne] or None
+    out_degrees: np.ndarray       # uint32 [nv]
+
+    @classmethod
+    def from_file(cls, path: str, weighted: bool | None = None,
+                  weight_dtype=np.int32) -> "Graph":
+        hdr, row_ptrs, col_idx, weights, degrees = luxfmt.read_lux(
+            path, weighted, weight_dtype)
+        if degrees is None:
+            # The reference recomputes out-degrees at load time anyway
+            # (PullScanTask, reference pull_model.inl:322-345).
+            degrees = np.bincount(col_idx, minlength=hdr.nv).astype(np.uint32)
+        return cls(nv=hdr.nv, ne=hdr.ne, row_ptrs=row_ptrs, col_idx=col_idx,
+                   weights=weights, out_degrees=degrees)
+
+    @classmethod
+    def from_edges(cls, src, dst, nv: int, weights=None) -> "Graph":
+        from lux_tpu.convert import edges_to_csc
+        row_ptrs, col_idx, w_sorted, deg = edges_to_csc(src, dst, nv, weights)
+        return cls(nv=nv, ne=int(col_idx.shape[0]), row_ptrs=row_ptrs,
+                   col_idx=col_idx, weights=w_sorted, out_degrees=deg)
+
+    def in_degrees(self) -> np.ndarray:
+        return np.diff(self.row_ptrs.astype(np.int64), prepend=0)
+
+
+@dataclasses.dataclass
+class ShardedGraph:
+    """Padded part-major device layout (all arrays are host numpy;
+    engines move them on device with the right sharding)."""
+
+    nv: int
+    ne: int
+    num_parts: int
+    starts: np.ndarray        # int64 [num_parts+1] partition cut points
+    vpad: int                 # padded vertices per part
+    epad: int                 # padded edges per part
+    nv_part: np.ndarray       # int32 [num_parts] real vertices per part
+    ne_part: np.ndarray       # int64 [num_parts] real edges per part
+    src_slot: np.ndarray      # int32 [num_parts, epad] padded global src slot
+    dst_local: np.ndarray     # int32 [num_parts, epad] local dst, pad -> vpad
+    edge_weight: np.ndarray | None  # float32 [num_parts, epad]
+    row_ptr_local: np.ndarray  # int32 [num_parts, vpad+1] local END offsets
+    vmask: np.ndarray         # bool [num_parts, vpad] valid-vertex mask
+    deg_padded: np.ndarray    # int32 [num_parts, vpad] out-degrees, padded
+
+    weighted: bool = False
+
+    @classmethod
+    def build(cls, g: Graph, num_parts: int, vpad_align: int = 8,
+              epad_align: int = 128) -> "ShardedGraph":
+        starts = edge_balanced_bounds(g.row_ptrs, num_parts)
+        nv_part = (starts[1:] - starts[:-1]).astype(np.int32)
+        ne_part = part_edge_counts(g.row_ptrs, starts).astype(np.int64)
+        vpad = _round_up(max(1, int(nv_part.max())), vpad_align)
+        epad = _round_up(max(1, int(ne_part.max())), epad_align)
+        if epad >= np.iinfo(np.int32).max:
+            raise ValueError(
+                f"per-part edge count {epad} overflows int32; "
+                f"use more partitions")
+        if num_parts * vpad >= np.iinfo(np.int32).max:
+            raise ValueError(
+                f"padded vertex-slot space {num_parts * vpad} overflows "
+                f"int32 src_slot indices")
+
+        rp = g.row_ptrs.astype(np.int64)
+        col = g.col_idx
+        # part id of every vertex, for the src -> padded-slot translation
+        v_part = np.searchsorted(starts, np.arange(g.nv, dtype=np.int64),
+                                 side="right") - 1
+        v_slot = (v_part * vpad +
+                  (np.arange(g.nv, dtype=np.int64) - starts[v_part]))
+        v_slot = v_slot.astype(np.int64)
+
+        src_slot = np.zeros((num_parts, epad), dtype=np.int32)
+        dst_local = np.full((num_parts, epad), vpad, dtype=np.int32)
+        edge_weight = None
+        if g.weights is not None:
+            edge_weight = np.zeros((num_parts, epad), dtype=np.float32)
+        row_ptr_local = np.zeros((num_parts, vpad + 1), dtype=np.int32)
+        vmask = np.zeros((num_parts, vpad), dtype=bool)
+        deg_padded = np.zeros((num_parts, vpad), dtype=np.int32)
+
+        ebegin = 0
+        for p in range(num_parts):
+            v0, v1 = int(starts[p]), int(starts[p + 1])
+            nep = int(ne_part[p])
+            eend = ebegin + nep
+            srcs = col[ebegin:eend].astype(np.int64)
+            src_slot[p, :nep] = v_slot[srcs]
+            # local dst of each edge: expand per-vertex in-degree runs
+            local_ends = (rp[v0:v1] - ebegin).astype(np.int64)
+            in_deg = np.diff(np.concatenate(([0], local_ends)))
+            dst_local[p, :nep] = np.repeat(
+                np.arange(v1 - v0, dtype=np.int32), in_deg)
+            if edge_weight is not None:
+                edge_weight[p, :nep] = np.asarray(
+                    g.weights[ebegin:eend], dtype=np.float32)
+            row_ptr_local[p, 1:v1 - v0 + 1] = local_ends
+            row_ptr_local[p, v1 - v0 + 1:] = nep
+            vmask[p, :v1 - v0] = True
+            deg_padded[p, :v1 - v0] = g.out_degrees[v0:v1]
+            ebegin = eend
+
+        return cls(nv=g.nv, ne=g.ne, num_parts=num_parts, starts=starts,
+                   vpad=vpad, epad=epad, nv_part=nv_part, ne_part=ne_part,
+                   src_slot=src_slot, dst_local=dst_local,
+                   edge_weight=edge_weight, row_ptr_local=row_ptr_local,
+                   vmask=vmask, deg_padded=deg_padded,
+                   weighted=g.weights is not None)
+
+    # ---- state layout conversion -------------------------------------
+
+    def to_padded(self, x: np.ndarray) -> np.ndarray:
+        """[nv, ...] user order -> [num_parts, vpad, ...] padded layout."""
+        x = np.asarray(x)
+        out = np.zeros((self.num_parts, self.vpad) + x.shape[1:], x.dtype)
+        for p in range(self.num_parts):
+            v0, v1 = int(self.starts[p]), int(self.starts[p + 1])
+            out[p, :v1 - v0] = x[v0:v1]
+        return out
+
+    def from_padded(self, x: np.ndarray) -> np.ndarray:
+        """[num_parts, vpad, ...] padded layout -> [nv, ...] user order."""
+        x = np.asarray(x)
+        out = np.empty((self.nv,) + x.shape[2:], x.dtype)
+        for p in range(self.num_parts):
+            v0, v1 = int(self.starts[p]), int(self.starts[p + 1])
+            out[v0:v1] = x[p, :v1 - v0]
+        return out
+
+    def memory_report(self) -> dict:
+        """HBM bytes needed per part — the analogue of the reference's
+        startup memory advisor (reference pagerank.cc:60-85)."""
+        edge_bytes = self.epad * (4 + 4 + (4 if self.weighted else 0))
+        vert_bytes = self.vpad * (4 + 4 + 1) + (self.vpad + 1) * 4
+        return {
+            "num_parts": self.num_parts,
+            "edge_bytes_per_part": edge_bytes,
+            "vertex_bytes_per_part": vert_bytes,
+            "total_bytes": self.num_parts * (edge_bytes + vert_bytes),
+        }

@@ -1,0 +1,76 @@
+"""Edge-balanced contiguous vertex partitioning.
+
+The reference assigns each GPU a contiguous vertex range holding an
+approximately equal number of in-edges (reference pull_model.inl:108-131,
+push_model.inl:378-423: cut when a running edge count exceeds
+``edge_cap = ceil(ne / num_parts)``).  We compute the same family of
+partitions with a direct quantile search over the CSC end-offset array:
+cut point p is the smallest vertex whose cumulative edge count reaches
+``p * ne / num_parts``.  This is O(parts · log nv), balances at least as
+well as the reference's greedy sweep, and is a pure function — the
+partition is host-side metadata only; on device it becomes sharding
+layout (SURVEY.md §2.2 item 1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def edge_balanced_bounds(row_ptrs, num_parts: int) -> np.ndarray:
+    """Return cut points ``starts`` with shape [num_parts + 1].
+
+    Part p owns the half-open vertex range [starts[p], starts[p+1]) and
+    in-edges col_idx[b : e] with b = row_ptrs[starts[p]-1] if
+    starts[p] > 0 else 0 and e = row_ptrs[starts[p+1]-1].
+    starts[0] == 0 and starts[-1] == nv.  Every part is non-empty in
+    vertices as long as num_parts <= nv.
+    """
+    row_ptrs = np.asarray(row_ptrs)
+    nv = row_ptrs.shape[0]
+    if num_parts < 1:
+        raise ValueError("num_parts must be >= 1")
+    if num_parts > nv:
+        raise ValueError(f"num_parts={num_parts} exceeds nv={nv}")
+    ne = int(row_ptrs[-1]) if nv else 0
+    targets = (np.arange(1, num_parts) * ne) // num_parts
+    # Smallest v with row_ptrs[v] >= target == edge count through v
+    # reaches the quantile; +1 converts to a cut point (exclusive end).
+    cuts = np.searchsorted(row_ptrs, targets, side="left") + 1
+    starts = np.empty(num_parts + 1, dtype=np.int64)
+    starts[0] = 0
+    starts[1:num_parts] = cuts
+    starts[num_parts] = nv
+    # Degenerate distributions (one vertex owning most edges) can make
+    # quantile cuts collide or run past nv; enforce strict monotonicity
+    # so every part keeps at least one vertex, as the reference's greedy
+    # sweep does.  Feasible because num_parts <= nv.
+    for p in range(1, num_parts):
+        if starts[p] <= starts[p - 1]:
+            starts[p] = starts[p - 1] + 1
+    for p in range(num_parts - 1, 0, -1):
+        if starts[p] >= starts[p + 1]:
+            starts[p] = starts[p + 1] - 1
+    assert starts[0] == 0 and starts[num_parts] == nv
+    return starts
+
+
+def part_edge_counts(row_ptrs, starts) -> np.ndarray:
+    """Edges owned by each part (in-edges of its vertex range)."""
+    row_ptrs = np.asarray(row_ptrs)
+    ends = row_ptrs[np.asarray(starts[1:]) - 1].astype(np.int64)
+    begins = np.empty_like(ends)
+    begins[0] = 0
+    begins[1:] = ends[:-1]
+    return ends - begins
+
+
+def frontier_capacity(part_nv: int, sparse_threshold: int = 16,
+                      slack: int = 100) -> int:
+    """Sparse-frontier queue slot budget for a partition.
+
+    Mirrors the reference's sizing rule: a part's sparse queue holds
+    ``part_nv / SPARSE_THRESHOLD + 100`` vertex ids
+    (reference push_model.inl:393-397, sssp/app.h:19).
+    """
+    return part_nv // sparse_threshold + slack

@@ -1,0 +1,17 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+This is the TPU-native analogue of the reference's "multi-node without a
+cluster" gap (SURVEY.md §4): all sharding/collective paths are exercised
+on host devices via --xla_force_host_platform_device_count.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax
+
+# Keep default 32-bit types: that is what runs on TPU.
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,87 @@
+"""Golden tests for the .lux binary format (reference README.md:55-79)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from lux_tpu import format as luxfmt
+from lux_tpu.convert import edges_to_csc, uniform_random_edges
+from lux_tpu.graph import Graph
+
+
+def tiny_graph():
+    # 4 vertices; edges (src -> dst): 1->0, 2->0, 0->1, 3->2, 0->2, 2->3
+    src = np.array([1, 2, 0, 3, 0, 2], dtype=np.uint32)
+    dst = np.array([0, 0, 1, 2, 2, 3], dtype=np.uint32)
+    return src, dst, 4
+
+
+def test_csc_build_matches_hand_computed():
+    src, dst, nv = tiny_graph()
+    row_ptrs, col_idx, w, deg = edges_to_csc(src, dst, nv)
+    # in-edges per dst: v0 <- {1,2}, v1 <- {0}, v2 <- {3,0}, v3 <- {2}
+    assert row_ptrs.tolist() == [2, 3, 5, 6]          # END offsets
+    assert col_idx.tolist() == [1, 2, 0, 3, 0, 2]     # dst-sorted sources
+    assert deg.tolist() == [2, 1, 2, 1]               # out-degrees
+
+
+def test_file_byte_layout(tmp_path):
+    """The exact byte layout: nv u32, ne u64, row_ptrs u64[nv],
+    col_idx u32[ne], trailing degrees u32[nv]."""
+    src, dst, nv = tiny_graph()
+    row_ptrs, col_idx, _, deg = edges_to_csc(src, dst, nv)
+    p = tmp_path / "tiny.lux"
+    luxfmt.write_lux(str(p), row_ptrs, col_idx, degrees=deg)
+    blob = p.read_bytes()
+    assert len(blob) == 12 + 8 * 4 + 4 * 6 + 4 * 4
+    assert struct.unpack_from("<I", blob, 0)[0] == 4
+    assert struct.unpack_from("<Q", blob, 4)[0] == 6
+    assert struct.unpack_from("<4Q", blob, 12) == (2, 3, 5, 6)
+    assert struct.unpack_from("<6I", blob, 44) == (1, 2, 0, 3, 0, 2)
+    assert struct.unpack_from("<4I", blob, 68) == (2, 1, 2, 1)
+
+
+def test_roundtrip_unweighted(tmp_path):
+    src, dst = uniform_random_edges(100, 1000, seed=3)
+    g = Graph.from_edges(src, dst, 100)
+    p = tmp_path / "g.lux"
+    luxfmt.write_lux(str(p), g.row_ptrs, g.col_idx, degrees=g.out_degrees)
+    g2 = Graph.from_file(str(p))
+    np.testing.assert_array_equal(g.row_ptrs, g2.row_ptrs)
+    np.testing.assert_array_equal(g.col_idx, g2.col_idx)
+    np.testing.assert_array_equal(g.out_degrees, g2.out_degrees)
+    assert g2.weights is None
+
+
+def test_roundtrip_weighted(tmp_path):
+    src, dst, w = uniform_random_edges(50, 400, seed=4, weighted=True)
+    g = Graph.from_edges(src, dst, 50, weights=w)
+    p = tmp_path / "gw.lux"
+    luxfmt.write_lux(str(p), g.row_ptrs, g.col_idx, weights=g.weights,
+                     degrees=g.out_degrees)
+    g2 = Graph.from_file(str(p), weighted=True)
+    np.testing.assert_array_equal(np.asarray(g.weights),
+                                  np.asarray(g2.weights))
+    np.testing.assert_array_equal(g.col_idx, g2.col_idx)
+
+
+def test_peek_and_size_validation(tmp_path):
+    src, dst, nv = tiny_graph()
+    row_ptrs, col_idx, _, deg = edges_to_csc(src, dst, nv)
+    p = tmp_path / "t.lux"
+    luxfmt.write_lux(str(p), row_ptrs, col_idx)
+    hdr = luxfmt.peek_lux(str(p))
+    assert (hdr.nv, hdr.ne, hdr.has_weights, hdr.has_degrees) == \
+        (4, 6, False, False)
+    # corrupt: truncate
+    blob = p.read_bytes()[:-3]
+    p.write_bytes(blob)
+    with pytest.raises(ValueError):
+        luxfmt.peek_lux(str(p))
+
+
+def test_write_rejects_inconsistent():
+    with pytest.raises(ValueError):
+        luxfmt.write_lux("/tmp/never.lux", np.array([1, 2], np.uint64),
+                         np.array([0, 0, 0], np.uint32))

@@ -1,0 +1,72 @@
+"""Property tests for the edge-balanced partitioner (SURVEY.md §7 step 2)."""
+
+import numpy as np
+import pytest
+
+from lux_tpu.convert import edges_to_csc, rmat_edges, uniform_random_edges
+from lux_tpu.partition import (edge_balanced_bounds, frontier_capacity,
+                               part_edge_counts)
+
+
+def _row_ptrs(nv, ne, seed=0):
+    src, dst = uniform_random_edges(nv, ne, seed=seed)
+    rp, _, _, _ = edges_to_csc(src, dst, nv)
+    return rp
+
+
+@pytest.mark.parametrize("num_parts", [1, 2, 3, 8, 17])
+def test_partition_invariants(num_parts):
+    rp = _row_ptrs(500, 4000)
+    starts = edge_balanced_bounds(rp, num_parts)
+    assert starts[0] == 0 and starts[-1] == 500
+    assert np.all(np.diff(starts) >= 1)
+    counts = part_edge_counts(rp, starts)
+    assert counts.sum() == 4000
+
+
+def test_edge_balance_quality():
+    """On a skew-free graph, no part should exceed ~2x the ideal load."""
+    rp = _row_ptrs(10_000, 200_000)
+    starts = edge_balanced_bounds(rp, 16)
+    counts = part_edge_counts(rp, starts)
+    ideal = 200_000 / 16
+    assert counts.max() <= 2 * ideal
+
+
+def test_skewed_degrees_rmat():
+    """Power-law graph: partitioner must stay balanced despite hubs."""
+    src, dst, nv = rmat_edges(scale=12, edge_factor=8, seed=1)
+    rp, _, _, _ = edges_to_csc(src, dst, nv)
+    starts = edge_balanced_bounds(rp, 8)
+    counts = part_edge_counts(rp, starts)
+    # a single hub vertex can exceed the ideal, but each part should not
+    # exceed ideal + max single-vertex in-degree
+    in_deg = np.diff(np.concatenate(([0], rp))).max()
+    assert counts.max() <= rp[-1] / 8 + in_deg
+
+
+def test_degenerate_single_hub():
+    """All edges into one vertex: every part still gets >= 1 vertex."""
+    nv, ne = 64, 1000
+    dst = np.zeros(ne, dtype=np.uint32)
+    src = np.arange(ne, dtype=np.uint32) % nv
+    rp, _, _, _ = edges_to_csc(src, dst, nv)
+    starts = edge_balanced_bounds(rp, 8)
+    assert np.all(np.diff(starts) >= 1)
+    assert starts[-1] == nv
+
+
+def test_num_parts_bounds():
+    rp = _row_ptrs(10, 50)
+    with pytest.raises(ValueError):
+        edge_balanced_bounds(rp, 0)
+    with pytest.raises(ValueError):
+        edge_balanced_bounds(rp, 11)
+    starts = edge_balanced_bounds(rp, 10)  # one vertex per part
+    assert np.all(np.diff(starts) == 1)
+
+
+def test_frontier_capacity_rule():
+    # reference push_model.inl:393-397 with SPARSE_THRESHOLD=16
+    assert frontier_capacity(1600) == 200
+    assert frontier_capacity(0) == 100
