@@ -1,0 +1,1 @@
+from lux_tpu.apps import pagerank, colfilter

@@ -1,0 +1,77 @@
+"""PageRank (pull model, fixed iteration count).
+
+Semantics match the reference exactly (reference pagerank_gpu.cu:49-102,
+pagerank/app.h:24, pull_init at pagerank_gpu.cu:255-259):
+
+- ALPHA = 0.15 used as ``pr = (1-ALPHA)/nv + ALPHA * sum`` — i.e. the
+  damping factor is 0.15, not the usual 0.85 (SURVEY.md §7 quirks;
+  preserved for parity).
+- State is *degree-normalized* rank: after each update the rank is
+  divided by out-degree so the next gather needs no degree lookup
+  (pagerank_gpu.cu:97-100); init seeds ``(1/nv)/deg`` (deg==0 -> 1/nv).
+- Final output is therefore also degree-scaled; ``true_ranks``
+  un-scales it for conventional PageRank values.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine.program import PullProgram
+from lux_tpu.engine.pull import PullEngine
+from lux_tpu.graph import Graph, ShardedGraph
+
+ALPHA = 0.15  # reference pagerank/app.h:24
+
+
+def make_program(dtype=jnp.float32) -> PullProgram:
+    def edge_value(src_val, dst_val, weight):
+        return src_val
+
+    def apply(old, red, ctx):
+        pr = (1.0 - ALPHA) / ctx.nv + ALPHA * red
+        deg = ctx.deg.astype(pr.dtype)
+        return jnp.where(ctx.deg > 0, pr / jnp.maximum(deg, 1), pr)
+
+    def init(sg: ShardedGraph):
+        rank = 1.0 / sg.nv
+        deg = sg.deg_padded
+        state = np.where(deg > 0, rank / np.maximum(deg, 1), rank)
+        return state.astype(np.dtype(dtype))
+
+    return PullProgram(reduce="sum", edge_value=edge_value, apply=apply,
+                       init=init, needs_dst=False)
+
+
+def build_engine(g: Graph, num_parts: int = 1, mesh=None,
+                 dtype=jnp.float32) -> PullEngine:
+    sg = ShardedGraph.build(g, num_parts)
+    return PullEngine(sg, make_program(dtype), mesh=mesh)
+
+
+def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):
+    """Run PageRank; returns degree-normalized ranks [nv] (host)."""
+    eng = build_engine(g, num_parts, mesh)
+    state = eng.init_state()
+    state = eng.run(state, num_iters)
+    return eng.unpad(state)
+
+
+def true_ranks(norm_ranks: np.ndarray, out_degrees: np.ndarray):
+    """Undo the degree scaling: conventional PageRank values."""
+    deg = np.asarray(out_degrees)
+    return np.where(deg > 0, norm_ranks * np.maximum(deg, 1), norm_ranks)
+
+
+def reference_pagerank(g: Graph, num_iters: int) -> np.ndarray:
+    """NumPy oracle with identical semantics (degree-normalized)."""
+    src, dst = g.edge_arrays()
+    deg = g.out_degrees.astype(np.float64)
+    state = np.where(deg > 0, (1.0 / g.nv) / np.maximum(deg, 1), 1.0 / g.nv)
+    for _ in range(num_iters):
+        acc = np.zeros(g.nv, dtype=np.float64)
+        np.add.at(acc, dst, state[src])
+        pr = (1.0 - ALPHA) / g.nv + ALPHA * acc
+        state = np.where(deg > 0, pr / np.maximum(deg, 1), pr)
+    return state

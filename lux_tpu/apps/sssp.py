@@ -1,0 +1,95 @@
+"""Single-source shortest paths (push model, convergence-driven).
+
+Two modes:
+
+- ``hops`` (default): unweighted hop-count distances, candidate =
+  dist[src] + 1.  This matches the reference exactly — its "SSSP" never
+  loads edge weights and computes BFS levels (reference
+  sssp_gpu.cu:122,208,225; weights unread in PushLoadTask,
+  push_model.inl:60-75; SURVEY.md §7 quirks).
+- ``weighted``: true shortest paths with float edge weights, candidate
+  = dist[src] + w — the superset BASELINE.md's config list asks for.
+
+Distances of unreachable vertices stay at INF (the reference seeds
+dist = nv as its infinity, sssp_gpu.cu:733-744; we use a large sentinel
+and expose ``unreachable`` masks instead of leaking graph-size-dependent
+magic values).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine.push import PushEngine, PushProgram
+from lux_tpu.graph import Graph, ShardedGraph
+
+HOP_INF = np.int32(np.iinfo(np.int32).max // 2)   # +1 cannot overflow
+DIST_INF = np.float32(np.inf)
+
+
+def make_program(start_vertex: int, weighted: bool = False) -> PushProgram:
+    if weighted:
+        def relax(src_label, w):
+            return src_label + w
+        identity = np.float32(np.inf)
+        dtype = np.float32
+        inf = DIST_INF
+    else:
+        def relax(src_label, w):
+            return src_label + np.int32(1)
+        identity = HOP_INF
+        dtype = np.int32
+        inf = HOP_INF
+
+    def init(sg: ShardedGraph):
+        dist = np.full(sg.nv, inf, dtype=dtype)
+        dist[start_vertex] = 0
+        active = np.zeros(sg.nv, dtype=bool)
+        active[start_vertex] = True
+        return sg.to_padded(dist), sg.to_padded(active)
+
+    return PushProgram(reduce="min", relax=relax, identity=identity,
+                       init=init)
+
+
+def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
+                 mesh=None, weighted: bool = False) -> PushEngine:
+    if weighted and g.weights is None:
+        raise ValueError("weighted SSSP needs a weighted graph")
+    sg = ShardedGraph.build(g, num_parts)
+    return PushEngine(sg, make_program(start_vertex, weighted), mesh=mesh)
+
+
+def run(g: Graph, start_vertex: int = 0, num_parts: int = 1, mesh=None,
+        weighted: bool = False, max_iters=None, verbose: bool = False):
+    """Returns (dist [nv], iterations)."""
+    eng = build_engine(g, start_vertex, num_parts, mesh, weighted)
+    return eng.run(max_iters=max_iters, verbose=verbose)
+
+
+def unreachable(dist: np.ndarray) -> np.ndarray:
+    if dist.dtype == np.int32:
+        return dist >= HOP_INF
+    return ~np.isfinite(dist)
+
+
+def reference_sssp(g: Graph, start_vertex: int = 0,
+                   weighted: bool = False) -> np.ndarray:
+    """NumPy Bellman-Ford oracle (exact fixed point)."""
+    src, dst = g.edge_arrays()
+    if weighted:
+        w = np.asarray(g.weights, dtype=np.float64)
+        dist = np.full(g.nv, np.inf)
+    else:
+        w = np.ones(g.ne, dtype=np.int64)
+        dist = np.full(g.nv, int(HOP_INF), dtype=np.int64)
+    dist[start_vertex] = 0
+    while True:
+        cand = dist[src] + w
+        new = dist.copy()
+        np.minimum.at(new, dst, cand)
+        if np.array_equal(new, dist):
+            break
+        dist = new
+    return dist

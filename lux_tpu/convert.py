@@ -49,6 +49,11 @@ def convert_edge_list(text_path: str, lux_path: str, nv: int,
     data = np.loadtxt(text_path, dtype=np.float64, ndmin=2)
     if data.size == 0:
         data = data.reshape(0, ncols)
+    if data.shape[1] != ncols:
+        raise ValueError(
+            f"{text_path}: expected {ncols} columns "
+            f"({'src dst weight' if weighted else 'src dst'}), "
+            f"got {data.shape[1]}")
     src = data[:, 0].astype(np.uint32)
     dst = data[:, 1].astype(np.uint32)
     w = data[:, 2].astype(weight_dtype) if weighted else None

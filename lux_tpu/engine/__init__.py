@@ -1,0 +1,2 @@
+from lux_tpu.engine.program import PartCtx, PullProgram
+from lux_tpu.engine.pull import PullEngine

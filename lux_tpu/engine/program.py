@@ -1,0 +1,56 @@
+"""Vertex-program abstraction.
+
+The reference specializes its two compute templates per app at compile
+time through app.h typedefs + extern task hooks (reference
+core/graph.h:146-225).  Here a vertex program is a small bundle of pure
+functions over arrays; engines trace them under jit, so specialization
+happens at XLA-compile time — the same "zero-cost per-app dispatch"
+property, without separate binaries.
+
+All functions see *padded part-local* arrays (see graph.ShardedGraph):
+state ``[vpad, ...]``, per-edge values ``[epad, ...]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class PartCtx:
+    """Per-partition context handed to program callbacks.
+
+    deg    int32 [vpad]   out-degrees (the reference's VERTEX_DEGREE)
+    vmask  bool  [vpad]   True for real (non-padding) vertex slots
+    nv     int            global vertex count (static)
+    ne     int            global edge count (static)
+    """
+    deg: Any
+    vmask: Any
+    nv: int
+    ne: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PullProgram:
+    """Dense gather-apply program (the reference's pull model,
+    core/pull_model.inl).
+
+    reduce      'sum' | 'min' | 'max' — how edge messages combine per
+                destination (replaces atomicAdd/Min/Max).
+    edge_value  (src_val [epad,...], dst_val [epad,...], weight
+                [epad]|None) -> msg [epad,...]; traced per edge batch.
+    apply       (old [vpad,...], reduced [vpad,...], ctx: PartCtx) ->
+                new [vpad,...]; the per-vertex epilogue (the reference's
+                post-scan code, e.g. pagerank_gpu.cu:97-100).
+    init        (sharded_graph) -> initial padded state
+                [num_parts, vpad, ...] (numpy).
+    needs_dst   whether edge_value reads dst_val (skips a gather when
+                False).
+    """
+    reduce: str
+    edge_value: Callable
+    apply: Callable
+    init: Callable
+    needs_dst: bool = False

@@ -1,0 +1,177 @@
+"""The pull engine: dense gather-apply iterations.
+
+One iteration (the analogue of one PullAppTask index launch,
+reference pull_model.inl:423-470 + pagerank_gpu.cu:104-151):
+
+1. make the full vertex state visible to every part — single device:
+   a reshape; mesh: ``lax.all_gather`` over the ``parts`` axis (the
+   reference's whole-region READ_ONLY requirement that Legion/GASNet
+   materialize remotely, pull_model.inl:454-461);
+2. gather each edge's source state by precomputed padded slot;
+3. per-edge message (program.edge_value);
+4. sorted segmented reduction to each part's local destinations
+   (replacing the CUB BlockScan + atomicAdd CTA pattern, SURVEY.md §3.3);
+5. per-vertex apply epilogue.
+
+Fixed-iteration runs are fused into a single XLA program with
+``lax.fori_loop`` — the TPU-native version of the reference's
+fire-and-forget launch pipeline (pagerank.cc:109-114), with zero host
+round-trips instead of deferred-execution tricks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from lux_tpu.engine.program import PartCtx, PullProgram
+from lux_tpu.graph import ShardedGraph
+from lux_tpu.ops.segment import segment_reduce
+from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
+
+_GRAPH_KEYS = ("src_slot", "dst_local", "weight", "deg", "vmask")
+
+
+class PullEngine:
+    """Compiled pull-model iterations for one ShardedGraph + program.
+
+    With ``mesh=None`` everything runs on one device (parts stacked on
+    the leading axis, vmapped).  With a mesh, all part-major arrays are
+    sharded over the ``parts`` axis and the same per-part computation
+    runs under shard_map with an all-gather for remote state.
+    """
+
+    def __init__(self, sg: ShardedGraph, program: PullProgram, mesh=None):
+        if mesh is not None and sg.num_parts % mesh.devices.size != 0:
+            raise ValueError(
+                f"num_parts={sg.num_parts} not divisible by mesh size "
+                f"{mesh.devices.size}")
+        self.sg = sg
+        self.program = program
+        self.mesh = mesh
+
+        arrays = dict(
+            src_slot=jnp.asarray(sg.src_slot),
+            dst_local=jnp.asarray(sg.dst_local),
+            weight=(jnp.asarray(sg.edge_weight) if sg.weighted else None),
+            deg=jnp.asarray(sg.deg_padded),
+            vmask=jnp.asarray(sg.vmask),
+        )
+        if mesh is not None:
+            arrays = shard_over_parts(mesh, arrays)
+        self.arrays = arrays
+        self._step_fn = self._build_step()
+
+    # -- state placement ----------------------------------------------
+
+    def init_state(self):
+        state = jnp.asarray(self.program.init(self.sg))
+        if self.mesh is not None:
+            state = jax.device_put(state, parts_spec(self.mesh))
+        return state
+
+    # -- one part's work ----------------------------------------------
+
+    def _part_step(self, flat_state, old_p, g):
+        """g: dict of this part's graph arrays."""
+        prog, sg = self.program, self.sg
+        src_vals = jnp.take(flat_state, g["src_slot"], axis=0)
+        dst_vals = (jnp.take(old_p, jnp.minimum(g["dst_local"],
+                                                sg.vpad - 1), axis=0)
+                    if prog.needs_dst else None)
+        msgs = prog.edge_value(src_vals, dst_vals, g["weight"])
+        red = segment_reduce(msgs, g["dst_local"], sg.vpad + 1,
+                             prog.reduce)[:sg.vpad]
+        ctx = PartCtx(deg=g["deg"], vmask=g["vmask"], nv=sg.nv, ne=sg.ne)
+        new = prog.apply(old_p, red, ctx)
+        keep = g["vmask"].reshape(g["vmask"].shape +
+                                  (1,) * (new.ndim - 1))
+        return jnp.where(keep, new, old_p)
+
+    def _parts_step(self, local_state, full_state, g_local):
+        """vmap _part_step over this device's parts."""
+        sg = self.sg
+        flat = full_state.reshape((sg.num_parts * sg.vpad,) +
+                                  full_state.shape[2:])
+        has_w = g_local["weight"] is not None
+
+        def one(src_slot, dst_local, weight, old, deg, vmask):
+            g = dict(src_slot=src_slot, dst_local=dst_local,
+                     weight=weight, deg=deg, vmask=vmask)
+            return self._part_step(flat, old, g)
+
+        if has_w:
+            return jax.vmap(one)(
+                g_local["src_slot"], g_local["dst_local"],
+                g_local["weight"], local_state, g_local["deg"],
+                g_local["vmask"])
+        return jax.vmap(lambda s, d, o, dg, vm: one(s, d, None, o, dg, vm))(
+            g_local["src_slot"], g_local["dst_local"], local_state,
+            g_local["deg"], g_local["vmask"])
+
+    # -- full step over all parts -------------------------------------
+
+    def _build_step(self):
+        a = self.arrays
+        has_w = a["weight"] is not None
+        keys = [k for k in _GRAPH_KEYS if not (k == "weight" and not has_w)]
+        graph_args = tuple(a[k] for k in keys)
+
+        if self.mesh is None:
+            def step(state, *gargs):
+                g = dict(zip(keys, gargs), **({} if has_w
+                                              else {"weight": None}))
+                return self._parts_step(state, state, g)
+
+            jitted = jax.jit(step, donate_argnums=0)
+            return lambda state: jitted(state, *graph_args)
+
+        P = PartitionSpec
+        in_specs = (P(PARTS_AXIS),) * (1 + len(keys))
+        out_specs = P(PARTS_AXIS)
+
+        @functools.partial(jax.shard_map, mesh=self.mesh,
+                           in_specs=in_specs, out_specs=out_specs)
+        def sharded_step(state, *gargs):
+            g = dict(zip(keys, gargs), **({} if has_w
+                                          else {"weight": None}))
+            # The per-iteration vertex-state exchange over ICI.
+            full = jax.lax.all_gather(state, PARTS_AXIS, tiled=True)
+            return self._parts_step(state, full, g)
+
+        jitted = jax.jit(sharded_step, donate_argnums=0)
+        return lambda state: jitted(state, *graph_args)
+
+    # -- public API ---------------------------------------------------
+
+    def step(self, state):
+        """One iteration (compiled)."""
+        return self._step_fn(state)
+
+    @functools.cached_property
+    def _run_fused(self):
+        step = self._step_fn
+
+        @functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def run(state, num_iters):
+            return jax.lax.fori_loop(0, num_iters, lambda _, s: step(s),
+                                     state)
+
+        return run
+
+    def run(self, state, num_iters: int, fused: bool = True):
+        """num_iters iterations; fused=True compiles the whole loop into
+        one XLA program (no host round-trips)."""
+        if fused:
+            return self._run_fused(state, num_iters)
+        for _ in range(num_iters):
+            state = self.step(state)
+        return state
+
+    def unpad(self, state) -> np.ndarray:
+        """Padded device state -> [nv, ...] user order (host)."""
+        return self.sg.from_padded(np.asarray(jax.device_get(state)))

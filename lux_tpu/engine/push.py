@@ -1,0 +1,224 @@
+"""The push engine: frontier-driven label propagation to convergence.
+
+The reference's push model (reference core/push_model.inl,
+sssp_gpu.cu:335-522) keeps per-partition frontier queues with
+dense-bitmap/sparse-queue representations, exchanges them through
+zero-copy memory each iteration, pipelines SLIDING_WINDOW=4 launches,
+and halts when every part's future reports an empty frontier
+(sssp.cc:115-129).
+
+The TPU-native design dissolves all of that machinery:
+
+- The frontier is a dense boolean mask in the padded part-major vertex
+  layout — a shape-stable array that all-gathers trivially over ICI
+  (SURVEY.md §7 "sparse frontiers" hard part).  Inactive sources are
+  masked to the reduction identity, so converged regions cost no HBM
+  traffic beyond the mask read.
+- The ENTIRE convergence run is one XLA program: ``lax.while_loop``
+  whose predicate is a ``psum`` of active counts.  There is no
+  device->host sync per iteration at all, so the reference's
+  sliding-window latency-hiding trick is unnecessary by construction.
+- A stepwise mode (one compiled step per call, returning the active
+  count) exists for verbose per-iteration observability — the analogue
+  of the reference's -verbose per-part timing (sssp_gpu.cu:516-518).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from lux_tpu.engine.program import PartCtx
+from lux_tpu.graph import ShardedGraph
+from lux_tpu.ops.segment import segment_reduce
+from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
+
+_GRAPH_KEYS = ("src_slot", "dst_local", "weight", "deg", "vmask")
+
+
+@dataclasses.dataclass(frozen=True)
+class PushProgram:
+    """Monotone label-propagation program.
+
+    reduce    'min' (SSSP/BFS) or 'max' (components) — the atomicMin/
+              atomicMax of the reference's process_edge (sssp_gpu.cu:
+              48-82, components_gpu.cu:57-59).
+    relax     (src_label [epad], weight [epad]|None) -> candidate label
+              offered to the edge's destination.
+    identity  scalar no-op candidate (+inf for min, -inf/0 for max).
+    init      (sharded_graph) -> (label0 [num_parts, vpad],
+              active0 bool [num_parts, vpad]) numpy.
+    """
+    reduce: str
+    relax: Callable
+    identity: Any
+    init: Callable
+
+    def better(self, cand, old):
+        return cand < old if self.reduce == "min" else cand > old
+
+
+class PushEngine:
+    """Compiled frontier iterations for one ShardedGraph + PushProgram."""
+
+    def __init__(self, sg: ShardedGraph, program: PushProgram, mesh=None):
+        if mesh is not None and sg.num_parts % mesh.devices.size != 0:
+            raise ValueError(
+                f"num_parts={sg.num_parts} not divisible by mesh size "
+                f"{mesh.devices.size}")
+        self.sg = sg
+        self.program = program
+        self.mesh = mesh
+        arrays = dict(
+            src_slot=jnp.asarray(sg.src_slot),
+            dst_local=jnp.asarray(sg.dst_local),
+            weight=(jnp.asarray(sg.edge_weight) if sg.weighted else None),
+            deg=jnp.asarray(sg.deg_padded),
+            vmask=jnp.asarray(sg.vmask),
+        )
+        if mesh is not None:
+            arrays = shard_over_parts(mesh, arrays)
+        self.arrays = arrays
+        self._step_fn = self._build(converge=False)
+        self._converge_fn = self._build(converge=True)
+
+    # ------------------------------------------------------------------
+
+    def init_state(self):
+        label0, active0 = self.program.init(self.sg)
+        label = jnp.asarray(label0)
+        active = jnp.asarray(active0)
+        if self.mesh is not None:
+            label = jax.device_put(label, parts_spec(self.mesh))
+            active = jax.device_put(active, parts_spec(self.mesh))
+        return label, active
+
+    # -- one iteration over this device's parts ------------------------
+
+    def _iter_parts(self, label, active, full_label, full_active, g):
+        sg, prog = self.sg, self.program
+        flat_l = full_label.reshape(-1)
+        flat_a = full_active.reshape(-1)
+
+        def one(src_slot, dst_local, weight, old, vmask):
+            src_l = jnp.take(flat_l, src_slot, axis=0)
+            src_a = jnp.take(flat_a, src_slot, axis=0)
+            cand = prog.relax(src_l, weight)
+            ident = jnp.asarray(prog.identity, cand.dtype)
+            cand = jnp.where(src_a, cand, ident)
+            red = segment_reduce(cand, dst_local, sg.vpad + 1,
+                                 prog.reduce)[:sg.vpad]
+            improved = prog.better(red, old) & vmask
+            new = jnp.where(improved, red, old)
+            return new, improved
+
+        if g["weight"] is not None:
+            return jax.vmap(one)(g["src_slot"], g["dst_local"],
+                                 g["weight"], label, g["vmask"])
+        return jax.vmap(lambda s, d, o, vm: one(s, d, None, o, vm))(
+            g["src_slot"], g["dst_local"], label, g["vmask"])
+
+    # -- compiled whole-run / single-step ------------------------------
+
+    def _build(self, converge: bool):
+        a = self.arrays
+        has_w = a["weight"] is not None
+        keys = [k for k in _GRAPH_KEYS if not (k == "weight" and not has_w)]
+        graph_args = tuple(a[k] for k in keys)
+        on_mesh = self.mesh is not None
+
+        def global_sum(x):
+            s = jnp.sum(x)
+            if on_mesh:
+                s = jax.lax.psum(s, PARTS_AXIS)
+            return s
+
+        def body(label, active, g):
+            if on_mesh:
+                full_l = jax.lax.all_gather(label, PARTS_AXIS, tiled=True)
+                full_a = jax.lax.all_gather(active, PARTS_AXIS, tiled=True)
+            else:
+                full_l, full_a = label, active
+            new_label, new_active = self._iter_parts(
+                label, active, full_l, full_a, g)
+            return new_label, new_active
+
+        def inner(label, active, max_iters, *gargs):
+            g = dict(zip(keys, gargs), **({} if has_w
+                                          else {"weight": None}))
+            if not converge:
+                new_label, new_active = body(label, active, g)
+                return new_label, new_active, global_sum(new_active)
+
+            def cond(c):
+                it, lbl, act, cnt = c
+                return (cnt > 0) & (it < max_iters)
+
+            def wbody(c):
+                it, lbl, act, _ = c
+                nl, na = body(lbl, act, g)
+                return it + 1, nl, na, global_sum(na)
+
+            it0 = jnp.int32(0)
+            cnt0 = global_sum(active)
+            it, lbl, act, _ = jax.lax.while_loop(
+                cond, wbody, (it0, label, active, cnt0))
+            return lbl, act, it
+
+        if on_mesh:
+            P = PartitionSpec
+            n_in = 2 + len(keys)
+            inner = jax.shard_map(
+                inner, mesh=self.mesh,
+                in_specs=(P(PARTS_AXIS), P(PARTS_AXIS), P()) +
+                         (P(PARTS_AXIS),) * len(keys),
+                out_specs=(P(PARTS_AXIS), P(PARTS_AXIS), P()))
+
+        jitted = jax.jit(inner, donate_argnums=(0, 1))
+
+        def call(label, active, max_iters=np.iinfo(np.int32).max):
+            return jitted(label, active, jnp.int32(max_iters), *graph_args)
+
+        return call
+
+    # -- public API ----------------------------------------------------
+
+    def step(self, label, active):
+        """One compiled iteration -> (label, active, global active count
+        as a device scalar)."""
+        return self._step_fn(label, active)
+
+    def converge(self, label, active, max_iters: int | None = None):
+        """Run to an empty frontier inside ONE XLA program.
+        Returns (label, active, iterations_executed)."""
+        cap = np.iinfo(np.int32).max if max_iters is None else max_iters
+        return self._converge_fn(label, active, cap)
+
+    def run(self, max_iters: int | None = None, verbose: bool = False):
+        """init -> converge -> host label array [nv]; returns
+        (labels, num_iters).  verbose=True uses the stepwise path and
+        prints per-iteration frontier sizes."""
+        label, active = self.init_state()
+        if verbose:
+            it = 0
+            cnt = int(jnp.sum(active)) if self.mesh is None else int(
+                jax.device_get(jnp.sum(active)))
+            cap = np.iinfo(np.int32).max if max_iters is None else max_iters
+            while cnt > 0 and it < cap:
+                label, active, c = self.step(label, active)
+                cnt = int(jax.device_get(c))
+                it += 1
+                print(f"iter {it}: frontier={cnt}")
+        else:
+            label, active, it = self.converge(label, active, max_iters)
+            it = int(jax.device_get(it))
+        return self.unpad(label), it
+
+    def unpad(self, state) -> np.ndarray:
+        return self.sg.from_padded(np.asarray(jax.device_get(state)))

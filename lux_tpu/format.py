@@ -64,9 +64,12 @@ def _infer_sections(path: str, nv: int, ne: int,
         raise ValueError(
             f"{path}: size {size} does not match any .lux layout for "
             f"nv={nv} ne={ne} (expected one of {sorted(candidates.values())})")
-    # Ambiguity (possible when 4*nv == wbytes i.e. nv == ne): prefer the
-    # weighted interpretation only if the caller asked for it.
-    matches.sort()
+    if len(matches) > 1:
+        # Possible when weight bytes == degree bytes (e.g. nv == ne with
+        # 4-byte weights): the file cannot be parsed without being told.
+        raise ValueError(
+            f"{path}: ambiguous layout ({matches}); pass weighted=True/"
+            f"False explicitly")
     return matches[0]
 
 

@@ -73,6 +73,13 @@ class Graph:
     def in_degrees(self) -> np.ndarray:
         return np.diff(self.row_ptrs.astype(np.int64), prepend=0)
 
+    def edge_arrays(self):
+        """(src, dst) int64 arrays in file (dst-sorted) order."""
+        src = self.col_idx.astype(np.int64)
+        dst = np.repeat(np.arange(self.nv, dtype=np.int64),
+                        self.in_degrees())
+        return src, dst
+
 
 @dataclasses.dataclass
 class ShardedGraph:

@@ -1,0 +1,1 @@
+from lux_tpu.ops.segment import segment_reduce

@@ -1,0 +1,46 @@
+"""Segmented reductions over dst-sorted edge arrays.
+
+This is the TPU replacement for the reference's atomicAdd/atomicMin/
+atomicMax edge scatters (reference pagerank_gpu.cu:90,
+sssp_gpu.cu:55-59, components_gpu.cu:57-59): because ShardedGraph keeps
+each partition's edges sorted by local destination, the scatter becomes
+a *sorted* segmented reduction, which XLA lowers without atomics.
+
+A Pallas fast path (ops/pallas/) can override this for the hot loop;
+this module is the portable XLA implementation and the correctness
+oracle for it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_KINDS = {
+    "sum": jax.ops.segment_sum,
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+# Identity elements per reduction, used for padding/masked lanes.
+def identity_for(kind: str, dtype) -> jnp.ndarray:
+    if kind == "sum":
+        return jnp.zeros((), dtype)
+    if kind == "min":
+        return (jnp.array(jnp.iinfo(dtype).max, dtype)
+                if jnp.issubdtype(dtype, jnp.integer)
+                else jnp.array(jnp.inf, dtype))
+    if kind == "max":
+        return (jnp.array(jnp.iinfo(dtype).min, dtype)
+                if jnp.issubdtype(dtype, jnp.integer)
+                else jnp.array(-jnp.inf, dtype))
+    raise ValueError(f"unknown reduction {kind!r}")
+
+
+def segment_reduce(vals, seg_ids, num_segments: int, kind: str):
+    """Reduce ``vals`` ([ne, ...]) into ``num_segments`` rows by sorted
+    ``seg_ids``.  Empty segments get the reduction identity."""
+    # jax.ops.segment_min/max already fill empty segments with the
+    # reduction identity, so no fix-up pass is needed.
+    return _KINDS[kind](vals, seg_ids, num_segments=num_segments,
+                        indices_are_sorted=True)

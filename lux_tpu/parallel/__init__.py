@@ -1,0 +1,1 @@
+from lux_tpu.parallel.mesh import make_mesh, shard_over_parts

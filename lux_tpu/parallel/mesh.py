@@ -1,0 +1,51 @@
+"""Device mesh construction and part-axis sharding helpers.
+
+The reference's placement layer is its Legion mapper: slice_task
+round-robins partition tasks over GPUs and pins regions to framebuffer
+vs zero-copy memory (reference lux_mapper.cc:97-165).  On TPU the same
+role is played declaratively: a 1-D ``Mesh`` over the ``parts`` axis
+plus ``NamedSharding`` annotations on the part-major arrays; XLA's SPMD
+partitioner then inserts the ICI collectives that Legion/GASNet
+performed implicitly (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PARTS_AXIS = "parts"
+
+
+def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the ``parts`` axis."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            if num_devices > len(devices):
+                raise ValueError(
+                    f"requested {num_devices} devices, have {len(devices)}")
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (PARTS_AXIS,))
+
+
+def parts_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(PARTS_AXIS))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_over_parts(mesh: Mesh, tree):
+    """device_put every array in ``tree`` sharded on its leading (parts)
+    axis.  Leading dims must be divisible by the mesh size."""
+    sharding = parts_spec(mesh)
+
+    def place(x):
+        if x is None:
+            return None
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(place, tree)

@@ -1,0 +1,137 @@
+"""Push engine: SSSP/BFS and Connected Components vs NumPy oracles,
+plus the fixed-point audits and the mesh path."""
+
+import jax
+import numpy as np
+import pytest
+
+from lux_tpu import check
+from lux_tpu.apps import components, sssp
+from lux_tpu.convert import rmat_edges, uniform_random_edges
+from lux_tpu.graph import Graph
+from lux_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+def chain_graph(n=10):
+    """0 -> 1 -> ... -> n-1 plus an unreachable island {n, n+1}."""
+    src = np.concatenate([np.arange(n - 1), [n]]).astype(np.uint32)
+    dst = np.concatenate([np.arange(1, n), [n + 1]]).astype(np.uint32)
+    return Graph.from_edges(src, dst, n + 2)
+
+
+class TestSSSP:
+    def test_chain_hops(self):
+        g = chain_graph(10)
+        dist, iters = sssp.run(g, start_vertex=0, num_parts=2)
+        assert dist[:10].tolist() == list(range(10))
+        assert sssp.unreachable(dist)[10:].all()
+        assert iters == 10  # 9 propagation steps + 1 empty-frontier probe
+
+    @pytest.mark.parametrize("num_parts", [1, 4])
+    def test_random_matches_oracle(self, num_parts):
+        src, dst = uniform_random_edges(250, 1800, seed=13)
+        g = Graph.from_edges(src, dst, 250)
+        dist, _ = sssp.run(g, start_vertex=3, num_parts=num_parts)
+        want = sssp.reference_sssp(g, start_vertex=3)
+        reach = ~sssp.unreachable(dist)
+        np.testing.assert_array_equal(dist[reach], want[reach])
+        assert np.array_equal(sssp.unreachable(dist),
+                              want >= int(sssp.HOP_INF))
+
+    def test_weighted_matches_oracle(self):
+        src, dst, w = uniform_random_edges(120, 900, seed=21,
+                                           weighted=True)
+        g = Graph.from_edges(src, dst, 120, weights=w)
+        dist, _ = sssp.run(g, start_vertex=0, num_parts=3, weighted=True)
+        want = sssp.reference_sssp(g, start_vertex=0, weighted=True)
+        np.testing.assert_allclose(dist, want.astype(np.float32),
+                                   rtol=1e-6)
+
+    def test_check_task(self):
+        src, dst = uniform_random_edges(150, 1000, seed=17)
+        g = Graph.from_edges(src, dst, 150)
+        dist, _ = sssp.run(g, start_vertex=0, num_parts=2)
+        res = check.check_sssp(g, dist)
+        assert res.ok, str(res)
+        # a corrupted result must FAIL the audit: inflate the distance
+        # of a vertex that has an in-edge from a reached vertex
+        d64 = dist.astype(np.int64)
+        s, t = g.edge_arrays()
+        ok_edges = d64[s] < int(sssp.HOP_INF)
+        victim = t[ok_edges][0]
+        bad = dist.copy()
+        bad[victim] = d64[s[ok_edges][0]] + 10
+        assert not check.check_sssp(g, bad).ok
+
+    def test_max_iters_cap(self):
+        g = chain_graph(20)
+        dist, iters = sssp.run(g, start_vertex=0, max_iters=3)
+        assert iters == 3
+        assert dist[3] == 3 and sssp.unreachable(dist)[6]
+
+    def test_mesh_matches_single(self, mesh8):
+        src, dst, nv = rmat_edges(scale=10, edge_factor=6, seed=6)
+        g = Graph.from_edges(src, dst, nv)
+        d1, i1 = sssp.run(g, start_vertex=1, num_parts=8)
+        d8, i8 = sssp.run(g, start_vertex=1, num_parts=8, mesh=mesh8)
+        np.testing.assert_array_equal(d1, d8)
+        assert i1 == i8
+
+    def test_verbose_stepwise_matches(self, capsys):
+        g = chain_graph(5)
+        d1, _ = sssp.run(g, start_vertex=0, num_parts=2, verbose=True)
+        out = capsys.readouterr().out
+        assert "frontier=" in out
+        d2, _ = sssp.run(g, start_vertex=0, num_parts=2)
+        np.testing.assert_array_equal(d1, d2)
+
+
+class TestComponents:
+    def test_two_islands(self):
+        # undirected pairs: {0,1,2} and {3,4}
+        src = np.array([0, 1, 3], dtype=np.uint32)
+        dst = np.array([1, 2, 4], dtype=np.uint32)
+        s, d = components.symmetrize(src, dst)
+        g = Graph.from_edges(s, d, 5)
+        labels, _ = components.run(g, num_parts=2)
+        assert labels[0] == labels[1] == labels[2] == 2
+        assert labels[3] == labels[4] == 4
+
+    @pytest.mark.parametrize("num_parts", [1, 5])
+    def test_random_matches_oracle(self, num_parts):
+        src, dst = uniform_random_edges(300, 600, seed=31)
+        s, d = components.symmetrize(src, dst)
+        g = Graph.from_edges(s, d, 300)
+        labels, _ = components.run(g, num_parts=num_parts)
+        want = components.reference_components(g)
+        np.testing.assert_array_equal(labels, want)
+        assert check.check_components(g, labels).ok
+
+    def test_mesh_matches_single(self, mesh8):
+        src, dst = uniform_random_edges(400, 900, seed=33)
+        s, d = components.symmetrize(src, dst)
+        g = Graph.from_edges(s, d, 400)
+        l1, _ = components.run(g, num_parts=8)
+        l8, _ = components.run(g, num_parts=8, mesh=mesh8)
+        np.testing.assert_array_equal(l1, l8)
+
+    def test_check_catches_corruption(self):
+        src = np.array([0, 1], dtype=np.uint32)
+        dst = np.array([1, 0], dtype=np.uint32)
+        g = Graph.from_edges(src, dst, 2)
+        labels, _ = components.run(g)
+        assert check.check_components(g, labels).ok
+        assert not check.check_components(g, np.array([5, 0])).ok
+
+
+def test_pagerank_residual_check():
+    from lux_tpu.apps import pagerank
+    src, dst = uniform_random_edges(100, 800, seed=41)
+    g = Graph.from_edges(src, dst, 100)
+    ranks = pagerank.run(g, 60, num_parts=2)
+    assert check.check_pagerank(g, ranks, tol=1e-5).ok
