@@ -1,0 +1,87 @@
+"""Benchmark driver: PageRank GTEPS per chip.
+
+Methodology matches the reference (BASELINE.md): wall-clock around the
+iteration loop only (graph generation/load/init excluded), GTEPS =
+ne * iterations / elapsed_seconds / num_chips.  The graph is an R-MAT
+(the reference's RMAT27 family, scaled to fit a single chip's HBM
+comfortably at default settings).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GTEPS", "vs_baseline": N}
+vs_baseline is against the north-star target of 1 GTEPS/chip
+(BASELINE.json "north_star").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-scale", type=int, default=21,
+                    help="RMAT scale (nv = 2**scale)")
+    ap.add_argument("-ef", type=int, default=16, help="edges per vertex")
+    ap.add_argument("-ni", type=int, default=20, help="iterations to time")
+    ap.add_argument("-np", type=int, default=1, help="partitions")
+    ap.add_argument("-verbose", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from lux_tpu.apps import pagerank
+    from lux_tpu.convert import rmat_edges
+    from lux_tpu.graph import Graph
+
+    t0 = time.perf_counter()
+    src, dst, nv = rmat_edges(scale=args.scale, edge_factor=args.ef,
+                              seed=0)
+    g = Graph.from_edges(src, dst, nv)
+    if args.verbose:
+        print(f"# graph built: nv={g.nv} ne={g.ne} "
+              f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+
+    eng = pagerank.build_engine(g, num_parts=args.np)
+    state = eng.init_state()
+
+    def fetch(x):
+        # On remote-tunnel TPU platforms block_until_ready can return
+        # before execution finishes; a host fetch is the reliable fence.
+        return float(np.asarray(jax.device_get(x)).ravel()[0])
+
+    # Warmup with the SAME static iteration count (num_iters is a
+    # static jit arg — a different count would recompile inside the
+    # timed region), then reset state for the timed run.
+    state = eng.run(state, args.ni)
+    fetch(state)
+    state = eng.init_state()
+    if args.verbose:
+        print(f"# compiled ({time.perf_counter() - t0:.1f}s)",
+              file=sys.stderr)
+
+    t1 = time.perf_counter()
+    state = eng.run(state, args.ni)
+    fetch(state)
+    elapsed = time.perf_counter() - t1
+
+    # Sanity: results must still match the oracle's magnitude.
+    out = eng.unpad(state)
+    assert np.isfinite(out).all()
+
+    gteps = g.ne * args.ni / elapsed / 1e9
+    result = {
+        "metric": f"pagerank_rmat{args.scale}_gteps_per_chip",
+        "value": round(gteps, 4),
+        "unit": "GTEPS",
+        "vs_baseline": round(gteps / 1.0, 4),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

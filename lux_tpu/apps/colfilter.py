@@ -47,10 +47,12 @@ def make_program(k: int = K, lam: float = LAMBDA,
                        init=init, needs_dst=True)
 
 
-def build_engine(g: Graph, num_parts: int = 1, mesh=None) -> PullEngine:
+def build_engine(g: Graph, num_parts: int = 1, mesh=None,
+                 sg: ShardedGraph | None = None) -> PullEngine:
     if g.weights is None:
         raise ValueError("collaborative filtering needs a weighted graph")
-    sg = ShardedGraph.build(g, num_parts)
+    if sg is None:
+        sg = ShardedGraph.build(g, num_parts)
     return PullEngine(sg, make_program(), mesh=mesh)
 
 

@@ -30,8 +30,10 @@ def make_program() -> PushProgram:
                        identity=np.int32(-1), init=init)
 
 
-def build_engine(g: Graph, num_parts: int = 1, mesh=None) -> PushEngine:
-    sg = ShardedGraph.build(g, num_parts)
+def build_engine(g: Graph, num_parts: int = 1, mesh=None,
+                 sg: ShardedGraph | None = None) -> PushEngine:
+    if sg is None:
+        sg = ShardedGraph.build(g, num_parts)
     return PushEngine(sg, make_program(), mesh=mesh)
 
 

@@ -45,8 +45,10 @@ def make_program(dtype=jnp.float32) -> PullProgram:
 
 
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
-                 dtype=jnp.float32) -> PullEngine:
-    sg = ShardedGraph.build(g, num_parts)
+                 dtype=jnp.float32, sg: ShardedGraph | None = None
+                 ) -> PullEngine:
+    if sg is None:
+        sg = ShardedGraph.build(g, num_parts)
     return PullEngine(sg, make_program(dtype), mesh=mesh)
 
 

@@ -43,6 +43,9 @@ def make_program(start_vertex: int, weighted: bool = False) -> PushProgram:
         inf = HOP_INF
 
     def init(sg: ShardedGraph):
+        if not 0 <= start_vertex < sg.nv:
+            raise ValueError(
+                f"start vertex {start_vertex} out of range [0, {sg.nv})")
         dist = np.full(sg.nv, inf, dtype=dtype)
         dist[start_vertex] = 0
         active = np.zeros(sg.nv, dtype=bool)
@@ -54,10 +57,12 @@ def make_program(start_vertex: int, weighted: bool = False) -> PushProgram:
 
 
 def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
-                 mesh=None, weighted: bool = False) -> PushEngine:
+                 mesh=None, weighted: bool = False,
+                 sg: ShardedGraph | None = None) -> PushEngine:
     if weighted and g.weights is None:
         raise ValueError("weighted SSSP needs a weighted graph")
-    sg = ShardedGraph.build(g, num_parts)
+    if sg is None:
+        sg = ShardedGraph.build(g, num_parts)
     return PushEngine(sg, make_program(start_vertex, weighted), mesh=mesh)
 
 

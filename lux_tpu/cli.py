@@ -1,0 +1,240 @@
+"""Command-line apps with the reference's flag surface.
+
+The reference ships one binary per app (``./pagerank -ll:gpu 4 -file
+g.lux -ni 10``, reference README.md:40-52, pagerank.cc:121-148,
+sssp.cc:148-180).  Here: ``python -m lux_tpu.cli <app> -file ... ``.
+
+Flags (reference names kept):
+  -file PATH    .lux graph file (required)
+  -ni N         iterations (pagerank/colfilter; default 10)
+  -start V      source vertex (sssp; default 0)
+  -check        run the correctness audit after the run
+  -verbose      per-iteration progress + phase timing
+  -np N         number of partitions (the reference's -ll:gpu x nodes;
+                default: the -mesh size, i.e. one partition per device)
+  -mesh N       shard over an N-device mesh (default: 1 device)
+  -weighted     treat the graph/run as weighted (colfilter implies it)
+
+Timing methodology matches the reference: wall clock around the
+iteration loop only, printed as ``ELAPSED TIME = ... s`` plus GTEPS
+(reference pagerank.cc:108-118; BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def _fetch(x):
+    """Reliable completion fence (see bench.py)."""
+    import jax
+    return np.asarray(jax.device_get(x))
+
+
+def _common(ap: argparse.ArgumentParser):
+    ap.add_argument("-file", required=True, help=".lux graph file")
+    ap.add_argument("-np", type=int, default=0,
+                    help="partitions (0 = the mesh size)")
+    ap.add_argument("-mesh", type=int, default=1,
+                    help="devices in the parts mesh")
+    ap.add_argument("-check", action="store_true")
+    ap.add_argument("-verbose", action="store_true")
+
+
+def _load(args, weighted: bool):
+    from lux_tpu.graph import Graph, ShardedGraph
+
+    import os
+    if not os.path.exists(args.file):
+        print(f"error: graph file not found: {args.file}", file=sys.stderr)
+        raise SystemExit(2)
+    t0 = time.perf_counter()
+    g = Graph.from_file(args.file, weighted=weighted or None)
+    if args.verbose:
+        print(f"loaded nv={g.nv} ne={g.ne} weighted={g.weights is not None}"
+              f" ({time.perf_counter() - t0:.2f}s)")
+    return g
+
+
+def _mesh_and_parts(args):
+    import jax
+
+    from lux_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(args.mesh) if args.mesh > 1 else None
+    num_parts = args.np or (args.mesh if args.mesh > 1 else 1)
+    if mesh is not None and num_parts % args.mesh:
+        num_parts = args.mesh * ((num_parts + args.mesh - 1) // args.mesh)
+    return mesh, num_parts
+
+
+def _build_sg(args, g, num_parts):
+    """Build the padded layout once; print the memory advisor (the
+    analogue of the reference's startup requirement estimate,
+    reference pagerank.cc:60-85) under -verbose."""
+    from lux_tpu.graph import ShardedGraph
+
+    sg = ShardedGraph.build(g, num_parts)
+    if args.verbose:
+        rep = sg.memory_report()
+        print(f"memory: {rep['total_bytes'] / 1e6:.1f} MB total over "
+              f"{num_parts} part(s) "
+              f"({rep['edge_bytes_per_part'] / 1e6:.1f} MB edges + "
+              f"{rep['vertex_bytes_per_part'] / 1e6:.1f} MB vertices "
+              f"per part)")
+    return sg
+
+
+def cmd_pagerank(argv):
+    ap = argparse.ArgumentParser(prog="lux_tpu pagerank")
+    _common(ap)
+    ap.add_argument("-ni", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    from lux_tpu.apps import pagerank
+
+    g = _load(args, weighted=False)
+    mesh, num_parts = _mesh_and_parts(args)
+    sg = _build_sg(args, g, num_parts)
+    eng = pagerank.build_engine(g, num_parts, mesh, sg=sg)
+    state = eng.init_state()
+    # Warmup with the same static iteration count so compilation stays
+    # outside the timing, then reset state.
+    state = eng.run(state, args.ni)
+    _fetch(state)
+    state = eng.init_state()
+
+    ts = time.perf_counter()
+    state = eng.run(state, args.ni)
+    _fetch(state)
+    elapsed = time.perf_counter() - ts
+    print(f"ELAPSED TIME = {elapsed:.7f} s")
+    print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
+
+    if args.check:
+        from lux_tpu import check
+        res = check.check_pagerank(g, eng.unpad(state), tol=1e-3)
+        print(res)
+        return 0 if res.ok else 1
+    return 0
+
+
+def _push_app(argv, prog_name):
+    ap = argparse.ArgumentParser(prog=f"lux_tpu {prog_name}")
+    _common(ap)
+    ap.add_argument("-start", type=int, default=0)
+    ap.add_argument("-weighted", action="store_true")
+    args = ap.parse_args(argv)
+
+    from lux_tpu import check
+    from lux_tpu.apps import components, sssp
+
+    weighted = prog_name == "sssp" and args.weighted
+    g = _load(args, weighted=weighted)
+    mesh, num_parts = _mesh_and_parts(args)
+    sg = _build_sg(args, g, num_parts)
+    if prog_name == "sssp":
+        eng = sssp.build_engine(g, start_vertex=args.start,
+                                num_parts=num_parts, mesh=mesh,
+                                weighted=weighted, sg=sg)
+    else:
+        eng = components.build_engine(g, num_parts=num_parts, mesh=mesh,
+                                      sg=sg)
+    # Warmup converge run compiles the while_loop outside the timing.
+    eng.run(verbose=False)
+
+    ts = time.perf_counter()
+    labels, iters = eng.run(verbose=args.verbose)
+    elapsed = time.perf_counter() - ts
+    print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
+    print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
+
+    if args.check:
+        res = (check.check_sssp(g, labels, weighted=weighted)
+               if prog_name == "sssp" else
+               check.check_components(g, labels))
+        print(res)
+        return 0 if res.ok else 1
+    return 0
+
+
+def cmd_sssp(argv):
+    return _push_app(argv, "sssp")
+
+
+def cmd_components(argv):
+    return _push_app(argv, "components")
+
+
+def cmd_colfilter(argv):
+    ap = argparse.ArgumentParser(prog="lux_tpu colfilter")
+    _common(ap)
+    ap.add_argument("-ni", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    from lux_tpu.apps import colfilter
+
+    g = _load(args, weighted=True)
+    mesh, num_parts = _mesh_and_parts(args)
+    sg = _build_sg(args, g, num_parts)
+    eng = colfilter.build_engine(g, num_parts, mesh, sg=sg)
+    state = eng.init_state()
+    state = eng.run(state, args.ni)
+    _fetch(state)
+    state = eng.init_state()
+
+    ts = time.perf_counter()
+    state = eng.run(state, args.ni)
+    _fetch(state)
+    elapsed = time.perf_counter() - ts
+    print(f"ELAPSED TIME = {elapsed:.7f} s")
+    print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
+    out = eng.unpad(state)
+    print(f"RMSE = {colfilter.rmse(g, out):.6f}")
+    return 0
+
+
+def cmd_convert(argv):
+    ap = argparse.ArgumentParser(prog="lux_tpu convert")
+    ap.add_argument("-input", required=True, help="text edge list")
+    ap.add_argument("-output", required=True, help=".lux output")
+    ap.add_argument("-nv", type=int, required=True)
+    ap.add_argument("-weighted", action="store_true")
+    args = ap.parse_args(argv)
+
+    from lux_tpu.convert import convert_edge_list
+    convert_edge_list(args.input, args.output, args.nv,
+                      weighted=args.weighted)
+    return 0
+
+
+_APPS = {
+    "pagerank": cmd_pagerank,
+    "sssp": cmd_sssp,
+    "components": cmd_components,
+    "colfilter": cmd_colfilter,
+    "convert": cmd_convert,
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m lux_tpu.cli "
+              f"{{{','.join(_APPS)}}} [flags]\n"
+              "run 'python -m lux_tpu.cli <app> -h' for app flags")
+        return 0 if argv else 2
+    app = argv[0]
+    if app not in _APPS:
+        print(f"unknown app {app!r}; choose from {list(_APPS)}",
+              file=sys.stderr)
+        return 2
+    return _APPS[app](argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

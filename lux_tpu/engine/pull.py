@@ -116,37 +116,51 @@ class PullEngine:
     # -- full step over all parts -------------------------------------
 
     def _build_step(self):
+        """Builds self._graph_args and the un-jitted core
+        step(state, *graph_args); returns a jitted single-step wrapper.
+
+        Graph arrays are always passed as ARGUMENTS, never closed over:
+        closing over them would bake hundreds of MB of edge indices
+        into the XLA program as constants.
+        """
         a = self.arrays
         has_w = a["weight"] is not None
         keys = [k for k in _GRAPH_KEYS if not (k == "weight" and not has_w)]
-        graph_args = tuple(a[k] for k in keys)
+        self._graph_keys = keys
+        self.graph_args = tuple(a[k] for k in keys)
 
         if self.mesh is None:
-            def step(state, *gargs):
+            def core(state, *gargs):
                 g = dict(zip(keys, gargs), **({} if has_w
                                               else {"weight": None}))
                 return self._parts_step(state, state, g)
+        else:
+            P = PartitionSpec
+            in_specs = (P(PARTS_AXIS),) * (1 + len(keys))
 
-            jitted = jax.jit(step, donate_argnums=0)
-            return lambda state: jitted(state, *graph_args)
+            @functools.partial(jax.shard_map, mesh=self.mesh,
+                               in_specs=in_specs,
+                               out_specs=P(PARTS_AXIS))
+            def core(state, *gargs):
+                g = dict(zip(keys, gargs), **({} if has_w
+                                              else {"weight": None}))
+                # The per-iteration vertex-state exchange over ICI.
+                full = jax.lax.all_gather(state, PARTS_AXIS, tiled=True)
+                return self._parts_step(state, full, g)
 
-        P = PartitionSpec
-        in_specs = (P(PARTS_AXIS),) * (1 + len(keys))
-        out_specs = P(PARTS_AXIS)
-
-        @functools.partial(jax.shard_map, mesh=self.mesh,
-                           in_specs=in_specs, out_specs=out_specs)
-        def sharded_step(state, *gargs):
-            g = dict(zip(keys, gargs), **({} if has_w
-                                          else {"weight": None}))
-            # The per-iteration vertex-state exchange over ICI.
-            full = jax.lax.all_gather(state, PARTS_AXIS, tiled=True)
-            return self._parts_step(state, full, g)
-
-        jitted = jax.jit(sharded_step, donate_argnums=0)
-        return lambda state: jitted(state, *graph_args)
+        self._step_core = core
+        jitted = jax.jit(core, donate_argnums=0)
+        return lambda state: jitted(state, *self.graph_args)
 
     # -- public API ---------------------------------------------------
+
+    def pure_step(self, state, *graph_args):
+        """Un-jitted step taking the graph arrays as ARGUMENTS (pass
+        ``*engine.graph_args``), so embedding jits don't bake hundreds
+        of MB of edge indices in as constants (mesh=None engines)."""
+        if self.mesh is not None:
+            raise ValueError("pure_step is for single-device engines")
+        return self._step_core(state, *graph_args)
 
     def step(self, state):
         """One iteration (compiled)."""
@@ -154,14 +168,14 @@ class PullEngine:
 
     @functools.cached_property
     def _run_fused(self):
-        step = self._step_fn
+        core = self._step_core
 
         @functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
-        def run(state, num_iters):
-            return jax.lax.fori_loop(0, num_iters, lambda _, s: step(s),
-                                     state)
+        def run(state, num_iters, *gargs):
+            return jax.lax.fori_loop(
+                0, num_iters, lambda _, s: core(s, *gargs), state)
 
-        return run
+        return lambda state, n: run(state, n, *self.graph_args)
 
     def run(self, state, num_iters: int, fused: bool = True):
         """num_iters iterations; fused=True compiles the whole loop into

@@ -59,7 +59,14 @@ def _infer_sections(path: str, nv: int, ne: int,
     }
     matches = [k for k, v in candidates.items() if v == size]
     if weighted is not None:
-        matches = [m for m in matches if m[0] == weighted]
+        filtered = [m for m in matches if m[0] == weighted]
+        if matches and not filtered:
+            have = "a weighted" if matches[0][0] else "an unweighted"
+            want = "weighted" if weighted else "unweighted"
+            raise ValueError(
+                f"{path}: looks like {have} graph but was opened as "
+                f"{want} (nv={nv} ne={ne} size={size})")
+        matches = filtered
     if not matches:
         raise ValueError(
             f"{path}: size {size} does not match any .lux layout for "
