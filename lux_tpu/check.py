@@ -57,6 +57,16 @@ def check_components(g: Graph, labels: np.ndarray) -> CheckResult:
     return CheckResult("components monotonicity", bad, g.ne)
 
 
+def check_colfilter(g: Graph, state: np.ndarray) -> CheckResult:
+    """Training audit the reference lacks: the learned factors must
+    predict ratings no worse than the uniform sqrt(1/K) init."""
+    from lux_tpu.apps.colfilter import K, rmse
+    init = np.full((g.nv, state.shape[1] if state.ndim > 1 else K),
+                   np.sqrt(1.0 / state.shape[1]), dtype=np.float64)
+    bad = int(rmse(g, state) > rmse(g, init) + 1e-9)
+    return CheckResult("colfilter rmse non-increase", bad, g.ne)
+
+
 def check_pagerank(g: Graph, norm_ranks: np.ndarray,
                    tol: float = 1e-6) -> CheckResult:
     """Residual audit the reference lacks: one more iteration moves

@@ -29,10 +29,8 @@ import time
 import numpy as np
 
 
-def _fetch(x):
-    """Reliable completion fence (see bench.py)."""
-    import jax
-    return np.asarray(jax.device_get(x))
+from lux_tpu.timing import fetch as _fetch
+from lux_tpu.timing import timed_converge, timed_fused_run
 
 
 def _common(ap: argparse.ArgumentParser):
@@ -46,7 +44,7 @@ def _common(ap: argparse.ArgumentParser):
 
 
 def _load(args, weighted: bool):
-    from lux_tpu.graph import Graph, ShardedGraph
+    from lux_tpu.graph import Graph
 
     import os
     if not os.path.exists(args.file):
@@ -61,14 +59,15 @@ def _load(args, weighted: bool):
 
 
 def _mesh_and_parts(args):
-    import jax
-
     from lux_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(args.mesh) if args.mesh > 1 else None
     num_parts = args.np or (args.mesh if args.mesh > 1 else 1)
     if mesh is not None and num_parts % args.mesh:
-        num_parts = args.mesh * ((num_parts + args.mesh - 1) // args.mesh)
+        rounded = args.mesh * ((num_parts + args.mesh - 1) // args.mesh)
+        print(f"note: -np {num_parts} rounded up to {rounded} "
+              f"(must divide the {args.mesh}-device mesh)")
+        num_parts = rounded
     return mesh, num_parts
 
 
@@ -101,17 +100,7 @@ def cmd_pagerank(argv):
     mesh, num_parts = _mesh_and_parts(args)
     sg = _build_sg(args, g, num_parts)
     eng = pagerank.build_engine(g, num_parts, mesh, sg=sg)
-    state = eng.init_state()
-    # Warmup with the same static iteration count so compilation stays
-    # outside the timing, then reset state.
-    state = eng.run(state, args.ni)
-    _fetch(state)
-    state = eng.init_state()
-
-    ts = time.perf_counter()
-    state = eng.run(state, args.ni)
-    _fetch(state)
-    elapsed = time.perf_counter() - ts
+    state, elapsed = timed_fused_run(eng, args.ni)
     print(f"ELAPSED TIME = {elapsed:.7f} s")
     print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
 
@@ -144,12 +133,7 @@ def _push_app(argv, prog_name):
     else:
         eng = components.build_engine(g, num_parts=num_parts, mesh=mesh,
                                       sg=sg)
-    # Warmup converge run compiles the while_loop outside the timing.
-    eng.run(verbose=False)
-
-    ts = time.perf_counter()
-    labels, iters = eng.run(verbose=args.verbose)
-    elapsed = time.perf_counter() - ts
+    labels, iters, elapsed = timed_converge(eng, verbose=args.verbose)
     print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
     print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
 
@@ -182,19 +166,16 @@ def cmd_colfilter(argv):
     mesh, num_parts = _mesh_and_parts(args)
     sg = _build_sg(args, g, num_parts)
     eng = colfilter.build_engine(g, num_parts, mesh, sg=sg)
-    state = eng.init_state()
-    state = eng.run(state, args.ni)
-    _fetch(state)
-    state = eng.init_state()
-
-    ts = time.perf_counter()
-    state = eng.run(state, args.ni)
-    _fetch(state)
-    elapsed = time.perf_counter() - ts
+    state, elapsed = timed_fused_run(eng, args.ni)
     print(f"ELAPSED TIME = {elapsed:.7f} s")
     print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
     out = eng.unpad(state)
     print(f"RMSE = {colfilter.rmse(g, out):.6f}")
+    if args.check:
+        from lux_tpu import check
+        res = check.check_colfilter(g, out)
+        print(res)
+        return 0 if res.ok else 1
     return 0
 
 

@@ -23,15 +23,15 @@ def edges_to_csc(src, dst, nv: int, weights=None):
     """Sort edges by destination and build CSC end-offset arrays.
 
     Returns (row_ptrs[u8 nv], col_idx[u4 ne] = sources, sorted_weights,
-    out_degrees[u4 nv]).  Matches the reference converter's output
-    semantics (converter.cc:98-124) without replicating its code: we use
-    a vectorized stable argsort instead of a per-edge struct sort.
+    out_degrees[u4 nv]).  Same output semantics as the reference
+    converter (converter.cc:98-124); the canonical order is (dst, src)
+    so the Python and native converters produce byte-identical files.
     """
     src = np.asarray(src, dtype=np.uint32)
     dst = np.asarray(dst, dtype=np.uint32)
     if src.size and (int(src.max()) >= nv or int(dst.max()) >= nv):
         raise ValueError("edge endpoint out of range")
-    order = np.argsort(dst, kind="stable")
+    order = np.lexsort((src, dst))
     col_idx = src[order]
     counts = np.bincount(dst, minlength=nv).astype(np.uint64)
     row_ptrs = np.cumsum(counts, dtype=np.uint64)

@@ -53,7 +53,27 @@ class Graph:
 
     @classmethod
     def from_file(cls, path: str, weighted: bool | None = None,
-                  weight_dtype=np.int32) -> "Graph":
+                  weight_dtype=np.int32, use_native: bool = False
+                  ) -> "Graph":
+        """Load a .lux file.  use_native=True routes the bulk reads
+        through the C++ pthread-pread loader (lux_tpu.native), the
+        analogue of the reference's native per-partition load tasks
+        (reference pull_model.inl:253-320); falls back to mmap when
+        the native library is unavailable."""
+        if use_native:
+            from lux_tpu import native
+            if native.available():
+                hdr = luxfmt.peek_lux(path, weighted, weight_dtype)
+                row_ptrs, col_idx, weights, _ = native.load_partition(
+                    path, hdr.nv, hdr.ne, 0, hdr.nv,
+                    weighted=hdr.has_weights, weight_dtype=weight_dtype)
+                # degrees: col_idx is already in RAM, so count there
+                # rather than re-reading 4*ne bytes from disk
+                degrees = np.bincount(col_idx,
+                                      minlength=hdr.nv).astype(np.uint32)
+                return cls(nv=hdr.nv, ne=hdr.ne, row_ptrs=row_ptrs,
+                           col_idx=col_idx, weights=weights,
+                           out_degrees=degrees)
         hdr, row_ptrs, col_idx, weights, degrees = luxfmt.read_lux(
             path, weighted, weight_dtype)
         if degrees is None:

@@ -1,0 +1,121 @@
+"""ctypes bindings for the native converter/loader, with auto-build.
+
+The reference's host-side native components are its converter tool and
+its per-partition file load tasks (SURVEY.md §2.4); here they are a C++
+CLI (converter.cc) and a pthread pread loader (loader.cc).  Python
+falls back to the mmap path in lux_tpu.format when the library is not
+built or the platform has no toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "build")
+_LIB = os.path.join(_BUILD, "liblux_native.so")
+CONVERTER = os.path.join(_BUILD, "lux_converter")
+
+_lib = None
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Build the native tools if missing.  Returns availability."""
+    if os.path.exists(_LIB) and os.path.exists(CONVERTER):
+        return True
+    try:
+        subprocess.run(["make", "-C", _DIR],
+                       check=True,
+                       capture_output=quiet)
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    return os.path.exists(_LIB) and os.path.exists(CONVERTER)
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB) and not ensure_built():
+        raise OSError("native library unavailable (no toolchain?)")
+    lib = ctypes.CDLL(_LIB)
+    lib.lux_read_header.restype = ctypes.c_int
+    lib.lux_read_header.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.lux_load_partition.restype = ctypes.c_int
+    lib.lux_load_partition.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+    lib.lux_count_degrees.restype = ctypes.c_int
+    lib.lux_count_degrees.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        _load_lib()
+        return True
+    except OSError:
+        return False
+
+
+def _check(rc: int, what: str):
+    if rc != 0:
+        raise OSError(f"{what} failed with native error {rc} "
+                      f"({os.strerror(-rc) if rc < 0 else rc})")
+
+
+def read_header(path: str) -> tuple[int, int]:
+    lib = _load_lib()
+    nv = ctypes.c_uint32()
+    ne = ctypes.c_uint64()
+    _check(lib.lux_read_header(path.encode(), ctypes.byref(nv),
+                               ctypes.byref(ne)), "read_header")
+    return nv.value, ne.value
+
+
+def load_partition(path: str, nv: int, ne: int, v0: int, v1: int,
+                   weighted: bool = False, weight_dtype=np.int32,
+                   threads: int = 8):
+    """Load vertex range [v0, v1): returns (row_ptrs u64[v1-v0] END
+    offsets, col_idx u32[e_hi-e_lo], weights|None, e_lo)."""
+    lib = _load_lib()
+    e_lo = ctypes.c_uint64()
+    e_hi = ctypes.c_uint64()
+    # size query
+    _check(lib.lux_load_partition(path.encode(), nv, ne, v0, v1,
+                                  int(weighted), 4, ctypes.byref(e_lo),
+                                  ctypes.byref(e_hi), None, None, None,
+                                  threads), "load_partition(size)")
+    n_edges = e_hi.value - e_lo.value
+    rows = np.empty(v1 - v0, dtype=np.uint64)
+    cols = np.empty(n_edges, dtype=np.uint32)
+    wdt = np.dtype(weight_dtype)
+    weights = np.empty(n_edges, dtype=wdt) if weighted else None
+    _check(lib.lux_load_partition(
+        path.encode(), nv, ne, v0, v1, int(weighted), wdt.itemsize,
+        ctypes.byref(e_lo), ctypes.byref(e_hi),
+        rows.ctypes.data_as(ctypes.c_void_p),
+        cols.ctypes.data_as(ctypes.c_void_p),
+        weights.ctypes.data_as(ctypes.c_void_p) if weighted else None,
+        threads), "load_partition")
+    return rows, cols, weights, e_lo.value
+
+
+def count_degrees(path: str, nv: int, ne: int, threads: int = 8):
+    lib = _load_lib()
+    deg = np.zeros(nv, dtype=np.uint32)
+    _check(lib.lux_count_degrees(path.encode(), nv, ne,
+                                 deg.ctypes.data_as(ctypes.c_void_p),
+                                 threads), "count_degrees")
+    return deg

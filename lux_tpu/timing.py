@@ -1,0 +1,55 @@
+"""Timing utilities with reliable completion fences.
+
+The reference drains its deferred-execution pipeline with an execution
+fence + TimingLauncher before reading wall clocks (reference
+sssp.cc:132-135).  The TPU analogue: on remote-tunnel platforms
+``block_until_ready`` can return before the device finishes, so the
+only trustworthy fence is a host fetch.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def fetch(x) -> np.ndarray:
+    """Force completion of everything ``x`` depends on; returns host
+    value."""
+    import jax
+    return np.asarray(jax.device_get(x))
+
+
+def timed_fused_run(eng, num_iters: int):
+    """Warm up a pull engine with the SAME static iteration count
+    (num_iters is a static jit arg — a different count would recompile
+    inside the timed region), then time a fresh fused run.
+
+    Returns (final_state, elapsed_seconds).
+    """
+    state = eng.init_state()
+    state = eng.run(state, num_iters)
+    fetch(state)
+    state = eng.init_state()
+    t0 = time.perf_counter()
+    state = eng.run(state, num_iters)
+    fetch(state)
+    return state, time.perf_counter() - t0
+
+
+def timed_converge(eng, max_iters=None, verbose: bool = False):
+    """Warm up a push engine's converge program (printing per-iteration
+    frontier sizes during the warmup pass when verbose), then time a
+    fresh whole-run converge.  Returns (labels, iters, elapsed)."""
+    if verbose:
+        eng.run(max_iters=max_iters, verbose=True)   # stepwise, printed
+    label, active = eng.init_state()
+    l2, a2, _ = eng.converge(label, active, max_iters)  # compile
+    fetch(l2)
+    label, active = eng.init_state()
+    t0 = time.perf_counter()
+    label, active, iters = eng.converge(label, active, max_iters)
+    iters = int(fetch(iters))
+    elapsed = time.perf_counter() - t0
+    return eng.unpad(label), iters, elapsed

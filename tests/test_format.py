@@ -20,9 +20,9 @@ def tiny_graph():
 def test_csc_build_matches_hand_computed():
     src, dst, nv = tiny_graph()
     row_ptrs, col_idx, w, deg = edges_to_csc(src, dst, nv)
-    # in-edges per dst: v0 <- {1,2}, v1 <- {0}, v2 <- {3,0}, v3 <- {2}
+    # in-edges per dst: v0 <- {1,2}, v1 <- {0}, v2 <- {0,3}, v3 <- {2}
     assert row_ptrs.tolist() == [2, 3, 5, 6]          # END offsets
-    assert col_idx.tolist() == [1, 2, 0, 3, 0, 2]     # dst-sorted sources
+    assert col_idx.tolist() == [1, 2, 0, 0, 3, 2]     # (dst, src) order
     assert deg.tolist() == [2, 1, 2, 1]               # out-degrees
 
 
@@ -38,7 +38,7 @@ def test_file_byte_layout(tmp_path):
     assert struct.unpack_from("<I", blob, 0)[0] == 4
     assert struct.unpack_from("<Q", blob, 4)[0] == 6
     assert struct.unpack_from("<4Q", blob, 12) == (2, 3, 5, 6)
-    assert struct.unpack_from("<6I", blob, 44) == (1, 2, 0, 3, 0, 2)
+    assert struct.unpack_from("<6I", blob, 44) == (1, 2, 0, 0, 3, 2)
     assert struct.unpack_from("<4I", blob, 68) == (2, 1, 2, 1)
 
 

@@ -1,0 +1,116 @@
+"""Native C++ converter/loader vs the Python implementations."""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+from lux_tpu import format as luxfmt
+from lux_tpu import native
+from lux_tpu.convert import uniform_random_edges
+from lux_tpu.graph import Graph
+from lux_tpu.partition import edge_balanced_bounds
+
+pytestmark = pytest.mark.skipif(not native.ensure_built(),
+                                reason="no C++ toolchain")
+
+
+def _write_text(path, src, dst, w=None):
+    with open(path, "w") as f:
+        for i in range(len(src)):
+            if w is None:
+                f.write(f"{src[i]} {dst[i]}\n")
+            else:
+                f.write(f"{src[i]} {dst[i]} {w[i]}\n")
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+def test_converter_byte_identical_with_python(tmp_path, weighted):
+    if weighted:
+        src, dst, w = uniform_random_edges(60, 500, seed=3, weighted=True)
+    else:
+        src, dst = uniform_random_edges(60, 500, seed=3)
+        w = None
+    txt = tmp_path / "e.txt"
+    _write_text(txt, src, dst, w)
+
+    # Python path
+    g = Graph.from_edges(src, dst, 60, weights=w)
+    py_out = tmp_path / "py.lux"
+    luxfmt.write_lux(str(py_out), g.row_ptrs, g.col_idx,
+                     weights=g.weights, degrees=g.out_degrees)
+
+    # Native path
+    cc_out = tmp_path / "cc.lux"
+    cmd = [native.CONVERTER, "-nv", "60", "-input", str(txt),
+           "-output", str(cc_out)]
+    if weighted:
+        cmd.append("-weighted")
+    subprocess.run(cmd, check=True, capture_output=True)
+
+    assert py_out.read_bytes() == cc_out.read_bytes()
+
+
+def test_converter_rejects_bad_input(tmp_path):
+    txt = tmp_path / "bad.txt"
+    txt.write_text("0 99\n")  # out of range for nv=3
+    r = subprocess.run([native.CONVERTER, "-nv", "3", "-input", str(txt),
+                        "-output", str(tmp_path / "x.lux")],
+                       capture_output=True)
+    assert r.returncode == 1
+    assert b"out of range" in r.stderr
+
+
+def test_native_header_and_degrees(tmp_path):
+    src, dst = uniform_random_edges(100, 900, seed=4)
+    g = Graph.from_edges(src, dst, 100)
+    p = tmp_path / "g.lux"
+    luxfmt.write_lux(str(p), g.row_ptrs, g.col_idx)
+    nv, ne = native.read_header(str(p))
+    assert (nv, ne) == (100, 900)
+    deg = native.count_degrees(str(p), nv, ne)
+    np.testing.assert_array_equal(deg, g.out_degrees)
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+def test_native_partition_slices(tmp_path, weighted):
+    if weighted:
+        src, dst, w = uniform_random_edges(80, 700, seed=5, weighted=True)
+        g = Graph.from_edges(src, dst, 80, weights=w)
+    else:
+        src, dst = uniform_random_edges(80, 700, seed=5)
+        g = Graph.from_edges(src, dst, 80)
+    p = tmp_path / "g.lux"
+    luxfmt.write_lux(str(p), g.row_ptrs, g.col_idx, weights=g.weights)
+
+    starts = edge_balanced_bounds(g.row_ptrs, 4)
+    for i in range(4):
+        v0, v1 = int(starts[i]), int(starts[i + 1])
+        rows, cols, ws, e_lo = native.load_partition(
+            str(p), g.nv, g.ne, v0, v1, weighted=weighted)
+        np.testing.assert_array_equal(rows, g.row_ptrs[v0:v1])
+        lo = int(g.row_ptrs[v0 - 1]) if v0 else 0
+        hi = int(g.row_ptrs[v1 - 1])
+        assert e_lo == lo
+        np.testing.assert_array_equal(cols, g.col_idx[lo:hi])
+        if weighted:
+            np.testing.assert_array_equal(ws, np.asarray(g.weights)[lo:hi])
+
+
+def test_native_missing_file_error():
+    with pytest.raises(OSError):
+        native.read_header("/nonexistent/g.lux")
+
+
+def test_graph_from_file_native_matches_mmap(tmp_path):
+    src, dst = uniform_random_edges(90, 800, seed=6)
+    g = Graph.from_edges(src, dst, 90)
+    p = tmp_path / "g.lux"
+    luxfmt.write_lux(str(p), g.row_ptrs, g.col_idx, degrees=g.out_degrees)
+    gm = Graph.from_file(str(p))
+    gn = Graph.from_file(str(p), use_native=True)
+    np.testing.assert_array_equal(np.asarray(gm.row_ptrs),
+                                  np.asarray(gn.row_ptrs))
+    np.testing.assert_array_equal(np.asarray(gm.col_idx),
+                                  np.asarray(gn.col_idx))
+    np.testing.assert_array_equal(gm.out_degrees, gn.out_degrees)
