@@ -9,8 +9,12 @@ reference pull_model.inl:423-470 + pagerank_gpu.cu:104-151):
    materialize remotely, pull_model.inl:454-461);
 2. gather each edge's source state by precomputed padded slot;
 3. per-edge message (program.edge_value);
-4. sorted segmented reduction to each part's local destinations
-   (replacing the CUB BlockScan + atomicAdd CTA pattern, SURVEY.md §3.3);
+4. scatter-free segment reduction to each part's local destinations
+   (replacing the CUB BlockScan + atomicAdd CTA pattern, SURVEY.md
+   §3.3) — by default via the tiled chunk layout (ops/tiled.py),
+   which keeps the hot loop on dense VPU/MXU ops; ``layout="flat"``
+   falls back to the XLA scatter path (ops/segment.py), the
+   correctness oracle;
 5. per-vertex apply epilogue.
 
 Fixed-iteration runs are fused into a single XLA program with
@@ -31,9 +35,35 @@ from jax.sharding import PartitionSpec
 from lux_tpu.engine.program import PartCtx, PullProgram
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
+from lux_tpu.ops.tiled import TiledLayout, tiled_segment_reduce
 from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
 
-_GRAPH_KEYS = ("src_slot", "dst_local", "weight", "deg", "vmask")
+
+def build_graph_arrays(sg: ShardedGraph, layout: str, needs_dst: bool,
+                       tile_w: int, tile_e: int):
+    """Device-ready per-part graph arrays (all leading dim num_parts)
+    for either edge layout; returns (arrays dict, TiledLayout|None)."""
+    common = dict(deg=jnp.asarray(sg.deg_padded),
+                  vmask=jnp.asarray(sg.vmask))
+    if layout == "flat":
+        arrays = dict(src_slot=jnp.asarray(sg.src_slot),
+                      dst_local=jnp.asarray(sg.dst_local), **common)
+        if sg.weighted:
+            arrays["weight"] = jnp.asarray(sg.edge_weight)
+        return arrays, None
+    if layout != "tiled":
+        raise ValueError(f"unknown layout {layout!r}")
+    lay = TiledLayout.build(sg.row_ptr_local, sg.dst_local, sg.vpad,
+                            W=tile_w, E=tile_e)
+    arrays = dict(src_slot=jnp.asarray(lay.chunk(sg.src_slot)),
+                  rel_dst=jnp.asarray(lay.rel_dst),
+                  chunk_start=jnp.asarray(lay.chunk_start),
+                  last_chunk=jnp.asarray(lay.last_chunk), **common)
+    if sg.weighted:
+        arrays["weight"] = jnp.asarray(lay.chunk(sg.edge_weight))
+    if needs_dst:
+        arrays["chunk_tile"] = jnp.asarray(lay.chunk_tile)
+    return arrays, lay
 
 
 class PullEngine:
@@ -45,7 +75,9 @@ class PullEngine:
     runs under shard_map with an all-gather for remote state.
     """
 
-    def __init__(self, sg: ShardedGraph, program: PullProgram, mesh=None):
+    def __init__(self, sg: ShardedGraph, program: PullProgram, mesh=None,
+                 layout: str = "tiled", tile_w: int = 128,
+                 tile_e: int = 512, use_mxu: bool = False):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -53,14 +85,9 @@ class PullEngine:
         self.sg = sg
         self.program = program
         self.mesh = mesh
-
-        arrays = dict(
-            src_slot=jnp.asarray(sg.src_slot),
-            dst_local=jnp.asarray(sg.dst_local),
-            weight=(jnp.asarray(sg.edge_weight) if sg.weighted else None),
-            deg=jnp.asarray(sg.deg_padded),
-            vmask=jnp.asarray(sg.vmask),
-        )
+        self.use_mxu = use_mxu
+        arrays, self.tiles = build_graph_arrays(
+            sg, layout, program.needs_dst, tile_w, tile_e)
         if mesh is not None:
             arrays = shard_over_parts(mesh, arrays)
         self.arrays = arrays
@@ -78,14 +105,26 @@ class PullEngine:
 
     def _part_step(self, flat_state, old_p, g):
         """g: dict of this part's graph arrays."""
-        prog, sg = self.program, self.sg
+        prog, sg, lay = self.program, self.sg, self.tiles
         src_vals = jnp.take(flat_state, g["src_slot"], axis=0)
-        dst_vals = (jnp.take(old_p, jnp.minimum(g["dst_local"],
-                                                sg.vpad - 1), axis=0)
-                    if prog.needs_dst else None)
-        msgs = prog.edge_value(src_vals, dst_vals, g["weight"])
-        red = segment_reduce(msgs, g["dst_local"], sg.vpad + 1,
-                             prog.reduce)[:sg.vpad]
+        if prog.needs_dst:
+            if lay is None:
+                dst_idx = jnp.minimum(g["dst_local"], sg.vpad - 1)
+            else:
+                dst_idx = jnp.minimum(
+                    g["chunk_tile"][:, None] * lay.W + g["rel_dst"],
+                    sg.vpad - 1)
+            dst_vals = jnp.take(old_p, dst_idx, axis=0)
+        else:
+            dst_vals = None
+        msgs = prog.edge_value(src_vals, dst_vals, g.get("weight"))
+        if lay is None:
+            red = segment_reduce(msgs, g["dst_local"], sg.vpad + 1,
+                                 prog.reduce)[:sg.vpad]
+        else:
+            red = tiled_segment_reduce(
+                msgs, lay, g["chunk_start"], g["last_chunk"],
+                g["rel_dst"], sg.vpad, prog.reduce, use_mxu=self.use_mxu)
         ctx = PartCtx(deg=g["deg"], vmask=g["vmask"], nv=sg.nv, ne=sg.ne)
         new = prog.apply(old_p, red, ctx)
         keep = g["vmask"].reshape(g["vmask"].shape +
@@ -97,21 +136,8 @@ class PullEngine:
         sg = self.sg
         flat = full_state.reshape((sg.num_parts * sg.vpad,) +
                                   full_state.shape[2:])
-        has_w = g_local["weight"] is not None
-
-        def one(src_slot, dst_local, weight, old, deg, vmask):
-            g = dict(src_slot=src_slot, dst_local=dst_local,
-                     weight=weight, deg=deg, vmask=vmask)
-            return self._part_step(flat, old, g)
-
-        if has_w:
-            return jax.vmap(one)(
-                g_local["src_slot"], g_local["dst_local"],
-                g_local["weight"], local_state, g_local["deg"],
-                g_local["vmask"])
-        return jax.vmap(lambda s, d, o, dg, vm: one(s, d, None, o, dg, vm))(
-            g_local["src_slot"], g_local["dst_local"], local_state,
-            g_local["deg"], g_local["vmask"])
+        return jax.vmap(lambda old, g: self._part_step(flat, old, g))(
+            local_state, g_local)
 
     # -- full step over all parts -------------------------------------
 
@@ -123,27 +149,22 @@ class PullEngine:
         closing over them would bake hundreds of MB of edge indices
         into the XLA program as constants.
         """
-        a = self.arrays
-        has_w = a["weight"] is not None
-        keys = [k for k in _GRAPH_KEYS if not (k == "weight" and not has_w)]
+        keys = sorted(self.arrays)
         self._graph_keys = keys
-        self.graph_args = tuple(a[k] for k in keys)
+        self.graph_args = tuple(self.arrays[k] for k in keys)
 
         if self.mesh is None:
             def core(state, *gargs):
-                g = dict(zip(keys, gargs), **({} if has_w
-                                              else {"weight": None}))
+                g = dict(zip(keys, gargs))
                 return self._parts_step(state, state, g)
         else:
             P = PartitionSpec
-            in_specs = (P(PARTS_AXIS),) * (1 + len(keys))
 
             @functools.partial(jax.shard_map, mesh=self.mesh,
-                               in_specs=in_specs,
+                               in_specs=(P(PARTS_AXIS),) * (1 + len(keys)),
                                out_specs=P(PARTS_AXIS))
             def core(state, *gargs):
-                g = dict(zip(keys, gargs), **({} if has_w
-                                              else {"weight": None}))
+                g = dict(zip(keys, gargs))
                 # The per-iteration vertex-state exchange over ICI.
                 full = jax.lax.all_gather(state, PARTS_AXIS, tiled=True)
                 return self._parts_step(state, full, g)

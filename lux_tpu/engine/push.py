@@ -37,9 +37,8 @@ from jax.sharding import PartitionSpec
 from lux_tpu.engine.program import PartCtx
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
+from lux_tpu.ops.tiled import tiled_segment_reduce
 from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
-
-_GRAPH_KEYS = ("src_slot", "dst_local", "weight", "deg", "vmask")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,21 +66,19 @@ class PushProgram:
 class PushEngine:
     """Compiled frontier iterations for one ShardedGraph + PushProgram."""
 
-    def __init__(self, sg: ShardedGraph, program: PushProgram, mesh=None):
+    def __init__(self, sg: ShardedGraph, program: PushProgram, mesh=None,
+                 layout: str = "tiled", tile_w: int = 128,
+                 tile_e: int = 512):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
                 f"{mesh.devices.size}")
+        from lux_tpu.engine.pull import build_graph_arrays
         self.sg = sg
         self.program = program
         self.mesh = mesh
-        arrays = dict(
-            src_slot=jnp.asarray(sg.src_slot),
-            dst_local=jnp.asarray(sg.dst_local),
-            weight=(jnp.asarray(sg.edge_weight) if sg.weighted else None),
-            deg=jnp.asarray(sg.deg_padded),
-            vmask=jnp.asarray(sg.vmask),
-        )
+        arrays, self.tiles = build_graph_arrays(
+            sg, layout, needs_dst=False, tile_w=tile_w, tile_e=tile_e)
         if mesh is not None:
             arrays = shard_over_parts(mesh, arrays)
         self.arrays = arrays
@@ -102,35 +99,34 @@ class PushEngine:
     # -- one iteration over this device's parts ------------------------
 
     def _iter_parts(self, label, active, full_label, full_active, g):
-        sg, prog = self.sg, self.program
+        sg, prog, lay = self.sg, self.program, self.tiles
         flat_l = full_label.reshape(-1)
         flat_a = full_active.reshape(-1)
 
-        def one(src_slot, dst_local, weight, old, vmask):
-            src_l = jnp.take(flat_l, src_slot, axis=0)
-            src_a = jnp.take(flat_a, src_slot, axis=0)
-            cand = prog.relax(src_l, weight)
+        def one(old, g):
+            src_l = jnp.take(flat_l, g["src_slot"], axis=0)
+            src_a = jnp.take(flat_a, g["src_slot"], axis=0)
+            cand = prog.relax(src_l, g.get("weight"))
             ident = jnp.asarray(prog.identity, cand.dtype)
             cand = jnp.where(src_a, cand, ident)
-            red = segment_reduce(cand, dst_local, sg.vpad + 1,
-                                 prog.reduce)[:sg.vpad]
-            improved = prog.better(red, old) & vmask
+            if lay is None:
+                red = segment_reduce(cand, g["dst_local"], sg.vpad + 1,
+                                     prog.reduce)[:sg.vpad]
+            else:
+                red = tiled_segment_reduce(
+                    cand, lay, g["chunk_start"], g["last_chunk"],
+                    g["rel_dst"], sg.vpad, prog.reduce)
+            improved = prog.better(red, old) & g["vmask"]
             new = jnp.where(improved, red, old)
             return new, improved
 
-        if g["weight"] is not None:
-            return jax.vmap(one)(g["src_slot"], g["dst_local"],
-                                 g["weight"], label, g["vmask"])
-        return jax.vmap(lambda s, d, o, vm: one(s, d, None, o, vm))(
-            g["src_slot"], g["dst_local"], label, g["vmask"])
+        return jax.vmap(one)(label, g)
 
     # -- compiled whole-run / single-step ------------------------------
 
     def _build(self, converge: bool):
-        a = self.arrays
-        has_w = a["weight"] is not None
-        keys = [k for k in _GRAPH_KEYS if not (k == "weight" and not has_w)]
-        graph_args = tuple(a[k] for k in keys)
+        keys = sorted(self.arrays)
+        graph_args = tuple(self.arrays[k] for k in keys)
         on_mesh = self.mesh is not None
 
         def global_sum(x):
@@ -150,8 +146,7 @@ class PushEngine:
             return new_label, new_active
 
         def inner(label, active, max_iters, *gargs):
-            g = dict(zip(keys, gargs), **({} if has_w
-                                          else {"weight": None}))
+            g = dict(zip(keys, gargs))
             if not converge:
                 new_label, new_active = body(label, active, g)
                 return new_label, new_active, global_sum(new_active)
@@ -173,7 +168,6 @@ class PushEngine:
 
         if on_mesh:
             P = PartitionSpec
-            n_in = 2 + len(keys)
             inner = jax.shard_map(
                 inner, mesh=self.mesh,
                 in_specs=(P(PARTS_AXIS), P(PARTS_AXIS), P()) +

@@ -1,0 +1,197 @@
+"""Tiled (chunked) edge layout and scatter-free segment reduction.
+
+The portable ``ops.segment.segment_reduce`` lowers to an XLA scatter,
+which TPUs execute (near-)serially — measured ~0.05 GTEPS on the hot
+loop.  This module is the TPU-native replacement for the reference's
+CUB BlockScan + atomic scatter CTA pattern (reference
+pagerank_gpu.cu:49-102, SURVEY.md §3.3): the host re-lays each
+partition's dst-sorted edges into fixed-shape chunks bound to output
+vertex tiles, so the device-side reduction is nothing but dense,
+static-shape VPU/MXU work plus one short segmented scan:
+
+- Output vertices are grouped into tiles of ``W``; edges (already
+  dst-sorted and therefore tile-contiguous) are padded so each tile
+  owns a whole number of ``E``-edge chunks -> arrays ``[C, E]``.
+- Within a chunk, every edge's destination is a *relative* index in
+  ``[0, W)`` (``W`` marks padding lanes).  The chunk's partial result
+  ``[W]`` is a masked broadcast-reduce (VPU) or a one-hot matmul (MXU)
+  — both fuse in XLA, neither scatters.
+- Chunks of the same tile are combined with a segmented
+  ``associative_scan`` over the chunk axis (flag-reset, exact — no
+  cumsum boundary-difference cancellation), then the last chunk of
+  each tile is gathered.  When every tile fits in one chunk the scan
+  is skipped statically.
+
+Degree skew (the Twitter/RMAT power-law "hard part", SURVEY.md §7) is
+absorbed by construction: a hub vertex simply owns many chunks, and
+every chunk is the same shape — the TPU analogue of the reference's
+edge-parallel load balancing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.ops.segment import identity_for
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+@dataclasses.dataclass
+class TiledLayout:
+    """Host-side chunk plan for one partitioned graph (stacked over
+    parts; all chunk arrays are ``[num_parts, C, ...]``)."""
+
+    W: int                      # vertices per output tile
+    E: int                      # edges per chunk
+    n_tiles: int                # ceil(vpad / W), same for every part
+    n_chunks: int               # padded chunk count C (max over parts)
+    needs_scan: bool            # False when every tile fits in 1 chunk
+    edge_gather: np.ndarray     # int64 [P, C, E] index into flat [epad]
+    rel_dst: np.ndarray         # int32 [P, C, E] in [0, W]; W = pad lane
+    chunk_tile: np.ndarray      # int32 [P, C] owning tile; n_tiles = pad
+    chunk_start: np.ndarray     # bool  [P, C] True at each tile's 1st chunk
+    last_chunk: np.ndarray      # int32 [P, n_tiles] index of tile's last
+                                #   chunk, -1 for edge-less tiles
+
+    @classmethod
+    def build(cls, row_ptr_local: np.ndarray, dst_local: np.ndarray,
+              vpad: int, W: int = 128, E: int = 512) -> "TiledLayout":
+        """row_ptr_local: int [P, vpad+1] END offsets; dst_local:
+        int32 [P, epad] part-local sorted destinations (pad -> vpad)."""
+        P = row_ptr_local.shape[0]
+        n_tiles = max(1, _ceil_div(vpad, W))
+
+        per_part = []
+        for p in range(P):
+            rp = row_ptr_local[p].astype(np.int64)
+            tile_lo = rp[np.minimum(np.arange(n_tiles) * W, vpad)]
+            tile_hi = rp[np.minimum((np.arange(n_tiles) + 1) * W, vpad)]
+            n_ch = np.maximum(0, _ceil_div_arr(tile_hi - tile_lo, E))
+            per_part.append((tile_lo, tile_hi, n_ch))
+
+        C = max(1, int(max(int(x[2].sum()) for x in per_part)))
+
+        edge_gather = np.zeros((P, C, E), dtype=np.int64)
+        rel_dst = np.full((P, C, E), W, dtype=np.int32)
+        chunk_tile = np.full((P, C), n_tiles, dtype=np.int32)
+        chunk_start = np.ones((P, C), dtype=bool)   # pad chunks isolated
+        last_chunk = np.full((P, n_tiles), -1, dtype=np.int32)
+        needs_scan = False
+
+        lanes = np.arange(E, dtype=np.int64)
+        for p in range(P):
+            tile_lo, tile_hi, n_ch = per_part[p]
+            if n_ch.max(initial=0) > 1:
+                needs_scan = True
+            ci = 0
+            for t in range(n_tiles):
+                for j in range(int(n_ch[t])):
+                    start = tile_lo[t] + j * E
+                    idx = start + lanes
+                    valid = idx < tile_hi[t]
+                    idx = np.where(valid, idx, 0)
+                    edge_gather[p, ci] = idx
+                    rel_dst[p, ci] = np.where(
+                        valid, dst_local[p, idx] - t * W, W)
+                    chunk_tile[p, ci] = t
+                    chunk_start[p, ci] = (j == 0)
+                    ci += 1
+                if n_ch[t] > 0:
+                    last_chunk[p, t] = ci - 1
+
+        return cls(W=W, E=E, n_tiles=n_tiles, n_chunks=C,
+                   needs_scan=needs_scan, edge_gather=edge_gather,
+                   rel_dst=rel_dst, chunk_tile=chunk_tile,
+                   chunk_start=chunk_start, last_chunk=last_chunk)
+
+    def chunk(self, flat: np.ndarray) -> np.ndarray:
+        """Re-lay a per-part flat edge array [P, epad, ...] into chunk
+        form [P, C, E, ...] (host, done once at build time)."""
+        parts = np.arange(flat.shape[0])[:, None, None]
+        return flat[parts, self.edge_gather]
+
+
+def _ceil_div_arr(a, b):
+    return (a + b - 1) // b
+
+
+def _combine(kind: str):
+    return {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}[kind]
+
+
+def chunk_partials(vals, rel_dst, W: int, kind: str, use_mxu: bool = False):
+    """Per-chunk reduction [C, E, ...] -> [C, W, ...].
+
+    use_mxu=True (sum only) contracts against a one-hot matrix on the
+    MXU — profitable for wide vector payloads (e.g. colfilter's K=20
+    factors); the default masked broadcast-reduce stays on the VPU and
+    fuses without materializing the [C, E, W] intermediate.
+    """
+    if use_mxu:
+        if kind != "sum":
+            raise ValueError("MXU one-hot path only supports 'sum'")
+        onehot = (rel_dst[..., None] ==
+                  jnp.arange(W, dtype=rel_dst.dtype)).astype(vals.dtype)
+        # [C, E, ...] x [C, E, W] -> [C, W, ...]
+        return jnp.einsum("ce...,cew->cw...", vals, onehot)
+    ident = identity_for(kind, vals.dtype)
+    match = rel_dst[..., None] == jnp.arange(W, dtype=rel_dst.dtype)
+    if vals.ndim > 2:                       # vector payload [C, E, K]
+        match = match[:, :, None, :]        # [C, E, 1, W]
+        masked = jnp.where(match, vals[..., None], ident)
+        red = _reduce_axis(masked, 1, kind)     # [C, K, W]
+        return jnp.moveaxis(red, -1, 1)         # [C, W, K]
+    masked = jnp.where(match, vals[..., None], ident)   # [C, E, W]
+    return _reduce_axis(masked, 1, kind)
+
+
+def _reduce_axis(x, axis, kind):
+    return {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[kind](
+        x, axis=axis)
+
+
+def combine_chunks(partials, layout: TiledLayout, chunk_start, last_chunk,
+                   kind: str):
+    """Segmented combine of per-chunk partials [C, W, ...] into tile
+    results [n_tiles, W, ...]; chunk_start/last_chunk are this part's
+    rows of the layout arrays (device)."""
+    if layout.needs_scan:
+        flags = chunk_start.reshape(
+            chunk_start.shape + (1,) * (partials.ndim - 1))
+        comb = _combine(kind)
+
+        def op(a, b):
+            va, fa = a
+            vb, fb = b
+            return jnp.where(fb, vb, comb(va, vb)), fa | fb
+
+        partials, _ = jax.lax.associative_scan(
+            op, (partials, jnp.broadcast_to(flags, partials.shape)))
+    ident = identity_for(kind, partials.dtype)
+    out = jnp.take(partials, jnp.maximum(last_chunk, 0), axis=0)
+    empty = (last_chunk < 0).reshape(
+        last_chunk.shape + (1,) * (out.ndim - 1))
+    return jnp.where(empty, ident, out)
+
+
+def tiled_segment_reduce(vals, layout: TiledLayout, chunk_start,
+                         last_chunk, rel_dst, vpad: int, kind: str,
+                         use_mxu: bool = False):
+    """Full scatter-free segment reduce for ONE part.
+
+    vals [C, E, ...] chunked edge messages; returns [vpad, ...] —
+    drop-in for ``segment_reduce(msgs, dst_local, vpad+1, kind)[:vpad]``.
+    """
+    partials = chunk_partials(vals, rel_dst, layout.W, kind,
+                              use_mxu=use_mxu)
+    tiles = combine_chunks(partials, layout, chunk_start, last_chunk,
+                           kind)
+    flatshape = (layout.n_tiles * layout.W,) + tiles.shape[2:]
+    return tiles.reshape(flatshape)[:vpad]
