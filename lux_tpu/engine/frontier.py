@@ -1,0 +1,112 @@
+"""Sparse-frontier machinery for the push engine.
+
+The reference keeps per-partition frontier queues with a
+dense-bitmap / sparse-queue state machine and switches representation
+on occupancy (reference graph.h:100-106, sssp_gpu.cu:408-491,
+SURVEY.md §3.4).  On TPU, variable-size queues fight XLA's static
+shapes, so the design is:
+
+- The CANONICAL frontier is always the dense bool mask (shape-stable,
+  trivially all-gatherable).  The sparse path is an *execution
+  strategy*, not a distinct representation: when the active count is
+  small, the step compacts the mask into a capacity-bounded padded
+  queue of (vertex slot, label) pairs and relaxes ONLY the frontier's
+  out-edges — a fixed edge budget ``EB`` of work instead of a full
+  pass over every edge.
+- Queue capacity mirrors the reference's sizing rule
+  (``part_nv/SPARSE_THRESHOLD + 100``, push_model.inl:393-397); the
+  caller falls back to the dense step (lax.cond) when the frontier
+  overflows either the queue or the edge budget, which is exactly the
+  reference's sparse->dense overflow transition (sssp_gpu.cu:485-490).
+- Labels ride along with vertex ids in the queue (the reference
+  gathers them from the all-parts dist region instead), so multi-chip
+  sparse iterations exchange O(queue) bytes over ICI, not O(nv).
+
+Everything here is per-part, static-shape, and built from sorted
+cumsum/gather primitives — no data-dependent shapes anywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def compact_mask(mask, labels, capacity: int):
+    """Dense bool mask [vpad] -> padded queue.
+
+    Returns (ids int32 [capacity], vals [capacity], count int32).
+    ids[i] for i >= count is vpad (an invalid slot); callers mask on
+    position < count.  If count > capacity the queue is truncated —
+    callers must branch to the dense path in that case.
+    """
+    vpad = mask.shape[0]
+    ranks = jnp.cumsum(mask.astype(jnp.int32))          # 1-based
+    count = ranks[-1]
+    # i-th set bit = first position whose running count reaches i+1;
+    # vectorized binary search over the monotone ranks array.
+    want = jnp.arange(capacity, dtype=jnp.int32) + 1
+    ids = jnp.searchsorted(ranks, want, side="left",
+                           method="scan_unrolled").astype(jnp.int32)
+    valid = want <= count
+    ids = jnp.where(valid, ids, vpad)
+    vals = jnp.take(labels, jnp.minimum(ids, vpad - 1), axis=0)
+    return ids, vals, count
+
+
+def expand_frontier(ids, vals, in_row_ptr, edge_budget: int):
+    """Map a gathered queue to its out-edge slots in this part.
+
+    ids   int32 [Q]  vertex GLOBAL ids (graph numbering), nv = invalid
+    vals  [Q]        the queue vertices' labels
+    in_row_ptr int   [nv+1] END offsets into this part's src-sorted
+                     edge arrays (ShardedGraph.src_sorted)
+    Returns (edge_idx int32 [EB], src_val [EB], in_range bool [EB],
+             total int32) where edge_idx indexes the part's src-sorted
+    edge arrays, src_val is the owning queue item's label, and total is
+    the real number of frontier out-edges in this part (may exceed EB —
+    callers must then use the dense path; entries past ``total`` are
+    masked by in_range).
+    """
+    nv = in_row_ptr.shape[0] - 1
+    Q = ids.shape[0]
+    safe = jnp.minimum(ids, nv - 1)
+    begin = jnp.take(in_row_ptr, safe, axis=0)
+    end = jnp.take(in_row_ptr, safe + 1, axis=0)
+    deg = jnp.where(ids < nv, (end - begin).astype(jnp.int32), 0)
+    off = jnp.cumsum(deg)                       # END offsets per item
+    total = off[-1]
+    start = off - deg                           # begin offset per item
+    # Owner of each edge slot via the CSR-expand trick: drop each
+    # item's 1-based queue index at its first slot, then a running max
+    # spreads it across the item's extent.  (Items with deg > 0 have
+    # distinct starts, so the scatter-max never collides.)
+    marks = jnp.zeros((edge_budget + 1,), jnp.int32)
+    qidx = jnp.arange(Q, dtype=jnp.int32) + 1
+    marks = marks.at[jnp.minimum(start, edge_budget)].max(
+        jnp.where(deg > 0, qidx, 0))
+    owner = jax.lax.cummax(marks[:edge_budget]) - 1      # [EB]
+    owner = jnp.maximum(owner, 0)
+    slot = jnp.arange(edge_budget, dtype=off.dtype)
+    in_range = slot < jnp.minimum(total, edge_budget)
+    within = slot - jnp.take(start, owner, axis=0)
+    edge_idx = (jnp.take(begin, owner, axis=0) + within).astype(jnp.int32)
+    edge_idx = jnp.where(in_range, edge_idx, 0)
+    src_val = jnp.take(vals, owner, axis=0)
+    return edge_idx, src_val, in_range, total
+
+
+def scatter_reduce(labels, dst_local, cand, kind: str):
+    """Scatter-combine candidates into per-part labels.
+
+    dst_local indexes [0, vpad); out-of-frontier lanes should carry the
+    reduction identity so they are no-ops.  Unsorted scatter — only used
+    on the bounded sparse edge budget, never on full edge arrays.
+    """
+    vpad = labels.shape[0]
+    safe = jnp.minimum(dst_local, vpad - 1)
+    if kind == "min":
+        return labels.at[safe].min(cand, mode="drop")
+    if kind == "max":
+        return labels.at[safe].max(cand, mode="drop")
+    raise ValueError(f"unsupported sparse reduce {kind!r}")

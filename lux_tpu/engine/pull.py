@@ -39,6 +39,18 @@ from lux_tpu.ops.tiled import TiledLayout, tiled_segment_reduce
 from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
 
 
+def resolve_reduce_method(method: str) -> str:
+    """'auto' picks the Pallas kernel on real TPUs and the portable
+    XLA formulation elsewhere (including the CPU test mesh);
+    'pallas-interpret' forces the kernel in interpreter mode so its
+    code path is testable off-TPU."""
+    if method == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if method in ("xla", "pallas", "pallas-interpret"):
+        return method
+    raise ValueError(f"unknown reduce_method {method!r}")
+
+
 def build_graph_arrays(sg: ShardedGraph, layout: str, needs_dst: bool,
                        tile_w: int, tile_e: int):
     """Device-ready per-part graph arrays (all leading dim num_parts)
@@ -77,7 +89,8 @@ class PullEngine:
 
     def __init__(self, sg: ShardedGraph, program: PullProgram, mesh=None,
                  layout: str = "tiled", tile_w: int = 128,
-                 tile_e: int = 512, use_mxu: bool = False):
+                 tile_e: int = 512, use_mxu: bool = False,
+                 reduce_method: str = "auto"):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -86,6 +99,7 @@ class PullEngine:
         self.program = program
         self.mesh = mesh
         self.use_mxu = use_mxu
+        self.reduce_method = resolve_reduce_method(reduce_method)
         arrays, self.tiles = build_graph_arrays(
             sg, layout, program.needs_dst, tile_w, tile_e)
         if mesh is not None:
@@ -122,9 +136,20 @@ class PullEngine:
             red = segment_reduce(msgs, g["dst_local"], sg.vpad + 1,
                                  prog.reduce)[:sg.vpad]
         else:
+            if self.reduce_method == "xla" or msgs.ndim != 2:
+                # Keep the (serial, expensive) gather from being fused
+                # into the W-wide broadcast consumer, which re-executes
+                # it per output lane — measured 3-5x slower on v5e.
+                # The Pallas kernel is an opaque boundary and needs no
+                # barrier.
+                msgs = jax.lax.optimization_barrier(msgs)
             red = tiled_segment_reduce(
                 msgs, lay, g["chunk_start"], g["last_chunk"],
-                g["rel_dst"], sg.vpad, prog.reduce, use_mxu=self.use_mxu)
+                g["rel_dst"], sg.vpad, prog.reduce, use_mxu=self.use_mxu,
+                method=("xla" if msgs.ndim != 2 else
+                        "pallas" if self.reduce_method.startswith("pallas")
+                        else "xla"),
+                interpret=self.reduce_method == "pallas-interpret")
         ctx = PartCtx(deg=g["deg"], vmask=g["vmask"], nv=sg.nv, ne=sg.ne)
         new = prog.apply(old_p, red, ctx)
         keep = g["vmask"].reshape(g["vmask"].shape +

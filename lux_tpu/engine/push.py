@@ -7,26 +7,40 @@ zero-copy memory each iteration, pipelines SLIDING_WINDOW=4 launches,
 and halts when every part's future reports an empty frontier
 (sssp.cc:115-129).
 
-The TPU-native design dissolves all of that machinery:
+The TPU-native design:
 
-- The frontier is a dense boolean mask in the padded part-major vertex
-  layout — a shape-stable array that all-gathers trivially over ICI
-  (SURVEY.md §7 "sparse frontiers" hard part).  Inactive sources are
-  masked to the reduction identity, so converged regions cost no HBM
-  traffic beyond the mask read.
+- The CANONICAL frontier is a dense boolean mask in the padded
+  part-major vertex layout — a shape-stable array that all-gathers
+  trivially over ICI (SURVEY.md §7 "sparse frontiers" hard part).
+- Each iteration picks one of two execution strategies with a real
+  ``lax.cond`` branch (the analogue of the reference's adaptive
+  pull/push switch on ``frontier > nv/16``, sssp_gpu.cu:414-421):
+  * DENSE: masked pull over every edge — inactive sources contribute
+    the reduction identity (tiled scatter-free segment reduction).
+  * SPARSE: compact the mask into capacity-bounded padded queues of
+    (vertex, label) pairs, exchange the queues (all-gather over ICI —
+    O(queue) bytes, not O(nv)), and relax only the frontier's
+    out-edges through the src-sorted CSR view (engine/frontier.py).
+  The cond predicate is replicated (a psum), so the branch stays a
+  branch — it is deliberately hoisted OUTSIDE the per-part vmap,
+  where it would decay into select-both-sides.
+- Sparse overflow safety: when a frontier's out-edges exceed the
+  static edge budget, the un-expanded queue suffix simply STAYS
+  ACTIVE (the globally-agreed processed prefix is cleared via a
+  pmin), so truncation degrades performance, never correctness —
+  the reference instead re-densifies on queue overflow
+  (sssp_gpu.cu:485-490).
 - The ENTIRE convergence run is one XLA program: ``lax.while_loop``
   whose predicate is a ``psum`` of active counts.  There is no
   device->host sync per iteration at all, so the reference's
-  sliding-window latency-hiding trick is unnecessary by construction.
-- A stepwise mode (one compiled step per call, returning the active
-  count) exists for verbose per-iteration observability — the analogue
-  of the reference's -verbose per-part timing (sssp_gpu.cu:516-518).
+  SLIDING_WINDOW=4 latency-hiding trick is unnecessary by
+  construction.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import functools
+
 from typing import Any, Callable
 
 import jax
@@ -34,11 +48,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
-from lux_tpu.engine.program import PartCtx
+from lux_tpu.engine import frontier as fr
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
 from lux_tpu.ops.tiled import tiled_segment_reduce
 from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
+from lux_tpu.partition import frontier_capacity
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,7 +83,9 @@ class PushEngine:
 
     def __init__(self, sg: ShardedGraph, program: PushProgram, mesh=None,
                  layout: str = "tiled", tile_w: int = 128,
-                 tile_e: int = 512):
+                 tile_e: int = 512, enable_sparse: bool = True,
+                 sparse_threshold: int = 16,
+                 edge_budget: int | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -77,8 +94,30 @@ class PushEngine:
         self.sg = sg
         self.program = program
         self.mesh = mesh
+        self.sparse_threshold = sparse_threshold
         arrays, self.tiles = build_graph_arrays(
             sg, layout, needs_dst=False, tile_w=tile_w, tile_e=tile_e)
+        self.enable_sparse = enable_sparse
+        if enable_sparse:
+            ss = sg.src_sorted()
+            # Reference queue sizing rule (push_model.inl:393-397).
+            self.queue_cap = frontier_capacity(sg.vpad, sparse_threshold)
+            # The edge budget must cover any single vertex's out-edges
+            # within one part, or a truncated hub could make zero
+            # progress forever (see module docstring).
+            max_deg = int(np.max(np.diff(ss["in_row_ptr"], axis=1))) \
+                if sg.ne else 1
+            default_eb = max(1024, sg.epad // sparse_threshold)
+            self.edge_budget = int(edge_budget if edge_budget is not None
+                                   else max(default_eb, max_deg + 128))
+            arrays = dict(arrays,
+                          in_row_ptr=jnp.asarray(
+                              ss["in_row_ptr"].astype(np.int32)),
+                          ss_dst=jnp.asarray(ss["ss_dst"]),
+                          part_start=jnp.asarray(
+                              sg.starts[:-1].astype(np.int32)[:, None]))
+            if ss["ss_weight"] is not None:
+                arrays["ss_weight"] = jnp.asarray(ss["ss_weight"])
         if mesh is not None:
             arrays = shard_over_parts(mesh, arrays)
         self.arrays = arrays
@@ -96,9 +135,9 @@ class PushEngine:
             active = jax.device_put(active, parts_spec(self.mesh))
         return label, active
 
-    # -- one iteration over this device's parts ------------------------
+    # -- dense iteration over this device's parts ----------------------
 
-    def _iter_parts(self, label, active, full_label, full_active, g):
+    def _dense_parts(self, label, active, full_label, full_active, g):
         sg, prog, lay = self.sg, self.program, self.tiles
         flat_l = full_label.reshape(-1)
         flat_a = full_active.reshape(-1)
@@ -109,6 +148,7 @@ class PushEngine:
             cand = prog.relax(src_l, g.get("weight"))
             ident = jnp.asarray(prog.identity, cand.dtype)
             cand = jnp.where(src_a, cand, ident)
+            cand = jax.lax.optimization_barrier(cand)
             if lay is None:
                 red = segment_reduce(cand, g["dst_local"], sg.vpad + 1,
                                      prog.reduce)[:sg.vpad]
@@ -120,7 +160,104 @@ class PushEngine:
             new = jnp.where(improved, red, old)
             return new, improved
 
-        return jax.vmap(one)(label, g)
+        dense_keys = [k for k in ("src_slot", "dst_local", "weight",
+                                  "rel_dst", "chunk_start", "last_chunk",
+                                  "chunk_tile", "vmask", "deg")
+                      if k in g]
+        return jax.vmap(one)(label, {k: g[k] for k in dense_keys})
+
+    # -- sparse iteration ----------------------------------------------
+
+    def _sparse_parts(self, label, active, g, gather_fn, pmin_fn):
+        """One frontier-queue iteration over this device's parts.
+
+        gather_fn concatenates per-part queue arrays across the whole
+        mesh (identity + reshape on a single device); pmin_fn reduces a
+        scalar with min across the mesh.
+        """
+        sg, prog = self.sg, self.program
+        Q, EB = self.queue_cap, self.edge_budget
+        nv = sg.nv
+
+        # 1. compact each local part's mask into a (global id, label)
+        #    queue.
+        def compact(mask, lab, start):
+            ids, vals, cnt = fr.compact_mask(mask, lab, Q)
+            gids = jnp.where(ids < sg.vpad, start[0] + ids, nv)
+            return gids.astype(jnp.int32), vals, cnt
+
+        gids, vals, cnts = jax.vmap(compact)(
+            active, label, g["part_start"])
+
+        # 2. exchange queues: [P_total * Q] flat, part-major order
+        #    (identical on every device).
+        all_gids = gather_fn(gids).reshape(-1)
+        all_vals = gather_fn(vals).reshape(-1)
+
+        # 3. each part relaxes the gathered frontier's edges that land
+        #    in its partition, through its src-sorted CSR view.
+        def relax_part(lab, rowp, ssd, ssw):
+            edge_idx, src_val, in_range, _total = fr.expand_frontier(
+                all_gids, all_vals, rowp, EB)
+            dst = jnp.take(ssd, edge_idx, axis=0)
+            w = jnp.take(ssw, edge_idx, axis=0) if ssw is not None \
+                else None
+            cand = prog.relax(src_val, w)
+            ident = jnp.asarray(prog.identity, cand.dtype)
+            cand = jnp.where(in_range & (dst < sg.vpad), cand, ident)
+            dst = jnp.where(in_range, dst, sg.vpad - 1)
+            new = fr.scatter_reduce(lab, dst, cand, prog.reduce)
+            improved = prog.better(new, lab)
+            # number of fully-expanded queue items (flat prefix)
+            safe = jnp.minimum(all_gids, nv - 1)
+            deg = jnp.where(all_gids < nv,
+                            (jnp.take(rowp, safe + 1, axis=0) -
+                             jnp.take(rowp, safe, axis=0)), 0)
+            off = jnp.cumsum(deg)
+            done = jnp.searchsorted(off, jnp.asarray(EB, off.dtype),
+                                    side="right",
+                                    method="scan_unrolled")
+            return new, improved, done.astype(jnp.int32)
+
+        ssw = g.get("ss_weight")
+        if ssw is None:
+            new_label, improved, done = jax.vmap(
+                lambda lab, rowp, ssd: relax_part(lab, rowp, ssd, None))(
+                label, g["in_row_ptr"], g["ss_dst"])
+        else:
+            new_label, improved, done = jax.vmap(relax_part)(
+                label, g["in_row_ptr"], g["ss_dst"], ssw)
+        improved = improved & g["vmask"]
+
+        # 4. clear the globally-agreed processed prefix of the queue;
+        #    everything else stays active (truncation safety).
+        done_min = pmin_fn(jnp.min(done))
+
+        # ids are global; convert back to local slots for clearing
+        def clear_local(mask, gid, cnt, start, pidx):
+            pos = jnp.arange(Q, dtype=jnp.int32)
+            flat_base = pidx * Q
+            processed = (flat_base + pos < done_min) & (pos < cnt) & \
+                (gid < nv)
+            loc = jnp.clip(gid - start[0], 0, sg.vpad - 1)
+            upd = jnp.zeros((sg.vpad,), bool).at[loc].max(
+                processed, mode="drop")
+            return mask & ~upd
+
+        pidx = self._part_index()
+        cleared = jax.vmap(clear_local)(active, gids, cnts,
+                                        g["part_start"], pidx)
+        new_active = improved | cleared
+        return new_label, new_active
+
+    def _part_index(self):
+        """Global part index of this device's parts [P_local] int32."""
+        P_local = self.sg.num_parts if self.mesh is None else \
+            self.sg.num_parts // self.mesh.devices.size
+        base = jnp.int32(0)
+        if self.mesh is not None:
+            base = jax.lax.axis_index(PARTS_AXIS) * P_local
+        return base + jnp.arange(P_local, dtype=jnp.int32)
 
     # -- compiled whole-run / single-step ------------------------------
 
@@ -128,6 +265,8 @@ class PushEngine:
         keys = sorted(self.arrays)
         graph_args = tuple(self.arrays[k] for k in keys)
         on_mesh = self.mesh is not None
+        sg, prog = self.sg, self.program
+        use_sparse = self.enable_sparse and prog.reduce in ("min", "max")
 
         def global_sum(x):
             s = jnp.sum(x)
@@ -135,20 +274,43 @@ class PushEngine:
                 s = jax.lax.psum(s, PARTS_AXIS)
             return s
 
-        def body(label, active, g):
+        def gather_fn(x):
+            if on_mesh:
+                return jax.lax.all_gather(x, PARTS_AXIS, tiled=True)
+            return x
+
+        def pmin_fn(x):
+            if on_mesh:
+                return jax.lax.pmin(x, PARTS_AXIS)
+            return x
+
+        def dense_body(label, active, g):
             if on_mesh:
                 full_l = jax.lax.all_gather(label, PARTS_AXIS, tiled=True)
                 full_a = jax.lax.all_gather(active, PARTS_AXIS, tiled=True)
             else:
                 full_l, full_a = label, active
-            new_label, new_active = self._iter_parts(
-                label, active, full_l, full_a, g)
-            return new_label, new_active
+            return self._dense_parts(label, active, full_l, full_a, g)
+
+        def body(label, active, count, g):
+            if not use_sparse:
+                return dense_body(label, active, g)
+            # Reference heuristic: frontier > nv/16 -> dense/pull mode
+            # (sssp_gpu.cu:414), and the queue must fit.
+            q_fits = count <= jnp.int32(
+                min(self.queue_cap,
+                    max(1, sg.nv // self.sparse_threshold)))
+            return jax.lax.cond(
+                q_fits,
+                lambda: self._sparse_parts(label, active, g, gather_fn,
+                                           pmin_fn),
+                lambda: dense_body(label, active, g))
 
         def inner(label, active, max_iters, *gargs):
             g = dict(zip(keys, gargs))
             if not converge:
-                new_label, new_active = body(label, active, g)
+                cnt0 = global_sum(active)
+                new_label, new_active = body(label, active, cnt0, g)
                 return new_label, new_active, global_sum(new_active)
 
             def cond(c):
@@ -156,8 +318,8 @@ class PushEngine:
                 return (cnt > 0) & (it < max_iters)
 
             def wbody(c):
-                it, lbl, act, _ = c
-                nl, na = body(lbl, act, g)
+                it, lbl, act, cnt = c
+                nl, na = body(lbl, act, cnt, g)
                 return it + 1, nl, na, global_sum(na)
 
             it0 = jnp.int32(0)

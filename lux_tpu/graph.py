@@ -186,6 +186,49 @@ class ShardedGraph:
                    vmask=vmask, deg_padded=deg_padded,
                    weighted=g.weights is not None)
 
+    # ---- push-model (src-sorted) edge view ---------------------------
+
+    _src_sorted_cache: tuple | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def src_sorted(self):
+        """Per-part edges re-sorted by GLOBAL source id — the dual CSR
+        view the reference's push init builds on device with atomic
+        degree counting (reference sssp_gpu.cu:550-607, and the
+        nv-wide per-part row pointers of push_model.inl:321-324).
+        Here it is host-side preprocessing, done once and cached.
+
+        Returns dict of numpy arrays:
+          in_row_ptr  int64 [num_parts, nv+1]  END offsets into the
+                      part's src-sorted edge list, indexed by global src
+          ss_dst      int32 [num_parts, epad]  part-local dst, pad->vpad
+          ss_weight   float32 [num_parts, epad] or None
+        """
+        if self._src_sorted_cache is not None:
+            return self._src_sorted_cache
+        P = self.num_parts
+        in_row_ptr = np.zeros((P, self.nv + 1), dtype=np.int64)
+        ss_dst = np.full((P, self.epad), self.vpad, dtype=np.int32)
+        ss_weight = (np.zeros((P, self.epad), dtype=np.float32)
+                     if self.weighted else None)
+        for p in range(P):
+            nep = int(self.ne_part[p])
+            # global src of each real edge: src_slot is part-major slot;
+            # invert the slot translation
+            slot = self.src_slot[p, :nep].astype(np.int64)
+            sp = slot // self.vpad
+            src = self.starts[sp] + (slot - sp * self.vpad)
+            order = np.argsort(src, kind="stable")
+            src_sorted = src[order]
+            ss_dst[p, :nep] = self.dst_local[p, :nep][order]
+            if ss_weight is not None:
+                ss_weight[p, :nep] = self.edge_weight[p, :nep][order]
+            counts = np.bincount(src_sorted, minlength=self.nv)
+            in_row_ptr[p] = np.concatenate(([0], np.cumsum(counts)))
+        self._src_sorted_cache = dict(in_row_ptr=in_row_ptr,
+                                      ss_dst=ss_dst, ss_weight=ss_weight)
+        return self._src_sorted_cache
+
     # ---- state layout conversion -------------------------------------
 
     def to_padded(self, x: np.ndarray) -> np.ndarray:

@@ -1,0 +1,84 @@
+"""Pallas TPU kernel for the per-chunk segment partial reduction.
+
+This is the hot loop of every vertex program — the TPU replacement for
+the reference's CUB BlockScan + atomic scatter CTA pattern
+(reference pagerank_gpu.cu:49-102, sssp_gpu.cu:148-244; SURVEY.md
+§3.3).  It consumes the tiled chunk layout of ops/tiled.py: edge
+messages ``vals [C, E]`` with relative destinations ``rel_dst [C, E]``
+in ``[0, W]`` (W = padding lane) and produces per-chunk partials
+``[C, W]``, which ops/tiled.combine_chunks folds into vertex tiles.
+
+Why a kernel instead of the XLA broadcast-compare reduction
+(ops/tiled.chunk_partials):
+
+- The ``[C, E, W]`` one-hot intermediate stays in VMEM one grid block
+  at a time instead of spilling W× the edge data to HBM.
+- ``pallas_call`` is an opaque custom call, so XLA cannot fuse the
+  (serial, expensive) source-state gather that produces ``vals`` into
+  the W-wide broadcast — re-executing the gather per output lane —
+  which it measurably does to the pure-XLA formulation on TPU v5e.
+
+The kernel is shape-generic over the reduction kind (sum/min/max) and
+runs in interpret mode off-TPU so the same code path is testable on
+CPU (tests/test_pallas_reduce.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lux_tpu.ops.segment import identity_for
+
+
+def _partial_kernel(vals_ref, rel_ref, out_ref, *, W: int, kind: str):
+    vals = vals_ref[:]                                   # [B, E]
+    rel = rel_ref[:]                                     # [B, E]
+    B, E = vals.shape
+    ident = identity_for(kind, vals.dtype)
+    lanes = jax.lax.broadcasted_iota(rel.dtype, (B, E, W), 2)
+    match = rel[:, :, None] == lanes
+    masked = jnp.where(match, vals[:, :, None], ident)   # [B, E, W]
+    if kind == "sum":
+        out_ref[:] = jnp.sum(masked, axis=1)
+    elif kind == "min":
+        out_ref[:] = jnp.min(masked, axis=1)
+    elif kind == "max":
+        out_ref[:] = jnp.max(masked, axis=1)
+    else:
+        raise ValueError(f"unknown reduce kind {kind!r}")
+
+
+@functools.partial(jax.jit, static_argnames=("W", "kind", "block_c",
+                                             "interpret"))
+def chunk_partials_pallas(vals, rel_dst, W: int, kind: str,
+                          block_c: int = 8, interpret: bool = False):
+    """Per-chunk partial reduction [C, E] -> [C, W] on the TPU.
+
+    C must be a multiple of block_c (TiledLayout pads to this).
+    Scalar payloads only — vector payloads (colfilter) use the XLA
+    path, whose [C, E, W, K] broadcast XLA handles acceptably once the
+    gather is materialized.
+    """
+    C, E = vals.shape
+    if C % block_c:
+        raise ValueError(f"C={C} not a multiple of block_c={block_c}")
+    kern = functools.partial(_partial_kernel, W=W, kind=kind)
+    return pl.pallas_call(
+        kern,
+        grid=(C // block_c,),
+        in_specs=[
+            pl.BlockSpec((block_c, E), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_c, E), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_c, W), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C, W), vals.dtype),
+        interpret=interpret,
+    )(vals, rel_dst)

@@ -76,7 +76,11 @@ class TiledLayout:
             n_ch = np.maximum(0, _ceil_div_arr(tile_hi - tile_lo, E))
             per_part.append((tile_lo, tile_hi, n_ch))
 
+        # Pad the chunk count to the Pallas kernel's block granularity
+        # (pad chunks are isolated identity segments, dropped by the
+        # last-chunk gather).
         C = max(1, int(max(int(x[2].sum()) for x in per_part)))
+        C = _ceil_div(C, 8) * 8
 
         edge_gather = np.zeros((P, C, E), dtype=np.int64)
         rel_dst = np.full((P, C, E), W, dtype=np.int32)
@@ -90,21 +94,23 @@ class TiledLayout:
             tile_lo, tile_hi, n_ch = per_part[p]
             if n_ch.max(initial=0) > 1:
                 needs_scan = True
-            ci = 0
-            for t in range(n_tiles):
-                for j in range(int(n_ch[t])):
-                    start = tile_lo[t] + j * E
-                    idx = start + lanes
-                    valid = idx < tile_hi[t]
-                    idx = np.where(valid, idx, 0)
-                    edge_gather[p, ci] = idx
-                    rel_dst[p, ci] = np.where(
-                        valid, dst_local[p, idx] - t * W, W)
-                    chunk_tile[p, ci] = t
-                    chunk_start[p, ci] = (j == 0)
-                    ci += 1
-                if n_ch[t] > 0:
-                    last_chunk[p, t] = ci - 1
+            nc = int(n_ch.sum())
+            if nc == 0:
+                continue
+            # chunk -> owning tile, and chunk's index within that tile
+            ct = np.repeat(np.arange(n_tiles, dtype=np.int64), n_ch)
+            tile_first = np.concatenate(([0], np.cumsum(n_ch)[:-1]))
+            cj = np.arange(nc, dtype=np.int64) - tile_first[ct]
+            start = tile_lo[ct] + cj * E
+            idx = start[:, None] + lanes[None, :]          # [nc, E]
+            valid = idx < tile_hi[ct][:, None]
+            idx = np.where(valid, idx, 0)
+            edge_gather[p, :nc] = idx
+            rel_dst[p, :nc] = np.where(
+                valid, dst_local[p][idx] - (ct * W)[:, None], W)
+            chunk_tile[p, :nc] = ct
+            chunk_start[p, :nc] = cj == 0
+            last_chunk[p] = np.where(n_ch > 0, np.cumsum(n_ch) - 1, -1)
 
         return cls(W=W, E=E, n_tiles=n_tiles, n_chunks=C,
                    needs_scan=needs_scan, edge_gather=edge_gather,
@@ -183,14 +189,24 @@ def combine_chunks(partials, layout: TiledLayout, chunk_start, last_chunk,
 
 def tiled_segment_reduce(vals, layout: TiledLayout, chunk_start,
                          last_chunk, rel_dst, vpad: int, kind: str,
-                         use_mxu: bool = False):
+                         use_mxu: bool = False, method: str = "xla",
+                         interpret: bool = False):
     """Full scatter-free segment reduce for ONE part.
 
     vals [C, E, ...] chunked edge messages; returns [vpad, ...] —
     drop-in for ``segment_reduce(msgs, dst_local, vpad+1, kind)[:vpad]``.
+
+    method 'pallas' runs the per-chunk partial reduction as a Pallas
+    TPU kernel (ops/pallas_reduce.py) — scalar payloads only; 'xla'
+    is the portable broadcast-compare formulation.
     """
-    partials = chunk_partials(vals, rel_dst, layout.W, kind,
-                              use_mxu=use_mxu)
+    if method == "pallas" and vals.ndim == 2:
+        from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+        partials = chunk_partials_pallas(vals, rel_dst, layout.W, kind,
+                                         interpret=interpret)
+    else:
+        partials = chunk_partials(vals, rel_dst, layout.W, kind,
+                                  use_mxu=use_mxu)
     tiles = combine_chunks(partials, layout, chunk_start, last_chunk,
                            kind)
     flatshape = (layout.n_tiles * layout.W,) + tiles.shape[2:]
