@@ -1,0 +1,127 @@
+"""Validate the degree-class slotted layout on real RMAT data.
+
+Host: degree-sort vertices, build per-class slot index arrays
+[T_i, R_i, 128] pointing into the permuted state vector (dead slot for
+padding).  Device: per class, out = state[slots].sum(axis=1) — fused
+gather+reduce, fully static.  Measures total step time vs the old
+engine's 433 ms.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+SCALE = int(sys.argv[1]) if len(sys.argv) > 1 else 21
+EF = 16
+W = 128
+REPS = 10
+
+from lux_tpu.convert import rmat_edges
+from lux_tpu.graph import Graph
+
+t0 = time.perf_counter()
+src, dst, nv = rmat_edges(scale=SCALE, edge_factor=EF, seed=0)
+g = Graph.from_edges(src, dst, nv)
+indeg = g.in_degrees().astype(np.int64)
+print(f"graph {time.perf_counter() - t0:.1f}s  nv={g.nv} ne={g.ne}")
+
+t0 = time.perf_counter()
+# ---- degree-sorted permutation: perm[new] = old, rank[old] = new
+perm = np.argsort(-indeg, kind="stable")
+rank = np.empty(nv, dtype=np.int64)
+rank[perm] = np.arange(nv)
+
+ntile = (nv + W - 1) // W
+vpad = ntile * W
+dead = vpad  # one extra state row, holds identity
+
+# per-tile row depth = max indeg in tile (degree-sorted -> first lane)
+d_sorted = indeg[perm]
+tile_depth = np.zeros(ntile, dtype=np.int64)
+tile_depth[:] = d_sorted[::W][:ntile]   # max = first element of each tile
+tile_depth = np.maximum(tile_depth, 1)
+
+# ---- class boundaries: exact depths up to 8, then x1.5 steps
+levels = [1, 2, 3, 4, 5, 6, 8]
+v = 8
+while v < int(tile_depth.max()):
+    v = int(v * 1.5) + 1
+    levels.append(v)
+lev = np.asarray(levels, dtype=np.int64)
+tile_lvl = lev[np.searchsorted(lev, tile_depth)]
+
+slots_total = int((tile_lvl * W).sum())
+print(f"inflation {slots_total / g.ne:.3f}x, classes used "
+      f"{len(np.unique(tile_lvl))}, rows {slots_total // W}")
+
+# ---- build slot arrays per class (vectorized)
+# edges sorted by (new dst): edge list (src_new, dst_new)
+src_new = rank[g.col_idx.astype(np.int64)]
+dst_new = rank[np.repeat(np.arange(nv, dtype=np.int64), indeg)]
+order = np.argsort(dst_new, kind="stable")
+src_new = src_new[order]
+dst_new = dst_new[order]
+# row index of each edge within its dst vertex
+rp = np.concatenate(([0], np.cumsum(np.bincount(dst_new, minlength=vpad))))
+erow = np.arange(g.ne, dtype=np.int64) - rp[dst_new]
+
+classes = []
+for L in np.unique(tile_lvl):
+    tids = np.nonzero(tile_lvl == L)[0]          # tile ids, contiguous? (sorted by depth desc -> yes)
+    T_i = len(tids)
+    slots = np.full((T_i, int(L), W), dead, dtype=np.int32)
+    # edges whose dst tile is in this class
+    tile_of_edge = dst_new // W
+    sel = np.isin(tile_of_edge, tids)
+    e = np.nonzero(sel)[0]
+    tpos = np.searchsorted(tids, tile_of_edge[e])
+    slots[tpos, erow[e], dst_new[e] % W] = src_new[e]
+    classes.append((tids, slots))
+print(f"layout build {time.perf_counter() - t0:.1f}s")
+
+# ---- device
+import jax
+import jax.numpy as jnp
+
+state_h = np.random.default_rng(0).random(vpad + 1, np.float32)
+state_h[dead] = 0.0
+state = jnp.asarray(state_h)
+slot_d = [jnp.asarray(s) for _, s in classes]
+
+
+def step(state, *slot_arrays):
+    outs = [jnp.sum(jnp.take(state, s, axis=0), axis=1)
+            for s in slot_arrays]
+    return jnp.concatenate(outs, axis=0)        # [ntile, W] tiles in class order
+
+
+jstep = jax.jit(step)
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:44s} {dt * 1e3:8.2f} ms  ({g.ne / dt / 1e9:6.2f} GTEPS)")
+    return dt
+
+
+timeit("class gather+sum step", jstep, state, *slot_d)
+
+# correctness vs numpy
+out = np.asarray(jax.device_get(jstep(state, *slot_d)))
+ref = np.zeros(vpad)
+np.add.at(ref, dst_new, state_h[src_new])
+tid_order = np.concatenate([t for t, _ in classes])
+ref_tiles = ref.reshape(ntile, W)[tid_order]
+err = np.abs(out - ref_tiles).max()
+print(f"max err vs numpy: {err:.2e}")

@@ -1,0 +1,112 @@
+"""Decompose the real engine step cost in-context (same vmap/jit shape)."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.apps import pagerank
+from lux_tpu.convert import rmat_edges
+from lux_tpu.graph import Graph
+
+SCALE = 21
+REPS = 5
+
+src, dst, nv = rmat_edges(scale=SCALE, edge_factor=16, seed=0)
+g = Graph.from_edges(src, dst, nv)
+eng = pagerank.build_engine(g, num_parts=1)
+sg, lay = eng.sg, eng.tiles
+state = eng.init_state()
+keys = eng._graph_keys
+gargs = eng.graph_args
+print(f"nv={sg.nv} ne={sg.ne} vpad={sg.vpad} C={lay.n_chunks} E={lay.E}")
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:46s} {dt * 1e3:8.2f} ms")
+    return dt
+
+
+def make(stage):
+    def core(state, *ga):
+        gd = dict(zip(keys, ga))
+        flat = state.reshape((sg.num_parts * sg.vpad,) + state.shape[2:])
+
+        def part(old_p, gp):
+            src_vals = jnp.take(flat, gp["src_slot"], axis=0)
+            if stage == "gather":
+                return jnp.sum(src_vals, axis=1)
+            from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+            partials = chunk_partials_pallas(src_vals, lay.rel_dst.shape
+                                             and lay.W, "sum") \
+                if False else chunk_partials_pallas(src_vals, lay.W, "sum")
+            return partials
+
+        return jax.vmap(part)(state, gd)
+
+    return core
+
+
+# stage: gather only (in-context shape), cheap consume
+def core_gather(state, *ga):
+    gd = dict(zip(keys, ga))
+    flat = state.reshape((sg.num_parts * sg.vpad,) + state.shape[2:])
+    def part(old_p, gp):
+        sv = jnp.take(flat, gp["src_slot"], axis=0)
+        return jnp.sum(sv, axis=1)
+    return jax.vmap(lambda old, gp: part(old, gp))(state, gd)
+
+
+def core_gather_mat(state, *ga):
+    """Materialize the gather output (no reduce)."""
+    gd = dict(zip(keys, ga))
+    flat = state.reshape((sg.num_parts * sg.vpad,) + state.shape[2:])
+    def part(old_p, gp):
+        return jnp.take(flat, gp["src_slot"], axis=0)
+    return jax.vmap(lambda old, gp: part(old, gp))(state, gd)
+
+
+def core_gp(state, *ga):
+    """gather + pallas partials."""
+    from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+    gd = dict(zip(keys, ga))
+    flat = state.reshape((sg.num_parts * sg.vpad,) + state.shape[2:])
+    def part(old_p, gp):
+        sv = jnp.take(flat, gp["src_slot"], axis=0)
+        return chunk_partials_pallas(sv, lay.W, "sum")
+    return jax.vmap(lambda old, gp: part(old, gp))(state, gd)
+
+
+def core_gpc(state, *ga):
+    """gather + pallas + combine (no apply)."""
+    from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+    from lux_tpu.ops.tiled import combine_chunks
+    gd = dict(zip(keys, ga))
+    flat = state.reshape((sg.num_parts * sg.vpad,) + state.shape[2:])
+    def part(old_p, gp):
+        sv = jnp.take(flat, gp["src_slot"], axis=0)
+        partials = chunk_partials_pallas(sv, lay.W, "sum")
+        return combine_chunks(partials, lay, gp["chunk_start"],
+                              gp["last_chunk"], "sum")
+    return jax.vmap(lambda old, gp: part(old, gp))(state, gd)
+
+
+timeit("in-context gather (+cheap sum over E)", jax.jit(core_gather),
+       state, *gargs)
+timeit("in-context gather (materialized)", jax.jit(core_gather_mat),
+       state, *gargs)
+timeit("gather + pallas partials", jax.jit(core_gp), state, *gargs)
+timeit("gather + pallas + combine", jax.jit(core_gpc), state, *gargs)
+timeit("full step", jax.jit(eng._step_core), state, *gargs)

@@ -1,0 +1,67 @@
+"""Isolate TPU gather lowering variants: plain vs vmapped vs one-dim."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+V = 1 << 21
+N = 57636 * 1024  # ~59M slots
+REPS = 5
+
+rng = np.random.default_rng(0)
+state = jnp.asarray(rng.random(V, np.float32))
+idx_flat = jnp.asarray(rng.integers(0, V, N).astype(np.int32))
+idx_2d = idx_flat.reshape(-1, 1024)
+idx_3d = idx_flat.reshape(-1, 8, 128)
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:44s} {dt * 1e3:8.2f} ms  ({N / dt / 1e9:6.2f} G/s)")
+    return dt
+
+
+timeit("take flat [N]", jax.jit(lambda s, i: jnp.take(s, i)), state,
+       idx_flat)
+timeit("take 2d [C,1024]", jax.jit(lambda s, i: jnp.take(s, i)), state,
+       idx_2d)
+timeit("take 3d [C,8,128]", jax.jit(lambda s, i: jnp.take(s, i)), state,
+       idx_3d)
+
+vm = jax.jit(jax.vmap(lambda s, i: jnp.take(s, i), in_axes=(None, 0)))
+timeit("vmapped take [1,C,1024]", vm, state, idx_2d[None])
+
+vm1 = jax.jit(jax.vmap(lambda s, i: jnp.take(s, i), in_axes=(None, 0)))
+timeit("vmapped take rows [C rows of 1024]", vm1, state, idx_2d)
+
+# exact engine formulation: reshape then take then sum
+def engine_like(s, i):
+    v = jnp.take(s, i, axis=0)
+    return v
+
+timeit("take axis=0 2d", jax.jit(engine_like), state, idx_2d)
+
+# take_along_axis formulation
+def taa(s, i):
+    return jnp.take_along_axis(s[None, :].repeat(1, 0),
+                               i.reshape(1, -1), axis=1)
+
+# one-hot matmul small sanity skipped
+
+# sum fused
+def gsum(s, i):
+    return jnp.take(s, i.reshape(-1, 8, 128), axis=0).sum(axis=1)
+
+timeit("take+sum fused 3d", jax.jit(gsum), state, idx_flat)

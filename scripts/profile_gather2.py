@@ -1,0 +1,73 @@
+"""Gather speed vs table size / dtype / sortedness, fused-loop method.
+Plus host-side (src_tile, dst_tile) pair-density stats for RMAT21."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 25          # 33.5M gathers
+K = 10
+rng = np.random.default_rng(0)
+
+
+def bench(name, table_log2, dtype, sorted_idx=False):
+    V = 1 << table_log2
+    table = jnp.asarray(rng.random(V, np.float32).astype(dtype))
+    idx = rng.integers(0, V, N).astype(np.int32)
+    if sorted_idx:
+        idx = np.sort(idx)
+    idx = jnp.asarray(idx)
+
+    @jax.jit
+    def run(t, i):
+        def body(_, carry):
+            s, t = carry
+            v = jnp.take(t, i, axis=0)
+            return (s + jnp.sum(v.astype(jnp.float32)),
+                    t * jnp.float32(1.0).astype(t.dtype))
+        s, _ = jax.lax.fori_loop(0, K, body,
+                                 (jnp.float32(0.0), t))
+        return s
+
+    out = run(table, idx)
+    float(out)
+    t0 = time.perf_counter()
+    out = run(table, idx)
+    float(out)
+    dt = (time.perf_counter() - t0) / K
+    print(f"{name:44s} {dt * 1e3:8.2f} ms  ({dt / N * 1e9:5.2f} ns/elem)")
+    return dt
+
+
+bench("gather f32 table=2^21", 21, np.float32)
+bench("gather f32 table=2^16", 16, np.float32)
+bench("gather f32 table=2^12", 12, np.float32)
+bench("gather f32 table=2^8", 8, np.float32)
+bench("gather bf16 table=2^21", 21, jnp.bfloat16)
+bench("gather f32 table=2^21 sorted idx", 21, np.float32, sorted_idx=True)
+
+# ---- pair stats ---------------------------------------------------------
+from lux_tpu.convert import rmat_edges
+from lux_tpu.graph import Graph
+
+src, dst, nv = rmat_edges(scale=21, edge_factor=16, seed=0)
+g = Graph.from_edges(src, dst, nv)
+indeg = g.in_degrees()
+perm = np.argsort(-indeg, kind="stable")
+rank = np.empty(nv, dtype=np.int64)
+rank[perm] = np.arange(nv)
+
+s_new = rank[g.col_idx.astype(np.int64)]
+d_new = rank[np.repeat(np.arange(nv, dtype=np.int64), indeg)]
+pair = (d_new // 128) * (nv // 128) + (s_new // 128)
+upair, counts = np.unique(pair, return_counts=True)
+print(f"\nRMAT21 deg-sorted 128x128 pairs: {len(upair)} nonzero "
+      f"({g.ne / len(upair):.2f} edges/pair)")
+for thresh in (1, 2, 4, 8, 16, 32, 64, 128, 256):
+    sel = counts >= thresh
+    print(f"  pairs>={thresh:4d}: {sel.sum():9d} pairs, "
+          f"{counts[sel].sum() / g.ne * 100:5.1f}% of edges")

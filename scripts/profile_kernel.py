@@ -1,0 +1,135 @@
+"""Sweep pallas kernel variants for the chunk partial reduction."""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+C = 80 * 1024
+E = 512
+W = 128
+REPS = 10
+
+rng = np.random.default_rng(0)
+vals_h = rng.random((C, E), np.float32)
+rel_h = np.sort(rng.integers(0, W + 1, (C, E)), axis=1).astype(np.int32)
+start_h = (rng.random(C) < 0.2).astype(np.int32)
+start_h[0] = 1
+
+vals = jnp.asarray(vals_h)
+rel = jnp.asarray(rel_h)
+start = jnp.asarray(start_h).reshape(C, 1)
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    ed = C * E / dt / 1e9
+    print(f"{name:40s} {dt * 1e3:8.2f} ms  ({ed:6.2f} Gedge/s)")
+    return dt
+
+
+# -- current kernel (3D), block sweep --------------------------------------
+from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+
+if "--3d" in sys.argv:
+    f = jax.jit(functools.partial(chunk_partials_pallas, W=W, kind="sum",
+                                  block_c=8))
+    timeit("3d kernel block_c=8", f, vals, rel)
+
+
+# -- 2D row-loop kernel with fused carry ----------------------------------
+def _fused_kernel(start_ref, vals_ref, rel_ref, out_ref, carry, *, B):
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (E, W), 1)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        carry[:] = jnp.zeros_like(carry)
+
+    def body(i, _):
+        v = vals_ref[i, :]
+        r = rel_ref[i, :]
+        m = r[:, None] == lanes
+        part = jnp.sum(jnp.where(m, v[:, None], 0.0), axis=0)  # [W]
+        acc = jnp.where(start_ref[i, 0] == 1, part, carry[0, :] + part)
+        carry[0, :] = acc
+        out_ref[i, :] = acc
+        return 0
+
+    jax.lax.fori_loop(0, B, body, 0, unroll=True)
+
+
+def fused(vals, rel, start, bc):
+    kern = functools.partial(_fused_kernel, B=bc)
+    return pl.pallas_call(
+        kern,
+        grid=(C // bc,),
+        in_specs=[
+            pl.BlockSpec((bc, 1), lambda b: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((bc, E), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, E), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bc, W), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C, W), vals.dtype),
+        scratch_shapes=[pltpu.VMEM((1, W), vals.dtype)],
+    )(start, vals, rel)
+
+
+for bc in (32, 128):
+    f = jax.jit(functools.partial(fused, bc=bc))
+    timeit(f"2d fused-carry block_c={bc}", f, vals, rel, start)
+
+
+# -- MXU one-hot variant: partial = onehot(rel).T @ vals per row? ----------
+# batched matvec via dot_general inside kernel, one chunk at a time
+def _mxu_kernel(vals_ref, rel_ref, out_ref, *, B):
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (E, W), 1)
+
+    def body(i, _):
+        r = rel_ref[i, :]
+        oh = (r[:, None] == lanes).astype(jnp.float32)      # [E, W]
+        v = vals_ref[i, :].reshape(1, E)
+        out_ref[i, :] = jnp.dot(
+            v, oh, preferred_element_type=jnp.float32)[0]
+        return 0
+
+    jax.lax.fori_loop(0, B, body, 0, unroll=True)
+
+
+def mxu(vals, rel, bc):
+    kern = functools.partial(_mxu_kernel, B=bc)
+    return pl.pallas_call(
+        kern,
+        grid=(C // bc,),
+        in_specs=[
+            pl.BlockSpec((bc, E), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, E), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bc, W), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C, W), vals.dtype),
+    )(vals, rel)
+
+
+f = jax.jit(functools.partial(mxu, bc=32))
+timeit("mxu onehot block_c=32", f, vals, rel)

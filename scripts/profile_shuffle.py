@@ -1,0 +1,98 @@
+"""Microbench pallas take_along_axis (tpu.dynamic_gather) throughput.
+
+a) axis=1: per-row 128-lane shuffle on [R, 128]
+b) axis=0: per-lane sublane gather on [M, 128] for varying M
+c) transpose cost for comparison
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+REPS = 10
+rng = np.random.default_rng(0)
+
+
+def timeit(name, fn, *args, n_elems=None):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    r = f"  ({n_elems / dt / 1e9:7.2f} G/s)" if n_elems else ""
+    print(f"{name:44s} {dt * 1e3:8.2f} ms{r}")
+    return dt
+
+
+# ---- a) axis=1 lane shuffle ---------------------------------------------
+R = 1 << 18  # 262144 rows x 128 = 33.5M elements
+x = jnp.asarray(rng.random((R, 128), np.float32))
+idx1 = jnp.asarray(rng.integers(0, 128, (R, 128)).astype(np.int32))
+
+
+def shuffle_kernel(x_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=1)
+
+
+def lane_shuffle(x, idx, bm):
+    return pl.pallas_call(
+        shuffle_kernel,
+        grid=(R // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, 128), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, 128), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 128), x.dtype),
+    )(x, idx)
+
+
+for bm in (512, 2048):
+    f = jax.jit(functools.partial(lane_shuffle, bm=bm))
+    timeit(f"lane shuffle axis=1 [R,128] bm={bm}", f, x, idx1,
+           n_elems=R * 128)
+
+# ---- b) axis=0 sublane gather, varying M --------------------------------
+def sub_kernel(x_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=0)
+
+
+def sub_gather(x, idx, M):
+    return pl.pallas_call(
+        sub_kernel,
+        grid=(x.shape[0] // M,),
+        in_specs=[
+            pl.BlockSpec((M, 128), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, 128), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((M, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x, idx)
+
+
+for M in (8, 64, 512, 4096):
+    idx0 = jnp.asarray(rng.integers(0, M, (R, 128)).astype(np.int32))
+    f = jax.jit(functools.partial(sub_gather, M=M))
+    timeit(f"sublane gather axis=0 M={M}", f, x, idx0, n_elems=R * 128)
+
+# ---- c) transpose -------------------------------------------------------
+xt = jnp.asarray(rng.random((16384, 2048), np.float32))
+timeit("xla transpose [16384,2048]", jax.jit(lambda a: a.T.copy()), xt,
+       n_elems=16384 * 2048)

@@ -1,0 +1,150 @@
+"""Validate the slotted positional layout idea.
+
+1. Host: compute padding inflation of the k-slot layout on RMAT21
+   (with and without per-part degree sorting).
+2. TPU: microbench the step core it enables:
+     vals = take(state, slot_idx [C,k,W]); partials = sum(vals, axis=1)
+   fused by XLA, plus a trivial carry kernel on [C, W].
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+SCALE = int(sys.argv[1]) if len(sys.argv) > 1 else 21
+EF = 16
+K = 8
+W = 128
+REPS = 10
+
+from lux_tpu.convert import rmat_edges
+from lux_tpu.graph import Graph
+
+src, dst, nv = rmat_edges(scale=SCALE, edge_factor=EF, seed=0)
+g = Graph.from_edges(src, dst, nv)
+indeg = g.in_degrees()
+
+
+def inflation(indeg, sort: bool, k=K, w=W):
+    d = np.sort(indeg)[::-1] if sort else np.asarray(indeg)
+    ntile = (len(d) + w - 1) // w
+    pad = np.zeros(ntile * w, dtype=np.int64)
+    pad[:len(d)] = d
+    tiles = pad.reshape(ntile, w)
+    chunks = np.maximum(1, -(-tiles.max(axis=1) // k))  # per-tile chunks
+    slots = int(chunks.sum()) * k * w
+    return slots / int(indeg.sum()), int(chunks.sum())
+
+
+for sort in (False, True):
+    inf, C = inflation(indeg, sort)
+    print(f"sorted={sort}: slot inflation {inf:.3f}x, chunks={C}")
+
+inf, C = inflation(indeg, True)
+
+# --- TPU microbench -------------------------------------------------------
+import jax
+import jax.numpy as jnp
+
+C = -(-C // 64) * 64
+V = 1 << SCALE
+rng = np.random.default_rng(0)
+slots = rng.integers(0, V, (C, K, W)).astype(np.int32)
+state = rng.random(V, np.float32)
+
+slots_d = jnp.asarray(slots)
+state_d = jnp.asarray(state)
+ne = g.ne
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:42s} {dt * 1e3:8.2f} ms  ({ne / dt / 1e9:6.2f} GTEPS-equiv)")
+    return dt
+
+
+@jax.jit
+def gather_sum(state, slots):
+    vals = jnp.take(state, slots, axis=0)        # [C, K, W]
+    return jnp.sum(vals, axis=1)                 # [C, W]
+
+
+timeit("xla gather+sum (fused)", gather_sum, state_d, slots_d)
+
+
+@jax.jit
+def gather_only(state, slots):
+    return jnp.take(state, slots, axis=0)
+
+
+timeit("xla gather only (materialized)", gather_only, state_d, slots_d)
+
+bf = state_d.astype(jnp.bfloat16)
+timeit("xla gather+sum bf16 state", gather_sum, bf, slots_d)
+
+# carry kernel over [C, W]
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+starts = (rng.random(C) < 0.3)
+starts[0] = True
+start_d = jnp.asarray(starts.astype(np.int32).reshape(C, 1))
+
+
+def _carry_kernel(start_ref, part_ref, out_ref, carry, *, B):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        carry[:] = jnp.zeros_like(carry)
+
+    def body(i, _):
+        part = part_ref[i, :]
+        acc = jnp.where(start_ref[i, 0] == 1, part, carry[0, :] + part)
+        carry[0, :] = acc
+        out_ref[i, :] = acc
+        return 0
+
+    jax.lax.fori_loop(0, B, body, 0, unroll=False)
+
+
+def carry(partials, start, bc=256):
+    kern = functools.partial(_carry_kernel, B=bc)
+    return pl.pallas_call(
+        kern,
+        grid=(C // bc,),
+        in_specs=[
+            pl.BlockSpec((bc, 1), lambda b: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((bc, W), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bc, W), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C, W), partials.dtype),
+        scratch_shapes=[pltpu.VMEM((1, W), partials.dtype)],
+    )(start, partials)
+
+
+partials = gather_sum(state_d, slots_d)
+jax.block_until_ready(partials)
+f = jax.jit(functools.partial(carry, bc=256))
+timeit("pallas carry combine [C,W]", f, partials, start_d)
+
+
+@jax.jit
+def full(state, slots, start):
+    return carry(gather_sum(state, slots), start)
+
+
+timeit("gather+sum+carry (one jit)", full, state_d, slots_d, start_d)

@@ -1,0 +1,83 @@
+"""Microbenchmark the pull-step components on the live TPU.
+
+Times, for one PageRank iteration at the bench shape (rmat scale S):
+  - full fused step
+  - src gather alone (jnp.take of flat state by src_slot)
+  - pallas chunk partial reduce alone
+  - combine_chunks alone
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.apps import pagerank
+from lux_tpu.convert import rmat_edges
+from lux_tpu.graph import Graph
+
+SCALE = int(sys.argv[1]) if len(sys.argv) > 1 else 21
+EF = 16
+REPS = 10
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)  # compile
+    jax.block_until_ready(out)
+    _ = np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    _ = np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:32s} {dt * 1e3:9.2f} ms")
+    return dt
+
+
+def main():
+    src, dst, nv = rmat_edges(scale=SCALE, edge_factor=EF, seed=0)
+    g = Graph.from_edges(src, dst, nv)
+    print(f"nv={g.nv} ne={g.ne}")
+    eng = pagerank.build_engine(g, num_parts=1)
+    lay = eng.tiles
+    state = eng.init_state()
+    gd = eng.arrays
+
+    step = jax.jit(eng._step_core)
+    dt = timeit("full step", step, state, *eng.graph_args)
+    print(f"  -> {g.ne / dt / 1e9:.3f} GTEPS")
+
+    flat = state.reshape((-1,) + state.shape[2:])
+    src_slot = gd["src_slot"][0]
+    gather = jax.jit(lambda f, s: jnp.take(f, s, axis=0))
+    timeit("src gather (take)", gather, flat, src_slot)
+
+    vals = gather(flat, src_slot)
+    jax.block_until_ready(vals)
+    rel = gd["rel_dst"][0]
+
+    from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+    pr = jax.jit(lambda v, r: chunk_partials_pallas(v, r, lay.W, "sum"))
+    timeit("pallas chunk partials", pr, vals, rel)
+
+    partials = pr(vals, rel)
+    jax.block_until_ready(partials)
+
+    from lux_tpu.ops.tiled import combine_chunks
+    cc = jax.jit(lambda p, s, l: combine_chunks(p, lay, s, l, "sum"))
+    timeit("combine_chunks", cc, partials, gd["chunk_start"][0],
+           gd["last_chunk"][0])
+
+    # gather variants
+    timeit("gather bf16", gather, flat.astype(jnp.bfloat16), src_slot)
+    srt = jnp.sort(src_slot.ravel()).reshape(src_slot.shape)
+    timeit("gather sorted idx", gather, flat, srt)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+"""Hoisting-proof microbenchmarks: every input is loop-dependent, output
+is a scalar, work runs K times inside one jit.  The ground truth for
+architecture selection."""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+K = 10
+rng = np.random.default_rng(0)
+
+
+def bench(name, fn, args, n, unit="elem"):
+    run = jax.jit(fn)
+    out = run(*args)
+    float(out)
+    t0 = time.perf_counter()
+    float(run(*args))
+    dt = (time.perf_counter() - t0) / K
+    print(f"{name:46s} {dt * 1e3:8.2f} ms  ({dt / n * 1e9:6.2f} ns/{unit})")
+
+
+# ---- 1. XLA gather, loop-dependent table --------------------------------
+N = 1 << 25
+V = 1 << 21
+table0 = jnp.asarray(rng.random(V, np.float32))
+idx = jnp.asarray(rng.integers(0, V, N).astype(np.int32))
+
+
+def g_run(t0, i):
+    def body(_, c):
+        s, t = c
+        v = jnp.take(t, i, axis=0)
+        sv = jnp.sum(v)
+        return (s + sv, t + sv * 1e-30)
+    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), t0))[0]
+
+
+bench("xla gather 33.5M (loop-dep)", g_run, (table0, idx), N)
+
+# ---- 2. pallas lane shuffle axis=1 --------------------------------------
+R = 1 << 18
+x0 = jnp.asarray(rng.random((R, 128), np.float32))
+sidx = jnp.asarray(rng.integers(0, 128, (R, 128)).astype(np.int32))
+
+
+def shuffle_kernel(x_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=1)
+
+
+def lane_shuffle(x, i):
+    return pl.pallas_call(
+        shuffle_kernel,
+        grid=(R // 1024,),
+        in_specs=[pl.BlockSpec((1024, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM)] * 2,
+        out_specs=pl.BlockSpec((1024, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 128), x.dtype),
+    )(x, i)
+
+
+def s_run(x0, i):
+    def body(_, c):
+        s, x = c
+        v = lane_shuffle(x, i)
+        sv = jnp.sum(v[0])
+        return (s + sv, x + sv * 1e-30)
+    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), x0))[0]
+
+
+bench("pallas lane shuffle 33.5M (loop-dep)", s_run, (x0, sidx), R * 128)
+
+# ---- 3. sublane gather axis=0, M=8 --------------------------------------
+sidx8 = jnp.asarray(rng.integers(0, 8, (R, 128)).astype(np.int32))
+
+
+def sub_kernel(x_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=0)
+
+
+def sub_shuffle(x, i):
+    return pl.pallas_call(
+        sub_kernel,
+        grid=(R // 8,),
+        in_specs=[pl.BlockSpec((8, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM)] * 2,
+        out_specs=pl.BlockSpec((8, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 128), x.dtype),
+    )(x, i)
+
+
+def sub_run(x0, i):
+    def body(_, c):
+        s, x = c
+        v = sub_shuffle(x, i)
+        sv = jnp.sum(v[0])
+        return (s + sv, x + sv * 1e-30)
+    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), x0))[0]
+
+
+bench("pallas sublane shuffle M=8 (loop-dep)", sub_run, (x0, sidx8), R * 128)
+
+# ---- 4. transpose -------------------------------------------------------
+xt0 = jnp.asarray(rng.random((8192, 4096), np.float32))
+
+
+def t_run(x0):
+    def body(_, c):
+        s, x = c
+        v = x.T
+        sv = jnp.sum(v[0])
+        return (s + sv, x + sv * 1e-30)
+    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), x0))[0]
+
+
+bench("xla transpose 33.5M f32 (loop-dep)", t_run, (xt0,), 8192 * 4096)
+
+# ---- 5. v3 compare kernel -----------------------------------------------
+E = 512
+NB = 512
+vals0 = jnp.asarray(rng.random((E, NB * 128), np.float32))
+rel = jnp.asarray(
+    np.sort(rng.integers(0, 128, (E, NB * 128)), axis=0).astype(np.int32))
+
+
+def v3_kernel(vals_ref, rel_ref, out_ref):
+    v = vals_ref[:]
+    r = rel_ref[:]
+    g = pl.program_id(1)
+    for j in range(8):
+        wd = g * 8 + j
+        row = jnp.sum(jnp.where(r == wd, v, 0.0), axis=0, keepdims=True)
+        out_ref[j:j + 1, :] = row
+
+
+def v3(vals, rel):
+    return pl.pallas_call(
+        v3_kernel,
+        grid=(NB, 16),
+        in_specs=[
+            pl.BlockSpec((E, 128), lambda b, g: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((E, 128), lambda b, g: (0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda b, g: (g, b),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((128, NB * 128), vals.dtype),
+    )(vals, rel)
+
+
+def v3_run(v0, r):
+    def body(_, c):
+        s, x = c
+        out = v3(x, r)
+        sv = jnp.sum(out[0])
+        return (s + sv, x + sv * 1e-30)
+    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), v0))[0]
+
+
+bench("v3 compare reduce 33.5M edges (loop-dep)", v3_run, (vals0, rel),
+      E * NB * 128, "edge")
+
+# ---- 6. VPU chained adds ------------------------------------------------
+def chain_kernel(x_ref, o_ref):
+    v = x_ref[:]
+    acc = v
+    for _ in range(32):
+        acc = acc * 1.0000001 + v
+    o_ref[:] = acc
+
+
+def chain(x):
+    return pl.pallas_call(
+        chain_kernel,
+        grid=(R // 1024,),
+        in_specs=[pl.BlockSpec((1024, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1024, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 128), x.dtype),
+    )(x)
+
+
+def c_run(x0):
+    def body(_, c):
+        s, x = c
+        v = chain(x)
+        sv = jnp.sum(v[0])
+        return (s + sv, x + sv * 1e-30)
+    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), x0))[0]
+
+
+run = jax.jit(c_run)
+out = run(x0)
+float(out)
+t0 = time.perf_counter()
+float(run(x0))
+dt = (time.perf_counter() - t0) / K
+ops = 64 * R * 128
+print(f"{'vpu 64 ops/elem chain (loop-dep)':46s} {dt * 1e3:8.2f} ms  "
+      f"({ops / dt / 1e12:6.2f} Tops/s)")

@@ -1,0 +1,87 @@
+"""Kernel v3: transposed-chunk compare reduce.
+
+Layout: block = [E sublanes, 128 chunk-lanes]; each lane is one chunk of
+E edges, all edges of a chunk target one dst tile of W=128 vertices.
+Grid = (NB, WG): wd-group g computes 8 output rows (dst offsets) of the
+block's 128 chunks via scalar-broadcast compares — fully static ops.
+
+out[wd, chunk] = sum_e (rel[e, chunk] == wd) * vals[e, chunk]
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+E = 512
+W = 128
+NB = 512            # blocks of 128 chunks
+REPS = 10
+NEDGE = NB * 128 * E
+
+rng = np.random.default_rng(0)
+vals_h = rng.random((E, NB * 128), np.float32)
+rel_h = np.sort(rng.integers(0, W, (E, NB * 128)), axis=0).astype(np.int32)
+
+vals = jnp.asarray(vals_h)
+rel = jnp.asarray(rel_h)
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:44s} {dt * 1e3:8.2f} ms  ({NEDGE / dt / 1e9:6.2f} Gedge/s)")
+    return dt
+
+
+def kernel(vals_ref, rel_ref, out_ref, *, wg):
+    v = vals_ref[:]
+    r = rel_ref[:]
+    g = pl.program_id(1)
+    for j in range(wg):
+        wd = g * wg + j
+        row = jnp.sum(jnp.where(r == wd, v, 0.0), axis=0, keepdims=True)
+        out_ref[j:j + 1, :] = row
+
+
+def reduce_v3(vals, rel, wg):
+    kern = functools.partial(kernel, wg=wg)
+    return pl.pallas_call(
+        kern,
+        grid=(NB, W // wg),
+        in_specs=[
+            pl.BlockSpec((E, 128), lambda b, g: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((E, 128), lambda b, g: (0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((wg, 128), lambda b, g: (g, b),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((W, NB * 128), vals.dtype),
+    )(vals, rel)
+
+
+for wg in (8, 16, 32):
+    f = jax.jit(functools.partial(reduce_v3, wg=wg))
+    timeit(f"v3 transposed compare wg={wg}", f, vals, rel)
+
+# sanity check
+out = np.asarray(jax.device_get(jax.jit(
+    functools.partial(reduce_v3, wg=8))(vals, rel)))
+ref = np.zeros((W, 128), np.float32)
+for wd in range(W):
+    ref[wd] = np.where(rel_h[:, :128] == wd, vals_h[:, :128], 0).sum(axis=0)
+print("max err:", np.abs(out[:, :128] - ref).max())

@@ -1,0 +1,112 @@
+"""Calibrate Mosaic VPU primitive throughput: elementwise, compare+select,
+sublane reduce, at f32/bf16 — to find the real per-op cost."""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 64 * 1024 * 1024 // 128   # rows; N*128 = 64M elements
+REPS = 10
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.random((N, 128), np.float32))
+r = jnp.asarray(rng.integers(0, 128, (N, 128)).astype(np.int32))
+
+
+def timeit(name, fn, *args, work):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:46s} {dt * 1e3:8.2f} ms  ({work / dt / 1e12:6.2f} Tops/s)")
+    return dt
+
+
+def mk(body, n_in=2, bm=1024):
+    def kern(*refs):
+        out = refs[-1]
+        out[:] = body(*[rr[:] for rr in refs[:-1]])
+
+    def run(*arrs):
+        return pl.pallas_call(
+            kern,
+            grid=(N // bm,),
+            in_specs=[pl.BlockSpec((bm, 128), lambda b: (b, 0),
+                                   memory_space=pltpu.VMEM)] * n_in,
+            out_specs=pl.BlockSpec((bm, 128), lambda b: (b, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((N, 128), arrs[0].dtype),
+        )(*arrs)
+
+    return jax.jit(run)
+
+
+# 1 op per element
+timeit("add x+x (1 op/elem)", mk(lambda a, b: a + b), x, x, work=N * 128)
+
+# 10 chained adds
+def chain10(a, b):
+    for _ in range(10):
+        a = a + b
+    return a
+
+timeit("10 chained adds", mk(chain10), x, x, work=10 * N * 128)
+
+# cmp + select + add vs iota-scalar, 16 rounds (like the reduce inner loop)
+def cmpsel16(v, rr):
+    acc = jnp.zeros_like(v)
+    for wd in range(16):
+        acc = acc + jnp.where(rr == wd, v, 0.0)
+    return acc
+
+timeit("16x (cmp+sel+add)", mk(cmpsel16), x, r, work=3 * 16 * N * 128)
+
+
+# mul by bool instead of select
+def cmpmul16(v, rr):
+    acc = jnp.zeros_like(v)
+    for wd in range(16):
+        acc = acc + v * (rr == wd).astype(v.dtype)
+    return acc
+
+timeit("16x (cmp+cast+mul+add)", mk(cmpmul16), x, r,
+       work=4 * 16 * N * 128)
+
+
+# sublane reduce of [bm,128] -> [bm/8? ...]: sum groups of 8 sublanes
+def subred(v):
+    return v.reshape(-1, 8, 128).sum(axis=1).repeat(8, axis=0)
+
+# skip: shape-changing; instead full-block reduce to one row
+def redrow_kern(v_ref, o_ref):
+    o_ref[:] = jnp.sum(v_ref[:], axis=0, keepdims=True)
+
+def redrow(v, bm=1024):
+    return pl.pallas_call(
+        redrow_kern,
+        grid=(N // bm,),
+        in_specs=[pl.BlockSpec((bm, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, 128), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((N // 1024, 128), v.dtype),
+    )(v)
+
+timeit("block reduce rows [1024,128]->[1,128]", jax.jit(redrow), x,
+       work=N * 128)
+
+# bf16 comparison
+xb = x.astype(jnp.bfloat16)
+timeit("bf16 10 chained adds", mk(chain10), xb, xb, work=10 * N * 128)
