@@ -1,15 +1,21 @@
-"""Benchmark driver: PageRank GTEPS per chip.
+"""Benchmark driver: GTEPS per chip on the BASELINE.md configurations.
 
 Methodology matches the reference (BASELINE.md): wall-clock around the
 iteration loop only (graph generation/load/init excluded), GTEPS =
-ne * iterations / elapsed_seconds / num_chips.  The graph is an R-MAT
-(the reference's RMAT27 family, scaled to fit a single chip's HBM
+ne * iterations / elapsed_seconds / num_chips.  Graphs are R-MAT
+(the reference's RMAT family, scaled to fit a single chip's HBM
 comfortably at default settings).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "GTEPS", "vs_baseline": N}
 vs_baseline is against the north-star target of 1 GTEPS/chip
 (BASELINE.json "north_star").
+
+Configs (-config; default "pagerank" is what the driver records):
+  pagerank        PageRank, pull model, fixed iterations   (BASELINE #1/#4)
+  cc              Connected Components, push, to convergence (BASELINE #2)
+  sssp            SSSP/BFS hops, push, to convergence        (BASELINE #3)
+  colfilter       SGD matrix factorization, weighted pull    (BASELINE #5)
 """
 
 from __future__ import annotations
@@ -20,61 +26,91 @@ import sys
 import time
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("-scale", type=int, default=21,
-                    help="RMAT scale (nv = 2**scale)")
-    ap.add_argument("-ef", type=int, default=16, help="edges per vertex")
-    ap.add_argument("-ni", type=int, default=20, help="iterations to time")
-    ap.add_argument("-np", type=int, default=1, help="partitions")
-    ap.add_argument("-verbose", action="store_true")
-    args = ap.parse_args()
-
-    import jax
+def build_graph(args, weighted=False):
     import numpy as np
 
-    from lux_tpu.apps import pagerank
     from lux_tpu.convert import rmat_edges
     from lux_tpu.graph import Graph
 
     t0 = time.perf_counter()
     src, dst, nv = rmat_edges(scale=args.scale, edge_factor=args.ef,
                               seed=0)
-    g = Graph.from_edges(src, dst, nv)
+    w = None
+    if weighted:
+        rng = np.random.default_rng(1)
+        w = rng.integers(1, 6, size=src.shape[0]).astype(np.int32)
+    g = Graph.from_edges(src, dst, nv, weights=w)
     if args.verbose:
         print(f"# graph built: nv={g.nv} ne={g.ne} "
               f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+    return g
 
-    eng = pagerank.build_engine(g, num_parts=args.np)
-    state = eng.init_state()
 
-    def fetch(x):
-        # On remote-tunnel TPU platforms block_until_ready can return
-        # before execution finishes; a host fetch is the reliable fence.
-        return float(np.asarray(jax.device_get(x)).ravel()[0])
+def bench_fused(eng, g, ni, verbose):
+    import numpy as np
 
-    # Warmup with the SAME static iteration count (num_iters is a
-    # static jit arg — a different count would recompile inside the
-    # timed region), then reset state for the timed run.
-    state = eng.run(state, args.ni)
-    fetch(state)
-    state = eng.init_state()
-    if args.verbose:
-        print(f"# compiled ({time.perf_counter() - t0:.1f}s)",
-              file=sys.stderr)
+    from lux_tpu.timing import timed_fused_run
 
-    t1 = time.perf_counter()
-    state = eng.run(state, args.ni)
-    fetch(state)
-    elapsed = time.perf_counter() - t1
+    t0 = time.perf_counter()
+    state, elapsed = timed_fused_run(eng, ni)
+    if verbose:
+        print(f"# ran ({time.perf_counter() - t0:.1f}s total, "
+              f"{elapsed:.2f}s timed)", file=sys.stderr)
+    # the benched result must be sane, or the GTEPS line is meaningless
+    assert np.isfinite(eng.unpad(state)).all(), "non-finite bench result"
+    return g.ne * ni / elapsed
 
-    # Sanity: results must still match the oracle's magnitude.
-    out = eng.unpad(state)
-    assert np.isfinite(out).all()
 
-    gteps = g.ne * args.ni / elapsed / 1e9
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-config", default="pagerank",
+                    choices=["pagerank", "cc", "sssp", "colfilter"])
+    ap.add_argument("-scale", type=int, default=0,
+                    help="RMAT scale (nv = 2**scale; 0 = per-config "
+                         "default)")
+    ap.add_argument("-ef", type=int, default=16, help="edges per vertex")
+    ap.add_argument("-ni", type=int, default=20,
+                    help="iterations (fixed-iteration configs)")
+    ap.add_argument("-np", type=int, default=1, help="partitions")
+    ap.add_argument("-verbose", action="store_true")
+    args = ap.parse_args()
+    if not args.scale:
+        args.scale = {"pagerank": 21, "cc": 20, "sssp": 21,
+                      "colfilter": 18}[args.config]
+
+    import numpy as np
+
+    from lux_tpu.timing import timed_converge
+
+    if args.config == "pagerank":
+        from lux_tpu.apps import pagerank
+        g = build_graph(args)
+        eng = pagerank.build_engine(g, num_parts=args.np)
+        gteps = bench_fused(eng, g, args.ni, args.verbose) / 1e9
+        name = f"pagerank_rmat{args.scale}"
+    elif args.config == "colfilter":
+        from lux_tpu.apps import colfilter
+        g = build_graph(args, weighted=True)
+        eng = colfilter.build_engine(g, num_parts=args.np)
+        gteps = bench_fused(eng, g, args.ni, args.verbose) / 1e9
+        name = f"colfilter_rmat{args.scale}"
+    else:
+        from lux_tpu.apps import components, sssp
+        g = build_graph(args)
+        if args.config == "cc":
+            eng = components.build_engine(g, num_parts=args.np)
+        else:
+            eng = sssp.build_engine(g, start_vertex=0,
+                                    num_parts=args.np)
+        labels, iters, elapsed = timed_converge(eng)
+        if args.verbose:
+            print(f"# converged in {iters} iterations, {elapsed:.2f}s",
+                  file=sys.stderr)
+        gteps = g.ne * iters / elapsed / 1e9
+        name = f"{args.config}_rmat{args.scale}"
+
     result = {
-        "metric": f"pagerank_rmat{args.scale}_gteps_per_chip",
+        "metric": f"{name}_gteps_per_chip",
         "value": round(gteps, 4),
         "unit": "GTEPS",
         "vs_baseline": round(gteps / 1.0, 4),

@@ -56,20 +56,36 @@ def make_program(start_vertex: int, weighted: bool = False) -> PushProgram:
                        init=init)
 
 
+def default_delta(g: Graph) -> float:
+    """Bucket width heuristic: mean edge weight (Meyer & Sanders
+    suggest ~max_weight/max_degree; the mean is robust for the
+    power-law graphs the reference benchmarks)."""
+    return float(np.mean(np.asarray(g.weights, np.float64))) or 1.0
+
+
 def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                  mesh=None, weighted: bool = False,
+                 delta: float | str | None = None,
                  sg: ShardedGraph | None = None) -> PushEngine:
+    """delta: bucket width for delta-stepping priority ordering
+    (weighted runs); "auto" picks a heuristic; None disables (plain
+    Bellman-Ford frontier relaxation)."""
     if weighted and g.weights is None:
         raise ValueError("weighted SSSP needs a weighted graph")
+    if delta == "auto":
+        delta = default_delta(g) if weighted else 1.0
     if sg is None:
         sg = ShardedGraph.build(g, num_parts)
-    return PushEngine(sg, make_program(start_vertex, weighted), mesh=mesh)
+    return PushEngine(sg, make_program(start_vertex, weighted), mesh=mesh,
+                      delta=delta)
 
 
 def run(g: Graph, start_vertex: int = 0, num_parts: int = 1, mesh=None,
-        weighted: bool = False, max_iters=None, verbose: bool = False):
+        weighted: bool = False, delta=None, max_iters=None,
+        verbose: bool = False):
     """Returns (dist [nv], iterations)."""
-    eng = build_engine(g, start_vertex, num_parts, mesh, weighted)
+    eng = build_engine(g, start_vertex, num_parts, mesh, weighted,
+                       delta=delta)
     return eng.run(max_iters=max_iters, verbose=verbose)
 
 

@@ -117,6 +117,9 @@ def _push_app(argv, prog_name):
     _common(ap)
     ap.add_argument("-start", type=int, default=0)
     ap.add_argument("-weighted", action="store_true")
+    ap.add_argument("-delta", default=None,
+                    help="delta-stepping bucket width (sssp; a number "
+                         "or 'auto'; default: off)")
     args = ap.parse_args(argv)
 
     from lux_tpu import check
@@ -127,9 +130,12 @@ def _push_app(argv, prog_name):
     mesh, num_parts = _mesh_and_parts(args)
     sg = _build_sg(args, g, num_parts)
     if prog_name == "sssp":
+        delta = args.delta
+        if delta is not None and delta != "auto":
+            delta = float(delta)
         eng = sssp.build_engine(g, start_vertex=args.start,
                                 num_parts=num_parts, mesh=mesh,
-                                weighted=weighted, sg=sg)
+                                weighted=weighted, delta=delta, sg=sg)
     else:
         eng = components.build_engine(g, num_parts=num_parts, mesh=mesh,
                                       sg=sg)

@@ -52,6 +52,37 @@ class TestSSSP:
         np.testing.assert_allclose(dist, want.astype(np.float32),
                                    rtol=1e-6)
 
+    @pytest.mark.parametrize("delta", ["auto", 2.5])
+    def test_delta_stepping_matches_oracle(self, delta):
+        src, dst, w = uniform_random_edges(120, 900, seed=22,
+                                           weighted=True)
+        g = Graph.from_edges(src, dst, 120, weights=w)
+        dist, iters = sssp.run(g, start_vertex=0, num_parts=2,
+                               weighted=True, delta=delta)
+        want = sssp.reference_sssp(g, start_vertex=0, weighted=True)
+        np.testing.assert_allclose(dist, want.astype(np.float32),
+                                   rtol=1e-6)
+        assert iters > 0
+
+    def test_delta_stepping_mesh_matches_single(self, mesh8):
+        src, dst, w = uniform_random_edges(200, 1400, seed=23,
+                                           weighted=True)
+        g = Graph.from_edges(src, dst, 200, weights=w)
+        d1, _ = sssp.run(g, start_vertex=5, num_parts=1, weighted=True,
+                         delta="auto")
+        d8, _ = sssp.run(g, start_vertex=5, num_parts=8, mesh=mesh8,
+                         weighted=True, delta="auto")
+        np.testing.assert_allclose(d8, d1, rtol=1e-6)
+
+    def test_delta_rejects_max_program(self):
+        from lux_tpu.engine.push import PushEngine
+        g = chain_graph(6)
+        from lux_tpu.graph import ShardedGraph
+        sg = ShardedGraph.build(g, 1)
+        from lux_tpu.apps.components import make_program
+        with pytest.raises(ValueError, match="min"):
+            PushEngine(sg, make_program(), delta=1.0)
+
     def test_check_task(self):
         src, dst = uniform_random_edges(150, 1000, seed=17)
         g = Graph.from_edges(src, dst, 150)
@@ -135,3 +166,13 @@ def test_pagerank_residual_check():
     g = Graph.from_edges(src, dst, 100)
     ranks = pagerank.run(g, 60, num_parts=2)
     assert check.check_pagerank(g, ranks, tol=1e-5).ok
+
+
+def test_delta_rejects_nonpositive():
+    src, dst, w = uniform_random_edges(60, 300, seed=30, weighted=True)
+    g = Graph.from_edges(src, dst, 60, weights=w)
+    with pytest.raises(ValueError, match="not > 0"):
+        sssp.build_engine(g, 0, weighted=True, delta=0.0)
+    # fractional delta on int32 hop labels truncates to 0 -> rejected
+    with pytest.raises(ValueError, match="not > 0"):
+        sssp.build_engine(g, 0, weighted=False, delta=0.5)
