@@ -41,6 +41,9 @@ def _common(ap: argparse.ArgumentParser):
                     help="devices in the parts mesh")
     ap.add_argument("-check", action="store_true")
     ap.add_argument("-verbose", action="store_true")
+    ap.add_argument("-profile", default=None, metavar="DIR",
+                    help="capture an XLA profiler trace of the timed "
+                         "run into DIR (view in TensorBoard/Perfetto)")
 
 
 def _load(args, weighted: bool):
@@ -100,7 +103,8 @@ def cmd_pagerank(argv):
     mesh, num_parts = _mesh_and_parts(args)
     sg = _build_sg(args, g, num_parts)
     eng = pagerank.build_engine(g, num_parts, mesh, sg=sg)
-    state, elapsed = timed_fused_run(eng, args.ni)
+    state, elapsed = timed_fused_run(eng, args.ni,
+                                     trace_dir=args.profile)
     print(f"ELAPSED TIME = {elapsed:.7f} s")
     print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
 
@@ -117,9 +121,10 @@ def _push_app(argv, prog_name):
     _common(ap)
     ap.add_argument("-start", type=int, default=0)
     ap.add_argument("-weighted", action="store_true")
-    ap.add_argument("-delta", default=None,
-                    help="delta-stepping bucket width (sssp; a number "
-                         "or 'auto'; default: off)")
+    if prog_name == "sssp":
+        ap.add_argument("-delta", default=None,
+                        help="delta-stepping bucket width (a number or "
+                             "'auto'; default: off)")
     args = ap.parse_args(argv)
 
     from lux_tpu import check
@@ -139,7 +144,8 @@ def _push_app(argv, prog_name):
     else:
         eng = components.build_engine(g, num_parts=num_parts, mesh=mesh,
                                       sg=sg)
-    labels, iters, elapsed = timed_converge(eng, verbose=args.verbose)
+    labels, iters, elapsed = timed_converge(eng, verbose=args.verbose,
+                                            trace_dir=args.profile)
     print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
     print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
 
@@ -172,7 +178,8 @@ def cmd_colfilter(argv):
     mesh, num_parts = _mesh_and_parts(args)
     sg = _build_sg(args, g, num_parts)
     eng = colfilter.build_engine(g, num_parts, mesh, sg=sg)
-    state, elapsed = timed_fused_run(eng, args.ni)
+    state, elapsed = timed_fused_run(eng, args.ni,
+                                     trace_dir=args.profile)
     print(f"ELAPSED TIME = {elapsed:.7f} s")
     print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
     out = eng.unpad(state)

@@ -141,8 +141,13 @@ class PushEngine:
 
     def init_state(self):
         label0, active0 = self.program.init(self.sg)
-        label = jnp.asarray(label0)
-        active = jnp.asarray(active0)
+        return self.place(label0, active0)
+
+    def place(self, label, active):
+        """Put host (or replicated) state arrays on the engine's
+        devices with the parts sharding (used by checkpoint resume)."""
+        label = jnp.asarray(label)
+        active = jnp.asarray(active)
         if self.mesh is not None:
             label = jax.device_put(label, parts_spec(self.mesh))
             active = jax.device_put(active, parts_spec(self.mesh))
@@ -343,6 +348,12 @@ class PushEngine:
                         m = jax.lax.pmin(m, PARTS_AXIS)
                     return m
 
+                # `it` counts RELAX iterations only (what max_iters
+                # caps and what GTEPS reporting uses); bucket advances
+                # relax nothing and are not iterations.  Advance-only
+                # stretches terminate on their own: while any vertex is
+                # active, raising B eventually makes the frontier
+                # non-empty.
                 def cond(c):
                     it, lbl, act, B, cnt = c
                     return (cnt > 0) & (it < max_iters)
@@ -352,16 +363,16 @@ class PushEngine:
                     front = act & (lbl < B)
                     nf = global_sum(front)
 
-                    def relax(lbl, act, B):
+                    def relax(it, lbl, act, B):
                         nl, na = body(lbl, front, nf, g)
-                        return nl, (act & ~front) | na, B
+                        return it + 1, nl, (act & ~front) | na, B
 
-                    def advance(lbl, act, B):
-                        return lbl, act, active_min(lbl, act) + delta
+                    def advance(it, lbl, act, B):
+                        return it, lbl, act, active_min(lbl, act) + delta
 
-                    lbl, act, B = jax.lax.cond(nf > 0, relax, advance,
-                                               lbl, act, B)
-                    return it + 1, lbl, act, B, global_sum(act)
+                    it, lbl, act, B = jax.lax.cond(
+                        nf > 0, relax, advance, it, lbl, act, B)
+                    return it, lbl, act, B, global_sum(act)
 
                 B0 = active_min(label, active) + delta
                 it, lbl, act, _B, _ = jax.lax.while_loop(

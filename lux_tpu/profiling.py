@@ -1,0 +1,77 @@
+"""Profiling and tracing.
+
+The reference's observability is wall clocks plus per-part phase
+timings printed under -verbose (reference sssp_gpu.cu:513-518,
+pagerank.cc:108-118).  The TPU-native equivalents:
+
+- ``trace(dir)``: captures an XLA/TPU profiler trace viewable in
+  TensorBoard / Perfetto (the analogue of Legion's prof logs).
+- ``phase_timer()``: host-side phase timing with completion fences
+  (load / build / compile / iterate), printed like the reference's
+  loadTime/compTime/updateTime breakdown.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Capture a jax.profiler trace into ``log_dir`` (no-op if None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+    print(f"profiler trace written to {log_dir}")
+
+
+class _Phase:
+    """Set ``.fence`` to a device value produced INSIDE the block to
+    include its async execution in the phase time."""
+
+    def __init__(self):
+        self.fence = None
+
+
+class PhaseTimer:
+    """Named phase wall-clocks with reliable fences.
+
+    Device work dispatches asynchronously, so a phase that produces
+    device values must fence them — assign the result to the phase
+    handle (or pass ``fence=`` a zero-arg callable evaluated at exit):
+
+    >>> pt = PhaseTimer()
+    >>> with pt.phase("load"):
+    ...     g = Graph.from_file(...)
+    >>> with pt.phase("iterate") as ph:
+    ...     state = eng.run(state, 10)
+    ...     ph.fence = state
+    >>> pt.report()
+    """
+
+    def __init__(self):
+        self.phases: list[tuple[str, float]] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str, fence=None):
+        h = _Phase()
+        t0 = time.perf_counter()
+        yield h
+        f = fence() if callable(fence) else fence
+        for val in (f, h.fence):
+            if val is not None:
+                from lux_tpu.timing import fetch
+                fetch(val)
+        self.phases.append((name, time.perf_counter() - t0))
+
+    def report(self, file=None):
+        total = sum(t for _, t in self.phases)
+        for name, t in self.phases:
+            print(f"  {name:<12s} {t:8.3f} s "
+                  f"({100 * t / max(total, 1e-12):5.1f}%)", file=file)
+        print(f"  {'total':<12s} {total:8.3f} s", file=file)

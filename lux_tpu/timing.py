@@ -21,10 +21,17 @@ def fetch(x) -> np.ndarray:
     return np.asarray(jax.device_get(x))
 
 
-def timed_fused_run(eng, num_iters: int):
+def _trace_ctx(trace_dir):
+    from lux_tpu.profiling import trace
+    return trace(trace_dir)
+
+
+def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None):
     """Warm up a pull engine with the SAME static iteration count
     (num_iters is a static jit arg — a different count would recompile
-    inside the timed region), then time a fresh fused run.
+    inside the timed region), then time a fresh fused run.  When
+    trace_dir is set, a profiler trace captures ONLY the timed run
+    (warmup and compilation are excluded).
 
     Returns (final_state, elapsed_seconds).
     """
@@ -32,24 +39,29 @@ def timed_fused_run(eng, num_iters: int):
     state = eng.run(state, num_iters)
     fetch(state)
     state = eng.init_state()
-    t0 = time.perf_counter()
-    state = eng.run(state, num_iters)
-    fetch(state)
-    return state, time.perf_counter() - t0
+    with _trace_ctx(trace_dir):
+        t0 = time.perf_counter()
+        state = eng.run(state, num_iters)
+        fetch(state)
+        elapsed = time.perf_counter() - t0
+    return state, elapsed
 
 
-def timed_converge(eng, max_iters=None, verbose: bool = False):
+def timed_converge(eng, max_iters=None, verbose: bool = False,
+                   trace_dir: str | None = None):
     """Warm up a push engine's converge program (printing per-iteration
     frontier sizes during the warmup pass when verbose), then time a
-    fresh whole-run converge.  Returns (labels, iters, elapsed)."""
+    fresh whole-run converge; a trace_dir captures only the timed run.
+    Returns (labels, iters, elapsed)."""
     if verbose:
         eng.run(max_iters=max_iters, verbose=True)   # stepwise, printed
     label, active = eng.init_state()
     l2, a2, _ = eng.converge(label, active, max_iters)  # compile
     fetch(l2)
     label, active = eng.init_state()
-    t0 = time.perf_counter()
-    label, active, iters = eng.converge(label, active, max_iters)
-    iters = int(fetch(iters))
-    elapsed = time.perf_counter() - t0
+    with _trace_ctx(trace_dir):
+        t0 = time.perf_counter()
+        label, active, iters = eng.converge(label, active, max_iters)
+        iters = int(fetch(iters))
+        elapsed = time.perf_counter() - t0
     return eng.unpad(label), iters, elapsed

@@ -1,0 +1,70 @@
+"""Checkpoint/resume and profiling utilities."""
+
+import os
+
+import numpy as np
+
+from lux_tpu import checkpoint as ckpt
+from lux_tpu.apps import pagerank, sssp
+from lux_tpu.convert import uniform_random_edges
+from lux_tpu.graph import Graph
+from lux_tpu.profiling import PhaseTimer
+
+
+def test_save_load_roundtrip(tmp_path):
+    p = str(tmp_path / "c.npz")
+    state = (np.arange(6, dtype=np.float32).reshape(2, 3),
+             np.array([True, False]))
+    ckpt.save(p, state, {"iter": 7})
+    leaves, meta = ckpt.load(p)
+    assert meta == {"iter": 7}
+    np.testing.assert_array_equal(leaves[0], state[0])
+    np.testing.assert_array_equal(leaves[1], state[1])
+
+
+def test_pull_checkpointed_matches_plain(tmp_path):
+    src, dst = uniform_random_edges(100, 700, seed=61)
+    g = Graph.from_edges(src, dst, 100)
+    eng = pagerank.build_engine(g, num_parts=2)
+    p = str(tmp_path / "pr.npz")
+
+    want = eng.unpad(eng.run(eng.init_state(), 10))
+    got_state = ckpt.run_checkpointed(eng, eng.init_state(), 10, p,
+                                      segment=3)
+    np.testing.assert_allclose(eng.unpad(got_state), want, rtol=1e-6)
+    leaves, meta = ckpt.load(p)
+    assert meta["iter"] == 10
+    # resume from the iteration-6 structure: load and continue
+    (state_arr,), meta = ckpt.load(p)
+    assert np.isfinite(state_arr).all()
+
+
+def test_push_converge_checkpointed_resume(tmp_path):
+    src, dst = uniform_random_edges(200, 1500, seed=62)
+    g = Graph.from_edges(src, dst, 200)
+    eng = sssp.build_engine(g, start_vertex=0, num_parts=2)
+    p = str(tmp_path / "ss.npz")
+
+    want, _ = sssp.run(g, start_vertex=0, num_parts=2)
+
+    # run only 2 iterations' worth of segments, then "crash"
+    l, a, total = ckpt.converge_checkpointed(eng, p, segment=2,
+                                             max_iters=2)
+    assert os.path.exists(p) and total == 2
+    # resume to convergence
+    l, a, total = ckpt.converge_checkpointed(eng, p, segment=3,
+                                             resume=True)
+    got = eng.unpad(l)
+    reach = ~sssp.unreachable(got)
+    np.testing.assert_array_equal(got[reach], want[reach])
+
+
+def test_phase_timer(capsys):
+    pt = PhaseTimer()
+    with pt.phase("a"):
+        pass
+    with pt.phase("b", fence=np.zeros(3)):
+        pass
+    pt.report()
+    out = capsys.readouterr().out
+    assert "a" in out and "total" in out
