@@ -54,6 +54,20 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def occurrence_index(vals) -> np.ndarray:
+    """Occurrence index of each element among equal values (0 for the
+    first occurrence, 1 for the second, ...)."""
+    srt = np.argsort(vals, kind="stable")
+    vs = np.asarray(vals)[srt]
+    newg = np.ones(len(vs), bool)
+    newg[1:] = vs[1:] != vs[:-1]
+    pos = np.arange(len(vs))
+    gst = np.maximum.accumulate(np.where(newg, pos, 0))
+    occ = np.empty(len(vs), np.int64)
+    occ[srt] = pos - gst
+    return occ
+
+
 # ---------------------------------------------------------------------------
 # slotted output layout
 # ---------------------------------------------------------------------------

@@ -1,0 +1,473 @@
+"""Three-stage shuffle-routing planner ("deep router").
+
+Extends ops/router.py's window planner with the staging depth that the
+power-law tail needs (PERF_NOTES.md "Routing-network experiments"):
+instead of spilling every value whose z-row spans multiple state rows
+to the 9 ns/edge XLA gather, values flow through up to three
+shuffle/transpose stages, each a fast primitive (~0.4 ns/elem):
+
+  x-layer   global *band instances*: state rows are grouped into <=128
+            contiguous 128-row bands (degree-sorted, so bands are
+            contiguous quantiles).  An instance binds <=128 rows of one
+            band (with multiplicity); each instance column (an ``xT``
+            row after the block transpose) carries up to 128 values of
+            that band destined for ONE out-block.
+  w-layer   per-out-block *band mixers*: a w-row lane-shuffles one xT
+            row; a wT column mixes <=1 value per w-row — i.e. up to 128
+            values from up to 128 different bands: full reach.
+  z-layer   staged rows feeding the output: z-row (b, k) lane-shuffles
+            ONE pool row — a state2d row (direct, pure z-rows), an xT
+            row (single-band z-rows), or a wT row (mixed z-rows) —
+            placing values into out-row-indexed lanes.
+  out       block-transpose + sigma3 shuffle + per-class positional
+            reduce (same machinery as router.py).
+
+Anything that still does not fit (capacity overflows) spills to the
+compact XLA gather, but unlike the 1-stage planner the spill is a few
+percent, not ~95%.
+
+The device pipeline would be three rounds of [row-gather ->
+lane-shuffle -> batched 128x128 transpose] plus the spill gather — all
+measured-fast primitives.  It is NOT implemented: real-graph planner
+stats (PERF_NOTES.md "Deep-router") show the x-layer collapses to ~1%
+utilization on power-law tails, so this module stands as the tested
+record of that design point; ``route3_numpy`` is the only executor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from lux_tpu.ops.router import (SlottedOut, W,
+                                occurrence_index as _occ)
+
+
+@dataclasses.dataclass
+class Route3Plan:
+    """Static arrays for the 3-stage network of one part.
+
+    Pools (rows available to the next layer), in order:
+      state2d  [S, 128]     (S includes NO dead row; dead handled via
+                             the spill gather and unselected lanes)
+      xT       [X, 128]     X = n_xblocks * 128
+      wT       [Wn, 128]    Wn = n_wblocks * 128
+      spill    [Zs, 128]    gathered rows
+
+    z assembly: z[(b,k), :] = shuffle(pool[zbind[(b,k)]], sigma_z) with
+    pool = concat(state2d, xT, wT, spill); zbind indexes that concat.
+    """
+
+    # x-layer
+    xbind: np.ndarray        # int32 [X] state2d row per x-row
+    sigma_x: np.ndarray      # int32 [X, 128]
+    n_xblocks: int
+    # w-layer
+    wbind: np.ndarray        # int32 [Wn] row into concat(state2d, xT)
+    sigma_w: np.ndarray      # int32 [Wn, 128]
+    n_wblocks: int
+    # z-layer
+    zbind: np.ndarray        # int32 [Z] row into the full pool
+    sigma_z: np.ndarray      # int32 [Z, 128]
+    # spill + out
+    spill_need: np.ndarray   # int32 [Zs, 128] flat slot into state_ext
+    sigma3: np.ndarray       # int32 [R_out, 128]
+    n_blocks: int
+    out: SlottedOut
+    dead_slot: int
+    n_state_rows: int
+    stats: dict
+
+
+def build_route3_plan(src_slot: np.ndarray, dst_local: np.ndarray,
+                      vpad: int, n_state_rows: int) -> Route3Plan:
+    out = SlottedOut.build(src_slot, dst_local, vpad)
+    R = out.R_out
+    nb = R // W
+    S = n_state_rows
+    dead_slot = S * W
+    if dead_slot >= 2**31:
+        raise ValueError("state slot space overflows int32 routing")
+
+    need = out.need                                  # [R,128], -1 pad
+    srow = np.where(need >= 0, need // W, -1)
+
+    # ---- z-rows by per-out-row rank sort --------------------------------
+    order = np.argsort(np.where(srow < 0, np.int64(1) << 40, srow),
+                       axis=1, kind="stable")
+    sigma3 = np.empty((R, W), dtype=np.int32)
+    np.put_along_axis(
+        sigma3, order,
+        np.broadcast_to(np.arange(W, dtype=np.int32), (R, W)), axis=1)
+    srow_k = np.take_along_axis(srow, order, axis=1)
+    scol_k = np.take_along_axis((need % W).astype(np.int32), order,
+                                axis=1)
+    Z = nb * W
+    srow_z = (srow_k.reshape(nb, W, W).transpose(0, 2, 1).reshape(Z, W))
+    scol_z = (scol_k.reshape(nb, W, W).transpose(0, 2, 1).reshape(Z, W))
+    live = srow_z >= 0
+
+    # bands: contiguous 128-row groups of state rows
+    n_bands = (S + W - 1) // W
+    band_z = np.where(live, srow_z // W, -1)
+
+    # ---- classify z-rows ------------------------------------------------
+    any_live = live.any(axis=1)
+    first = np.where(any_live, live.argmax(axis=1), 0)
+    ref_row = srow_z[np.arange(Z), first]
+    ref_band = band_z[np.arange(Z), first]
+    pure_row = ((np.where(live, srow_z, ref_row[:, None])
+                 == ref_row[:, None]).all(axis=1) & any_live)
+    # single-band: all live values in one band, and within the band no
+    # state row needed twice... multiplicity IS allowed via instance
+    # multiplicity, but a single xT row holds <=1 value per x-row; we
+    # bind x-instances with multiplicity, so duplicates are fine as
+    # long as the (block, band) column capacity (128) holds.
+    one_band = ((np.where(live, band_z, ref_band[:, None])
+                 == ref_band[:, None]).all(axis=1) & any_live)
+
+    kind = np.full(Z, 2, np.int8)        # 2 = mixed (w-layer)
+    kind[one_band] = 1                   # 1 = single-band (xT direct)
+    kind[pure_row] = 0                   # 0 = direct state row
+    kind[~any_live] = 3                  # 3 = all-dead (sigma3-proof)
+
+    # ---- x-layer construction ------------------------------------------
+    # Demands: for kind-1 z-rows: one xT row carrying ALL its values
+    # (columns of an instance of its band).  For kind-2 z-rows: per
+    # band, the block's w-layer needs xT rows carrying the block's
+    # values of that band.  Group kind-2 demands by (out-block, band).
+    #
+    # An x-instance of band beta has 128 columns; each column is an xT
+    # row: EITHER a kind-1 z-row's full value set, OR a (block, band,
+    # copy) value set for the w-layer.  Column constraint: <=1 value
+    # per x-row; instance binds band rows with multiplicity = max over
+    # its columns' per-row counts (<=128 total).
+
+    x_cols: dict[int, list] = {b: [] for b in range(n_bands)}
+    # each entry: (tag, payload); tag "z1" payload = z-row id;
+    # tag "w" payload = (block, band, rows[], cols[], zk[], zl[])
+
+    for zi in np.nonzero(kind == 1)[0]:
+        x_cols[int(ref_band[zi])].append(("z1", int(zi)))
+
+    # kind-2 z-rows: first partition them into W-GROUPS.  A w-group is
+    # a future w-block: its w-rows are (band, copy) slots, its columns
+    # are member z-rows.  Budget per group: sum over bands of the max
+    # per-member per-band value count <= 128 w-rows, and <= 128
+    # members (columns).  Hub-heavy blocks overflow a single group,
+    # so out-blocks may own several.
+    mixed = np.nonzero(kind == 2)[0]
+    wgroup_of = np.full(Z, -1, np.int64)      # z-row -> w-group id
+    wcol_of = np.full(Z, -1, np.int64)        # z-row -> column in group
+    n_wgroups = 0
+    if mixed.size:
+        # per-z-row per-band counts (sparse: bands + counts per row)
+        zrow_bands = []
+        for zi in mixed:
+            bz = band_z[zi][live[zi]]
+            ub, uc = np.unique(bz, return_counts=True)
+            zrow_bands.append((ub, uc))
+        cur_counts: dict[int, int] = {}
+        cur_members = 0
+        for idx, zi in enumerate(mixed):
+            ub, uc = zrow_bands[idx]
+            grow = sum(max(0, int(c) - cur_counts.get(int(bb), 0))
+                       for bb, c in zip(ub, uc))
+            if (cur_members >= W or
+                    sum(cur_counts.values()) + grow > W) \
+                    and cur_members > 0:
+                n_wgroups += 1
+                cur_counts = {}
+                cur_members = 0
+            for bb, c in zip(ub, uc):
+                cur_counts[int(bb)] = max(cur_counts.get(int(bb), 0),
+                                          int(c))
+            assert sum(cur_counts.values()) <= W, \
+                "mixed z-row alone exceeds w capacity"
+            wgroup_of[zi] = n_wgroups
+            wcol_of[zi] = cur_members
+            cur_members += 1
+        if cur_members:
+            n_wgroups += 1
+
+        # per (w-group, band): values of member z-rows, split into
+        # copies (a wT column takes <=1 value per w-row, so a z-row
+        # with m values from one band needs m copies of that band).
+        mz = mixed.repeat(W)
+        lanes = np.tile(np.arange(W), mixed.size)
+        lv = live[mz, lanes]
+        mz, lanes = mz[lv], lanes[lv]
+        groups_of = wgroup_of[mz]
+        bands_of = band_z[mz, lanes]
+        key = groups_of * n_bands + bands_of
+        srt = np.argsort(key, kind="stable")
+        mz, lanes, key = mz[srt], lanes[srt], key[srt]
+        grp_starts = np.concatenate(
+            ([0], np.nonzero(key[1:] != key[:-1])[0] + 1, [len(key)]))
+        for gi in range(len(grp_starts) - 1):
+            lo, hi = grp_starts[gi], grp_starts[gi + 1]
+            wg = int(wgroup_of[mz[lo]])
+            beta = int(band_z[mz[lo], lanes[lo]])
+            zids = mz[lo:hi]
+            lns = lanes[lo:hi]
+            occ = _occ(zids)
+            n_copies = int(occ.max()) + 1
+            for cp in range(n_copies):
+                sel = occ == cp
+                x_cols[beta].append(
+                    ("w", (wg, beta, cp,
+                           srow_z[zids[sel], lns[sel]],
+                           scol_z[zids[sel], lns[sel]],
+                           zids[sel], lns[sel])))
+
+    # pack columns into instances per band (capacity: 128 columns and
+    # sum of row multiplicities <= 128)
+    xbind_l: list[np.ndarray] = []
+    sigma_x_l: list[np.ndarray] = []
+    xT_of: dict = {}          # ("z1", zi) or ("w", b, beta, copy#) ->
+                              # global xT row, plus per-value slots
+    x_slot_of: dict = {}      # same key -> {(row,col,occ): slot}
+
+    n_xblocks = 0
+    for beta, cols in x_cols.items():
+        ci = 0
+        while ci < len(cols):
+            # greedy: take columns while capacity holds
+            inst_cols = []
+            mult: dict[int, int] = {}
+            while ci < len(cols) and len(inst_cols) < W:
+                tag, payload = cols[ci]
+                if tag == "z1":
+                    zi = payload
+                    lvz = live[zi]
+                    rows_i, counts_i = np.unique(srow_z[zi][lvz],
+                                                 return_counts=True)
+                else:
+                    (_b, _beta, _cp, vrows, vcols, vzk, vzl) = payload
+                    rows_i, counts_i = np.unique(vrows,
+                                                 return_counts=True)
+                m2 = dict(mult)
+                for r, c in zip(rows_i, counts_i):
+                    m2[int(r)] = max(m2.get(int(r), 0), int(c))
+                if sum(m2.values()) > W and inst_cols:
+                    break
+                if sum(m2.values()) > W:
+                    raise AssertionError("x column alone exceeds 128")
+                mult = m2
+                inst_cols.append(cols[ci])
+                ci += 1
+            # emit instance
+            k_of: dict[int, int] = {}
+            k = 0
+            rb = np.zeros(W, np.int32)
+            for r, m in mult.items():
+                k_of[r] = k
+                rb[k:k + m] = r
+                k += m
+            rb[k:] = rb[0] if k else 0
+            sx = np.zeros((W, W), np.int32)
+            for col_idx, (tag, payload) in enumerate(inst_cols):
+                if tag == "z1":
+                    zi = payload
+                    lvz = live[zi]
+                    vrows = srow_z[zi][lvz]
+                    vcols = scol_z[zi][lvz]
+                    key2 = ("z1", zi)
+                else:
+                    (pb, pbeta, pcp, vrows, vcols, vzk, vzl) = payload
+                    key2 = ("w", pb, pbeta, pcp)
+                # occurrence per row within this column
+                o = _occ(vrows)
+                slots = np.array([k_of[int(r)] for r in vrows],
+                                 np.int64) + o
+                sx[slots, col_idx] = vcols
+                xT_of[key2] = n_xblocks * W + col_idx
+                x_slot_of[key2] = slots
+            xbind_l.append(rb)
+            sigma_x_l.append(sx)
+            n_xblocks += 1
+
+    xbind = (np.concatenate(xbind_l) if xbind_l
+             else np.zeros(0, np.int32))
+    sigma_x = (np.concatenate(sigma_x_l, axis=0) if sigma_x_l
+               else np.zeros((0, W), np.int32))
+
+    # ---- w-layer: one block per out-block that has mixed z-rows --------
+    wbind_l: list[np.ndarray] = []
+    sigma_w_l: list[np.ndarray] = []
+    n_wblocks = 0
+    # z assembly
+    zbind = np.zeros(Z, np.int64)
+    sigma_z = np.zeros((Z, W), np.int32)
+    spill_rows: list[np.ndarray] = []
+
+    X = n_xblocks * W
+    pool_x0 = S                    # xT rows start here in pool indexing
+    pool_w0 = S + X
+
+    # direct z-rows
+    for zi in np.nonzero(kind == 0)[0]:
+        zbind[zi] = ref_row[zi]
+        sigma_z[zi] = np.where(live[zi], scol_z[zi], 0)
+    # (kind-3 all-dead rows are bound to the spill identity row after
+    # the spill layer is laid out below)
+
+    # single-band z-rows: z = shuffle of their xT row; the xT row holds
+    # the values at slots x_slot_of -> sigma_z maps out-lane -> slot
+    for zi in np.nonzero(kind == 1)[0]:
+        key2 = ("z1", int(zi))
+        xt = xT_of[key2]
+        slots = x_slot_of[key2]
+        lanes_live = np.nonzero(live[zi])[0]
+        zbind[zi] = pool_x0 + xt
+        sz = np.zeros(W, np.int32)
+        sz[lanes_live] = slots
+        sigma_z[zi] = sz
+    # (dead lanes of kind 0/1/3 z-rows carry garbage; sigma3 never
+    #  selects them — padding out-slots are pointed at spill identity
+    #  cells below.)
+
+    # mixed z-rows: per out-block build the w-block
+    # regroup the "w" columns by out-block
+    wcols_by_group: dict[int, list] = {}
+    payload_of = {}
+    for beta, cols in x_cols.items():
+        for tag, payload in cols:
+            if tag == "w":
+                key2 = ("w", payload[0], payload[1], payload[2])
+                payload_of[key2] = payload
+                wcols_by_group.setdefault(payload[0], []).append(key2)
+
+    for wg, keys2 in sorted(wcols_by_group.items()):
+        assert len(keys2) <= W, "w-group band-copy budget violated"
+        wb = np.zeros(W, np.int32)
+        sw = np.zeros((W, W), np.int32)
+        for m, key2 in enumerate(keys2):
+            (_pg, _pbeta, _pcp, vrows, vcols, vzk, vzl) = \
+                payload_of[key2]
+            wb[m] = pool_x0 + xT_of[key2]
+            slots = x_slot_of[key2]
+            # w[m, c]: lane c = the z-row's column within its w-group;
+            # the wT column c holds z-row c's values, one per (band,
+            # copy) row m; sigma_z routes out-lane -> m.
+            sw[m, wcol_of[vzk]] = slots
+            sigma_z[vzk, vzl] = m
+        wbind_l.append(wb)
+        sigma_w_l.append(sw)
+    n_wblocks = n_wgroups
+    mixed_all = np.nonzero(kind == 2)[0]
+    zbind[mixed_all] = (pool_w0 + wgroup_of[mixed_all] * W +
+                        wcol_of[mixed_all])
+
+    wbind = (np.concatenate(wbind_l) if wbind_l
+             else np.zeros(0, np.int32))
+    sigma_w = (np.concatenate(sigma_w_l, axis=0) if sigma_w_l
+               else np.zeros((0, W), np.int32))
+
+    # ---- spill layer: identity cells for padding output slots ----------
+    # one spill row per out-block that has padding slots; cell [0, i]
+    # = dead for all i.
+    Wn = n_wblocks * W
+    pool_s0 = S + X + Wn
+    spill_need = np.full((1, W), dead_slot, np.int64)   # shared row
+    # Padding out-slots must read the identity; the resolution: point
+    # them (via sigma3) at a z position whose cell is identity for
+    # every lane — an all-dead (kind-3) z-row bound to the spill
+    # identity row, or, for blocks without one, position 127 converted
+    # into a spill-backed row (its dead lanes gather the identity).
+    for zi in np.nonzero(kind == 3)[0]:
+        zbind[zi] = pool_s0 + 0
+        sigma_z[zi] = 0
+    # padding out-slots: their rank-k positions: if that z-row is
+    # kind 3 -> identity (ok).  If the z-row has live lanes (mixed
+    # dead/live), lane i is dead there by construction (out-row i's
+    # k-th rank is dead only when ranks >= its live count; z-row k has
+    # i's k-th ranked need...).  For such rows we must deliver
+    # identity at lane i: only kind-2 rows can mix sources per lane?
+    # No -- every z-row has ONE source row.  Fix: route padding slots
+    # through a dedicated spill z position is impossible (depth 128).
+    # Instead re-point sigma3 for padding slots at position k* where
+    # k* is a kind-3 z-row of the block (exists iff some out-row in
+    # the block is fully padded...).  Not guaranteed.  FALLBACK: for
+    # blocks with padding but no kind-3 row, convert their LAST z-row
+    # (k=127, the most-dead position) to a spill row gathering its
+    # live needs + identity elsewhere.
+    sp_count = 1
+    for b in range(nb):
+        blk = slice(b * W, (b + 1) * W)
+        needb = need[blk]
+        if not (needb < 0).any():
+            continue
+        zk3 = np.nonzero(kind[b * W:(b + 1) * W] == 3)[0]
+        if zk3.size:
+            kstar = int(zk3[0])
+        else:
+            # convert position 127 into a spill row
+            zi = b * W + (W - 1)
+            row = np.full(W, dead_slot, np.int64)
+            lvz = live[zi]
+            row[np.nonzero(lvz)[0]] = (srow_z[zi][lvz].astype(np.int64)
+                                       * W + scol_z[zi][lvz])
+            spill_rows.append(row)
+            zbind[zi] = pool_s0 + sp_count
+            sigma_z[zi] = np.arange(W, dtype=np.int32)
+            kind[zi] = 4                      # spill-backed
+            sp_count += 1
+            kstar = W - 1
+        pr, pl = np.nonzero(needb < 0)
+        sigma3[b * W + pr, pl] = kstar
+
+    if spill_rows:
+        spill_need = np.concatenate(
+            [spill_need, np.stack(spill_rows)], axis=0)
+    Zs = spill_need.shape[0]
+
+    live_vals = int(live.sum())
+    plan = Route3Plan(
+        xbind=xbind, sigma_x=sigma_x, n_xblocks=n_xblocks,
+        wbind=wbind, sigma_w=sigma_w, n_wblocks=n_wblocks,
+        zbind=zbind.astype(np.int32), sigma_z=sigma_z,
+        spill_need=spill_need.astype(np.int32), sigma3=sigma3,
+        n_blocks=nb, out=out, dead_slot=dead_slot, n_state_rows=S,
+        stats={})
+    ne = len(dst_local)
+    plan.stats = dict(
+        ne=ne, R_out=R, Z=Z, X=X, Wn=Wn, Zs=Zs,
+        n_xblocks=n_xblocks, n_wblocks=n_wblocks,
+        kinds={int(kk): int((kind == kk).sum()) for kk in range(5)},
+        gather_per_edge=Zs * W / max(ne, 1),
+        x_slots_per_edge=X * W / max(ne, 1),
+        w_slots_per_edge=Wn * W / max(ne, 1),
+        out_inflation=R * W / max(ne, 1))
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# numpy reference executor
+# ---------------------------------------------------------------------------
+
+def route3_numpy(plan: Route3Plan, state_ext: np.ndarray) -> np.ndarray:
+    """state_ext: flat state with the identity row appended at
+    plan.dead_slot's row.  Returns delivered values [R_out, 128]."""
+    s2d = np.asarray(state_ext).reshape(-1, W)[:plan.n_state_rows]
+
+    def layer(bind, sigma, pool):
+        src = pool[bind]
+        blk = np.take_along_axis(src, sigma, axis=1)
+        n = blk.shape[0] // W
+        return (blk.reshape(n, W, W).transpose(0, 2, 1)
+                .reshape(-1, W))
+
+    xT = (layer(plan.xbind, plan.sigma_x, s2d)
+          if plan.xbind.size else np.zeros((0, W), s2d.dtype))
+    pool1 = np.concatenate([s2d, xT], axis=0)
+    wT = (layer(plan.wbind, plan.sigma_w, pool1)
+          if plan.wbind.size else np.zeros((0, W), s2d.dtype))
+    spill = np.asarray(state_ext)[plan.spill_need]
+    pool = np.concatenate([s2d, xT, wT, spill], axis=0)
+    zsrc = pool[plan.zbind]
+    z = np.take_along_axis(zsrc, plan.sigma_z, axis=1)
+    zT = (z.reshape(plan.n_blocks, W, W).transpose(0, 2, 1)
+          .reshape(-1, W))
+    return np.take_along_axis(zT, plan.sigma3, axis=1)
