@@ -29,17 +29,13 @@ import time
 def build_graph(args, weighted=False):
     import numpy as np
 
-    from lux_tpu.convert import rmat_edges
-    from lux_tpu.graph import Graph
+    from lux_tpu.convert import rmat_graph
 
     t0 = time.perf_counter()
-    src, dst, nv = rmat_edges(scale=args.scale, edge_factor=args.ef,
-                              seed=0)
-    w = None
+    g = rmat_graph(scale=args.scale, edge_factor=args.ef, seed=0)
     if weighted:
         rng = np.random.default_rng(1)
-        w = rng.integers(1, 6, size=src.shape[0]).astype(np.int32)
-    g = Graph.from_edges(src, dst, nv, weights=w)
+        g.weights = rng.integers(1, 6, size=g.ne).astype(np.int32)
     if args.verbose:
         print(f"# graph built: nv={g.nv} ne={g.ne} "
               f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)

@@ -89,6 +89,27 @@ def rmat_edges(scale: int, edge_factor: int = 16, seed: int = 0,
     return perm[src.astype(np.uint32)], perm[dst.astype(np.uint32)], nv
 
 
+def rmat_graph(scale: int, edge_factor: int = 16, seed: int = 0,
+               prefer_native: bool = True):
+    """Build an R-MAT Graph, using the native C++ generate+sort+CSC
+    path when available (~10x faster host setup at benchmark scales);
+    falls back to rmat_edges + edges_to_csc.  The two paths use
+    different RNG streams: same distribution, different instances."""
+    from lux_tpu.graph import Graph
+
+    if prefer_native:
+        from lux_tpu import native
+        if native.available():
+            row_ptrs, col_idx, degrees = native.rmat_csc(
+                scale, edge_factor, seed)
+            nv = 1 << scale
+            return Graph(nv=nv, ne=int(col_idx.shape[0]),
+                         row_ptrs=row_ptrs, col_idx=col_idx,
+                         weights=None, out_degrees=degrees)
+    src, dst, nv = rmat_edges(scale, edge_factor, seed)
+    return Graph.from_edges(src, dst, nv)
+
+
 def uniform_random_edges(nv: int, ne: int, seed: int = 0, weighted=False):
     """Erdos-Renyi-ish random edge list (test-sized graphs)."""
     rng = np.random.default_rng(seed)

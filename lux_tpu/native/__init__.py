@@ -36,6 +36,18 @@ def ensure_built(quiet: bool = True) -> bool:
     return os.path.exists(_LIB) and os.path.exists(CONVERTER)
 
 
+def _rebuild() -> bool:
+    """Force a rebuild (stale .so from before a source was added)."""
+    try:
+        subprocess.run(["make", "-C", _DIR, "clean"], check=True,
+                       capture_output=True)
+        subprocess.run(["make", "-C", _DIR], check=True,
+                       capture_output=True)
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    return os.path.exists(_LIB)
+
+
 def _load_lib():
     global _lib
     if _lib is not None:
@@ -43,6 +55,23 @@ def _load_lib():
     if not os.path.exists(_LIB) and not ensure_built():
         raise OSError("native library unavailable (no toolchain?)")
     lib = ctypes.CDLL(_LIB)
+    try:
+        _bind(lib)
+    except AttributeError:
+        # stale build missing a newer symbol: rebuild once.  dlopen
+        # caches by path, so the old handle must be closed before the
+        # rebuilt library can be mapped.
+        import _ctypes
+        _ctypes.dlclose(lib._handle)
+        if not _rebuild():
+            raise OSError("native library stale and rebuild failed")
+        lib = ctypes.CDLL(_LIB)
+        _bind(lib)
+    _lib = lib
+    return lib
+
+
+def _bind(lib):
     lib.lux_read_header.restype = ctypes.c_int
     lib.lux_read_header.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
@@ -57,8 +86,11 @@ def _load_lib():
     lib.lux_count_degrees.argtypes = [
         ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64,
         ctypes.c_void_p, ctypes.c_int]
-    _lib = lib
-    return lib
+    lib.lux_rmat_csc.restype = ctypes.c_int
+    lib.lux_rmat_csc.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -119,3 +151,26 @@ def count_degrees(path: str, nv: int, ne: int, threads: int = 8):
                                  deg.ctypes.data_as(ctypes.c_void_p),
                                  threads), "count_degrees")
     return deg
+
+
+def rmat_csc(scale: int, edge_factor: int = 16, seed: int = 0,
+             a: float = 0.57, b: float = 0.19, c: float = 0.19):
+    """Generate an R-MAT graph directly as dst-sorted CSC in C++.
+
+    Returns (row_ptrs u64[nv] END offsets, col_idx u32[ne],
+    out_degrees u32[nv]).  Same distribution family as
+    lux_tpu.convert.rmat_edges but a different RNG stream, so graphs
+    are NOT bit-identical to the numpy generator's.
+    """
+    lib = _load_lib()
+    nv = 1 << scale
+    ne = nv * edge_factor
+    row_ptrs = np.empty(nv, dtype=np.uint64)
+    col_idx = np.empty(ne, dtype=np.uint32)
+    degrees = np.empty(nv, dtype=np.uint32)
+    _check(lib.lux_rmat_csc(
+        scale, edge_factor, seed, a, b, c,
+        row_ptrs.ctypes.data_as(ctypes.c_void_p),
+        col_idx.ctypes.data_as(ctypes.c_void_p),
+        degrees.ctypes.data_as(ctypes.c_void_p)), "rmat_csc")
+    return row_ptrs, col_idx, degrees

@@ -114,3 +114,29 @@ def test_graph_from_file_native_matches_mmap(tmp_path):
     np.testing.assert_array_equal(np.asarray(gm.col_idx),
                                   np.asarray(gn.col_idx))
     np.testing.assert_array_equal(gm.out_degrees, gn.out_degrees)
+
+
+def test_native_rmat_csc_valid_and_deterministic():
+    from lux_tpu import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    import numpy as np
+    rp, ci, deg = native.rmat_csc(10, 8, seed=7)
+    nv, ne = 1 << 10, (1 << 10) * 8
+    assert rp.shape == (nv,) and ci.shape == (ne,)
+    assert rp[-1] == ne and (np.diff(rp.astype(np.int64)) >= 0).all()
+    assert (np.bincount(ci, minlength=nv) == deg).all()
+    rp2, ci2, _ = native.rmat_csc(10, 8, seed=7)
+    assert (ci2 == ci).all() and (rp2 == rp).all()
+    _, ci3, _ = native.rmat_csc(10, 8, seed=8)
+    assert not (ci3 == ci).all()
+
+
+def test_rmat_graph_runs_apps():
+    """The native-generated graph must drive the engines end to end."""
+    import numpy as np
+    from lux_tpu.apps import pagerank
+    from lux_tpu.convert import rmat_graph
+    g = rmat_graph(9, 4, seed=3)
+    ranks = pagerank.run(g, 5, num_parts=2)
+    assert np.isfinite(ranks).all() and ranks.shape == (g.nv,)
