@@ -66,13 +66,12 @@ def run_checkpointed(eng, state, num_iters: int, path: str,
     """Run a pull engine ``num_iters`` iterations, checkpointing every
     ``segment`` iterations.  Resume by loading the checkpoint and
     passing its iteration counter as ``start_iter``."""
-    it = start_iter
-    while it < num_iters:
-        n = min(segment, num_iters - it)
-        state = eng.run(state, n)
-        it += n
-        save(path, (state,), {"iter": it, "kind": "pull"})
-    return state
+    from lux_tpu.segmented import run_segments
+
+    return run_segments(
+        eng, state, num_iters, segment, start_iter=start_iter,
+        on_segment=lambda s, done:
+            save(path, (s,), {"iter": done, "kind": "pull"}))
 
 
 def converge_checkpointed(eng, path: str, segment: int = 50,
@@ -81,6 +80,8 @@ def converge_checkpointed(eng, path: str, segment: int = 50,
     """Run a push engine to convergence in ``segment``-iteration
     slices, checkpointing after each slice.  Returns
     (labels, active, total_iters)."""
+    from lux_tpu.segmented import converge_segments
+
     if resume and os.path.exists(path):
         leaves, meta = load(path)
         if meta.get("kind") != "push" or len(leaves) != 2:
@@ -92,17 +93,7 @@ def converge_checkpointed(eng, path: str, segment: int = 50,
     else:
         label, active = eng.init_state()
         done = 0
-    total = done
-    cap = np.iinfo(np.int32).max if max_iters is None else max_iters
-    while total < cap:
-        n = min(segment, cap - total)
-        label, active, it = eng.converge(label, active, n)
-        total += int(np.asarray(it))
-        save(path, (label, active), {"iter": total, "kind": "push"})
-        # converged iff no vertex is active (iteration counts are not a
-        # reliable signal: delta-stepping counts relax steps only)
-        import jax
-
-        if not np.asarray(jax.device_get(active)).any():
-            break
-    return label, active, total
+    return converge_segments(
+        eng, label, active, segment, max_iters, start_iter=done,
+        on_segment=lambda lbl, act, total, cnt:
+            save(path, (lbl, act), {"iter": total, "kind": "push"}))

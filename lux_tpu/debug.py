@@ -1,0 +1,100 @@
+"""Failure detection and numeric guards.
+
+The reference aborts on assert/FatalError and has no divergence
+detection (SURVEY.md §5).  Long iterative runs on real data deserve
+better: these helpers catch NaN escapes and stalled convergence loops
+with actionable errors, without slowing the compiled hot loop (checks
+run on segment boundaries, host-side, via lux_tpu.segmented).
+
+Race detection note: there is nothing to detect.  The engines are
+pure-functional XLA programs — no shared mutable state, no atomics;
+the only "races" possible in the reference's design (concurrent
+region access, atomic update ordering) are excluded by construction
+here, and jit(donate_argnums) buffer reuse is checked by JAX itself.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lux_tpu import segmented
+
+
+class DivergenceError(RuntimeError):
+    pass
+
+
+class StallError(RuntimeError):
+    pass
+
+
+def check_finite(state, where: str = "state",
+                 allow_inf: bool = False) -> None:
+    """Raise DivergenceError if any floating leaf holds NaN (or Inf,
+    unless allow_inf — push labels legitimately use +inf as the
+    unreached sentinel).  Fetches to host; call on segment
+    boundaries."""
+    import jax
+
+    for i, leaf in enumerate(jax.tree.leaves(state)):
+        arr = np.asarray(jax.device_get(leaf))
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        bad = np.isnan(arr) if allow_inf else ~np.isfinite(arr)
+        if bad.any():
+            kind = "NaN" if allow_inf else "non-finite"
+            raise DivergenceError(
+                f"{where}: leaf {i} has {int(bad.sum())} {kind} values "
+                f"(dtype {arr.dtype}, shape {arr.shape})")
+
+
+def run_guarded(eng, state, num_iters: int, segment: int = 50,
+                where: str = "pull run"):
+    """Pull-engine run with a finite check every ``segment``
+    iterations; raises DivergenceError naming the failing segment."""
+    return segmented.run_segments(
+        eng, state, num_iters, segment,
+        on_segment=lambda s, done:
+            check_finite(s, f"{where} @ iteration {done}"))
+
+
+def converge_guarded(eng, max_iters: int | None = None,
+                     segment: int = 64, stall_segments: int = 3):
+    """Push-engine convergence with stall detection.
+
+    Progress is measured by the (monotone) label fingerprint — the sum
+    of finite labels — not the frontier size, which legitimately stays
+    constant on path-like graphs.  If the fingerprint AND the active
+    count are unchanged for ``stall_segments`` consecutive segments
+    while the frontier is non-empty, raises StallError (a monotone
+    program that stops improving but keeps a frontier indicates a
+    broken relax function or truncation livelock).  NaN labels raise
+    DivergenceError (+inf sentinels are fine).
+    Returns (labels, total_iters).
+    """
+    import jax
+
+    label0, active0 = eng.init_state()
+    history: list[tuple] = []
+
+    def on_segment(label, active, total, cnt):
+        if cnt == 0:
+            return
+        check_finite(label, f"push converge @ iteration {total}",
+                     allow_inf=True)
+        arr = np.asarray(jax.device_get(label)).astype(np.float64)
+        fp = float(arr[np.isfinite(arr)].sum())
+        history.append((cnt, fp))
+        if len(history) > stall_segments:
+            history.pop(0)
+        if (len(history) == stall_segments and
+                len(set(history)) == 1):
+            raise StallError(
+                f"frontier stuck at {cnt} active vertices with no "
+                f"label progress for {stall_segments * segment} "
+                f"iterations")
+
+    label, active, total = segmented.converge_segments(
+        eng, label0, active0, segment, max_iters,
+        on_segment=on_segment)
+    return eng.unpad(label), total

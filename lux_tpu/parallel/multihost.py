@@ -1,0 +1,92 @@
+"""Multi-host initialization and mesh construction.
+
+The reference scales multi-node by running the same binary under
+GASNet: Realm address spaces multiply the partition count and the
+mapper spreads index points across nodes (reference pagerank.cc:51-53,
+lux_mapper.cc:116, README.md:33-38).  The TPU-native equivalent is a
+``jax.distributed`` process group: every host runs the same program,
+``jax.devices()`` spans the whole slice/pod, and the same
+``Mesh('parts')`` code paths shard over ICI within a slice and DCN
+across slices — XLA inserts and routes the collectives, exactly as
+Legion/GASNet materialized remote regions.
+
+Typical use (same script on every host):
+
+    from lux_tpu.parallel import multihost
+    multihost.initialize()                  # env-driven (TPU pods:
+                                            # fully automatic)
+    mesh = multihost.global_mesh()          # all devices, 'parts' axis
+    eng = pagerank.build_engine(g, num_parts=mesh.devices.size,
+                                mesh=mesh)
+
+Engines already accept any parts mesh; host-local data feeding uses
+``jax.make_array_from_process_local_data`` if the graph is loaded
+shard-wise per host (each host loads its partitions' slices with
+``native.load_partition`` — the reference's per-part load tasks).
+"""
+
+from __future__ import annotations
+
+
+def initialize(**kwargs) -> None:
+    """Join the jax.distributed process group.  On TPU pods all
+    parameters come from the environment; pass coordinator_address /
+    num_processes / process_id explicitly elsewhere.
+
+    Only the specific "no coordinator configured" case degrades to a
+    single-process run; genuine init failures (unreachable
+    coordinator, bad env) propagate — silently computing per-host
+    answers on a pod would be the worst possible failure mode."""
+    import jax
+
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already" in msg:
+            return                     # double-init is harmless
+        if not kwargs and "before" in msg:
+            # env-driven init after the backend started: single-process
+            import logging
+            logging.getLogger(__name__).info(
+                "jax.distributed not initialized (%s); running "
+                "single-process", e)
+            return
+        raise
+    except ValueError as e:
+        if kwargs:
+            raise
+        if "coordinator_address" in str(e):
+            import logging
+            logging.getLogger(__name__).info(
+                "jax.distributed not initialized (%s); running "
+                "single-process", e)
+            return
+        raise
+
+
+def global_mesh(n_devices: int | None = None):
+    """A 1-D 'parts' mesh over all (global) devices — the axis every
+    lux_tpu engine shards over."""
+    from lux_tpu.parallel.mesh import make_mesh
+
+    import jax
+
+    return make_mesh(n_devices or len(jax.devices()))
+
+
+def process_parts(num_parts: int) -> range:
+    """The contiguous range of partition ids this host is responsible
+    for loading (partition i lives on global device i * P / num_parts).
+    Use with native.load_partition to read only this host's slices of
+    a .lux file."""
+    import jax
+
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    per = num_parts // nproc
+    if num_parts % nproc:
+        raise ValueError(
+            f"num_parts={num_parts} must divide evenly over "
+            f"{nproc} processes")
+    return range(pid * per, (pid + 1) * per)

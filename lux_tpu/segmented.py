@@ -1,0 +1,56 @@
+"""Shared segmented-execution drivers.
+
+Checkpointing (checkpoint.py) and runtime guards (debug.py) both run
+engines in host-visible segments; this module is the single copy of
+that slicing logic so per-segment behaviors (save, finite checks,
+stall detection) compose instead of forking.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+
+def run_segments(eng, state, num_iters: int, segment: int,
+                 on_segment: Callable | None = None,
+                 start_iter: int = 0):
+    """Run a pull engine in ``segment``-iteration slices.
+    ``on_segment(state, done_iters)`` runs after each slice."""
+    done = start_iter
+    while done < num_iters:
+        n = min(segment, num_iters - done)
+        state = eng.run(state, n)
+        done += n
+        if on_segment is not None:
+            on_segment(state, done)
+    return state
+
+
+def converge_segments(eng, label, active, segment: int,
+                      max_iters: int | None = None,
+                      on_segment: Callable | None = None,
+                      start_iter: int = 0):
+    """Run a push engine to convergence in slices.
+
+    ``on_segment(label, active, total_iters, active_count)`` runs after
+    each slice (may raise to abort).  Convergence is detected from the
+    active mask, never from iteration counts (delta-stepping counts
+    relax steps only).  Returns (label, active, total_iters).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    total = start_iter
+    cap = np.iinfo(np.int32).max if max_iters is None else max_iters
+    while total < cap:
+        n = min(segment, cap - total)
+        label, active, it = eng.converge(label, active, n)
+        total += int(np.asarray(jax.device_get(it)))
+        cnt = int(np.asarray(jax.device_get(jnp.sum(active))))
+        if on_segment is not None:
+            on_segment(label, active, total, cnt)
+        if cnt == 0:
+            break
+    return label, active, total
