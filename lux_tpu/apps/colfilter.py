@@ -36,6 +36,11 @@ def make_program(k: int = K, lam: float = LAMBDA,
         err = weight - jnp.sum(src_val * dst_val, axis=-1)
         return err[..., None] * src_val
 
+    def edge_value_from_dot(src_val, dot, weight):
+        # dst dependence is only <src, dst>: lets the tiled engine get
+        # the dot from MXU matmuls instead of a per-edge dst gather
+        return (weight - dot)[..., None] * src_val
+
     def apply(old, red, ctx):
         return old + gamma * (red - lam * old)
 
@@ -44,7 +49,8 @@ def make_program(k: int = K, lam: float = LAMBDA,
         return np.full((sg.num_parts, sg.vpad, k), val, dtype=np.float32)
 
     return PullProgram(reduce="sum", edge_value=edge_value, apply=apply,
-                       init=init, needs_dst=True)
+                       init=init, needs_dst=True,
+                       edge_value_from_dot=edge_value_from_dot)
 
 
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,

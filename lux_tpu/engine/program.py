@@ -48,9 +48,18 @@ class PullProgram:
                 [num_parts, vpad, ...] (numpy).
     needs_dst   whether edge_value reads dst_val (skips a gather when
                 False).
+    edge_value_from_dot
+                optional (src_val [*,K], dot [*], weight [*]) -> msg;
+                for programs whose dst dependence is ONLY through the
+                inner product <src, dst> (e.g. colfilter's rating
+                error).  When set and the layout is tiled, the engine
+                computes the dot on the MXU from the destination TILE
+                (dst values are tile-positional, so the ~9 ns/edge dst
+                row-gather disappears; see PullEngine._part_step_dot).
     """
     reduce: str
     edge_value: Callable
     apply: Callable
     init: Callable
     needs_dst: bool = False
+    edge_value_from_dot: Callable | None = None

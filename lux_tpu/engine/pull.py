@@ -35,7 +35,8 @@ from jax.sharding import PartitionSpec
 from lux_tpu.engine.program import PartCtx, PullProgram
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
-from lux_tpu.ops.tiled import TiledLayout, tiled_segment_reduce
+from lux_tpu.ops.tiled import (TiledLayout, combine_chunks,
+                               tiled_segment_reduce)
 from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
 
 
@@ -95,13 +96,24 @@ class PullEngine:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
                 f"{mesh.devices.size}")
+        if program.edge_value_from_dot is not None:
+            if program.reduce != "sum":
+                raise ValueError(
+                    "edge_value_from_dot requires reduce='sum' (the "
+                    "mask-matmul partial reduction is a sum)")
+            if not sg.weighted:
+                raise ValueError(
+                    "edge_value_from_dot requires a weighted graph "
+                    "(the dot path passes per-edge weights)")
         self.sg = sg
         self.program = program
         self.mesh = mesh
         self.use_mxu = use_mxu
         self.reduce_method = resolve_reduce_method(reduce_method)
         arrays, self.tiles = build_graph_arrays(
-            sg, layout, program.needs_dst, tile_w, tile_e)
+            sg, layout,
+            program.needs_dst or program.edge_value_from_dot is not None,
+            tile_w, tile_e)
         if mesh is not None:
             arrays = shard_over_parts(mesh, arrays)
         self.arrays = arrays
@@ -116,6 +128,14 @@ class PullEngine:
         return state
 
     # -- one part's work ----------------------------------------------
+
+    def _apply_epilogue(self, old_p, red, g):
+        sg, prog = self.sg, self.program
+        ctx = PartCtx(deg=g["deg"], vmask=g["vmask"], nv=sg.nv, ne=sg.ne)
+        new = prog.apply(old_p, red, ctx)
+        keep = g["vmask"].reshape(g["vmask"].shape +
+                                  (1,) * (new.ndim - 1))
+        return jnp.where(keep, new, old_p)
 
     def _part_step(self, flat_state, old_p, g):
         """g: dict of this part's graph arrays."""
@@ -150,18 +170,75 @@ class PullEngine:
                         "pallas" if self.reduce_method.startswith("pallas")
                         else "xla"),
                 interpret=self.reduce_method == "pallas-interpret")
-        ctx = PartCtx(deg=g["deg"], vmask=g["vmask"], nv=sg.nv, ne=sg.ne)
-        new = prog.apply(old_p, red, ctx)
-        keep = g["vmask"].reshape(g["vmask"].shape +
-                                  (1,) * (new.ndim - 1))
-        return jnp.where(keep, new, old_p)
+        return self._apply_epilogue(old_p, red, g)
+
+    def _part_step_dot(self, flat_state, old_p, g):
+        """Tiled-layout step for programs whose dst dependence is only
+        the inner product <src, dst> (program.edge_value_from_dot).
+
+        The dst row-gather (~9 ns/edge, 75% of a colfilter iteration)
+        is replaced by MXU matmuls against the chunk's destination
+        TILE: per chunk, D = src @ tile^T gives every (edge, dst-lane)
+        dot; a lane-compare selects each edge's own dot; and the
+        message reduction is a one-hot mask matmul — the SGD gradient
+        as two batched matmuls (the TPU answer to the reference's
+        shared-memory gradient staging, colfilter_gpu.cu:41-102).
+        Chunks are processed in lax.map blocks so the [B, E, W]
+        intermediates stay small.
+        """
+        sg, lay, prog = self.sg, self.tiles, self.program
+        W, E = lay.W, lay.E
+        C = lay.n_chunks
+        Kdim = old_p.shape[-1]
+
+        src_vals = jnp.take(flat_state, g["src_slot"], axis=0)
+        src_vals = jax.lax.optimization_barrier(src_vals)  # [C, E, K]
+        n_tiles = lay.n_tiles
+        old_pad = jnp.pad(old_p, ((0, n_tiles * W - sg.vpad), (0, 0)))
+        tiles = old_pad.reshape(n_tiles, W, Kdim)
+        tile_vals = jnp.take(tiles, jnp.minimum(g["chunk_tile"],
+                                                n_tiles - 1), axis=0)
+        rel = g["rel_dst"]
+        wgt = g.get("weight")
+
+        B = max(1, min(64, C))
+        nB = (C + B - 1) // B
+        Cp = nB * B
+
+        def pad_c(x):
+            return jnp.pad(x, ((0, Cp - C),) + ((0, 0),) * (x.ndim - 1))
+
+        lanes = jnp.arange(W, dtype=rel.dtype)
+
+        def block(args):
+            s, t, r, w = args
+            D = jnp.einsum("bek,bwk->bew", s, t,
+                           preferred_element_type=s.dtype)
+            mask = r[..., None] == lanes                   # [B, E, W]
+            dot = jnp.sum(jnp.where(mask, D, 0), axis=-1)  # [B, E]
+            msgs = prog.edge_value_from_dot(s, dot, w)     # [B, E, K]
+            return jnp.einsum("bew,bek->bwk", mask.astype(s.dtype),
+                              msgs)                        # [B, W, K]
+
+        args = (pad_c(src_vals).reshape(nB, B, E, Kdim),
+                pad_c(tile_vals).reshape(nB, B, W, Kdim),
+                pad_c(rel).reshape(nB, B, E),
+                pad_c(wgt).reshape(nB, B, E))
+        partials = jax.lax.map(block, args).reshape(Cp, W, Kdim)[:C]
+        red = combine_chunks(partials, lay, g["chunk_start"],
+                             g["last_chunk"], prog.reduce)
+        red = red.reshape(n_tiles * W, Kdim)[:sg.vpad]
+        return self._apply_epilogue(old_p, red, g)
 
     def _parts_step(self, local_state, full_state, g_local):
         """vmap _part_step over this device's parts."""
         sg = self.sg
         flat = full_state.reshape((sg.num_parts * sg.vpad,) +
                                   full_state.shape[2:])
-        return jax.vmap(lambda old, g: self._part_step(flat, old, g))(
+        use_dot = (self.program.edge_value_from_dot is not None
+                   and self.tiles is not None)
+        step = self._part_step_dot if use_dot else self._part_step
+        return jax.vmap(lambda old, g: step(flat, old, g))(
             local_state, g_local)
 
     # -- full step over all parts -------------------------------------

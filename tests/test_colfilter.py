@@ -44,3 +44,33 @@ def test_unweighted_rejected():
     g = Graph.from_edges(src, dst, 10)
     with pytest.raises(ValueError):
         colfilter.build_engine(g)
+
+
+def test_dot_path_rejects_bad_programs():
+    import pytest
+    from lux_tpu.engine.program import PullProgram
+    from lux_tpu.engine.pull import PullEngine
+    from lux_tpu.graph import Graph, ShardedGraph
+    from lux_tpu.convert import uniform_random_edges
+    import numpy as np
+
+    src, dst, w = uniform_random_edges(60, 300, seed=91, weighted=True)
+    gw = Graph.from_edges(src, dst, 60, weights=w)
+    gu = Graph.from_edges(src, dst, 60)
+
+    def mk(reduce):
+        return PullProgram(
+            reduce=reduce, edge_value=lambda s, d, w: s,
+            apply=lambda o, r, c: r,
+            init=lambda sg: np.zeros((sg.num_parts, sg.vpad, 4),
+                                     np.float32),
+            edge_value_from_dot=lambda s, dot, w: s)
+
+    with pytest.raises(ValueError, match="sum"):
+        PullEngine(ShardedGraph.build(gw, 1), mk("min"))
+    with pytest.raises(ValueError, match="weighted"):
+        PullEngine(ShardedGraph.build(gu, 1), mk("sum"))
+    # needs_dst=False with edge_value_from_dot must still work
+    eng = PullEngine(ShardedGraph.build(gw, 1), mk("sum"))
+    out = eng.step(eng.init_state())
+    assert np.isfinite(np.asarray(out)).all()
