@@ -60,6 +60,21 @@ def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):
     return eng.unpad(state)
 
 
+def run_until(g: Graph, tol: float = 1e-9, max_iters: int = 10000,
+              num_parts: int = 1, mesh=None):
+    """Convergence-driven PageRank (a superset of the reference's
+    fixed -ni runs): iterate until the max-abs change of the DEGREE-
+    SCALED rank state (the iteration variable, see module docstring)
+    is <= tol.  Conventional-rank changes can be up to out_degree
+    times larger; pick tol accordingly.  Returns
+    (ranks [nv], iterations)."""
+    import jax
+
+    eng = build_engine(g, num_parts, mesh)
+    state, it, _res = eng.run_until(eng.init_state(), tol, max_iters)
+    return eng.unpad(state), int(jax.device_get(it))
+
+
 def true_ranks(norm_ranks: np.ndarray, out_degrees: np.ndarray):
     """Undo the degree scaling: conventional PageRank values."""
     deg = np.asarray(out_degrees)

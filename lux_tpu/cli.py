@@ -95,6 +95,13 @@ def cmd_pagerank(argv):
     ap = argparse.ArgumentParser(prog="lux_tpu pagerank")
     _common(ap)
     ap.add_argument("-ni", type=int, default=10)
+    ap.add_argument("-tol", type=float, default=None,
+                    help="run to convergence (max-abs change of the "
+                         "degree-scaled rank state <= tol) instead of "
+                         "a fixed -ni count")
+    ap.add_argument("-max-iters", type=int, default=10000,
+                    dest="max_iters",
+                    help="iteration cap for -tol runs (default 10000)")
     args = ap.parse_args(argv)
 
     from lux_tpu.apps import pagerank
@@ -103,10 +110,18 @@ def cmd_pagerank(argv):
     mesh, num_parts = _mesh_and_parts(args)
     sg = _build_sg(args, g, num_parts)
     eng = pagerank.build_engine(g, num_parts, mesh, sg=sg)
-    state, elapsed = timed_fused_run(eng, args.ni,
-                                     trace_dir=args.profile)
-    print(f"ELAPSED TIME = {elapsed:.7f} s")
-    print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
+    if args.tol is not None:
+        from lux_tpu.timing import timed_run_until
+        state, iters, res, elapsed = timed_run_until(
+            eng, args.tol, args.max_iters, trace_dir=args.profile)
+        print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations, "
+              f"residual {res:.3e})")
+        print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
+    else:
+        state, elapsed = timed_fused_run(eng, args.ni,
+                                         trace_dir=args.profile)
+        print(f"ELAPSED TIME = {elapsed:.7f} s")
+        print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
 
     if args.check:
         from lux_tpu import check

@@ -309,6 +309,40 @@ class PullEngine:
             state = self.step(state)
         return state
 
+    @functools.cached_property
+    def _run_until(self):
+        core = self._step_core
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def run(state, tol, max_iters, *gargs):
+            def cond(c):
+                it, s, res = c
+                return (res > tol) & (it < max_iters)
+
+            def body(c):
+                it, s, _ = c
+                new = core(s, *gargs)
+                res = jnp.max(jnp.abs(new.astype(jnp.float32) -
+                                      s.astype(jnp.float32)))
+                return it + 1, new, res
+
+            it, s, res = jax.lax.while_loop(
+                cond, body, (jnp.int32(0), state, jnp.float32(jnp.inf)))
+            return s, it, res
+
+        return run
+
+    def run_until(self, state, tol: float,
+                  max_iters: int = np.iinfo(np.int32).max):
+        """Iterate until the max-abs change of the STATE (whatever
+        the program iterates — e.g. pagerank's degree-scaled ranks)
+        falls to ``tol``, or max_iters, entirely inside one XLA
+        program — convergence-driven runs the reference lacks (fixed
+        -ni only, reference pagerank.cc:109-114).  Returns
+        (state, iterations, final_residual) as device scalars."""
+        return self._run_until(state, jnp.float32(tol),
+                               jnp.int32(max_iters), *self.graph_args)
+
     def unpad(self, state) -> np.ndarray:
         """Padded device state -> [nv, ...] user order (host)."""
         return self.sg.from_padded(np.asarray(jax.device_get(state)))

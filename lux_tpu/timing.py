@@ -65,3 +65,21 @@ def timed_converge(eng, max_iters=None, verbose: bool = False,
         iters = int(fetch(iters))
         elapsed = time.perf_counter() - t0
     return eng.unpad(label), iters, elapsed
+
+
+def timed_run_until(eng, tol: float, max_iters: int,
+                    trace_dir: str | None = None):
+    """Warm a pull engine's convergence program with a one-iteration
+    call of the SAME executable (tol/max_iters are traced args, so no
+    recompile), then time a fresh run-to-convergence; a trace_dir
+    captures only the timed run.  Returns (state, iters, residual,
+    elapsed)."""
+    s0, _it, _res = eng.run_until(eng.init_state(), tol, max_iters=1)
+    fetch(s0)
+    state0 = eng.init_state()
+    with _trace_ctx(trace_dir):
+        t0 = time.perf_counter()
+        state, it, res = eng.run_until(state0, tol, max_iters)
+        iters = int(fetch(it))
+        elapsed = time.perf_counter() - t0
+    return state, iters, float(fetch(res)), elapsed

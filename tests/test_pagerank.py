@@ -60,3 +60,21 @@ def test_true_ranks_sum_to_one(small_graph):
     # with ALPHA=0.15 damping-form, fixed point sums near (1-A)/(1-A) = 1
     # only approximately on random graphs; sanity band:
     assert 0.5 < ranks.sum() < 2.0
+
+
+def test_run_until_matches_long_fixed_run():
+    from lux_tpu.convert import uniform_random_edges
+    src, dst = uniform_random_edges(120, 900, seed=55)
+    g = Graph.from_edges(src, dst, 120)
+    ranks, iters = pagerank.run_until(g, tol=1e-10, num_parts=2)
+    fixed = pagerank.run(g, 200, num_parts=2)
+    np.testing.assert_allclose(ranks, fixed, rtol=1e-6, atol=1e-12)
+    assert 0 < iters < 200
+
+
+def test_run_until_respects_max_iters():
+    from lux_tpu.convert import uniform_random_edges
+    src, dst = uniform_random_edges(80, 500, seed=56)
+    g = Graph.from_edges(src, dst, 80)
+    _, iters = pagerank.run_until(g, tol=0.0, max_iters=7, num_parts=1)
+    assert iters == 7
