@@ -94,6 +94,13 @@ def main() -> int:
         from lux_tpu.apps import components, sssp
         g = build_graph(args)
         if args.config == "cc":
+            # CC semantics need an undirected graph; symmetrize and
+            # count the doubled edge set in GTEPS (it is what runs)
+            from lux_tpu.graph import Graph
+            s, d = components.symmetrize(*g.edge_arrays())
+            g = Graph.from_edges(s, d, g.nv)
+            if args.verbose:
+                print(f"# symmetrized: ne={g.ne}", file=sys.stderr)
             eng = components.build_engine(g, num_parts=args.np)
         else:
             eng = sssp.build_engine(g, start_vertex=0,
