@@ -81,7 +81,18 @@ def main() -> int:
     if args.config == "pagerank":
         from lux_tpu.apps import pagerank
         g = build_graph(args)
-        eng = pagerank.build_engine(g, num_parts=args.np)
+        if args.np == 1:
+            # degree relabel + pair-lane delivery: dense tile pairs
+            # skip the per-edge gather (ops/pairs.py; +40% measured)
+            g2, _perm = pagerank.degree_relabel(g)
+            eng = pagerank.build_engine(g2, num_parts=1,
+                                        pair_threshold=16)
+            if args.verbose and eng.pairs is not None:
+                s = eng.pairs.stats
+                print(f"# pair-lane coverage "
+                      f"{s['coverage'] * 100:.1f}%", file=sys.stderr)
+        else:
+            eng = pagerank.build_engine(g, num_parts=args.np)
         gteps = bench_fused(eng, g, args.ni, args.verbose) / 1e9
         name = f"pagerank_rmat{args.scale}"
     elif args.config == "colfilter":

@@ -45,11 +45,32 @@ def make_program(dtype=jnp.float32) -> PullProgram:
 
 
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
-                 dtype=jnp.float32, sg: ShardedGraph | None = None
-                 ) -> PullEngine:
+                 dtype=jnp.float32, sg: ShardedGraph | None = None,
+                 pair_threshold: int | None = None) -> PullEngine:
     if sg is None:
-        sg = ShardedGraph.build(g, num_parts)
-    return PullEngine(sg, make_program(dtype), mesh=mesh)
+        sg = ShardedGraph.build(
+            g, num_parts,
+            vpad_align=128 if pair_threshold is not None else 8)
+    # residual edges after pair extraction are sparse; shorter chunks
+    # waste far fewer padded gather slots
+    tile_e = 128 if pair_threshold is not None else 512
+    return PullEngine(sg, make_program(dtype), mesh=mesh,
+                      pair_threshold=pair_threshold, tile_e=tile_e)
+
+
+def degree_relabel(g: Graph):
+    """Relabel vertices by descending total degree — concentrates hubs
+    into shared 128-vertex tiles so pair-lane delivery
+    (PullEngine pair_threshold; ops/pairs.py) finds dense tile pairs.
+    Returns (relabeled graph, perm) with perm[new] = old."""
+    src, dst = g.edge_arrays()
+    deg = (np.bincount(src, minlength=g.nv)
+           + np.bincount(dst, minlength=g.nv))
+    perm = np.argsort(-deg, kind="stable")
+    rank = np.empty(g.nv, np.int64)
+    rank[perm] = np.arange(g.nv)
+    g2 = Graph.from_edges(rank[src], rank[dst], g.nv, weights=g.weights)
+    return g2, perm
 
 
 def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):

@@ -36,7 +36,7 @@ from lux_tpu.engine.program import PartCtx, PullProgram
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
 from lux_tpu.ops.tiled import (TiledLayout, combine_chunks,
-                               tiled_segment_reduce)
+                               combine_op, tiled_segment_reduce)
 from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
 
 
@@ -44,6 +44,11 @@ from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
 # intermediate (~32 MB at the default tile sizes; 128 measured best
 # on v5e, within 3% of every size from 32 up)
 DOT_BLOCK_CHUNKS = 128
+
+
+def _reduce_axis1(x, kind: str):
+    return {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[kind](
+        x, axis=1)
 
 
 def resolve_reduce_method(method: str) -> str:
@@ -97,11 +102,16 @@ class PullEngine:
     def __init__(self, sg: ShardedGraph, program: PullProgram, mesh=None,
                  layout: str = "tiled", tile_w: int = 128,
                  tile_e: int = 512, use_mxu: bool = False,
-                 reduce_method: str = "auto"):
+                 reduce_method: str = "auto",
+                 pair_threshold: int | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
                 f"{mesh.devices.size}")
+        self.pairs = None
+        if pair_threshold is not None:
+            sg = self._setup_pairs(sg, pair_threshold, mesh, layout,
+                                   program)
         if program.edge_value_from_dot is not None:
             if program.reduce != "sum":
                 raise ValueError(
@@ -120,10 +130,122 @@ class PullEngine:
             sg, layout,
             program.needs_dst or program.edge_value_from_dot is not None,
             tile_w, tile_e)
+        if self.pairs is not None:
+            arrays["pair_rowbind"] = jnp.asarray(self.pairs.rowbind[None])
+            arrays["pair_rel"] = jnp.asarray(self.pairs.rel_dst[None])
+            arrays["pair_tile_pos"] = jnp.asarray(
+                self._pair_tile_pos[None])
         if mesh is not None:
             arrays = shard_over_parts(mesh, arrays)
         self.arrays = arrays
         self._step_fn = self._build_step()
+
+    # -- pair-lane fast path (ops/pairs.py) ----------------------------
+
+    def _setup_pairs(self, sg: ShardedGraph, threshold: int, mesh,
+                     layout, program):
+        """Split dense (src-tile, dst-tile) pair edges out of the
+        regular gather path (see ops/pairs.py): gather cost is per ROW
+        fetched, so pair rows fetch a 128-wide source state row once
+        and deliver positionally.  Returns the RESIDUAL ShardedGraph
+        the normal machinery should run on."""
+        import dataclasses as _dc
+
+        from lux_tpu.ops.pairs import build_pair_plan
+
+        if mesh is not None or sg.num_parts != 1:
+            raise ValueError("pair_threshold supports num_parts=1 "
+                             "without a mesh (bench configuration)")
+        if layout != "tiled":
+            raise ValueError("pair_threshold requires the tiled layout")
+        if sg.weighted:
+            raise ValueError("pair_threshold supports unweighted "
+                             "graphs (per-lane weights not plumbed)")
+        if program.needs_dst or program.edge_value_from_dot is not None:
+            raise ValueError("pair_threshold supports programs whose "
+                             "edge_value depends only on the source "
+                             "state (needs_dst=False)")
+        if sg.vpad % 128:
+            raise ValueError("pair_threshold needs vpad % 128 == 0; "
+                             "build the ShardedGraph with "
+                             "vpad_align=128")
+        nep = int(sg.ne_part[0])
+        plan = build_pair_plan(sg.src_slot[0, :nep],
+                               sg.dst_local[0, :nep], sg.vpad,
+                               threshold=threshold)
+        if plan.stats["covered"] == 0:
+            return sg                       # nothing dense enough
+        # pad rows to the pallas kernel's block granularity
+        R = plan.rowbind.shape[0]
+        Rp = -(-max(R, 64) // 64) * 64
+        if Rp != R:
+            plan.rowbind = np.concatenate(
+                [plan.rowbind, np.zeros(Rp - R, np.int32)])
+            plan.rel_dst = np.concatenate(
+                [plan.rel_dst,
+                 np.full((Rp - R, 128), 128, np.int32)], axis=0)
+        self.pairs = plan
+        # residual edge arrays, re-padded
+        res = plan.residual
+        r_src = sg.src_slot[0, :nep][res]
+        r_dst = sg.dst_local[0, :nep][res]
+        ne_r = len(r_dst)
+        epad_r = max(128, -(-ne_r // 128) * 128)
+        src_slot = np.zeros((1, epad_r), np.int32)
+        dst_local = np.full((1, epad_r), sg.vpad, np.int32)
+        src_slot[0, :ne_r] = r_src
+        dst_local[0, :ne_r] = r_dst
+        counts = np.bincount(r_dst, minlength=sg.vpad)
+        row_ptr_local = np.zeros((1, sg.vpad + 1), np.int32)
+        row_ptr_local[0, 1:] = np.cumsum(counts)
+        # tile position of every part-local tile in class-slot order
+        # (passed as a jit argument with the other pair arrays)
+        self._pair_tile_pos = np.empty(plan.n_tiles, np.int32)
+        self._pair_tile_pos[plan.tile_order] = np.arange(
+            plan.n_tiles, dtype=np.int32)
+        self._pair_covered_slots = sum(
+            cnt for (_t0, cnt, _L) in plan.classes)
+        return _dc.replace(sg, src_slot=src_slot, dst_local=dst_local,
+                           row_ptr_local=row_ptr_local,
+                           ne_part=np.array([ne_r], np.int64),
+                           epad=epad_r)
+
+    def _pair_red(self, flat_state, rowbind, rel, tile_pos):
+        """Pair-lane delivery + reduce -> [vpad] partial (identity
+        where pairs contribute nothing)."""
+        from lux_tpu.ops.segment import identity_for
+        from lux_tpu.ops.tiled import chunk_partials
+
+        plan = self.pairs
+        prog = self.program
+        if flat_state.ndim != 1:
+            raise ValueError("pair_threshold supports scalar vertex "
+                             "state only")
+        s2d = flat_state.reshape(-1, 128)
+        vals = jnp.take(s2d, rowbind, axis=0)           # [R, 128] rows
+        # per-edge message on the delivered source values (dead lanes
+        # carry garbage, masked by rel == 128 in the reduce)
+        vals = prog.edge_value(vals, None, None)
+        if self.reduce_method.startswith("pallas"):
+            from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+            # rows are short (E=128): large blocks amortize the grid
+            partials = chunk_partials_pallas(
+                vals, rel, 128, prog.reduce, block_c=64,
+                interpret=self.reduce_method == "pallas-interpret")
+        else:
+            partials = chunk_partials(vals, rel, 128, prog.reduce)
+        ident = identity_for(prog.reduce, partials.dtype)
+        outs = []
+        row0 = 0
+        for (_t0, cnt, L) in plan.classes:
+            blk = partials[row0:row0 + cnt * L].reshape(cnt, L, 128)
+            outs.append(_reduce_axis1(blk, prog.reduce))
+            row0 += cnt * L
+        n_rest = plan.n_tiles - self._pair_covered_slots
+        outs.append(jnp.full((n_rest, 128), ident, partials.dtype))
+        full = jnp.concatenate(outs, axis=0)            # class-slot order
+        red2d = jnp.take(full, tile_pos, axis=0)
+        return red2d.reshape(-1)[:self.sg.vpad]
 
     # -- state placement ----------------------------------------------
 
@@ -176,6 +298,10 @@ class PullEngine:
                         "pallas" if self.reduce_method.startswith("pallas")
                         else "xla"),
                 interpret=self.reduce_method == "pallas-interpret")
+        if self.pairs is not None:
+            pred = self._pair_red(flat_state, g["pair_rowbind"],
+                                  g["pair_rel"], g["pair_tile_pos"])
+            red = combine_op(prog.reduce)(red, pred)
         return self._apply_epilogue(old_p, red, g)
 
     def _part_step_dot(self, flat_state, old_p, g):

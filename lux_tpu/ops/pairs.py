@@ -1,0 +1,208 @@
+"""Pair-lane delivery: gather-free edge values for dense tile pairs.
+
+Measured fact (PERF_NOTES.md): the XLA gather costs ~9 ns per ROW
+fetched, independent of row width.  So edges in a dense (src-tile,
+dst-tile) pair — both tiles 128 vertices — can all be served by
+fetching the pair's 128-wide source state row ONCE per pair-row:
+lane = source offset within the src tile, so the value needs no
+selection at all; the existing chunk-partial compare-reduce routes it
+to its destination offset (``rel_dst``).
+
+Under a degree-sorted vertex numbering (hubs share tiles), pairs with
+>= 8 edges cover ~74% of RMAT edges at ~6x lane inflation — ~3 ns/edge
+total against 9 ns for the per-edge gather.  The residual sparse-pair
+edges keep the regular gather path.
+
+Row layout: pair (s, t) with maximum per-source multiplicity m gets m
+rows; occurrence o of source lane c carries the o-th edge (s*128+c ->
+t*128+rel).  Unused lanes carry rel = 128 (the reduce's pad marker).
+Rows are grouped per destination tile and depth-classed so the
+cross-row combine is a static reshape-reduce, like ops/router.py's
+slotted classes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+W = 128
+
+
+@dataclasses.dataclass
+class PairPlan:
+    """Per-part pair-lane arrays (host numpy).
+
+    rowbind   int32 [R]      global state2d row (= src tile) per row
+    rel_dst   int32 [R, 128] dst offset in [0,128), 128 = dead lane
+    classes   [(tile_start, tile_count, depth)] for the combine; rows
+              are tile-major in ``tile_order`` with per-tile depth
+              padded to the class depth (dead rows are all-128)
+    tile_order int32 [n_tiles] part-local dst tile of each class slot
+    residual  bool [ne_part]  True for edges NOT covered by pairs
+    """
+
+    rowbind: np.ndarray
+    rel_dst: np.ndarray
+    classes: list
+    tile_order: np.ndarray
+    residual: np.ndarray
+    n_tiles: int
+    stats: dict
+
+
+def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
+                    vpad: int, threshold: int = 8,
+                    max_occ: int = 128,
+                    levels_growth: float = 1.35) -> PairPlan:
+    """src_slot: int [ne] global padded state slots (state2d row =
+    slot // 128); dst_local: int [ne] part-local dst in [0, vpad);
+    vpad must be a multiple of 128."""
+    assert vpad % W == 0
+    ne = len(dst_local)
+    n_tiles = vpad // W
+    src_slot = np.asarray(src_slot, np.int64)
+    dst_local = np.asarray(dst_local, np.int64)
+
+    st = src_slot // W
+    dt = dst_local // W
+    pair = st * n_tiles + dt
+    order = np.argsort(pair, kind="stable")
+    pp = pair[order]
+    starts = np.concatenate(
+        ([0], np.nonzero(pp[1:] != pp[:-1])[0] + 1, [ne]))
+    sizes = np.diff(starts)
+    pair_id = np.repeat(np.arange(len(sizes)), sizes)
+
+    sel_pair = sizes >= threshold
+    esel_sorted = sel_pair[pair_id]               # in pair-sorted order
+    residual = np.ones(ne, bool)
+    residual[order[esel_sorted]] = False
+
+    # occurrence index of each covered edge within (pair, src lane)
+    cov = order[esel_sorted]                      # original edge idx
+    key = pair[cov] * (np.int64(1) << 32) + src_slot[cov]
+    srt = np.argsort(key, kind="stable")
+    ks = key[srt]
+    newg = np.ones(len(ks), bool)
+    newg[1:] = ks[1:] != ks[:-1]
+    pos = np.arange(len(ks))
+    gst = np.maximum.accumulate(np.where(newg, pos, 0))
+    occ = np.empty(len(ks), np.int64)
+    occ[srt] = pos - gst
+
+    # Optional occurrence-depth cap (edges beyond it ride the residual
+    # gather).  Measured on RMAT21: capping LOSES — deep-occurrence
+    # rows belong to hub pairs and are well-filled, so the default
+    # effectively disables the cap.
+    keep = occ < max_occ
+    if not keep.all():
+        # mark dropped edges residual; rebuild cov/occ on the kept set
+        dropped = np.zeros(len(cov), bool)
+        dropped[srt] = ~keep
+        residual[cov[dropped]] = True
+        cov = cov[~dropped]
+        k2 = np.argsort(pair[cov] * (np.int64(1) << 32) + src_slot[cov],
+                        kind="stable")
+        ks2 = (pair[cov] * (np.int64(1) << 32) + src_slot[cov])[k2]
+        ng2 = np.ones(len(ks2), bool)
+        ng2[1:] = ks2[1:] != ks2[:-1]
+        pos2 = np.arange(len(ks2))
+        gst2 = np.maximum.accumulate(np.where(ng2, pos2, 0))
+        occ = np.empty(len(ks2), np.int64)
+        occ[k2] = pos2 - gst2
+
+    # per-pair row count = max occurrence + 1 (pair ids of the
+    # possibly-reduced covered set, via the sorted unique pair keys)
+    pid_cov = np.searchsorted(pp[starts[:-1]], pair[cov])
+    # remap selected pair ids to dense [0, P)
+    sel_ids = np.nonzero(sel_pair)[0]
+    remap = np.full(len(sizes), -1, np.int64)
+    remap[sel_ids] = np.arange(len(sel_ids))
+    pidx = remap[pid_cov]                         # [n_cov]
+    nrows_pair = np.zeros(len(sel_ids), np.int64)
+    np.maximum.at(nrows_pair, pidx, occ + 1)
+
+    # order pairs by dst tile (for the per-tile combine), then src tile
+    pair_dt = (pp[starts[:-1]][sel_pair] % n_tiles)
+    tile_sort = np.argsort(pair_dt, kind="stable")
+    # per-tile total rows -> depth classes
+    rows_by_tile = np.zeros(n_tiles, np.int64)
+    np.add.at(rows_by_tile, pair_dt, nrows_pair)
+    t_order = np.argsort(-rows_by_tile, kind="stable")
+    depth_sorted = rows_by_tile[t_order]
+
+    levels = [0, 1, 2, 3, 4, 5, 6, 7, 8]
+    v = 8
+    while v < int(depth_sorted.max(initial=0)):
+        v = int(v * levels_growth) + 1
+        levels.append(v)
+    lev = np.asarray(levels, np.int64)
+    depth = lev[np.searchsorted(lev, depth_sorted)]
+
+    row_off_tile = np.concatenate(([0], np.cumsum(depth)))
+    R = int(row_off_tile[-1])
+
+    # rows of each pair: base = tile's offset + running offset within
+    # the tile (pairs in tile_sort order)
+    tile_pos = np.empty(n_tiles, np.int64)        # tile -> class slot
+    tile_pos[t_order] = np.arange(n_tiles)
+    pair_base = np.zeros(len(sel_ids), np.int64)
+    running = np.zeros(n_tiles, np.int64)
+    for j in tile_sort:                            # per selected pair
+        t = pair_dt[j]
+        pair_base[j] = row_off_tile[tile_pos[t]] + running[t]
+        running[t] += nrows_pair[j]
+    assert (running <= depth[tile_pos]).all()
+
+    rowbind = np.zeros(R, np.int32)
+    rel_dst = np.full((R, W), W, np.int32)
+    rows = pair_base[pidx] + occ
+    rowbind_rows = (src_slot[cov] // W).astype(np.int32)
+    rowbind[rows] = rowbind_rows
+    rel_dst[rows, src_slot[cov] % W] = (dst_local[cov] % W).astype(
+        np.int32)
+
+    classes = []
+    t0 = 0
+    for L in np.unique(depth)[::-1]:
+        cnt = int((depth == L).sum())
+        if L > 0:
+            classes.append((t0, cnt, int(L)))
+        t0 += cnt
+
+    plan = PairPlan(rowbind=rowbind, rel_dst=rel_dst, classes=classes,
+                    tile_order=t_order.astype(np.int32),
+                    residual=residual, n_tiles=n_tiles, stats={})
+    ncov = int((~residual).sum())
+    plan.stats = dict(ne=ne, covered=ncov, R=R,
+                      coverage=ncov / max(ne, 1),
+                      inflation=R * W / max(ncov, 1))
+    return plan
+
+
+def pair_reduce_numpy(plan: PairPlan, state_flat: np.ndarray,
+                      kind: str = "sum") -> np.ndarray:
+    """Oracle: run the pair-lane delivery + reduce on host.
+    Returns [vpad] partial reduction (identity where uncovered)."""
+    s2d = np.asarray(state_flat).reshape(-1, W)
+    vals = s2d[plan.rowbind]                       # [R, 128]
+    ident = {"sum": 0.0, "min": np.inf, "max": -np.inf}[kind]
+    op = {"sum": np.add, "min": np.minimum, "max": np.maximum}[kind]
+    vpad = plan.n_tiles * W
+    out = np.full(vpad, ident)
+    # per-row compare-reduce + per-tile combine
+    row0 = 0
+    for (t0, cnt, L) in plan.classes:
+        for i in range(cnt):
+            tile = plan.tile_order[t0 + i]
+            for r in range(row0 + i * L, row0 + (i + 1) * L):
+                lanes = plan.rel_dst[r]
+                for c in range(W):
+                    w = lanes[c]
+                    if w < W:
+                        out[tile * W + w] = op(out[tile * W + w],
+                                               vals[r, c])
+        row0 += cnt * L
+    return out

@@ -128,8 +128,12 @@ def _ceil_div_arr(a, b):
     return (a + b - 1) // b
 
 
-def _combine(kind: str):
+def combine_op(kind: str):
+    """The binary combiner for a reduce kind (shared lookup)."""
     return {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}[kind]
+
+
+_combine = combine_op
 
 
 def chunk_partials(vals, rel_dst, W: int, kind: str, use_mxu: bool = False):

@@ -1,0 +1,136 @@
+"""Pair-lane planner: covered + residual must exactly partition the
+edges, and the oracle reduce over pair rows plus a plain reduce over
+residual edges must equal the full-graph reduce."""
+
+import numpy as np
+import pytest
+
+from lux_tpu.ops.pairs import W, build_pair_plan, pair_reduce_numpy
+
+
+def full_oracle(src_slot, dst_local, state, vpad):
+    out = np.zeros(vpad)
+    for s, d in zip(src_slot, dst_local):
+        out[d] += state[s]
+    return out
+
+
+@pytest.mark.parametrize("seed,threshold", [(1, 2), (2, 4), (3, 8)])
+def test_pair_plus_residual_equals_full(seed, threshold):
+    rng = np.random.default_rng(seed)
+    vpad = 4 * W
+    n_state_rows = 6
+    ne = 6000
+    # skew sources so dense pairs exist
+    src = (rng.zipf(1.4, ne) - 1) % (n_state_rows * W)
+    dst = rng.integers(0, vpad, ne)
+    plan = build_pair_plan(src, dst, vpad, threshold=threshold)
+    state = rng.random(n_state_rows * W)
+
+    # partition property
+    assert plan.stats["covered"] + plan.residual.sum() == ne
+    if threshold <= 4:
+        assert plan.stats["covered"] > 0
+
+    got = pair_reduce_numpy(plan, state)
+    res = plan.residual
+    got_res = full_oracle(src[res], dst[res], state, vpad)
+    want = full_oracle(src, dst, state, vpad)
+    np.testing.assert_allclose(got + got_res, want, rtol=1e-9)
+
+
+def test_multiplicity_rows():
+    # one source hitting one dst tile many times forces occurrence rows
+    src = np.full(10, 5)
+    dst = np.arange(10) % 3          # some duplicate dsts too
+    state = np.arange(4 * W, dtype=np.float64)
+    want = full_oracle(src, dst, state, 2 * W)
+
+    # uncapped: fully covered
+    plan = build_pair_plan(src, dst, 2 * W, threshold=2, max_occ=16)
+    got = pair_reduce_numpy(plan, state)
+    np.testing.assert_allclose(got, want)
+    assert plan.residual.sum() == 0
+
+    # occurrence cap pushes deep multi-edges to the residual, and
+    # pair + residual still partition correctly
+    plan = build_pair_plan(src, dst, 2 * W, threshold=2, max_occ=4)
+    assert plan.residual.sum() == 6      # occ 4..9 of one source
+    got = pair_reduce_numpy(plan, state)
+    res = plan.residual
+    got += full_oracle(src[res], dst[res], state, 2 * W)
+    np.testing.assert_allclose(got, want)
+
+
+def test_engine_pair_path_matches_plain():
+    """PageRank with pair-lane delivery must equal the plain engine."""
+    from lux_tpu.apps import pagerank
+    from lux_tpu.convert import uniform_random_edges
+    from lux_tpu.graph import Graph
+
+    rng = np.random.default_rng(7)
+    nv = 3 * W
+    src = (rng.zipf(1.3, 4000) - 1) % nv
+    dst = (rng.zipf(1.2, 4000) - 1) % nv
+    g = Graph.from_edges(src.astype(np.uint32), dst.astype(np.uint32),
+                         nv)
+    g2, perm = pagerank.degree_relabel(g)
+
+    plain = pagerank.run(g, 8)
+    eng = pagerank.build_engine(g2, pair_threshold=4)
+    assert eng.pairs is not None and eng.pairs.stats["covered"] > 0
+    got_perm = eng.unpad(eng.run(eng.init_state(), 8))
+    got = np.empty_like(got_perm)
+    got[perm] = got_perm                   # back to original ids
+    np.testing.assert_allclose(got, plain, rtol=1e-5)
+
+
+def test_pair_path_applies_edge_value():
+    """Programs transforming src values must agree between pair rows
+    and the residual path."""
+    from lux_tpu.engine.program import PullProgram
+    from lux_tpu.engine.pull import PullEngine
+    from lux_tpu.graph import Graph, ShardedGraph
+
+    rng = np.random.default_rng(17)
+    nv = 2 * W
+    src = (rng.zipf(1.3, 2000) - 1) % nv
+    dst = rng.integers(0, nv, 2000)
+    g = Graph.from_edges(src.astype(np.uint32), dst.astype(np.uint32),
+                         nv)
+
+    def mk():
+        return PullProgram(
+            reduce="sum",
+            edge_value=lambda s, d, w: s * 2.0 + 1.0,
+            apply=lambda o, r, c: r,
+            init=lambda sg: np.linspace(
+                0, 1, sg.num_parts * sg.vpad,
+                dtype=np.float32).reshape(sg.num_parts, sg.vpad))
+
+    sgp = ShardedGraph.build(g, 1, vpad_align=128)
+    plain = PullEngine(sgp, mk())
+    pair = PullEngine(sgp, mk(), pair_threshold=2)
+    assert pair.pairs is not None
+    out_a = plain.unpad(plain.step(plain.init_state()))
+    out_b = pair.unpad(pair.step(pair.init_state()))
+    np.testing.assert_allclose(out_b, out_a, rtol=1e-5)
+
+
+def test_pair_path_rejects_dst_programs():
+    import pytest
+    from lux_tpu.engine.program import PullProgram
+    from lux_tpu.engine.pull import PullEngine
+    from lux_tpu.graph import Graph, ShardedGraph
+    src = np.zeros(40, np.uint32)
+    dst = (np.arange(40) % 7).astype(np.uint32)
+    g = Graph.from_edges(src, dst, 2 * W)
+    sg = ShardedGraph.build(g, 1, vpad_align=128)
+    prog = PullProgram(reduce="sum",
+                       edge_value=lambda s, d, w: s * d,
+                       apply=lambda o, r, c: r,
+                       init=lambda sg: np.zeros(
+                           (sg.num_parts, sg.vpad), np.float32),
+                       needs_dst=True)
+    with pytest.raises(ValueError, match="source"):
+        PullEngine(sg, prog, pair_threshold=2)
