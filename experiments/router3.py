@@ -1,6 +1,6 @@
 """Three-stage shuffle-routing planner ("deep router").
 
-Extends ops/router.py's window planner with the staging depth that the
+Extends router.py's window planner with the staging depth that the
 power-law tail needs (PERF_NOTES.md "Routing-network experiments"):
 instead of spilling every value whose z-row spans multiple state rows
 to the 9 ns/edge XLA gather, values flow through up to three
@@ -40,7 +40,7 @@ import dataclasses
 
 import numpy as np
 
-from lux_tpu.ops.router import (SlottedOut, W,
+from experiments.router import (SlottedOut, W,
                                 occurrence_index as _occ)
 
 

@@ -1,0 +1,8 @@
+import os
+import sys
+
+# Make `pytest experiments/tests` work from anywhere: the experiment
+# modules import as `experiments.router`, which needs the repo root on
+# sys.path (python -m pytest adds it; bare pytest does not).
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))

@@ -7,7 +7,7 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
-from lux_tpu.ops.router import (W, build_route_plan, reduce_numpy,
+from experiments.router import (W, build_route_plan, reduce_numpy,
                                 route_numpy)
 
 

@@ -3,8 +3,8 @@
 import numpy as np
 import pytest
 
-from lux_tpu.ops.router import W, reduce_numpy
-from lux_tpu.ops.router3 import build_route3_plan, route3_numpy
+from experiments.router import W, reduce_numpy
+from experiments.router3 import build_route3_plan, route3_numpy
 
 
 def oracle(src_slot, dst_local, state, vpad):

@@ -43,7 +43,20 @@ def save(path: str, state, meta: dict | None = None) -> None:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, _meta=json.dumps(meta or {}),
                      _n=len(leaves), **payload)
+            # os.replace is atomic against process kill, but only an
+            # fsync before the rename makes the checkpoint durable
+            # against host crash / power loss.
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

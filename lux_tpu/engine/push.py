@@ -371,7 +371,20 @@ class PushEngine:
                         return it + 1, nl, (act & ~front) | na, B
 
                     def advance(it, lbl, act, B):
-                        return it, lbl, act, active_min(lbl, act) + delta
+                        # Strict progress: with float labels a delta
+                        # below one ulp at the current magnitude makes
+                        # active_min + delta round back to active_min
+                        # and the advance loop livelocks (frontier
+                        # stays empty forever).  Raising B strictly
+                        # above active_min guarantees the argmin active
+                        # vertex enters the next frontier.
+                        am = active_min(lbl, act)
+                        nb = am + delta
+                        if jnp.issubdtype(label.dtype, jnp.inexact):
+                            nb = jnp.maximum(
+                                nb, jnp.nextafter(
+                                    am, jnp.asarray(jnp.inf, am.dtype)))
+                        return it, lbl, act, nb
 
                     it, lbl, act, B = jax.lax.cond(
                         nf > 0, relax, advance, it, lbl, act, B)

@@ -17,7 +17,7 @@ Row layout: pair (s, t) with maximum per-source multiplicity m gets m
 rows; occurrence o of source lane c carries the o-th edge (s*128+c ->
 t*128+rel).  Unused lanes carry rel = 128 (the reduce's pad marker).
 Rows are grouped per destination tile and depth-classed so the
-cross-row combine is a static reshape-reduce, like ops/router.py's
+cross-row combine is a static reshape-reduce, like experiments/router.py's
 slotted classes.
 """
 

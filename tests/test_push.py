@@ -74,6 +74,34 @@ class TestSSSP:
                          weighted=True, delta="auto")
         np.testing.assert_allclose(d8, d1, rtol=1e-6)
 
+    def test_delta_below_ulp_terminates(self):
+        # Regression: with float32 labels and a bucket width below one
+        # ulp at the current distance magnitude, active_min + delta
+        # rounds back to active_min and the bucket advance used to
+        # livelock inside the compiled while_loop (ADVICE round 1).
+        # Weights ~1e8 with delta=1.0 reproduce it: 1.0 < ulp(1e8)=8.
+        # max_iters caps only relax iterations, not advances, so a
+        # regressed livelock would HANG here — fail via alarm instead.
+        import signal
+
+        def boom(signum, frame):
+            raise TimeoutError("delta advance livelock regressed")
+
+        old = signal.signal(signal.SIGALRM, boom)
+        signal.alarm(120)
+        try:
+            src = np.array([0, 1, 2], np.uint32)
+            dst = np.array([1, 2, 3], np.uint32)
+            w = np.full(3, 1e8, np.float32)
+            g = Graph.from_edges(src, dst, 4, weights=w)
+            dist, _ = sssp.run(g, start_vertex=0, weighted=True,
+                               delta=1.0, max_iters=100)
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+        np.testing.assert_allclose(
+            dist, np.array([0, 1e8, 2e8, 3e8], np.float32), rtol=1e-6)
+
     def test_delta_rejects_max_program(self):
         from lux_tpu.engine.push import PushEngine
         g = chain_graph(6)
