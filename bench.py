@@ -6,16 +6,22 @@ ne * iterations / elapsed_seconds / num_chips.  Graphs are R-MAT
 (the reference's RMAT family, scaled to fit a single chip's HBM
 comfortably at default settings).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "GTEPS", "vs_baseline": N}
+Prints ONE JSON line per benched config:
+  {"metric": ..., "value": N, "unit": "GTEPS", "vs_baseline": N, ...}
 vs_baseline is against the north-star target of 1 GTEPS/chip
-(BASELINE.json "north_star").
+(BASELINE.json "north_star").  Preprocessing that affects
+comparability (degree relabel, pair-lane threshold, partitions) is
+recorded in the line.
 
 Configs (-config; default "pagerank" is what the driver records):
   pagerank        PageRank, pull model, fixed iterations   (BASELINE #1/#4)
   cc              Connected Components, push, to convergence (BASELINE #2)
   sssp            SSSP/BFS hops, push, to convergence        (BASELINE #3)
+  sssp-delta      weighted SSSP, delta-stepping frontier     (BASELINE #3)
   colfilter       SGD matrix factorization, weighted pull    (BASELINE #5)
+
+-all runs every config (one JSON line each, pagerank LAST so a
+line-parsing driver still records the headline metric).
 """
 
 from __future__ import annotations
@@ -25,24 +31,34 @@ import json
 import sys
 import time
 
+# The same preprocessing is applied at EVERY partition count so
+# single-chip and multi-chip GTEPS stay apples-to-apples (round-1
+# advice): degree relabel concentrates hubs into shared 128-vertex
+# tiles, pair-lane delivery then serves dense tile pairs without the
+# per-edge gather (ops/pairs.py, PERF_NOTES.md).
+PAIR_THRESHOLD = 16   # default; override with -pair
 
-def build_graph(args, weighted=False):
+DEFAULT_SCALE = {"pagerank": 21, "cc": 20, "sssp": 21,
+                 "sssp-delta": 21, "colfilter": 18}
+
+
+def build_graph(scale, ef, verbose, weighted=False):
     import numpy as np
 
     from lux_tpu.convert import rmat_graph
 
     t0 = time.perf_counter()
-    g = rmat_graph(scale=args.scale, edge_factor=args.ef, seed=0)
+    g = rmat_graph(scale=scale, edge_factor=ef, seed=0)
     if weighted:
         rng = np.random.default_rng(1)
         g.weights = rng.integers(1, 6, size=g.ne).astype(np.int32)
-    if args.verbose:
+    if verbose:
         print(f"# graph built: nv={g.nv} ne={g.ne} "
               f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
     return g
 
 
-def bench_fused(eng, g, ni, verbose):
+def bench_fused(eng, ne, ni, verbose):
     import numpy as np
 
     from lux_tpu.timing import timed_fused_run
@@ -54,57 +70,48 @@ def bench_fused(eng, g, ni, verbose):
               f"{elapsed:.2f}s timed)", file=sys.stderr)
     # the benched result must be sane, or the GTEPS line is meaningless
     assert np.isfinite(eng.unpad(state)).all(), "non-finite bench result"
-    return g.ne * ni / elapsed
+    return ne * ni / elapsed
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("-config", default="pagerank",
-                    choices=["pagerank", "cc", "sssp", "colfilter"])
-    ap.add_argument("-scale", type=int, default=0,
-                    help="RMAT scale (nv = 2**scale; 0 = per-config "
-                         "default)")
-    ap.add_argument("-ef", type=int, default=16, help="edges per vertex")
-    ap.add_argument("-ni", type=int, default=20,
-                    help="iterations (fixed-iteration configs)")
-    ap.add_argument("-np", type=int, default=1, help="partitions")
-    ap.add_argument("-verbose", action="store_true")
-    args = ap.parse_args()
-    if not args.scale:
-        args.scale = {"pagerank": 21, "cc": 20, "sssp": 21,
-                      "colfilter": 18}[args.config]
-
+def run_config(config, args):
+    """Returns (gteps, extra json fields)."""
+    pair_t = args.pair if args.pair > 0 else None
     import numpy as np
 
+    from lux_tpu.graph import pair_relabel
     from lux_tpu.timing import timed_converge
 
-    if args.config == "pagerank":
+    scale = args.scale or DEFAULT_SCALE[config]
+    extra = {"np": args.np, "scale": scale, "ef": args.ef}
+
+    if config == "pagerank":
         from lux_tpu.apps import pagerank
-        g = build_graph(args)
-        if args.np == 1:
-            # degree relabel + pair-lane delivery: dense tile pairs
-            # skip the per-edge gather (ops/pairs.py; +40% measured)
-            g2, _perm = pagerank.degree_relabel(g)
-            eng = pagerank.build_engine(g2, num_parts=1,
-                                        pair_threshold=16)
-            if args.verbose and eng.pairs is not None:
-                s = eng.pairs.stats
-                print(f"# pair-lane coverage "
-                      f"{s['coverage'] * 100:.1f}%", file=sys.stderr)
-        else:
-            eng = pagerank.build_engine(g, num_parts=args.np)
-        gteps = bench_fused(eng, g, args.ni, args.verbose) / 1e9
-        name = f"pagerank_rmat{args.scale}"
-    elif args.config == "colfilter":
+        g = build_graph(scale, args.ef, args.verbose)
+        g2, _perm, starts = pair_relabel(g, args.np, pair_threshold=pair_t or 16)
+        eng = pagerank.build_engine(g2, num_parts=args.np,
+                                    pair_threshold=pair_t,
+                                    starts=starts)
+        extra.update(relabel=True, pair_threshold=pair_t)
+        if args.verbose and eng.pairs is not None:
+            s = eng.pairs.stats
+            print(f"# pair-lane coverage {s['coverage'] * 100:.1f}%",
+                  file=sys.stderr)
+        gteps = bench_fused(eng, g.ne, args.ni, args.verbose) / 1e9
+        name = f"pagerank_rmat{scale}"
+    elif config == "colfilter":
         from lux_tpu.apps import colfilter
-        g = build_graph(args, weighted=True)
+        g = build_graph(scale, args.ef, args.verbose, weighted=True)
+        # dot-path engine: pair delivery does not apply (needs_dst via
+        # MXU tiles); no relabel so the factorization keeps user ids
         eng = colfilter.build_engine(g, num_parts=args.np)
-        gteps = bench_fused(eng, g, args.ni, args.verbose) / 1e9
-        name = f"colfilter_rmat{args.scale}"
+        extra.update(relabel=False, pair_threshold=None)
+        gteps = bench_fused(eng, g.ne, args.ni, args.verbose) / 1e9
+        name = f"colfilter_rmat{scale}"
     else:
         from lux_tpu.apps import components, sssp
-        g = build_graph(args)
-        if args.config == "cc":
+        weighted = config == "sssp-delta"
+        g = build_graph(scale, args.ef, args.verbose, weighted=weighted)
+        if config == "cc":
             # CC semantics need an undirected graph; symmetrize and
             # count the doubled edge set in GTEPS (it is what runs)
             from lux_tpu.graph import Graph
@@ -112,24 +119,69 @@ def main() -> int:
             g = Graph.from_edges(s, d, g.nv)
             if args.verbose:
                 print(f"# symmetrized: ne={g.ne}", file=sys.stderr)
-            eng = components.build_engine(g, num_parts=args.np)
+            g2, _perm, starts = pair_relabel(g, args.np, pair_threshold=pair_t or 16)
+            eng = components.build_engine(g2, num_parts=args.np,
+                                          pair_threshold=pair_t,
+                                          starts=starts)
+            extra.update(relabel=True, pair_threshold=pair_t)
         else:
-            eng = sssp.build_engine(g, start_vertex=0,
-                                    num_parts=args.np)
+            g2, perm, starts = pair_relabel(g, args.np, pair_threshold=pair_t or 16)
+            rank = np.empty(g.nv, np.int64)
+            rank[perm] = np.arange(g.nv)
+            eng = sssp.build_engine(
+                g2, start_vertex=int(rank[0]), num_parts=args.np,
+                weighted=weighted,
+                delta="auto" if config == "sssp-delta" else None,
+                pair_threshold=pair_t, starts=starts)
+            extra.update(relabel=True, pair_threshold=pair_t,
+                         delta="auto" if weighted else None)
+        if args.verbose and eng.pairs is not None:
+            s = eng.pairs.stats
+            print(f"# pair-lane coverage {s['coverage'] * 100:.1f}%",
+                  file=sys.stderr)
         labels, iters, elapsed = timed_converge(eng)
         if args.verbose:
             print(f"# converged in {iters} iterations, {elapsed:.2f}s",
                   file=sys.stderr)
         gteps = g.ne * iters / elapsed / 1e9
-        name = f"{args.config}_rmat{args.scale}"
+        name = f"{config.replace('-', '_')}_rmat{scale}"
+    return name, gteps, extra
 
+
+def emit(name, gteps, extra):
     result = {
         "metric": f"{name}_gteps_per_chip",
         "value": round(gteps, 4),
         "unit": "GTEPS",
         "vs_baseline": round(gteps / 1.0, 4),
+        **extra,
     }
     print(json.dumps(result))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-config", default="pagerank",
+                    choices=list(DEFAULT_SCALE))
+    ap.add_argument("-all", action="store_true",
+                    help="run every config (pagerank last)")
+    ap.add_argument("-scale", type=int, default=0,
+                    help="RMAT scale (nv = 2**scale; 0 = per-config "
+                         "default)")
+    ap.add_argument("-ef", type=int, default=16, help="edges per vertex")
+    ap.add_argument("-ni", type=int, default=20,
+                    help="iterations (fixed-iteration configs)")
+    ap.add_argument("-np", type=int, default=1, help="partitions")
+    ap.add_argument("-pair", type=int, default=PAIR_THRESHOLD,
+                    help="pair-lane threshold (0 disables)")
+    ap.add_argument("-verbose", action="store_true")
+    args = ap.parse_args()
+
+    configs = (["cc", "sssp", "sssp-delta", "colfilter", "pagerank"]
+               if args.all else [args.config])
+    for config in configs:
+        name, gteps, extra = run_config(config, args)
+        emit(name, gteps, extra)
     return 0
 
 

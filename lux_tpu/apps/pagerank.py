@@ -20,7 +20,8 @@ import numpy as np
 
 from lux_tpu.engine.program import PullProgram
 from lux_tpu.engine.pull import PullEngine
-from lux_tpu.graph import Graph, ShardedGraph
+from lux_tpu.graph import Graph, ShardedGraph, degree_relabel  # noqa: F401
+# degree_relabel moved to graph.py; re-exported for existing callers
 
 ALPHA = 0.15  # reference pagerank/app.h:24
 
@@ -46,11 +47,13 @@ def make_program(dtype=jnp.float32) -> PullProgram:
 
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  dtype=jnp.float32, sg: ShardedGraph | None = None,
-                 pair_threshold: int | None = None) -> PullEngine:
+                 pair_threshold: int | None = None,
+                 starts=None) -> PullEngine:
+    """starts: partition cut points (e.g. from graph.pair_relabel for
+    balanced multi-part pair delivery)."""
     if sg is None:
-        sg = ShardedGraph.build(
-            g, num_parts,
-            vpad_align=128 if pair_threshold is not None else 8)
+        sg = ShardedGraph.build(g, num_parts, starts=starts,
+                                pair_threshold=pair_threshold)
     # residual edges after pair extraction are sparse; shorter chunks
     # waste far fewer padded gather slots
     tile_e = 128 if pair_threshold is not None else 512
@@ -58,19 +61,6 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                       pair_threshold=pair_threshold, tile_e=tile_e)
 
 
-def degree_relabel(g: Graph):
-    """Relabel vertices by descending total degree — concentrates hubs
-    into shared 128-vertex tiles so pair-lane delivery
-    (PullEngine pair_threshold; ops/pairs.py) finds dense tile pairs.
-    Returns (relabeled graph, perm) with perm[new] = old."""
-    src, dst = g.edge_arrays()
-    deg = (np.bincount(src, minlength=g.nv)
-           + np.bincount(dst, minlength=g.nv))
-    perm = np.argsort(-deg, kind="stable")
-    rank = np.empty(g.nv, np.int64)
-    rank[perm] = np.arange(g.nv)
-    g2 = Graph.from_edges(rank[src], rank[dst], g.nv, weights=g.weights)
-    return g2, perm
 
 
 def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):

@@ -66,18 +66,23 @@ def default_delta(g: Graph) -> float:
 def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                  mesh=None, weighted: bool = False,
                  delta: float | str | None = None,
-                 sg: ShardedGraph | None = None) -> PushEngine:
+                 sg: ShardedGraph | None = None,
+                 pair_threshold: int | None = None,
+                 starts=None) -> PushEngine:
     """delta: bucket width for delta-stepping priority ordering
     (weighted runs); "auto" picks a heuristic; None disables (plain
-    Bellman-Ford frontier relaxation)."""
+    Bellman-Ford frontier relaxation).  pair_threshold enables pair-
+    lane delivery on dense iterations (best after graph.pair_relabel,
+    whose ``starts`` should be passed through here)."""
     if weighted and g.weights is None:
         raise ValueError("weighted SSSP needs a weighted graph")
     if delta == "auto":
         delta = default_delta(g) if weighted else 1.0
     if sg is None:
-        sg = ShardedGraph.build(g, num_parts)
+        sg = ShardedGraph.build(g, num_parts, starts=starts,
+                                pair_threshold=pair_threshold)
     return PushEngine(sg, make_program(start_vertex, weighted), mesh=mesh,
-                      delta=delta)
+                      delta=delta, pair_threshold=pair_threshold)
 
 
 def run(g: Graph, start_vertex: int = 0, num_parts: int = 1, mesh=None,

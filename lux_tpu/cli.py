@@ -44,6 +44,11 @@ def _common(ap: argparse.ArgumentParser):
     ap.add_argument("-profile", default=None, metavar="DIR",
                     help="capture an XLA profiler trace of the timed "
                          "run into DIR (view in TensorBoard/Perfetto)")
+    ap.add_argument("-pair", type=int, default=None, metavar="T",
+                    help="enable pair-lane delivery with threshold T "
+                         "(degree-relabels the graph internally; "
+                         "results are mapped back to input ids; "
+                         "ignored by colfilter)")
 
 
 def _load(args, weighted: bool):
@@ -74,13 +79,28 @@ def _mesh_and_parts(args):
     return mesh, num_parts
 
 
-def _build_sg(args, g, num_parts):
+def _relabel_for_pairs(args, g, num_parts):
+    """-pair T: relabel so pair-lane delivery finds dense tile pairs
+    (degree sort + tile round-robin over parts).  Returns (graph to
+    run on, perm|None, starts|None) with perm[new]=old."""
+    if getattr(args, "pair", None) is None:
+        return g, None, None
+    from lux_tpu.graph import pair_relabel
+    g2, perm, starts = pair_relabel(g, num_parts,
+                                    pair_threshold=args.pair)
+    if args.verbose:
+        print(f"pair-lane: degree relabel + threshold {args.pair}")
+    return g2, perm, starts
+
+
+def _build_sg(args, g, num_parts, starts=None):
     """Build the padded layout once; print the memory advisor (the
     analogue of the reference's startup requirement estimate,
     reference pagerank.cc:60-85) under -verbose."""
     from lux_tpu.graph import ShardedGraph
 
-    sg = ShardedGraph.build(g, num_parts)
+    sg = ShardedGraph.build(g, num_parts, starts=starts,
+                            pair_threshold=getattr(args, "pair", None))
     if args.verbose:
         rep = sg.memory_report()
         print(f"memory: {rep['total_bytes'] / 1e6:.1f} MB total over "
@@ -108,8 +128,10 @@ def cmd_pagerank(argv):
 
     g = _load(args, weighted=False)
     mesh, num_parts = _mesh_and_parts(args)
-    sg = _build_sg(args, g, num_parts)
-    eng = pagerank.build_engine(g, num_parts, mesh, sg=sg)
+    g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
+    sg = _build_sg(args, g_run, num_parts, starts)
+    eng = pagerank.build_engine(g_run, num_parts, mesh, sg=sg,
+                                pair_threshold=args.pair)
     if args.tol is not None:
         from lux_tpu.timing import timed_run_until
         state, iters, res, elapsed = timed_run_until(
@@ -125,7 +147,12 @@ def cmd_pagerank(argv):
 
     if args.check:
         from lux_tpu import check
-        res = check.check_pagerank(g, eng.unpad(state), tol=1e-3)
+        out = eng.unpad(state)
+        if perm is not None:            # back to input vertex ids
+            unperm = np.empty_like(out)
+            unperm[perm] = out
+            out = unperm
+        res = check.check_pagerank(g, out, tol=1e-3)
         print(res)
         return 0 if res.ok else 1
     return 0
@@ -148,26 +175,41 @@ def _push_app(argv, prog_name):
     weighted = prog_name == "sssp" and args.weighted
     g = _load(args, weighted=weighted)
     mesh, num_parts = _mesh_and_parts(args)
-    sg = _build_sg(args, g, num_parts)
+    g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
+    sg = _build_sg(args, g_run, num_parts, starts)
+    start = args.start if prog_name == "sssp" else None
+    if perm is not None and start is not None:
+        rank = np.empty(g.nv, np.int64)
+        rank[perm] = np.arange(g.nv)
+        start = int(rank[start])
     if prog_name == "sssp":
         delta = args.delta
         if delta is not None and delta != "auto":
             delta = float(delta)
-        eng = sssp.build_engine(g, start_vertex=args.start,
+        eng = sssp.build_engine(g_run, start_vertex=start,
                                 num_parts=num_parts, mesh=mesh,
-                                weighted=weighted, delta=delta, sg=sg)
+                                weighted=weighted, delta=delta, sg=sg,
+                                pair_threshold=args.pair)
     else:
-        eng = components.build_engine(g, num_parts=num_parts, mesh=mesh,
-                                      sg=sg)
+        eng = components.build_engine(g_run, num_parts=num_parts,
+                                      mesh=mesh, sg=sg,
+                                      pair_threshold=args.pair)
     labels, iters, elapsed = timed_converge(eng, verbose=args.verbose,
                                             trace_dir=args.profile)
     print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
     print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
 
     if args.check:
-        res = (check.check_sssp(g, labels, weighted=weighted)
-               if prog_name == "sssp" else
-               check.check_components(g, labels))
+        if prog_name == "sssp":
+            if perm is not None:        # back to input vertex ids
+                unperm = np.empty_like(labels)
+                unperm[perm] = labels
+                labels = unperm
+            res = check.check_sssp(g, labels, weighted=weighted)
+        else:
+            # CC labels live in the PROPAGATED id space; audit the
+            # fixed point there (on the relabeled graph when -pair)
+            res = check.check_components(g_run, labels)
         print(res)
         return 0 if res.ok else 1
     return 0
@@ -186,6 +228,7 @@ def cmd_colfilter(argv):
     _common(ap)
     ap.add_argument("-ni", type=int, default=10)
     args = ap.parse_args(argv)
+    args.pair = None          # dot-path engine: pair delivery n/a
 
     from lux_tpu.apps import colfilter
 

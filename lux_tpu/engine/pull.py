@@ -46,11 +46,6 @@ from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
 DOT_BLOCK_CHUNKS = 128
 
 
-def _reduce_axis1(x, kind: str):
-    return {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[kind](
-        x, axis=1)
-
-
 def resolve_reduce_method(method: str) -> str:
     """'auto' picks the Pallas kernel on real TPUs and the portable
     XLA formulation elsewhere (including the CPU test mesh);
@@ -131,10 +126,11 @@ class PullEngine:
             program.needs_dst or program.edge_value_from_dot is not None,
             tile_w, tile_e)
         if self.pairs is not None:
-            arrays["pair_rowbind"] = jnp.asarray(self.pairs.rowbind[None])
-            arrays["pair_rel"] = jnp.asarray(self.pairs.rel_dst[None])
-            arrays["pair_tile_pos"] = jnp.asarray(
-                self._pair_tile_pos[None])
+            arrays["pair_rowbind"] = jnp.asarray(self.pairs.rowbind)
+            arrays["pair_rel"] = jnp.asarray(self.pairs.rel_dst)
+            arrays["pair_tile_pos"] = jnp.asarray(self.pairs.tile_pos)
+            if self.pairs.weight is not None:
+                arrays["pair_weight"] = jnp.asarray(self.pairs.weight)
         if mesh is not None:
             arrays = shard_over_parts(mesh, arrays)
         self.arrays = arrays
@@ -147,105 +143,34 @@ class PullEngine:
         """Split dense (src-tile, dst-tile) pair edges out of the
         regular gather path (see ops/pairs.py): gather cost is per ROW
         fetched, so pair rows fetch a 128-wide source state row once
-        and deliver positionally.  Returns the RESIDUAL ShardedGraph
-        the normal machinery should run on."""
-        import dataclasses as _dc
+        and deliver positionally.  Works for any num_parts, with or
+        without a mesh, and on weighted graphs (per-lane weights).
+        Returns the RESIDUAL ShardedGraph the normal machinery should
+        run on."""
+        from lux_tpu.ops.pairs import plan_sharded_pairs
 
-        from lux_tpu.ops.pairs import build_pair_plan
-
-        if mesh is not None or sg.num_parts != 1:
-            raise ValueError("pair_threshold supports num_parts=1 "
-                             "without a mesh (bench configuration)")
         if layout != "tiled":
             raise ValueError("pair_threshold requires the tiled layout")
-        if sg.weighted:
-            raise ValueError("pair_threshold supports unweighted "
-                             "graphs (per-lane weights not plumbed)")
         if program.needs_dst or program.edge_value_from_dot is not None:
             raise ValueError("pair_threshold supports programs whose "
                              "edge_value depends only on the source "
                              "state (needs_dst=False)")
-        if sg.vpad % 128:
-            raise ValueError("pair_threshold needs vpad % 128 == 0; "
-                             "build the ShardedGraph with "
-                             "vpad_align=128")
-        nep = int(sg.ne_part[0])
-        plan = build_pair_plan(sg.src_slot[0, :nep],
-                               sg.dst_local[0, :nep], sg.vpad,
-                               threshold=threshold)
-        if plan.stats["covered"] == 0:
-            return sg                       # nothing dense enough
-        # pad rows to the pallas kernel's block granularity
-        R = plan.rowbind.shape[0]
-        Rp = -(-max(R, 64) // 64) * 64
-        if Rp != R:
-            plan.rowbind = np.concatenate(
-                [plan.rowbind, np.zeros(Rp - R, np.int32)])
-            plan.rel_dst = np.concatenate(
-                [plan.rel_dst,
-                 np.full((Rp - R, 128), 128, np.int32)], axis=0)
-        self.pairs = plan
-        # residual edge arrays, re-padded
-        res = plan.residual
-        r_src = sg.src_slot[0, :nep][res]
-        r_dst = sg.dst_local[0, :nep][res]
-        ne_r = len(r_dst)
-        epad_r = max(128, -(-ne_r // 128) * 128)
-        src_slot = np.zeros((1, epad_r), np.int32)
-        dst_local = np.full((1, epad_r), sg.vpad, np.int32)
-        src_slot[0, :ne_r] = r_src
-        dst_local[0, :ne_r] = r_dst
-        counts = np.bincount(r_dst, minlength=sg.vpad)
-        row_ptr_local = np.zeros((1, sg.vpad + 1), np.int32)
-        row_ptr_local[0, 1:] = np.cumsum(counts)
-        # tile position of every part-local tile in class-slot order
-        # (passed as a jit argument with the other pair arrays)
-        self._pair_tile_pos = np.empty(plan.n_tiles, np.int32)
-        self._pair_tile_pos[plan.tile_order] = np.arange(
-            plan.n_tiles, dtype=np.int32)
-        self._pair_covered_slots = sum(
-            cnt for (_t0, cnt, _L) in plan.classes)
-        return _dc.replace(sg, src_slot=src_slot, dst_local=dst_local,
-                           row_ptr_local=row_ptr_local,
-                           ne_part=np.array([ne_r], np.int64),
-                           epad=epad_r)
+        sp, residual = plan_sharded_pairs(sg, threshold)
+        self.pairs = sp                      # None if nothing dense
+        return residual
 
-    def _pair_red(self, flat_state, rowbind, rel, tile_pos):
-        """Pair-lane delivery + reduce -> [vpad] partial (identity
-        where pairs contribute nothing)."""
-        from lux_tpu.ops.segment import identity_for
-        from lux_tpu.ops.tiled import chunk_partials
+    def _pair_red(self, flat_state, g):
+        """Pair-lane delivery + reduce for one part -> [vpad] partial
+        (identity where pairs contribute nothing)."""
+        from lux_tpu.ops.pairs import pair_partial
 
-        plan = self.pairs
         prog = self.program
-        if flat_state.ndim != 1:
-            raise ValueError("pair_threshold supports scalar vertex "
-                             "state only")
-        s2d = flat_state.reshape(-1, 128)
-        vals = jnp.take(s2d, rowbind, axis=0)           # [R, 128] rows
-        # per-edge message on the delivered source values (dead lanes
-        # carry garbage, masked by rel == 128 in the reduce)
-        vals = prog.edge_value(vals, None, None)
-        if self.reduce_method.startswith("pallas"):
-            from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
-            # rows are short (E=128): large blocks amortize the grid
-            partials = chunk_partials_pallas(
-                vals, rel, 128, prog.reduce, block_c=64,
-                interpret=self.reduce_method == "pallas-interpret")
-        else:
-            partials = chunk_partials(vals, rel, 128, prog.reduce)
-        ident = identity_for(prog.reduce, partials.dtype)
-        outs = []
-        row0 = 0
-        for (_t0, cnt, L) in plan.classes:
-            blk = partials[row0:row0 + cnt * L].reshape(cnt, L, 128)
-            outs.append(_reduce_axis1(blk, prog.reduce))
-            row0 += cnt * L
-        n_rest = plan.n_tiles - self._pair_covered_slots
-        outs.append(jnp.full((n_rest, 128), ident, partials.dtype))
-        full = jnp.concatenate(outs, axis=0)            # class-slot order
-        red2d = jnp.take(full, tile_pos, axis=0)
-        return red2d.reshape(-1)[:self.sg.vpad]
+        red = pair_partial(
+            self.pairs, flat_state, g["pair_rowbind"], g["pair_rel"],
+            g.get("pair_weight"), g["pair_tile_pos"], prog.reduce,
+            lambda vals, w: prog.edge_value(vals, None, w),
+            reduce_method=self.reduce_method)
+        return red[:self.sg.vpad]
 
     # -- state placement ----------------------------------------------
 
@@ -299,8 +224,7 @@ class PullEngine:
                         else "xla"),
                 interpret=self.reduce_method == "pallas-interpret")
         if self.pairs is not None:
-            pred = self._pair_red(flat_state, g["pair_rowbind"],
-                                  g["pair_rel"], g["pair_tile_pos"])
+            pred = self._pair_red(flat_state, g)
             red = combine_op(prog.reduce)(red, pred)
         return self._apply_epilogue(old_p, red, g)
 

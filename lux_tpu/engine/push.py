@@ -86,12 +86,15 @@ class PushEngine:
                  tile_e: int = 512, enable_sparse: bool = True,
                  sparse_threshold: int = 16,
                  edge_budget: int | None = None,
-                 delta: float | None = None):
+                 delta: float | None = None,
+                 reduce_method: str = "auto",
+                 pair_threshold: int | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
                 f"{mesh.devices.size}")
-        from lux_tpu.engine.pull import build_graph_arrays
+        from lux_tpu.engine.pull import (build_graph_arrays,
+                                         resolve_reduce_method)
         if delta is not None:
             if program.reduce != "min":
                 raise ValueError("delta-stepping requires a 'min' program")
@@ -108,8 +111,28 @@ class PushEngine:
         self.mesh = mesh
         self.delta = delta
         self.sparse_threshold = sparse_threshold
+        self.reduce_method = resolve_reduce_method(reduce_method)
+        # Pair-lane delivery for the DENSE iterations (ops/pairs.py):
+        # dense pair edges leave the per-edge gather path; the SPARSE
+        # path below keeps the FULL graph's src-sorted view — frontier
+        # expansion must see every edge.
+        self.pairs = None
+        dense_sg = sg
+        if pair_threshold is not None:
+            from lux_tpu.ops.pairs import plan_sharded_pairs
+            if layout != "tiled":
+                raise ValueError(
+                    "pair_threshold requires the tiled layout")
+            self.pairs, dense_sg = plan_sharded_pairs(sg, pair_threshold)
         arrays, self.tiles = build_graph_arrays(
-            sg, layout, needs_dst=False, tile_w=tile_w, tile_e=tile_e)
+            dense_sg, layout, needs_dst=False, tile_w=tile_w,
+            tile_e=tile_e)
+        if self.pairs is not None:
+            arrays["pair_rowbind"] = jnp.asarray(self.pairs.rowbind)
+            arrays["pair_rel"] = jnp.asarray(self.pairs.rel_dst)
+            arrays["pair_tile_pos"] = jnp.asarray(self.pairs.tile_pos)
+            if self.pairs.weight is not None:
+                arrays["pair_weight"] = jnp.asarray(self.pairs.weight)
         self.enable_sparse = enable_sparse
         if enable_sparse:
             ss = sg.src_sorted()
@@ -176,14 +199,36 @@ class PushEngine:
             else:
                 red = tiled_segment_reduce(
                     cand, lay, g["chunk_start"], g["last_chunk"],
-                    g["rel_dst"], sg.vpad, prog.reduce)
+                    g["rel_dst"], sg.vpad, prog.reduce,
+                    method=("pallas"
+                            if self.reduce_method.startswith("pallas")
+                            else "xla"),
+                    interpret=self.reduce_method == "pallas-interpret")
+            if self.pairs is not None:
+                from lux_tpu.ops.pairs import pair_partial
+                from lux_tpu.ops.tiled import combine_op
+
+                def msg(vals, w):
+                    c = prog.relax(vals, w)
+                    return jnp.where(vals == ident_l,
+                                     jnp.asarray(prog.identity, c.dtype),
+                                     c)
+
+                pred = pair_partial(
+                    self.pairs, flat_l, g["pair_rowbind"],
+                    g["pair_rel"], g.get("pair_weight"),
+                    g["pair_tile_pos"], prog.reduce, msg,
+                    reduce_method=self.reduce_method)[:sg.vpad]
+                red = combine_op(prog.reduce)(red, pred)
             improved = prog.better(red, old) & g["vmask"]
             new = jnp.where(improved, red, old)
             return new, improved
 
         dense_keys = [k for k in ("src_slot", "dst_local", "weight",
                                   "rel_dst", "chunk_start", "last_chunk",
-                                  "chunk_tile", "vmask", "deg")
+                                  "chunk_tile", "vmask", "deg",
+                                  "pair_rowbind", "pair_rel",
+                                  "pair_weight", "pair_tile_pos")
                       if k in g]
         return jax.vmap(one)(label, {k: g[k] for k in dense_keys})
 

@@ -101,6 +101,105 @@ class Graph:
         return src, dst
 
 
+def degree_relabel(g: Graph):
+    """Relabel vertices by descending total degree — concentrates hubs
+    into shared 128-vertex tiles so pair-lane delivery (PullEngine /
+    PushEngine ``pair_threshold``; ops/pairs.py) finds dense tile
+    pairs.  Returns (relabeled graph, perm) with perm[new] = old."""
+    src, dst = g.edge_arrays()
+    deg = (np.bincount(src, minlength=g.nv)
+           + np.bincount(dst, minlength=g.nv))
+    perm = np.argsort(-deg, kind="stable")
+    rank = np.empty(g.nv, np.int64)
+    rank[perm] = np.arange(g.nv)
+    g2 = Graph.from_edges(rank[src], rank[dst], g.nv, weights=g.weights)
+    return g2, perm
+
+
+def pair_relabel(g: Graph, num_parts: int = 1,
+                 pair_threshold: int = 16, gather_cost: float = 9.0,
+                 pair_cost: float = 2.5):
+    """Degree-sort, then DEAL whole 128-vertex tiles to parts by
+    greedy cost balancing (LPT over degree-ordered tiles).
+
+    For multi-part pair-lane delivery (ops/pairs.py) a plain degree
+    sort is hostile twice over: contiguous partitions make the hub
+    part's depth profile few-deep-tiles and the tail part's
+    many-shallow-tiles — and the common padded class structure parts
+    must share (shard_map runs ONE program) inflates to the
+    elementwise max (measured 2.9x row padding at RMAT21/np=4) — and
+    the tail parts keep nearly all the residual gather-served edges
+    (measured 0.8M..5.9M skew).  Dealing tiles in descending degree
+    order to the currently-cheapest part gives every part a similar
+    depth profile AND balanced estimated cost.  Tile contents are
+    unchanged by dealing, so pair coverage is identical to the plain
+    degree sort.
+
+    Per-tile cost uses the exact global pair histogram (parts are
+    tile-aligned, so part-local pair structure equals the global
+    tiling): an in-edge in a dense (src-tile, dst-tile) pair costs
+    ``pair_cost`` ns, any other ``gather_cost`` ns (PERF_NOTES.md).
+
+    Returns (relabeled graph, perm, starts) with perm[new] = old and
+    ``starts`` the partition cut points to pass to ShardedGraph.build
+    (tile-aligned; a partial trailing tile is placed last).
+    """
+    src, dst = g.edge_arrays()
+    deg = (np.bincount(src, minlength=g.nv)
+           + np.bincount(dst, minlength=g.nv))
+    by_deg = np.argsort(-deg, kind="stable")      # degree position -> old
+    Wt = 128
+    n_tiles = -(-g.nv // Wt)
+    full = n_tiles - 1 if g.nv % Wt else n_tiles
+    P = max(1, num_parts)
+    if P > 1 and full < P:
+        # graph too small for whole-tile dealing; plain degree sort,
+        # default (cost-balanced) cuts
+        rank = np.empty(g.nv, np.int64)
+        rank[by_deg] = np.arange(g.nv)
+        g2 = Graph.from_edges(rank[src], rank[dst], g.nv,
+                              weights=g.weights)
+        return g2, by_deg, None
+
+    if P > 1 and full:
+        # estimated per-tile in-edge cost in the DEGREE-SORTED tiling
+        rank0 = np.empty(g.nv, np.int64)
+        rank0[by_deg] = np.arange(g.nv)
+        s2, d2 = rank0[src], rank0[dst]
+        key = (s2 // Wt) * np.int64(n_tiles) + d2 // Wt
+        _uniq, inv, cnt = np.unique(key, return_inverse=True,
+                                    return_counts=True)
+        cost_e = np.where(cnt[inv] >= pair_threshold, pair_cost,
+                          gather_cost)
+        tile_cost = np.bincount(d2 // Wt, weights=cost_e,
+                                minlength=n_tiles)
+        load = np.zeros(P)
+        owner = np.empty(full, np.int64)
+        for t in range(full):                     # LPT greedy
+            p = int(np.argmin(load))
+            owner[t] = p
+            load[p] += tile_cost[t]
+        part_tiles = [np.nonzero(owner == p)[0] for p in range(P)]
+    else:
+        part_tiles = [np.arange(p, full, P) for p in range(P)]
+
+    counts_v = [len(t) * Wt for t in part_tiles]
+    if g.nv % Wt:
+        part_tiles[-1] = np.concatenate(
+            [part_tiles[-1], [full]]).astype(np.int64)
+        counts_v[-1] += g.nv % Wt
+    starts = np.concatenate(([0], np.cumsum(counts_v))).astype(np.int64)
+    tile_seq = np.concatenate(part_tiles)
+    vert_order = (tile_seq[:, None] * Wt +
+                  np.arange(Wt)[None, :]).reshape(-1)
+    vert_order = vert_order[vert_order < g.nv]    # clip partial tile
+    perm = by_deg[vert_order]                     # new -> old
+    rank = np.empty(g.nv, np.int64)
+    rank[perm] = np.arange(g.nv)
+    g2 = Graph.from_edges(rank[src], rank[dst], g.nv, weights=g.weights)
+    return g2, perm, starts
+
+
 @dataclasses.dataclass
 class ShardedGraph:
     """Padded part-major device layout (all arrays are host numpy;
@@ -125,8 +224,22 @@ class ShardedGraph:
 
     @classmethod
     def build(cls, g: Graph, num_parts: int, vpad_align: int = 8,
-              epad_align: int = 128) -> "ShardedGraph":
-        starts = edge_balanced_bounds(g.row_ptrs, num_parts)
+              epad_align: int = 128, starts: np.ndarray | None = None,
+              pair_threshold: int | None = None) -> "ShardedGraph":
+        """pair_threshold: build FOR pair-lane delivery — forces the
+        128-aligned vertex padding the delivery needs and (for
+        num_parts > 1) cuts partitions balancing ESTIMATED cost under
+        the pair/gather split (ops/pairs.cost_balanced_starts) rather
+        than raw edge counts.  ``starts`` overrides the cut points."""
+        if pair_threshold is not None:
+            vpad_align = max(vpad_align, 128)
+            if starts is None and num_parts > 1:
+                from lux_tpu.ops.pairs import cost_balanced_starts
+                starts = cost_balanced_starts(g, num_parts,
+                                              pair_threshold)
+        if starts is None:
+            starts = edge_balanced_bounds(g.row_ptrs, num_parts)
+        starts = np.asarray(starts, np.int64)
         nv_part = (starts[1:] - starts[:-1]).astype(np.int32)
         ne_part = part_edge_counts(g.row_ptrs, starts).astype(np.int64)
         vpad = _round_up(max(1, int(nv_part.max())), vpad_align)

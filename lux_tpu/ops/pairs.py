@@ -36,6 +36,7 @@ class PairPlan:
 
     rowbind   int32 [R]      global state2d row (= src tile) per row
     rel_dst   int32 [R, 128] dst offset in [0,128), 128 = dead lane
+    weight    f32 [R, 128] | None  per-lane edge weight (0 dead lanes)
     classes   [(tile_start, tile_count, depth)] for the combine; rows
               are tile-major in ``tile_order`` with per-tile depth
               padded to the class depth (dead rows are all-128)
@@ -45,6 +46,7 @@ class PairPlan:
 
     rowbind: np.ndarray
     rel_dst: np.ndarray
+    weight: np.ndarray | None
     classes: list
     tile_order: np.ndarray
     residual: np.ndarray
@@ -52,13 +54,39 @@ class PairPlan:
     stats: dict
 
 
+def quantize_depths(depth_sorted: np.ndarray,
+                    levels_growth: float = 1.35) -> np.ndarray:
+    """Round a descending per-slot row-count profile up to the fixed
+    depth ladder (0..8 then *levels_growth), bounding the number of
+    distinct classes to O(log max_depth)."""
+    levels = [0, 1, 2, 3, 4, 5, 6, 7, 8]
+    v = 8
+    while v < int(np.max(depth_sorted, initial=0)):
+        v = int(v * levels_growth) + 1
+        levels.append(v)
+    lev = np.asarray(levels, np.int64)
+    return lev[np.searchsorted(lev, depth_sorted)]
+
+
 def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
                     vpad: int, threshold: int = 8,
                     max_occ: int = 128,
-                    levels_growth: float = 1.35) -> PairPlan:
+                    levels_growth: float = 1.35,
+                    weights: np.ndarray | None = None,
+                    slot_depths: np.ndarray | None = None,
+                    profile_only: bool = False):
     """src_slot: int [ne] global padded state slots (state2d row =
     slot // 128); dst_local: int [ne] part-local dst in [0, vpad);
-    vpad must be a multiple of 128."""
+    vpad must be a multiple of 128.  weights (optional, [ne]) are laid
+    out per lane so weighted programs get each delivered edge's weight
+    next to its value.
+
+    slot_depths (optional, [n_tiles] descending, ladder-quantized):
+    lay rows out against this EXTERNAL per-slot depth profile instead
+    of the part's own — every part of a multi-part graph laid out
+    against the elementwise-max profile gets IDENTICAL classes, so
+    stacking pads no rows beyond the max profile (see
+    plan_sharded_pairs)."""
     assert vpad % W == 0
     ne = len(dst_local)
     n_tiles = vpad // W
@@ -132,29 +160,38 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     np.add.at(rows_by_tile, pair_dt, nrows_pair)
     t_order = np.argsort(-rows_by_tile, kind="stable")
     depth_sorted = rows_by_tile[t_order]
+    if profile_only:
+        # first pass of plan_sharded_pairs: only the sorted per-tile
+        # row-count profile is needed to derive the common frame —
+        # skip materializing the [R, 128] row arrays entirely
+        return depth_sorted
 
-    levels = [0, 1, 2, 3, 4, 5, 6, 7, 8]
-    v = 8
-    while v < int(depth_sorted.max(initial=0)):
-        v = int(v * levels_growth) + 1
-        levels.append(v)
-    lev = np.asarray(levels, np.int64)
-    depth = lev[np.searchsorted(lev, depth_sorted)]
+    if slot_depths is None:
+        depth = quantize_depths(depth_sorted, levels_growth)
+    else:
+        depth = np.asarray(slot_depths, np.int64)
+        if depth.shape != (n_tiles,) or (depth < depth_sorted).any():
+            raise ValueError("slot_depths must cover this part's own "
+                             "sorted per-tile row counts")
 
     row_off_tile = np.concatenate(([0], np.cumsum(depth)))
     R = int(row_off_tile[-1])
 
-    # rows of each pair: base = tile's offset + running offset within
-    # the tile (pairs in tile_sort order)
+    # rows of each pair: base = tile's offset + exclusive running row
+    # count within the tile (pairs in tile_sort order are contiguous
+    # per destination tile)
     tile_pos = np.empty(n_tiles, np.int64)        # tile -> class slot
     tile_pos[t_order] = np.arange(n_tiles)
+    srt_rows = nrows_pair[tile_sort]
+    cum = np.cumsum(srt_rows) - srt_rows          # exclusive prefix
+    dts = pair_dt[tile_sort]
+    newt = np.ones(len(dts), bool)
+    newt[1:] = dts[1:] != dts[:-1]
+    grp_base = np.maximum.accumulate(np.where(newt, cum, 0))
+    within = cum - grp_base
     pair_base = np.zeros(len(sel_ids), np.int64)
-    running = np.zeros(n_tiles, np.int64)
-    for j in tile_sort:                            # per selected pair
-        t = pair_dt[j]
-        pair_base[j] = row_off_tile[tile_pos[t]] + running[t]
-        running[t] += nrows_pair[j]
-    assert (running <= depth[tile_pos]).all()
+    pair_base[tile_sort] = row_off_tile[tile_pos[dts]] + within
+    assert (within + srt_rows <= depth[tile_pos[dts]]).all()
 
     rowbind = np.zeros(R, np.int32)
     rel_dst = np.full((R, W), W, np.int32)
@@ -163,6 +200,11 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     rowbind[rows] = rowbind_rows
     rel_dst[rows, src_slot[cov] % W] = (dst_local[cov] % W).astype(
         np.int32)
+    weight = None
+    if weights is not None:
+        weight = np.zeros((R, W), np.float32)
+        weight[rows, src_slot[cov] % W] = np.asarray(
+            weights, np.float32)[cov]
 
     classes = []
     t0 = 0
@@ -172,13 +214,15 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
             classes.append((t0, cnt, int(L)))
         t0 += cnt
 
-    plan = PairPlan(rowbind=rowbind, rel_dst=rel_dst, classes=classes,
+    plan = PairPlan(rowbind=rowbind, rel_dst=rel_dst, weight=weight,
+                    classes=classes,
                     tile_order=t_order.astype(np.int32),
                     residual=residual, n_tiles=n_tiles, stats={})
     ncov = int((~residual).sum())
     plan.stats = dict(ne=ne, covered=ncov, R=R,
                       coverage=ncov / max(ne, 1),
-                      inflation=R * W / max(ncov, 1))
+                      inflation=R * W / max(ncov, 1),
+                      depth_profile=depth_sorted)
     return plan
 
 
@@ -205,4 +249,277 @@ def pair_reduce_numpy(plan: PairPlan, state_flat: np.ndarray,
                         out[tile * W + w] = op(out[tile * W + w],
                                                vals[r, c])
         row0 += cnt * L
+    return out
+
+
+# ---------------------------------------------------------------------
+# Stacked (multi-part) plans: the per-part PairPlans are padded to ONE
+# common class structure so they stack into rectangular [P, ...] arrays
+# that vmap over parts and shard over a mesh axis exactly like the rest
+# of the graph arrays.  The analogue of the reference running the same
+# per-part app task on every partition of the gathered whole-state
+# region (reference pull_model.inl:454-469).
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StackedPairPlan:
+    """Common-frame pair-lane arrays for all parts (host numpy).
+
+    rowbind   int32 [P, Rp]       global state2d row per delivery row
+    rel_dst   int32 [P, Rp, 128]  dst offset in [0,128), 128 = dead
+    weight    f32 [P, Rp, 128] | None  per-lane edge weight
+    tile_pos  int32 [P, n_tiles]  class slot of each part-local tile;
+              tiles with no pair rows point at the trailing identity
+              slot ``n_slots``
+    classes   [(count, depth)] shared by every part, depth descending;
+              a part with fewer tiles at some depth owns dead rows
+              there (all-128 rel), which reduce to the identity and
+              are never referenced by its tile_pos
+    """
+
+    rowbind: np.ndarray
+    rel_dst: np.ndarray
+    weight: np.ndarray | None
+    tile_pos: np.ndarray
+    classes: list
+    n_tiles: int
+    n_slots: int
+    R: int
+    Rp: int
+    stats: dict
+
+
+def stack_pair_plans(plans: list, weighted: bool,
+                     block_rows: int = 64) -> StackedPairPlan:
+    """Pad per-part plans to a common class structure and stack.
+
+    The depth ladder in build_pair_plan is a prefix of one fixed
+    sequence, so per-part class depths are subsets of a common
+    descending depth list; the common count per depth is the max over
+    parts.  Rows are padded to ``block_rows`` granularity for the
+    Pallas chunk-partial kernel.
+    """
+    P = len(plans)
+    n_tiles = plans[0].n_tiles
+    depths = sorted({L for pl in plans for (_t0, _c, L) in pl.classes},
+                    reverse=True)
+    cnt_by_depth = {
+        L: max((c for pl in plans for (_t0, c, Ld) in pl.classes
+                if Ld == L), default=0)
+        for L in depths}
+    classes = [(cnt_by_depth[L], L) for L in depths]
+    n_slots = sum(c for c, _L in classes)
+    R = sum(c * L for c, L in classes)
+    Rp = max(R, block_rows)
+    Rp = -(-Rp // block_rows) * block_rows
+
+    slot_base, row_base = {}, {}
+    s = r = 0
+    for c, L in classes:
+        slot_base[L], row_base[L] = s, r
+        s += c
+        r += c * L
+
+    rowbind = np.zeros((P, Rp), np.int32)
+    rel_dst = np.full((P, Rp, W), W, np.int32)
+    wgt = np.zeros((P, Rp, W), np.float32) if weighted else None
+    tile_pos = np.full((P, n_tiles), n_slots, np.int32)
+    for p, pl in enumerate(plans):
+        prow = 0
+        for (t0, c, L) in pl.classes:
+            rb, sb = row_base[L], slot_base[L]
+            rowbind[p, rb:rb + c * L] = pl.rowbind[prow:prow + c * L]
+            rel_dst[p, rb:rb + c * L] = pl.rel_dst[prow:prow + c * L]
+            if weighted:
+                wgt[p, rb:rb + c * L] = pl.weight[prow:prow + c * L]
+            tiles = pl.tile_order[t0:t0 + c]
+            tile_pos[p, tiles] = sb + np.arange(c, dtype=np.int32)
+            prow += c * L
+
+    ne = sum(pl.stats["ne"] for pl in plans)
+    cov = sum(pl.stats["covered"] for pl in plans)
+    return StackedPairPlan(
+        rowbind=rowbind, rel_dst=rel_dst, weight=wgt, tile_pos=tile_pos,
+        classes=classes, n_tiles=n_tiles, n_slots=n_slots, R=R, Rp=Rp,
+        stats=dict(ne=ne, covered=cov, coverage=cov / max(ne, 1),
+                   inflation=P * Rp * W / max(cov, 1)))
+
+
+def cost_balanced_starts(g, num_parts: int, threshold: int,
+                         gather_cost: float = 9.0,
+                         pair_cost: float = 2.5) -> np.ndarray:
+    """Partition cut points balancing ESTIMATED per-part iteration
+    cost under pair-lane delivery, instead of raw edge counts.
+
+    Edge-balanced cuts leave the tail-destination parts with nearly
+    all the residual (gather-served, ~9 ns) edges while hub parts'
+    edges ride cheap pair rows — measured 0.8M..5.9M residual skew at
+    RMAT21/np=4.  Cost model: an edge in a dense GLOBAL (src-tile,
+    dst-tile) pair costs ``pair_cost`` ns, any other ``gather_cost``
+    ns (PERF_NOTES.md).  Cuts are 128-aligned so part-local tile
+    structure equals the global tiling and the estimate is exact.
+    """
+    from lux_tpu.partition import weighted_balanced_bounds
+
+    src, dst = g.edge_arrays()
+    n_st = (g.nv + W - 1) // W
+    key = (src // W) * np.int64(n_st) + dst // W
+    uniq, inv, cnt = np.unique(key, return_inverse=True,
+                               return_counts=True)
+    edge_cost = np.where(cnt[inv] >= threshold, pair_cost, gather_cost)
+    ccum = np.concatenate(([0.0], np.cumsum(edge_cost)))
+    cost_ptrs = ccum[np.asarray(g.row_ptrs, np.int64)]  # END offsets
+    return weighted_balanced_bounds(cost_ptrs, num_parts, align=W)
+
+
+def plan_sharded_pairs(sg, threshold: int):
+    """Build per-part pair plans for a ShardedGraph and the RESIDUAL
+    ShardedGraph (uncovered edges, re-padded) the regular gather path
+    should run on.  Returns (StackedPairPlan | None, residual_sg);
+    None when no pair anywhere meets the threshold (residual is ``sg``
+    itself).  Works for any num_parts; requires vpad % 128 == 0
+    (build the ShardedGraph with vpad_align=128)."""
+    import dataclasses as _dc
+
+    if sg.vpad % W:
+        raise ValueError("pair delivery needs vpad % 128 == 0; build "
+                         "the ShardedGraph with vpad_align=128")
+    P = sg.num_parts
+
+    def plan_part(p, slot_depths=None, profile_only=False):
+        nep = int(sg.ne_part[p])
+        wp = (np.asarray(sg.edge_weight[p, :nep])
+              if sg.weighted and not profile_only else None)
+        return build_pair_plan(
+            sg.src_slot[p, :nep], sg.dst_local[p, :nep], sg.vpad,
+            threshold=threshold, weights=wp, slot_depths=slot_depths,
+            profile_only=profile_only)
+
+    if P > 1:
+        # Pass 1 (cheap, profile-only): per-part sorted row-count
+        # profiles.  Pass 2: lay every part out against the
+        # elementwise-max profile so classes are IDENTICAL across
+        # parts and stacking pads no rows beyond the max profile.
+        # (Per-depth max-count stacking of heterogeneous profiles
+        # measured 3.4x row inflation at RMAT21/np=4.)
+        profiles = [plan_part(p, profile_only=True) for p in range(P)]
+        if sum(int(prof.sum()) for prof in profiles) == 0:
+            return None, sg             # no pair anywhere dense enough
+        common = quantize_depths(np.maximum.reduce(profiles))
+        plans = [plan_part(p, slot_depths=common) for p in range(P)]
+    else:
+        plans = [plan_part(0)]
+        if plans[0].stats["covered"] == 0:
+            return None, sg
+
+    sp = stack_pair_plans(plans, sg.weighted)
+
+    ne_r = [int(pl.residual.sum()) for pl in plans]
+    epad_r = max(128, -(-max(ne_r) // 128) * 128)
+    src_slot = np.zeros((P, epad_r), np.int32)
+    dst_local = np.full((P, epad_r), sg.vpad, np.int32)
+    ew = np.zeros((P, epad_r), np.float32) if sg.weighted else None
+    row_ptr_local = np.zeros((P, sg.vpad + 1), np.int32)
+    for p, pl in enumerate(plans):
+        nep = int(sg.ne_part[p])
+        res = pl.residual
+        nr = ne_r[p]
+        src_slot[p, :nr] = sg.src_slot[p, :nep][res]
+        r_dst = sg.dst_local[p, :nep][res]
+        dst_local[p, :nr] = r_dst
+        if ew is not None:
+            ew[p, :nr] = sg.edge_weight[p, :nep][res]
+        counts = np.bincount(r_dst, minlength=sg.vpad)
+        row_ptr_local[p, 1:] = np.cumsum(counts).astype(np.int32)
+    residual = _dc.replace(
+        sg, src_slot=src_slot, dst_local=dst_local, edge_weight=ew,
+        row_ptr_local=row_ptr_local,
+        ne_part=np.asarray(ne_r, np.int64), epad=epad_r,
+        _src_sorted_cache=None)
+    return sp, residual
+
+
+def pair_partial(sp: StackedPairPlan, flat_state, rowbind, rel, weight,
+                 tile_pos, kind: str, msg_fn,
+                 reduce_method: str = "xla"):
+    """Device-side delivery + reduce for ONE part -> [n_tiles * 128]
+    partial (identity where pairs contribute nothing).
+
+    flat_state: [n_state_rows * 128] flat vertex state (the all-
+    gathered whole state); rowbind/rel/weight/tile_pos: this part's
+    rows of the stacked arrays; msg_fn(vals [R,128],
+    weight [R,128]|None) -> per-edge messages (dead lanes carry
+    garbage, masked by rel == 128).
+    """
+    import jax.numpy as jnp
+
+    from lux_tpu.ops.segment import identity_for
+    from lux_tpu.ops.tiled import chunk_partials
+
+    if flat_state.ndim != 1:
+        raise ValueError("pair delivery supports scalar vertex state "
+                         "only")
+    s2d = flat_state.reshape(-1, W)
+    vals = jnp.take(s2d, rowbind, axis=0)            # [Rp, 128] rows
+    vals = msg_fn(vals, weight)
+    if reduce_method.startswith("pallas"):
+        from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+        # rows are short (E=128): large blocks amortize the grid
+        partials = chunk_partials_pallas(
+            vals, rel, W, kind, block_c=64,
+            interpret=reduce_method == "pallas-interpret")
+    else:
+        partials = chunk_partials(vals, rel, W, kind)
+    partials = partials[:sp.R]                       # drop pad rows
+    ident = identity_for(kind, partials.dtype)
+    outs = []
+    row0 = 0
+    for (cnt, L) in sp.classes:
+        blk = partials[row0:row0 + cnt * L].reshape(cnt, L, W)
+        outs.append({"sum": jnp.sum, "min": jnp.min,
+                     "max": jnp.max}[kind](blk, axis=1))
+        row0 += cnt * L
+    outs.append(jnp.full((1, W), ident, partials.dtype))
+    slots = jnp.concatenate(outs, axis=0)            # [n_slots + 1, W]
+    red2d = jnp.take(slots, tile_pos, axis=0)        # [n_tiles, W]
+    return red2d.reshape(-1)
+
+
+def stacked_pair_reduce_numpy(sp: StackedPairPlan, p: int,
+                              state_flat: np.ndarray, kind: str = "sum",
+                              msg=None) -> np.ndarray:
+    """Oracle for one part of a stacked plan.  msg(vals, weight) maps
+    delivered values (+ per-lane weights) to messages; default uses
+    the values unchanged."""
+    s2d = np.asarray(state_flat).reshape(-1, W)
+    vals = s2d[sp.rowbind[p]].astype(np.float64)     # [Rp, 128]
+    wp = sp.weight[p] if sp.weight is not None else None
+    if msg is not None:
+        vals = msg(vals, wp)
+    ident = {"sum": 0.0, "min": np.inf, "max": -np.inf}[kind]
+    op = {"sum": np.add, "min": np.minimum, "max": np.maximum}[kind]
+    out = np.full(sp.n_tiles * W, ident)
+    row_base = {}
+    slot_base = {}
+    s = r = 0
+    for c, L in sp.classes:
+        slot_base[L], row_base[L] = s, r
+        s += c
+        r += c * L
+    for t in range(sp.n_tiles):
+        slot = int(sp.tile_pos[p, t])
+        if slot == sp.n_slots:
+            continue
+        for c, L in sp.classes:
+            sb, rb = slot_base[L], row_base[L]
+            if sb <= slot < sb + c:
+                for rr in range(rb + (slot - sb) * L,
+                                rb + (slot - sb + 1) * L):
+                    lanes = sp.rel_dst[p, rr]
+                    for col in range(W):
+                        if lanes[col] < W:
+                            out[t * W + lanes[col]] = op(
+                                out[t * W + lanes[col]], vals[rr, col])
+                break
     return out

@@ -55,6 +55,56 @@ def edge_balanced_bounds(row_ptrs, num_parts: int) -> np.ndarray:
     return starts
 
 
+def weighted_balanced_bounds(cost_ptrs, num_parts: int,
+                             align: int = 1) -> np.ndarray:
+    """Cut points balancing an arbitrary per-vertex cumulative COST
+    (``cost_ptrs[v]`` = total cost through vertex v, END-offset
+    semantics like row_ptrs).  ``edge_balanced_bounds`` is the special
+    case cost = in-degree.
+
+    align > 1 rounds interior cuts to multiples of ``align`` (e.g. 128
+    keeps every part's vertex range tile-aligned so (src-tile,
+    dst-tile) pair structure is identical to the global tiling); falls
+    back to align=1 when num_parts * align > nv.
+    """
+    cost_ptrs = np.asarray(cost_ptrs)
+    nv = cost_ptrs.shape[0]
+    if num_parts < 1:
+        raise ValueError("num_parts must be >= 1")
+    if num_parts > nv:
+        raise ValueError(f"num_parts={num_parts} exceeds nv={nv}")
+    if align > 1 and num_parts * align > nv:
+        align = 1
+    total = float(cost_ptrs[-1]) if nv else 0.0
+    targets = np.arange(1, num_parts) * (total / num_parts)
+    cuts = np.searchsorted(cost_ptrs, targets, side="left") + 1
+    if align > 1:
+        cuts = np.round(cuts / align).astype(np.int64) * align
+    starts = np.empty(num_parts + 1, dtype=np.int64)
+    starts[0] = 0
+    starts[1:num_parts] = cuts
+    starts[num_parts] = nv
+    # Same degenerate-distribution fixups as edge_balanced_bounds,
+    # stepping by ``align`` to preserve alignment where feasible (the
+    # backward pass near an unaligned nv may break alignment for the
+    # last interior cut; alignment is an optimization, not a
+    # correctness requirement).
+    for p in range(1, num_parts):
+        if starts[p] <= starts[p - 1]:
+            starts[p] = starts[p - 1] + align
+    for p in range(num_parts - 1, 0, -1):
+        if starts[p] >= starts[p + 1]:
+            starts[p] = starts[p + 1] - (align if starts[p + 1] % align
+                                         == 0 else 1)
+    starts[1:num_parts] = np.clip(starts[1:num_parts], 1, nv - 1)
+    for p in range(1, num_parts):
+        if starts[p] <= starts[p - 1]:
+            starts[p] = starts[p - 1] + 1
+    assert starts[0] == 0 and starts[num_parts] == nv
+    assert (np.diff(starts) > 0).all()
+    return starts
+
+
 def part_edge_counts(row_ptrs, starts) -> np.ndarray:
     """Edges owned by each part (in-edges of its vertex range)."""
     row_ptrs = np.asarray(row_ptrs)

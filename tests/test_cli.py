@@ -64,6 +64,20 @@ def test_colfilter_cli(weighted_lux_file, capsys):
     assert rc == 0 and "RMSE" in out
 
 
+def test_pair_flag_cli(lux_file, capsys):
+    """-pair relabels internally and maps results back to input ids,
+    so -check (which runs against the INPUT graph for pagerank/sssp)
+    must still pass."""
+    for app, extra in [("pagerank", ["-ni", "3"]),
+                       ("sssp", ["-start", "1"]),
+                       ("components", [])]:
+        rc = cli.main([app, "-file", lux_file, "-pair", "2", "-check",
+                       *extra])
+        out = capsys.readouterr().out
+        assert rc == 0, f"{app}: {out}"
+        assert "[PASS]" in out, f"{app}: {out}"
+
+
 def test_convert_cli(tmp_path, capsys):
     txt = tmp_path / "e.txt"
     txt.write_text("0 1\n1 2\n2 0\n")

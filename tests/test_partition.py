@@ -70,3 +70,38 @@ def test_frontier_capacity_rule():
     # reference push_model.inl:393-397 with SPARSE_THRESHOLD=16
     assert frontier_capacity(1600) == 200
     assert frontier_capacity(0) == 100
+
+
+def test_weighted_balanced_bounds_aligned():
+    from lux_tpu.partition import weighted_balanced_bounds
+    rng = np.random.default_rng(3)
+    nv = 4096
+    cost = rng.random(nv) * np.linspace(3, 1, nv)  # front-loaded
+    cum = np.cumsum(cost)
+    starts = weighted_balanced_bounds(cum, 4, align=128)
+    assert starts[0] == 0 and starts[-1] == nv
+    assert (np.diff(starts) > 0).all()
+    assert (starts[1:-1] % 128 == 0).all()
+    # balance: every part within 35% of the mean cost
+    per = np.diff(np.concatenate(([0.0], cum[starts[1:] - 1])))
+    assert per.max() / (cum[-1] / 4) < 1.35
+
+
+def test_weighted_balanced_bounds_fallback_small():
+    from lux_tpu.partition import weighted_balanced_bounds
+    # nv < parts * align -> falls back to unaligned but still valid
+    cum = np.cumsum(np.ones(100))
+    starts = weighted_balanced_bounds(cum, 4, align=128)
+    assert starts[0] == 0 and starts[-1] == 100
+    assert (np.diff(starts) > 0).all()
+
+
+def test_weighted_matches_edge_balanced_on_degrees():
+    from lux_tpu.partition import (edge_balanced_bounds,
+                                   weighted_balanced_bounds)
+    rng = np.random.default_rng(5)
+    deg = rng.integers(0, 50, 500)
+    row_ptrs = np.cumsum(deg).astype(np.uint64)
+    a = edge_balanced_bounds(row_ptrs, 5)
+    b = weighted_balanced_bounds(row_ptrs.astype(np.float64), 5)
+    np.testing.assert_array_equal(a, b)
