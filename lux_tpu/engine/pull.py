@@ -37,7 +37,7 @@ from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
 from lux_tpu.ops.tiled import (TiledLayout, combine_chunks,
                                combine_op, tiled_segment_reduce)
-from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
+from lux_tpu.parallel.mesh import PARTS_AXIS, shard_over_parts
 
 
 # chunks per lax.map block in the dot path: bounds the [B, E, W]
@@ -59,29 +59,35 @@ def resolve_reduce_method(method: str) -> str:
 
 
 def build_graph_arrays(sg: ShardedGraph, layout: str, needs_dst: bool,
-                       tile_w: int, tile_e: int):
-    """Device-ready per-part graph arrays (all leading dim num_parts)
-    for either edge layout; returns (arrays dict, TiledLayout|None)."""
-    common = dict(deg=jnp.asarray(sg.deg_padded),
-                  vmask=jnp.asarray(sg.vmask))
+                       tile_w: int, tile_e: int, device: bool = True):
+    """Per-part graph arrays (all leading dim num_parts) for either
+    edge layout; returns (arrays dict, TiledLayout|None).
+
+    device=False keeps them as host numpy — mesh engines place them
+    with ``shard_over_parts`` directly (one H2D per shard), instead of
+    staging everything through the default device first."""
+    dev = jnp.asarray if device else np.asarray
+    common = dict(deg=dev(sg.deg_padded), vmask=dev(sg.vmask))
     if layout == "flat":
-        arrays = dict(src_slot=jnp.asarray(sg.src_slot),
-                      dst_local=jnp.asarray(sg.dst_local), **common)
+        arrays = dict(src_slot=dev(sg.src_slot),
+                      dst_local=dev(sg.dst_local), **common)
         if sg.weighted:
-            arrays["weight"] = jnp.asarray(sg.edge_weight)
+            arrays["weight"] = dev(sg.edge_weight)
         return arrays, None
     if layout != "tiled":
         raise ValueError(f"unknown layout {layout!r}")
-    lay = TiledLayout.build(sg.row_ptr_local, sg.dst_local, sg.vpad,
-                            W=tile_w, E=tile_e)
-    arrays = dict(src_slot=jnp.asarray(lay.chunk(sg.src_slot)),
-                  rel_dst=jnp.asarray(lay.rel_dst),
-                  chunk_start=jnp.asarray(lay.chunk_start),
-                  last_chunk=jnp.asarray(lay.last_chunk), **common)
+    lay = TiledLayout.build(
+        sg.row_ptr_local, sg.dst_local, sg.vpad, W=tile_w, E=tile_e,
+        sizing_row_ptr=(None if sg.local_parts is None
+                        else sg.sizing_row_ptr()))
+    arrays = dict(src_slot=dev(lay.chunk(sg.src_slot)),
+                  rel_dst=dev(lay.rel_dst),
+                  chunk_start=dev(lay.chunk_start),
+                  last_chunk=dev(lay.last_chunk), **common)
     if sg.weighted:
-        arrays["weight"] = jnp.asarray(lay.chunk(sg.edge_weight))
+        arrays["weight"] = dev(lay.chunk(sg.edge_weight))
     if needs_dst:
-        arrays["chunk_tile"] = jnp.asarray(lay.chunk_tile)
+        arrays["chunk_tile"] = dev(lay.chunk_tile)
     return arrays, lay
 
 
@@ -103,6 +109,7 @@ class PullEngine:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
                 f"{mesh.devices.size}")
+        _check_local_parts(sg, mesh, pair_threshold)
         self.pairs = None
         if pair_threshold is not None:
             sg = self._setup_pairs(sg, pair_threshold, mesh, layout,
@@ -121,18 +128,19 @@ class PullEngine:
         self.mesh = mesh
         self.use_mxu = use_mxu
         self.reduce_method = resolve_reduce_method(reduce_method)
+        dev = jnp.asarray if mesh is None else np.asarray
         arrays, self.tiles = build_graph_arrays(
             sg, layout,
             program.needs_dst or program.edge_value_from_dot is not None,
-            tile_w, tile_e)
+            tile_w, tile_e, device=mesh is None)
         if self.pairs is not None:
-            arrays["pair_rowbind"] = jnp.asarray(self.pairs.rowbind)
-            arrays["pair_rel"] = jnp.asarray(self.pairs.rel_dst)
-            arrays["pair_tile_pos"] = jnp.asarray(self.pairs.tile_pos)
+            arrays["pair_rowbind"] = dev(self.pairs.rowbind)
+            arrays["pair_rel"] = dev(self.pairs.rel_dst)
+            arrays["pair_tile_pos"] = dev(self.pairs.tile_pos)
             if self.pairs.weight is not None:
-                arrays["pair_weight"] = jnp.asarray(self.pairs.weight)
+                arrays["pair_weight"] = dev(self.pairs.weight)
         if mesh is not None:
-            arrays = shard_over_parts(mesh, arrays)
+            arrays = shard_over_parts(mesh, arrays, sg.num_parts)
         self.arrays = arrays
         self._step_fn = self._build_step()
 
@@ -175,10 +183,11 @@ class PullEngine:
     # -- state placement ----------------------------------------------
 
     def init_state(self):
-        state = jnp.asarray(self.program.init(self.sg))
+        state = self.program.init(self.sg)
         if self.mesh is not None:
-            state = jax.device_put(state, parts_spec(self.mesh))
-        return state
+            return shard_over_parts(self.mesh, [np.asarray(state)],
+                                    self.sg.num_parts)[0]
+        return jnp.asarray(state)
 
     # -- one part's work ----------------------------------------------
 
@@ -400,5 +409,31 @@ class PullEngine:
                                jnp.int32(max_iters), *self.graph_args)
 
     def unpad(self, state) -> np.ndarray:
-        """Padded device state -> [nv, ...] user order (host)."""
-        return self.sg.from_padded(np.asarray(jax.device_get(state)))
+        """Padded device state -> [nv, ...] user order (host).
+        Multi-host runs gather remote shards over the process group."""
+        from lux_tpu.parallel.multihost import fetch_global
+        return self.sg.from_padded(fetch_global(state))
+
+
+def _check_local_parts(sg, mesh, pair_threshold):
+    """Validate a local-parts (multi-host) ShardedGraph against the
+    mesh: the materialized rows must be exactly the rows this process's
+    devices hold under the parts sharding."""
+    if sg.local_parts is None:
+        return
+    if mesh is None:
+        raise ValueError(
+            "a ShardedGraph built with parts= (multi-host local rows) "
+            "requires a mesh")
+    if pair_threshold is not None:
+        raise NotImplementedError(
+            "pair-lane delivery is not yet supported with per-host "
+            "local-parts builds (the pair planner needs every part's "
+            "edges)")
+    from lux_tpu.parallel.mesh import local_part_rows
+    expect = local_part_rows(mesh, sg.num_parts)
+    got = list(np.asarray(sg.local_parts))
+    if got != expect:
+        raise ValueError(
+            f"local_parts {got} != this process's sharding rows "
+            f"{expect}; build with parts=multihost.process_parts(P)")

@@ -52,7 +52,7 @@ from lux_tpu.engine import frontier as fr
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
 from lux_tpu.ops.tiled import tiled_segment_reduce
-from lux_tpu.parallel.mesh import PARTS_AXIS, parts_spec, shard_over_parts
+from lux_tpu.parallel.mesh import PARTS_AXIS, shard_over_parts
 from lux_tpu.partition import frontier_capacity
 
 
@@ -93,8 +93,10 @@ class PushEngine:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
                 f"{mesh.devices.size}")
-        from lux_tpu.engine.pull import (build_graph_arrays,
+        from lux_tpu.engine.pull import (_check_local_parts,
+                                         build_graph_arrays,
                                          resolve_reduce_method)
+        _check_local_parts(sg, mesh, pair_threshold)
         if delta is not None:
             if program.reduce != "min":
                 raise ValueError("delta-stepping requires a 'min' program")
@@ -124,15 +126,16 @@ class PushEngine:
                 raise ValueError(
                     "pair_threshold requires the tiled layout")
             self.pairs, dense_sg = plan_sharded_pairs(sg, pair_threshold)
+        dev = jnp.asarray if mesh is None else np.asarray
         arrays, self.tiles = build_graph_arrays(
             dense_sg, layout, needs_dst=False, tile_w=tile_w,
-            tile_e=tile_e)
+            tile_e=tile_e, device=mesh is None)
         if self.pairs is not None:
-            arrays["pair_rowbind"] = jnp.asarray(self.pairs.rowbind)
-            arrays["pair_rel"] = jnp.asarray(self.pairs.rel_dst)
-            arrays["pair_tile_pos"] = jnp.asarray(self.pairs.tile_pos)
+            arrays["pair_rowbind"] = dev(self.pairs.rowbind)
+            arrays["pair_rel"] = dev(self.pairs.rel_dst)
+            arrays["pair_tile_pos"] = dev(self.pairs.tile_pos)
             if self.pairs.weight is not None:
-                arrays["pair_weight"] = jnp.asarray(self.pairs.weight)
+                arrays["pair_weight"] = dev(self.pairs.weight)
         self.enable_sparse = enable_sparse
         if enable_sparse:
             ss = sg.src_sorted()
@@ -140,22 +143,29 @@ class PushEngine:
             self.queue_cap = frontier_capacity(sg.vpad, sparse_threshold)
             # The edge budget must cover any single vertex's out-edges
             # within one part, or a truncated hub could make zero
-            # progress forever (see module docstring).
-            max_deg = int(np.max(np.diff(ss["in_row_ptr"], axis=1))) \
-                if sg.ne else 1
+            # progress forever (see module docstring).  It is a STATIC
+            # shape, so on local-parts (multi-host) builds it must not
+            # depend on which parts this process holds — bound it by
+            # the global max out-degree instead.
+            if sg.local_parts is not None:
+                max_deg = int(sg.max_out_degree) or 1
+            else:
+                max_deg = int(np.max(np.diff(ss["in_row_ptr"], axis=1))) \
+                    if sg.ne else 1
             default_eb = max(1024, sg.epad // sparse_threshold)
             self.edge_budget = int(edge_budget if edge_budget is not None
                                    else max(default_eb, max_deg + 128))
             arrays = dict(arrays,
-                          in_row_ptr=jnp.asarray(
+                          in_row_ptr=dev(
                               ss["in_row_ptr"].astype(np.int32)),
-                          ss_dst=jnp.asarray(ss["ss_dst"]),
-                          part_start=jnp.asarray(
-                              sg.starts[:-1].astype(np.int32)[:, None]))
+                          ss_dst=dev(ss["ss_dst"]),
+                          part_start=dev(
+                              sg.starts[sg.part_ids()].astype(
+                                  np.int32)[:, None]))
             if ss["ss_weight"] is not None:
-                arrays["ss_weight"] = jnp.asarray(ss["ss_weight"])
+                arrays["ss_weight"] = dev(ss["ss_weight"])
         if mesh is not None:
-            arrays = shard_over_parts(mesh, arrays)
+            arrays = shard_over_parts(mesh, arrays, sg.num_parts)
         self.arrays = arrays
         self._step_fn = self._build(converge=False)
         self._converge_fn = self._build(converge=True)
@@ -169,12 +179,11 @@ class PushEngine:
     def place(self, label, active):
         """Put host (or replicated) state arrays on the engine's
         devices with the parts sharding (used by checkpoint resume)."""
-        label = jnp.asarray(label)
-        active = jnp.asarray(active)
         if self.mesh is not None:
-            label = jax.device_put(label, parts_spec(self.mesh))
-            active = jax.device_put(active, parts_spec(self.mesh))
-        return label, active
+            return tuple(shard_over_parts(
+                self.mesh, [np.asarray(label), np.asarray(active)],
+                self.sg.num_parts))
+        return jnp.asarray(label), jnp.asarray(active)
 
     # -- dense iteration over this device's parts ----------------------
 
@@ -510,4 +519,5 @@ class PushEngine:
         return self.unpad(label), it
 
     def unpad(self, state) -> np.ndarray:
-        return self.sg.from_padded(np.asarray(jax.device_get(state)))
+        from lux_tpu.parallel.multihost import fetch_global
+        return self.sg.from_padded(fetch_global(state))

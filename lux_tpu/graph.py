@@ -221,16 +221,40 @@ class ShardedGraph:
     deg_padded: np.ndarray    # int32 [num_parts, vpad] out-degrees, padded
 
     weighted: bool = False
+    # Multi-host builds (parallel/multihost.py): only these parts' rows
+    # are materialized in the part-major arrays (None = all parts).
+    # Global metadata (nv, starts, vpad, epad, nv_part, ne_part) stays
+    # global so every process compiles the SAME program shapes — the
+    # analogue of the reference's identical Graph ctor on every node
+    # with per-node load tasks (reference pull_model.inl:29-191,253-320).
+    local_parts: np.ndarray | None = None
+    # Global row_ptrs (END offsets), kept on local builds so chunk
+    # geometry (ops/tiled.py) can be sized over ALL parts.
+    row_ptr_global: np.ndarray | None = None
+    # Max out-degree over the WHOLE graph (push edge budgets must be
+    # process-independent static shapes).
+    max_out_degree: int = 0
+
+    def part_ids(self) -> np.ndarray:
+        """Global part id of each materialized array row."""
+        if self.local_parts is None:
+            return np.arange(self.num_parts, dtype=np.int64)
+        return np.asarray(self.local_parts, dtype=np.int64)
 
     @classmethod
     def build(cls, g: Graph, num_parts: int, vpad_align: int = 8,
               epad_align: int = 128, starts: np.ndarray | None = None,
-              pair_threshold: int | None = None) -> "ShardedGraph":
+              pair_threshold: int | None = None,
+              parts=None) -> "ShardedGraph":
         """pair_threshold: build FOR pair-lane delivery — forces the
         128-aligned vertex padding the delivery needs and (for
         num_parts > 1) cuts partitions balancing ESTIMATED cost under
         the pair/gather split (ops/pairs.cost_balanced_starts) rather
-        than raw edge counts.  ``starts`` overrides the cut points."""
+        than raw edge counts.  ``starts`` overrides the cut points.
+
+        parts: materialize only these parts' array rows (multi-host:
+        each process builds its own parts, engines assemble the global
+        sharded arrays with jax.make_array_from_process_local_data)."""
         if pair_threshold is not None:
             vpad_align = max(vpad_align, 128)
             if starts is None and num_parts > 1:
@@ -262,42 +286,139 @@ class ShardedGraph:
                   (np.arange(g.nv, dtype=np.int64) - starts[v_part]))
         v_slot = v_slot.astype(np.int64)
 
-        src_slot = np.zeros((num_parts, epad), dtype=np.int32)
-        dst_local = np.full((num_parts, epad), vpad, dtype=np.int32)
+        local = None if parts is None else np.asarray(list(parts), np.int64)
+        rows = np.arange(num_parts) if local is None else local
+        R = len(rows)
+        src_slot = np.zeros((R, epad), dtype=np.int32)
+        dst_local = np.full((R, epad), vpad, dtype=np.int32)
         edge_weight = None
         if g.weights is not None:
-            edge_weight = np.zeros((num_parts, epad), dtype=np.float32)
-        row_ptr_local = np.zeros((num_parts, vpad + 1), dtype=np.int32)
-        vmask = np.zeros((num_parts, vpad), dtype=bool)
-        deg_padded = np.zeros((num_parts, vpad), dtype=np.int32)
+            edge_weight = np.zeros((R, epad), dtype=np.float32)
+        row_ptr_local = np.zeros((R, vpad + 1), dtype=np.int32)
+        vmask = np.zeros((R, vpad), dtype=bool)
+        deg_padded = np.zeros((R, vpad), dtype=np.int32)
 
-        ebegin = 0
-        for p in range(num_parts):
+        for r, p in enumerate(rows):
             v0, v1 = int(starts[p]), int(starts[p + 1])
             nep = int(ne_part[p])
+            ebegin = int(rp[v0 - 1]) if v0 else 0
             eend = ebegin + nep
             srcs = col[ebegin:eend].astype(np.int64)
-            src_slot[p, :nep] = v_slot[srcs]
+            src_slot[r, :nep] = v_slot[srcs]
             # local dst of each edge: expand per-vertex in-degree runs
             local_ends = (rp[v0:v1] - ebegin).astype(np.int64)
             in_deg = np.diff(np.concatenate(([0], local_ends)))
-            dst_local[p, :nep] = np.repeat(
+            dst_local[r, :nep] = np.repeat(
                 np.arange(v1 - v0, dtype=np.int32), in_deg)
             if edge_weight is not None:
-                edge_weight[p, :nep] = np.asarray(
+                edge_weight[r, :nep] = np.asarray(
                     g.weights[ebegin:eend], dtype=np.float32)
-            row_ptr_local[p, 1:v1 - v0 + 1] = local_ends
-            row_ptr_local[p, v1 - v0 + 1:] = nep
-            vmask[p, :v1 - v0] = True
-            deg_padded[p, :v1 - v0] = g.out_degrees[v0:v1]
-            ebegin = eend
+            row_ptr_local[r, 1:v1 - v0 + 1] = local_ends
+            row_ptr_local[r, v1 - v0 + 1:] = nep
+            vmask[r, :v1 - v0] = True
+            deg_padded[r, :v1 - v0] = g.out_degrees[v0:v1]
 
         return cls(nv=g.nv, ne=g.ne, num_parts=num_parts, starts=starts,
                    vpad=vpad, epad=epad, nv_part=nv_part, ne_part=ne_part,
                    src_slot=src_slot, dst_local=dst_local,
                    edge_weight=edge_weight, row_ptr_local=row_ptr_local,
                    vmask=vmask, deg_padded=deg_padded,
-                   weighted=g.weights is not None)
+                   weighted=g.weights is not None,
+                   local_parts=local,
+                   row_ptr_global=(g.row_ptrs if local is not None
+                                   else None),
+                   max_out_degree=int(g.out_degrees.max(initial=0)))
+
+    @classmethod
+    def build_from_file(cls, path: str, num_parts: int, parts=None,
+                        vpad_align: int = 8, epad_align: int = 128,
+                        starts: np.ndarray | None = None,
+                        weighted: bool | None = None,
+                        weight_dtype=np.int32) -> "ShardedGraph":
+        """Per-host sharded load: read only ``parts``' edge slices from
+        a .lux file through the native pthread-pread loader
+        (lux_tpu.native.load_partition; mmap fallback) — the TPU-native
+        analogue of the reference's per-partition CPU load tasks
+        (reference pull_model.inl:253-320) running one process per
+        node.  Only the (small) row_ptr/degree sections are read in
+        full, for globally-consistent partition cuts and paddings.
+
+        Typical multi-host use (same code on every host):
+
+            multihost.initialize()
+            mesh = multihost.global_mesh()
+            sg = ShardedGraph.build_from_file(
+                path, P, parts=multihost.process_parts(P))
+            eng = PullEngine(sg, program, mesh=mesh)
+        """
+        from lux_tpu import native
+
+        hdr = luxfmt.peek_lux(path, weighted, weight_dtype)
+        # row_ptrs + degrees: small sections, read whole (mmap)
+        _, row_ptrs, col_mm, w_mm, degrees = luxfmt.read_lux(
+            path, weighted, weight_dtype)
+        row_ptrs = np.asarray(row_ptrs)
+        if degrees is not None:
+            out_deg = np.asarray(degrees).astype(np.uint32)
+        elif native.available():
+            out_deg = native.count_degrees(path, hdr.nv, hdr.ne)
+        else:
+            out_deg = np.bincount(np.asarray(col_mm),
+                                  minlength=hdr.nv).astype(np.uint32)
+
+        if parts is None:
+            parts = range(num_parts)
+        parts = np.asarray(list(parts), np.int64)
+        if starts is None:
+            starts = edge_balanced_bounds(row_ptrs, num_parts)
+
+        use_native = native.available()
+
+        class _LazyCols:
+            """Graph.col_idx stand-in that serves per-part slices from
+            the native loader (falls back to the mmap view)."""
+
+            def __getitem__(self, sl):
+                lo, hi = sl.start or 0, sl.stop
+                if hi <= lo:
+                    return np.empty(0, np.uint32)
+                if not use_native:
+                    return np.asarray(col_mm[sl])
+                # vertex range covering this edge slice: parts are
+                # vertex-contiguous, so invert via searchsorted
+                v0 = int(np.searchsorted(row_ptrs, lo, side="right"))
+                v1 = min(hdr.nv, 1 + int(
+                    np.searchsorted(row_ptrs, hi, side="left")))
+                # weights are served from the mmap view; don't read
+                # (and immediately discard) the weight bytes here
+                _, cols, _w, e_lo = native.load_partition(
+                    path, hdr.nv, hdr.ne, v0, v1, weighted=False)
+                return cols[lo - e_lo:hi - e_lo]
+
+        weights = None
+        if hdr.has_weights:
+            weights = w_mm      # mmap: sliced lazily per part
+        g = Graph(nv=hdr.nv, ne=hdr.ne, row_ptrs=row_ptrs,
+                  col_idx=_LazyCols(), weights=weights,
+                  out_degrees=out_deg)
+        return cls.build(g, num_parts, vpad_align=vpad_align,
+                         epad_align=epad_align, starts=starts,
+                         parts=parts)
+
+    def sizing_row_ptr(self) -> np.ndarray:
+        """row_ptr_local for ALL parts — chunk geometry (ops/tiled.py)
+        must be identical on every process even when only local parts
+        are materialized."""
+        if self.local_parts is None:
+            return self.row_ptr_local
+        rp = np.asarray(self.row_ptr_global).astype(np.int64)
+        out = np.zeros((self.num_parts, self.vpad + 1), np.int64)
+        for p in range(self.num_parts):
+            v0, v1 = int(self.starts[p]), int(self.starts[p + 1])
+            ebegin = int(rp[v0 - 1]) if v0 else 0
+            out[p, 1:v1 - v0 + 1] = rp[v0:v1] - ebegin
+            out[p, v1 - v0 + 1:] = out[p, v1 - v0]
+        return out
 
     # ---- push-model (src-sorted) edge view ---------------------------
 
@@ -319,25 +440,26 @@ class ShardedGraph:
         """
         if self._src_sorted_cache is not None:
             return self._src_sorted_cache
-        P = self.num_parts
-        in_row_ptr = np.zeros((P, self.nv + 1), dtype=np.int64)
-        ss_dst = np.full((P, self.epad), self.vpad, dtype=np.int32)
-        ss_weight = (np.zeros((P, self.epad), dtype=np.float32)
+        ids = self.part_ids()
+        R = len(ids)
+        in_row_ptr = np.zeros((R, self.nv + 1), dtype=np.int64)
+        ss_dst = np.full((R, self.epad), self.vpad, dtype=np.int32)
+        ss_weight = (np.zeros((R, self.epad), dtype=np.float32)
                      if self.weighted else None)
-        for p in range(P):
+        for r, p in enumerate(ids):
             nep = int(self.ne_part[p])
             # global src of each real edge: src_slot is part-major slot;
             # invert the slot translation
-            slot = self.src_slot[p, :nep].astype(np.int64)
+            slot = self.src_slot[r, :nep].astype(np.int64)
             sp = slot // self.vpad
             src = self.starts[sp] + (slot - sp * self.vpad)
             order = np.argsort(src, kind="stable")
             src_sorted = src[order]
-            ss_dst[p, :nep] = self.dst_local[p, :nep][order]
+            ss_dst[r, :nep] = self.dst_local[r, :nep][order]
             if ss_weight is not None:
-                ss_weight[p, :nep] = self.edge_weight[p, :nep][order]
+                ss_weight[r, :nep] = self.edge_weight[r, :nep][order]
             counts = np.bincount(src_sorted, minlength=self.nv)
-            in_row_ptr[p] = np.concatenate(([0], np.cumsum(counts)))
+            in_row_ptr[r] = np.concatenate(([0], np.cumsum(counts)))
         self._src_sorted_cache = dict(in_row_ptr=in_row_ptr,
                                       ss_dst=ss_dst, ss_weight=ss_weight)
         return self._src_sorted_cache
@@ -345,17 +467,26 @@ class ShardedGraph:
     # ---- state layout conversion -------------------------------------
 
     def to_padded(self, x: np.ndarray) -> np.ndarray:
-        """[nv, ...] user order -> [num_parts, vpad, ...] padded layout."""
+        """[nv, ...] user order -> [rows, vpad, ...] padded layout
+        (rows = materialized parts; all of them on a full build)."""
         x = np.asarray(x)
-        out = np.zeros((self.num_parts, self.vpad) + x.shape[1:], x.dtype)
-        for p in range(self.num_parts):
+        ids = self.part_ids()
+        out = np.zeros((len(ids), self.vpad) + x.shape[1:], x.dtype)
+        for r, p in enumerate(ids):
             v0, v1 = int(self.starts[p]), int(self.starts[p + 1])
-            out[p, :v1 - v0] = x[v0:v1]
+            out[r, :v1 - v0] = x[v0:v1]
         return out
 
     def from_padded(self, x: np.ndarray) -> np.ndarray:
-        """[num_parts, vpad, ...] padded layout -> [nv, ...] user order."""
+        """[num_parts, vpad, ...] padded layout -> [nv, ...] user order.
+
+        Requires ALL parts' rows: on a multi-host run fetch the global
+        state first (parallel.multihost.fetch_global)."""
         x = np.asarray(x)
+        if x.shape[0] != self.num_parts:
+            raise ValueError(
+                f"from_padded needs all {self.num_parts} part rows, got "
+                f"{x.shape[0]} (multi-host: fetch_global the state first)")
         out = np.empty((self.nv,) + x.shape[2:], x.dtype)
         for p in range(self.num_parts):
             v0, v1 = int(self.starts[p]), int(self.starts[p + 1])

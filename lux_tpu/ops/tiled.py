@@ -62,38 +62,46 @@ class TiledLayout:
 
     @classmethod
     def build(cls, row_ptr_local: np.ndarray, dst_local: np.ndarray,
-              vpad: int, W: int = 128, E: int = 512) -> "TiledLayout":
+              vpad: int, W: int = 128, E: int = 512,
+              sizing_row_ptr: np.ndarray | None = None) -> "TiledLayout":
         """row_ptr_local: int [P, vpad+1] END offsets; dst_local:
-        int32 [P, epad] part-local sorted destinations (pad -> vpad)."""
+        int32 [P, epad] part-local sorted destinations (pad -> vpad).
+
+        sizing_row_ptr: row_ptr_local rows of ALL parts, when
+        ``row_ptr_local`` holds only a process's local parts — chunk
+        count and scan-necessity are program SHAPE/structure and must
+        be identical on every process of a multi-host run."""
         P = row_ptr_local.shape[0]
         n_tiles = max(1, _ceil_div(vpad, W))
 
-        per_part = []
-        for p in range(P):
-            rp = row_ptr_local[p].astype(np.int64)
+        def tile_chunks(rp_row):
+            rp = rp_row.astype(np.int64)
             tile_lo = rp[np.minimum(np.arange(n_tiles) * W, vpad)]
             tile_hi = rp[np.minimum((np.arange(n_tiles) + 1) * W, vpad)]
             n_ch = np.maximum(0, _ceil_div_arr(tile_hi - tile_lo, E))
-            per_part.append((tile_lo, tile_hi, n_ch))
+            return tile_lo, tile_hi, n_ch
+
+        per_part = [tile_chunks(row_ptr_local[p]) for p in range(P)]
+        sizing = (per_part if sizing_row_ptr is None else
+                  [tile_chunks(r) for r in sizing_row_ptr])
 
         # Pad the chunk count to the Pallas kernel's block granularity
         # (pad chunks are isolated identity segments, dropped by the
         # last-chunk gather).
-        C = max(1, int(max(int(x[2].sum()) for x in per_part)))
+        C = max(1, int(max(int(x[2].sum()) for x in sizing)))
         C = _ceil_div(C, 8) * 8
+        global_needs_scan = any(x[2].max(initial=0) > 1 for x in sizing)
 
         edge_gather = np.zeros((P, C, E), dtype=np.int64)
         rel_dst = np.full((P, C, E), W, dtype=np.int32)
         chunk_tile = np.full((P, C), n_tiles, dtype=np.int32)
         chunk_start = np.ones((P, C), dtype=bool)   # pad chunks isolated
         last_chunk = np.full((P, n_tiles), -1, dtype=np.int32)
-        needs_scan = False
+        needs_scan = global_needs_scan
 
         lanes = np.arange(E, dtype=np.int64)
         for p in range(P):
             tile_lo, tile_hi, n_ch = per_part[p]
-            if n_ch.max(initial=0) > 1:
-                needs_scan = True
             nc = int(n_ch.sum())
             if nc == 0:
                 continue

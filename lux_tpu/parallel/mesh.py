@@ -38,14 +38,47 @@ def replicated_spec(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
-def shard_over_parts(mesh: Mesh, tree):
-    """device_put every array in ``tree`` sharded on its leading (parts)
-    axis.  Leading dims must be divisible by the mesh size."""
+def local_part_rows(mesh: Mesh, num_parts: int) -> list[int]:
+    """The global leading-axis rows this PROCESS's devices hold under
+    the parts sharding (sorted).  Single-process: all rows."""
     sharding = parts_spec(mesh)
+    idx_map = sharding.addressable_devices_indices_map((num_parts,))
+    rows = set()
+    for idx in idx_map.values():
+        rows.update(range(*idx[0].indices(num_parts)))
+    return sorted(rows)
+
+
+def shard_over_parts(mesh: Mesh, tree, num_parts: int | None = None):
+    """Place every array in ``tree`` sharded on its leading (parts)
+    axis.  Leading dims must be divisible by the mesh size.
+
+    Multi-process (jax.distributed): ``num_parts`` gives the global
+    leading dim.  Arrays carrying all ``num_parts`` rows are split into
+    per-local-device shards; arrays carrying only this process's rows
+    (ShardedGraph built with ``parts=``) are assembled with
+    ``jax.make_array_from_process_local_data`` — the analogue of the
+    reference's per-node region instances that Legion stitches into one
+    logical region (reference push_model.inl:8-51).
+    """
+    sharding = parts_spec(mesh)
+    multiproc = jax.process_count() > 1
 
     def place(x):
         if x is None:
             return None
-        return jax.device_put(x, sharding)
+        if not multiproc:
+            return jax.device_put(x, sharding)
+        if num_parts is None or x.shape[0] == num_parts:
+            # full array present on every process: hand each local
+            # device its slice
+            idx_map = sharding.addressable_devices_indices_map(x.shape)
+            shards = [jax.device_put(np.asarray(x[idx]), d)
+                      for d, idx in idx_map.items()]
+            return jax.make_array_from_single_device_arrays(
+                x.shape, sharding, shards)
+        gshape = (num_parts,) + tuple(x.shape[1:])
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(x), gshape)
 
     return jax.tree.map(place, tree)

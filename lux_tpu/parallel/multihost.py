@@ -75,6 +75,20 @@ def global_mesh(n_devices: int | None = None):
     return make_mesh(n_devices or len(jax.devices()))
 
 
+def fetch_global(x):
+    """Device state -> host numpy with ALL shards, also the ones this
+    process cannot address (multi-host runs): gathers the remote
+    shards over the process group first.  Single-process: plain
+    device_get."""
+    import jax
+    import numpy as np
+
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(jax.device_get(x))
+
+
 def process_parts(num_parts: int) -> range:
     """The contiguous range of partition ids this host is responsible
     for loading (partition i lives on global device i * P / num_parts).

@@ -1,0 +1,79 @@
+"""Multi-process worker for tests/test_multiprocess.py.
+
+Run as a subprocess — one per simulated host — with a CPU platform and
+4 virtual devices (the env is set by the spawning test, BEFORE python
+starts, because jax reads JAX_PLATFORMS/XLA_FLAGS at import time).
+
+This is the reference's "same binary on every node" model (reference
+README.md:33-38, pagerank.cc:51-53): every process runs this exact
+file; jax.distributed glues the address spaces together the way
+GASNet/Realm did.
+"""
+
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    lux_path = sys.argv[4]
+
+    from lux_tpu.parallel import multihost
+    multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nproc, process_id=pid)
+
+    import jax
+    import numpy as np
+
+    assert jax.process_count() == nproc, jax.process_count()
+    ndev = len(jax.devices())
+    assert ndev == 4 * nproc, ndev
+
+    from lux_tpu.apps import pagerank, sssp
+    from lux_tpu.engine.pull import PullEngine
+    from lux_tpu.engine.push import PushEngine
+    from lux_tpu.graph import Graph, ShardedGraph
+
+    mesh = multihost.global_mesh()
+    P = ndev
+
+    g = Graph.from_file(lux_path)
+    want_pr = pagerank.reference_pagerank(g, 5)
+    want_ds = sssp.reference_sssp(g, 0)
+
+    # 1. pull engine, full host arrays on every process (all-gather +
+    #    fused fori_loop across the process group)
+    eng = pagerank.build_engine(g, num_parts=P, mesh=mesh)
+    state = eng.run(eng.init_state(), 5)
+    np.testing.assert_allclose(eng.unpad(state), want_pr, rtol=2e-5)
+
+    # 2. push engine to convergence (while_loop + psum halt + sparse
+    #    queue all-gather + pmin, all across the process group)
+    eng2 = sssp.build_engine(g, start_vertex=0, num_parts=P, mesh=mesh)
+    dist, _iters = eng2.run()
+    np.testing.assert_array_equal(dist.astype(np.int64), want_ds)
+
+    # 3. per-host loading: each process materializes ONLY its parts
+    #    from the .lux file (native.load_partition) and the engines
+    #    assemble the global sharded arrays from process-local data.
+    local = multihost.process_parts(P)
+    sg = ShardedGraph.build_from_file(lux_path, P, parts=local)
+    assert sg.local_parts is not None
+    assert sg.src_slot.shape[0] == len(local)
+
+    eng3 = PullEngine(sg, pagerank.make_program(), mesh=mesh)
+    s3 = eng3.run(eng3.init_state(), 5)
+    np.testing.assert_allclose(eng3.unpad(s3), want_pr, rtol=2e-5)
+
+    eng4 = PushEngine(sg, sssp.make_program(0), mesh=mesh)
+    label, active = eng4.init_state()
+    label, active, _it = eng4.converge(label, active)
+    np.testing.assert_array_equal(
+        eng4.unpad(label).astype(np.int64), want_ds)
+
+    print(f"MP_OK pid={pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
