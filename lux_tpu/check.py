@@ -22,6 +22,9 @@ class CheckResult:
     name: str
     violations: int
     checked: int
+    # device audits report per-partition counts, like the reference's
+    # per-part [PASS]/[FAIL] prints (reference sssp_gpu.cu:837-842)
+    per_part: tuple | None = None
 
     @property
     def ok(self) -> bool:
@@ -29,8 +32,12 @@ class CheckResult:
 
     def __str__(self):
         tag = "PASS" if self.ok else "FAIL"
-        return (f"[{tag}] {self.name}: {self.violations} violations "
-                f"over {self.checked} edges")
+        s = (f"[{tag}] {self.name}: {self.violations} violations "
+             f"over {self.checked} edges")
+        if self.per_part is not None and not self.ok:
+            failing = {p: c for p, c in enumerate(self.per_part) if c}
+            s += f" (by part: {failing})"
+        return s
 
 
 def check_sssp(g: Graph, dist: np.ndarray,

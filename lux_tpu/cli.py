@@ -146,13 +146,14 @@ def cmd_pagerank(argv):
         print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
 
     if args.check:
-        from lux_tpu import check
-        out = eng.unpad(state)
-        if perm is not None:            # back to input vertex ids
-            unperm = np.empty_like(out)
-            unperm[perm] = out
-            out = unperm
-        res = check.check_pagerank(g, out, tol=1e-3)
+        # On-device sharded audit over the resident edge arrays (the
+        # reference's per-part GPU check tasks, sssp_gpu.cu:800-843);
+        # runs at any scale, no host edge-list rebuild.  NOTE: audits
+        # the FULL sg built above, not eng.sg (pair-lane engines keep
+        # only the residual edges there).  The residual is
+        # permutation-invariant, so no -pair un-relabel is needed.
+        from lux_tpu.device_check import check_pagerank_device
+        res = check_pagerank_device(sg, state, tol=1e-3, mesh=eng.mesh)
         print(res)
         return 0 if res.ok else 1
     return 0
@@ -169,7 +170,6 @@ def _push_app(argv, prog_name):
                              "'auto'; default: off)")
     args = ap.parse_args(argv)
 
-    from lux_tpu import check
     from lux_tpu.apps import components, sssp
 
     weighted = prog_name == "sssp" and args.weighted
@@ -200,16 +200,17 @@ def _push_app(argv, prog_name):
     print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
 
     if args.check:
+        # On-device per-part audits (reference sssp_gpu.cu:800-843,
+        # components_gpu.cu:788); labels are in g_run order, which is
+        # exactly sg's order — the fixed-point properties are
+        # permutation-invariant, so no -pair un-relabel is needed.
+        from lux_tpu import device_check
         if prog_name == "sssp":
-            if perm is not None:        # back to input vertex ids
-                unperm = np.empty_like(labels)
-                unperm[perm] = labels
-                labels = unperm
-            res = check.check_sssp(g, labels, weighted=weighted)
+            res = device_check.check_sssp_device(
+                sg, labels, weighted=weighted, mesh=eng.mesh)
         else:
-            # CC labels live in the PROPAGATED id space; audit the
-            # fixed point there (on the relabeled graph when -pair)
-            res = check.check_components(g_run, labels)
+            res = device_check.check_components_device(
+                sg, labels, mesh=eng.mesh)
         print(res)
         return 0 if res.ok else 1
     return 0
@@ -243,8 +244,8 @@ def cmd_colfilter(argv):
     out = eng.unpad(state)
     print(f"RMSE = {colfilter.rmse(g, out):.6f}")
     if args.check:
-        from lux_tpu import check
-        res = check.check_colfilter(g, out)
+        from lux_tpu.device_check import check_colfilter_device
+        res = check_colfilter_device(sg, out, mesh=eng.mesh)
         print(res)
         return 0 if res.ok else 1
     return 0

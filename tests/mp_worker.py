@@ -72,6 +72,13 @@ def main():
     np.testing.assert_array_equal(
         eng4.unpad(label).astype(np.int64), want_ds)
 
+    # 4. on-device sharded audit over the engine's live global state
+    #    (the pod-scale -check path: per-host edge arrays, no host
+    #    edge-list rebuild)
+    from lux_tpu import device_check
+    res = device_check.check_sssp_device(sg, label, mesh=mesh)
+    assert res.ok and res.checked == sg.ne, res
+
     print(f"MP_OK pid={pid}", flush=True)
 
 
