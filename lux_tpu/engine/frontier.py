@@ -54,26 +54,35 @@ def compact_mask(mask, labels, capacity: int):
     return ids, vals, count
 
 
-def expand_frontier(ids, vals, in_row_ptr, edge_budget: int):
+def expand_frontier(ids, vals, src_ids, src_off, nv: int,
+                    edge_budget: int):
     """Map a gathered queue to its out-edge slots in this part.
 
-    ids   int32 [Q]  vertex GLOBAL ids (graph numbering), nv = invalid
-    vals  [Q]        the queue vertices' labels
-    in_row_ptr int   [nv+1] END offsets into this part's src-sorted
-                     edge arrays (ShardedGraph.src_sorted)
+    ids     int32 [Q]   vertex GLOBAL ids (graph numbering), nv=invalid
+    vals    [Q]         the queue vertices' labels
+    src_ids int32 [S]   this part's present-source ids, sorted, pad=nv
+    src_off int32 [S+1] END offsets into the part's src-sorted edge
+                        arrays (ShardedGraph.src_sorted — the
+                        compressed replacement for the reference's
+                        nv-wide row pointers, push_model.inl:321-324)
     Returns (edge_idx int32 [EB], src_val [EB], in_range bool [EB],
-             total int32) where edge_idx indexes the part's src-sorted
-    edge arrays, src_val is the owning queue item's label, and total is
-    the real number of frontier out-edges in this part (may exceed EB —
-    callers must then use the dense path; entries past ``total`` are
-    masked by in_range).
+             total int32, off int32 [Q]) where edge_idx indexes the
+    part's src-sorted edge arrays, src_val is the owning queue item's
+    label, off is the running END offset of each queue item's out-edge
+    extent (off[-1] == total), and total is the real number of
+    frontier out-edges here (may exceed EB — callers must then use the
+    dense path; entries past ``total`` are masked by in_range).
     """
-    nv = in_row_ptr.shape[0] - 1
     Q = ids.shape[0]
-    safe = jnp.minimum(ids, nv - 1)
-    begin = jnp.take(in_row_ptr, safe, axis=0)
-    end = jnp.take(in_row_ptr, safe + 1, axis=0)
-    deg = jnp.where(ids < nv, (end - begin).astype(jnp.int32), 0)
+    S = src_ids.shape[0]
+    # binary-search each queue id in the compressed source index
+    pos = jnp.searchsorted(src_ids, ids, side="left",
+                           method="scan_unrolled")
+    posc = jnp.minimum(pos, S - 1).astype(jnp.int32)
+    present = (jnp.take(src_ids, posc, axis=0) == ids) & (ids < nv)
+    begin = jnp.where(present, jnp.take(src_off, posc, axis=0), 0)
+    end = jnp.where(present, jnp.take(src_off, posc + 1, axis=0), 0)
+    deg = (end - begin).astype(jnp.int32)
     off = jnp.cumsum(deg)                       # END offsets per item
     total = off[-1]
     start = off - deg                           # begin offset per item
@@ -93,7 +102,7 @@ def expand_frontier(ids, vals, in_row_ptr, edge_budget: int):
     edge_idx = (jnp.take(begin, owner, axis=0) + within).astype(jnp.int32)
     edge_idx = jnp.where(in_range, edge_idx, 0)
     src_val = jnp.take(vals, owner, axis=0)
-    return edge_idx, src_val, in_range, total
+    return edge_idx, src_val, in_range, total, off
 
 
 def scatter_reduce(labels, dst_local, cand, kind: str):

@@ -138,7 +138,15 @@ class PushEngine:
                 arrays["pair_weight"] = dev(self.pairs.weight)
         self.enable_sparse = enable_sparse
         if enable_sparse:
-            ss = sg.src_sorted()
+            # The compressed source index's pad size is a compiled
+            # SHAPE: on multi-host runs agree on the max across every
+            # process's parts.
+            s_pad = sg.src_unique_max()
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                s_pad = int(np.max(multihost_utils.process_allgather(
+                    np.asarray([s_pad]))))
+            ss = sg.src_sorted(s_pad=s_pad)
             # Reference queue sizing rule (push_model.inl:393-397).
             self.queue_cap = frontier_capacity(sg.vpad, sparse_threshold)
             # The edge budget must cover any single vertex's out-edges
@@ -150,14 +158,13 @@ class PushEngine:
             if sg.local_parts is not None:
                 max_deg = int(sg.max_out_degree) or 1
             else:
-                max_deg = int(np.max(np.diff(ss["in_row_ptr"], axis=1))) \
-                    if sg.ne else 1
+                max_deg = sg.max_in_deg() or 1
             default_eb = max(1024, sg.epad // sparse_threshold)
             self.edge_budget = int(edge_budget if edge_budget is not None
                                    else max(default_eb, max_deg + 128))
             arrays = dict(arrays,
-                          in_row_ptr=dev(
-                              ss["in_row_ptr"].astype(np.int32)),
+                          src_ids=dev(ss["src_ids"]),
+                          src_off=dev(ss["src_off"]),
                           ss_dst=dev(ss["ss_dst"]),
                           part_start=dev(
                               sg.starts[sg.part_ids()].astype(
@@ -270,10 +277,10 @@ class PushEngine:
         all_vals = gather_fn(vals).reshape(-1)
 
         # 3. each part relaxes the gathered frontier's edges that land
-        #    in its partition, through its src-sorted CSR view.
-        def relax_part(lab, rowp, ssd, ssw):
-            edge_idx, src_val, in_range, _total = fr.expand_frontier(
-                all_gids, all_vals, rowp, EB)
+        #    in its partition, through its compressed src-sorted view.
+        def relax_part(lab, sids, soff, ssd, ssw):
+            edge_idx, src_val, in_range, _total, off = fr.expand_frontier(
+                all_gids, all_vals, sids, soff, nv, EB)
             dst = jnp.take(ssd, edge_idx, axis=0)
             w = jnp.take(ssw, edge_idx, axis=0) if ssw is not None \
                 else None
@@ -284,11 +291,6 @@ class PushEngine:
             new = fr.scatter_reduce(lab, dst, cand, prog.reduce)
             improved = prog.better(new, lab)
             # number of fully-expanded queue items (flat prefix)
-            safe = jnp.minimum(all_gids, nv - 1)
-            deg = jnp.where(all_gids < nv,
-                            (jnp.take(rowp, safe + 1, axis=0) -
-                             jnp.take(rowp, safe, axis=0)), 0)
-            off = jnp.cumsum(deg)
             done = jnp.searchsorted(off, jnp.asarray(EB, off.dtype),
                                     side="right",
                                     method="scan_unrolled")
@@ -297,11 +299,12 @@ class PushEngine:
         ssw = g.get("ss_weight")
         if ssw is None:
             new_label, improved, done = jax.vmap(
-                lambda lab, rowp, ssd: relax_part(lab, rowp, ssd, None))(
-                label, g["in_row_ptr"], g["ss_dst"])
+                lambda lab, sids, soff, ssd: relax_part(
+                    lab, sids, soff, ssd, None))(
+                label, g["src_ids"], g["src_off"], g["ss_dst"])
         else:
             new_label, improved, done = jax.vmap(relax_part)(
-                label, g["in_row_ptr"], g["ss_dst"], ssw)
+                label, g["src_ids"], g["src_off"], g["ss_dst"], ssw)
         improved = improved & g["vmask"]
 
         # 4. clear the globally-agreed processed prefix of the queue;

@@ -425,44 +425,87 @@ class ShardedGraph:
     _src_sorted_cache: tuple | None = dataclasses.field(
         default=None, repr=False, compare=False)
 
-    def src_sorted(self):
+    def _src_sorted_raw(self):
+        """Per-part src-sort + unique-source compression (host, once)."""
+        if self._src_sorted_cache is None:
+            ids_l, off_l, dst_l, w_l = [], [], [], []
+            max_deg = 0
+            for r, p in enumerate(self.part_ids()):
+                nep = int(self.ne_part[p])
+                # global src of each real edge: src_slot is part-major
+                # slot; invert the slot translation
+                slot = self.src_slot[r, :nep].astype(np.int64)
+                sp = slot // self.vpad
+                src = self.starts[sp] + (slot - sp * self.vpad)
+                order = np.argsort(src, kind="stable")
+                uniq, counts = np.unique(src[order], return_counts=True)
+                if counts.size:
+                    max_deg = max(max_deg, int(counts.max()))
+                ids_l.append(uniq.astype(np.int32))
+                off_l.append(np.concatenate(
+                    ([0], np.cumsum(counts))).astype(np.int32))
+                dst_l.append(self.dst_local[r, :nep][order])
+                w_l.append(self.edge_weight[r, :nep][order]
+                           if self.weighted else None)
+            self._src_sorted_cache = (ids_l, off_l, dst_l, w_l, max_deg)
+        return self._src_sorted_cache
+
+    def src_unique_max(self) -> int:
+        """Max unique-source count over the materialized parts (the
+        compressed source index's natural pad size)."""
+        return max((len(u) for u in self._src_sorted_raw()[0]),
+                   default=1) or 1
+
+    def max_in_deg(self) -> int:
+        """Max edges of one source within a part (cheap: reads the
+        cached raw src-sort, no padded-array rebuild)."""
+        return self._src_sorted_raw()[4]
+
+    def src_sorted(self, s_pad: int | None = None):
         """Per-part edges re-sorted by GLOBAL source id — the dual CSR
         view the reference's push init builds on device with atomic
-        degree counting (reference sssp_gpu.cu:550-607, and the
-        nv-wide per-part row pointers of push_model.inl:321-324).
-        Here it is host-side preprocessing, done once and cached.
+        degree counting (reference sssp_gpu.cu:550-607) — with a
+        COMPRESSED source index: only sources with >=1 edge in the
+        part are stored (sorted ids + END offsets), binary-searched at
+        frontier-expansion time (engine/frontier.expand_frontier).
+        This replaces the reference's nv-wide per-part row pointers
+        (reference push_model.inl:321-324) — O(nv) rows per part,
+        ~1.1 GB/part int64 at RMAT27 — with O(present sources) rows.
+
+        s_pad pads the source-index dim; multi-host runs must pass a
+        process-independent value >= every part's unique-source count
+        (PushEngine all-gathers the max).  Default: the local max.
 
         Returns dict of numpy arrays:
-          in_row_ptr  int64 [num_parts, nv+1]  END offsets into the
-                      part's src-sorted edge list, indexed by global src
-          ss_dst      int32 [num_parts, epad]  part-local dst, pad->vpad
-          ss_weight   float32 [num_parts, epad] or None
+          src_ids   int32 [R, S]    present-source GLOBAL ids, pad=nv
+          src_off   int32 [R, S+1]  END offsets into the part's
+                                    src-sorted edge list (pad repeats)
+          ss_dst    int32 [R, epad] part-local dst, pad->vpad
+          ss_weight float32 [R, epad] or None
+          max_in_deg int            max edges of one source in a part
         """
-        if self._src_sorted_cache is not None:
-            return self._src_sorted_cache
-        ids = self.part_ids()
-        R = len(ids)
-        in_row_ptr = np.zeros((R, self.nv + 1), dtype=np.int64)
+        ids_l, off_l, dst_l, w_l, max_deg = self._src_sorted_raw()
+        R = len(ids_l)
+        need = max((len(u) for u in ids_l), default=0)
+        S = max(1, need if s_pad is None else int(s_pad))
+        if S < need:
+            raise ValueError(f"s_pad={s_pad} < max unique sources {need}")
+        src_ids = np.full((R, S), self.nv, dtype=np.int32)
+        src_off = np.zeros((R, S + 1), dtype=np.int32)
         ss_dst = np.full((R, self.epad), self.vpad, dtype=np.int32)
         ss_weight = (np.zeros((R, self.epad), dtype=np.float32)
                      if self.weighted else None)
-        for r, p in enumerate(ids):
-            nep = int(self.ne_part[p])
-            # global src of each real edge: src_slot is part-major slot;
-            # invert the slot translation
-            slot = self.src_slot[r, :nep].astype(np.int64)
-            sp = slot // self.vpad
-            src = self.starts[sp] + (slot - sp * self.vpad)
-            order = np.argsort(src, kind="stable")
-            src_sorted = src[order]
-            ss_dst[r, :nep] = self.dst_local[r, :nep][order]
+        for r in range(R):
+            u, off = ids_l[r], off_l[r]
+            src_ids[r, :len(u)] = u
+            src_off[r, :len(u) + 1] = off
+            src_off[r, len(u) + 1:] = off[-1]
+            nep = len(dst_l[r])
+            ss_dst[r, :nep] = dst_l[r]
             if ss_weight is not None:
-                ss_weight[r, :nep] = self.edge_weight[r, :nep][order]
-            counts = np.bincount(src_sorted, minlength=self.nv)
-            in_row_ptr[r] = np.concatenate(([0], np.cumsum(counts)))
-        self._src_sorted_cache = dict(in_row_ptr=in_row_ptr,
-                                      ss_dst=ss_dst, ss_weight=ss_weight)
-        return self._src_sorted_cache
+                ss_weight[r, :nep] = w_l[r]
+        return dict(src_ids=src_ids, src_off=src_off, ss_dst=ss_dst,
+                    ss_weight=ss_weight, max_in_deg=max_deg)
 
     # ---- state layout conversion -------------------------------------
 

@@ -32,14 +32,26 @@ def test_compact_mask_truncates():
     assert ids.tolist() == [0, 1, 2]              # queue truncated
 
 
+def _compress(row_ptr):
+    """nv-wide END-offset row pointers -> (src_ids, src_off) compressed
+    index, for readable test construction."""
+    rp = np.asarray(row_ptr, np.int64)
+    deg = np.diff(rp)
+    present = np.nonzero(deg > 0)[0]
+    off = np.concatenate(([0], np.cumsum(deg[present])))
+    return (jnp.asarray(present.astype(np.int32)),
+            jnp.asarray(off.astype(np.int32)))
+
+
 def test_expand_frontier_owners():
     # vertices 0..3 with out-degrees 2, 0, 3, 1
-    row_ptr = jnp.asarray(np.array([0, 2, 2, 5, 6], np.int32))
+    sids, soff = _compress([0, 2, 2, 5, 6])
     ids = jnp.asarray(np.array([2, 0, 4, 4], np.int32))   # nv=4 invalid
     vals = jnp.asarray(np.array([7, 9, 0, 0], np.int32))
-    edge_idx, src_val, in_range, total = fr.expand_frontier(
-        ids, vals, row_ptr, edge_budget=8)
+    edge_idx, src_val, in_range, total, off = fr.expand_frontier(
+        ids, vals, sids, soff, nv=4, edge_budget=8)
     assert int(total) == 5                        # deg(2) + deg(0)
+    assert np.asarray(off).tolist() == [3, 5, 5, 5]
     ok = np.asarray(in_range)
     assert ok.tolist() == [True] * 5 + [False] * 3
     # first item (vertex 2) owns edges 2,3,4; second (vertex 0) 0,1
@@ -47,25 +59,39 @@ def test_expand_frontier_owners():
     assert np.asarray(src_val)[:5].tolist() == [7, 7, 7, 9, 9]
 
 
+def test_expand_frontier_absent_source():
+    # queue ids not present in this part's compressed index (zero
+    # out-edges here) must expand to nothing
+    sids, soff = _compress([0, 2, 2, 5, 6])       # vertex 1 absent
+    ids = jnp.asarray(np.array([1, 3, 4, 4], np.int32))
+    vals = jnp.asarray(np.array([5, 8, 0, 0], np.int32))
+    edge_idx, src_val, in_range, total, off = fr.expand_frontier(
+        ids, vals, sids, soff, nv=4, edge_budget=8)
+    assert np.asarray(off).tolist() == [0, 1, 1, 1]
+    assert int(total) == 1
+    assert np.asarray(edge_idx)[:1].tolist() == [5]
+    assert np.asarray(src_val)[:1].tolist() == [8]
+
+
 def test_expand_frontier_gap_before_first_item():
     # invalid slots before the only real item (the flat multi-part
     # queue shape) must not confuse ownership
-    row_ptr = jnp.asarray(np.array([0, 1, 3, 3], np.int32))  # nv=3
+    sids, soff = _compress([0, 1, 3, 3])          # nv=3
     ids = jnp.asarray(np.array([3, 3, 1, 3], np.int32))
     vals = jnp.asarray(np.array([0, 0, 5, 0], np.int32))
-    edge_idx, src_val, in_range, total = fr.expand_frontier(
-        ids, vals, row_ptr, edge_budget=4)
+    edge_idx, src_val, in_range, total, _off = fr.expand_frontier(
+        ids, vals, sids, soff, nv=3, edge_budget=4)
     assert int(total) == 2
     assert np.asarray(edge_idx)[:2].tolist() == [1, 2]
     assert np.asarray(src_val)[:2].tolist() == [5, 5]
 
 
 def test_expand_frontier_budget_truncation():
-    row_ptr = jnp.asarray(np.array([0, 3, 6], np.int32))  # nv=2, deg 3+3
+    sids, soff = _compress([0, 3, 6])             # nv=2, deg 3+3
     ids = jnp.asarray(np.array([0, 1], np.int32))
     vals = jnp.asarray(np.array([1, 2], np.int32))
-    edge_idx, src_val, in_range, total = fr.expand_frontier(
-        ids, vals, row_ptr, edge_budget=4)
+    edge_idx, src_val, in_range, total, _off = fr.expand_frontier(
+        ids, vals, sids, soff, nv=2, edge_budget=4)
     assert int(total) == 6                        # exceeds budget
     assert np.asarray(in_range).tolist() == [True] * 4
     assert np.asarray(edge_idx).tolist() == [0, 1, 2, 3]
@@ -88,8 +114,7 @@ def test_sssp_tiny_edge_budget_still_converges(num_parts):
     eng = sssp.build_engine(g, start_vertex=0, num_parts=num_parts)
     # rebuild with a crippled budget (still >= max single in-part degree)
     from lux_tpu.engine.push import PushEngine
-    ss = eng.sg.src_sorted()
-    max_deg = int(np.max(np.diff(ss["in_row_ptr"], axis=1)))
+    max_deg = eng.sg.max_in_deg()
     eng2 = PushEngine(eng.sg, eng.program, edge_budget=max_deg + 2)
     dist, iters = eng2.run(max_iters=500)
     ref = sssp.reference_sssp(g, 0)
