@@ -49,6 +49,13 @@ def _common(ap: argparse.ArgumentParser):
                          "(degree-relabels the graph internally; "
                          "results are mapped back to input ids; "
                          "ignored by colfilter)")
+    ap.add_argument("-phases", type=int, default=0, metavar="N",
+                    help="after the timed run, run N instrumented "
+                         "iterations and print the per-iteration "
+                         "phase split (gather/reduce/exchange/apply; "
+                         "separate fenced programs — read relative "
+                         "weights, not GTEPS; iter 0 includes "
+                         "compilation)")
 
 
 def _load(args, weighted: bool):
@@ -77,6 +84,17 @@ def _mesh_and_parts(args):
               f"(must divide the {args.mesh}-device mesh)")
         num_parts = rounded
     return mesh, num_parts
+
+
+def _print_phases(report):
+    """Per-iteration phase table — the analogue of the reference's
+    -verbose per-iteration loadTime/compTime/updateTime prints
+    (reference sssp_gpu.cu:513-518)."""
+    for i, t in enumerate(report):
+        extra = (f" frontier={t['frontier']}" if "frontier" in t else "")
+        split = "  ".join(f"{k}={v * 1e3:7.2f}ms" for k, v in t.items()
+                          if k != "frontier")
+        print(f"iter {i}:{extra}  {split}")
 
 
 def _relabel_for_pairs(args, g, num_parts):
@@ -145,6 +163,9 @@ def cmd_pagerank(argv):
         print(f"ELAPSED TIME = {elapsed:.7f} s")
         print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
 
+    if args.phases:
+        _state, rep = eng.timed_phases(eng.init_state(), args.phases)
+        _print_phases(rep)
     if args.check:
         # On-device sharded audit over the resident edge arrays (the
         # reference's per-part GPU check tasks, sssp_gpu.cu:800-843);
@@ -199,6 +220,14 @@ def _push_app(argv, prog_name):
     print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
     print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
 
+    if args.phases:
+        if eng.delta is not None:
+            print("note: -phases instruments plain frontier "
+                  "relaxation; the timed converge path above ran "
+                  "delta-stepping")
+        lab0, act0 = eng.init_state()
+        _l, _a, rep = eng.timed_phases(lab0, act0, args.phases)
+        _print_phases(rep)
     if args.check:
         # On-device per-part audits (reference sssp_gpu.cu:800-843,
         # components_gpu.cu:788); labels are in g_run order, which is
@@ -243,6 +272,9 @@ def cmd_colfilter(argv):
     print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
     out = eng.unpad(state)
     print(f"RMSE = {colfilter.rmse(g, out):.6f}")
+    if args.phases:
+        print("note: -phases is unavailable for the colfilter dot-path "
+              "engine (fused MXU phases); use -profile for a trace")
     if args.check:
         from lux_tpu.device_check import check_colfilter_device
         res = check_colfilter_device(sg, out, mesh=eng.mesh)

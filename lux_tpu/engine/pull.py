@@ -199,8 +199,8 @@ class PullEngine:
                                   (1,) * (new.ndim - 1))
         return jnp.where(keep, new, old_p)
 
-    def _part_step(self, flat_state, old_p, g):
-        """g: dict of this part's graph arrays."""
+    def _part_msgs(self, flat_state, old_p, g):
+        """Phase 1 (gather): per-edge source gather + message values."""
         prog, sg, lay = self.program, self.sg, self.tiles
         src_vals = jnp.take(flat_state, g["src_slot"], axis=0)
         if prog.needs_dst:
@@ -214,17 +214,24 @@ class PullEngine:
         else:
             dst_vals = None
         msgs = prog.edge_value(src_vals, dst_vals, g.get("weight"))
+        if lay is not None and (self.reduce_method == "xla"
+                                or msgs.ndim != 2):
+            # Keep the (serial, expensive) gather from being fused
+            # into the W-wide broadcast consumer, which re-executes
+            # it per output lane — measured 3-5x slower on v5e.
+            # The Pallas kernel is an opaque boundary and needs no
+            # barrier.
+            msgs = jax.lax.optimization_barrier(msgs)
+        return msgs
+
+    def _part_reduce(self, flat_state, msgs, g):
+        """Phase 2 (reduce): scatter-free segment reduction (+ the
+        pair-lane delivery, which fetches and reduces in one go)."""
+        prog, sg, lay = self.program, self.sg, self.tiles
         if lay is None:
             red = segment_reduce(msgs, g["dst_local"], sg.vpad + 1,
                                  prog.reduce)[:sg.vpad]
         else:
-            if self.reduce_method == "xla" or msgs.ndim != 2:
-                # Keep the (serial, expensive) gather from being fused
-                # into the W-wide broadcast consumer, which re-executes
-                # it per output lane — measured 3-5x slower on v5e.
-                # The Pallas kernel is an opaque boundary and needs no
-                # barrier.
-                msgs = jax.lax.optimization_barrier(msgs)
             red = tiled_segment_reduce(
                 msgs, lay, g["chunk_start"], g["last_chunk"],
                 g["rel_dst"], sg.vpad, prog.reduce, use_mxu=self.use_mxu,
@@ -235,6 +242,12 @@ class PullEngine:
         if self.pairs is not None:
             pred = self._pair_red(flat_state, g)
             red = combine_op(prog.reduce)(red, pred)
+        return red
+
+    def _part_step(self, flat_state, old_p, g):
+        """g: dict of this part's graph arrays."""
+        msgs = self._part_msgs(flat_state, old_p, g)
+        red = self._part_reduce(flat_state, msgs, g)
         return self._apply_epilogue(old_p, red, g)
 
     def _part_step_dot(self, flat_state, old_p, g):
@@ -413,6 +426,83 @@ class PullEngine:
         Multi-host runs gather remote shards over the process group."""
         from lux_tpu.parallel.multihost import fetch_global
         return self.sg.from_padded(fetch_global(state))
+
+    # -- per-iteration phase observability ----------------------------
+
+    @functools.cached_property
+    def _phase_jits(self):
+        """One compiled program per phase (exchange / gather / reduce /
+        apply), each returning (output, scalar checksum) — the scalar
+        fetch is the tunnel-safe completion fence.  Separate
+        executables deliberately prevent cross-phase fusion, so the
+        split is honest at the cost of materializing phase outputs."""
+        from lux_tpu.engine.phased import cksum, mesh_wrap
+
+        if self.program.edge_value_from_dot is not None:
+            raise NotImplementedError(
+                "phase timing is not available for dot-path programs")
+        keys = self._graph_keys
+        sg = self.sg
+
+        def exchange(state, *gargs):
+            full = state
+            if self.mesh is not None:
+                full = jax.lax.all_gather(state, PARTS_AXIS, tiled=True)
+            flat = full.reshape((sg.num_parts * sg.vpad,) +
+                                full.shape[2:])
+            return flat, cksum(flat)
+
+        def gather(flat, state, *gargs):
+            g = dict(zip(keys, gargs))
+            msgs = jax.vmap(
+                lambda old, gp: self._part_msgs(flat, old, gp))(state, g)
+            return msgs, cksum(msgs)
+
+        def reduce(flat, msgs, *gargs):
+            g = dict(zip(keys, gargs))
+            red = jax.vmap(
+                lambda m, gp: self._part_reduce(flat, m, gp))(msgs, g)
+            return red, cksum(red)
+
+        def apply(state, red, *gargs):
+            g = dict(zip(keys, gargs))
+            new = jax.vmap(self._apply_epilogue)(state, red, g)
+            return new, cksum(new)
+
+        fns = dict(exchange=exchange, gather=gather, reduce=reduce,
+                   apply=apply)
+        if self.mesh is not None:
+            P = PartitionSpec
+            S, R = P(PARTS_AXIS), P()
+            wrap = mesh_wrap(self.mesh, len(keys), S, R)
+            fns = dict(exchange=wrap(exchange, (S,), R),
+                       gather=wrap(gather, (R, S), S),
+                       reduce=wrap(reduce, (R, S), S),
+                       apply=wrap(apply, (S, S), S))
+        return {k: jax.jit(f) for k, f in fns.items()}
+
+    def timed_phases(self, state, iters: int = 1):
+        """Instrumented stepwise iterations -> (state, [{phase: s}]).
+
+        The analogue of the reference's per-iteration per-part
+        loadTime/compTime/updateTime -verbose prints (reference
+        sssp_gpu.cu:513-518).  Phases run as SEPARATE fenced programs
+        (engine/phased.py), so absolute times carry dispatch overhead
+        the fused run does not; read them for relative weight, not for
+        GTEPS."""
+        from lux_tpu.engine.phased import PhaseTimer
+        from lux_tpu.timing import fetch
+        jits = self._phase_jits
+        gargs = self.graph_args
+        report = []
+        for _ in range(iters):
+            pt = PhaseTimer(fetch)
+            flat = pt("exchange", jits["exchange"], state, *gargs)
+            msgs = pt("gather", jits["gather"], flat, state, *gargs)
+            red = pt("reduce", jits["reduce"], flat, msgs, *gargs)
+            state = pt("apply", jits["apply"], state, red, *gargs)
+            report.append(pt.t)
+        return state, report
 
 
 def _check_local_parts(sg, mesh, pair_threshold):

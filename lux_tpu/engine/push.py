@@ -40,6 +40,7 @@ The TPU-native design:
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 from typing import Any, Callable
 
@@ -194,59 +195,78 @@ class PushEngine:
 
     # -- dense iteration over this device's parts ----------------------
 
-    def _dense_parts(self, label, active, full_label, full_active, g):
+    def _dense_flat(self, full_label, full_active):
+        """Phase 1 (exchange): mask inactive sources to the identity
+        BEFORE the per-edge gather — one gather instead of two (the
+        gather is ~90% of a dense iteration, PERF_NOTES.md), with
+        identical semantics: relax(identity) stays absorbing for
+        min/max programs."""
+        ident_l = jnp.asarray(self.program.identity, full_label.dtype)
+        return jnp.where(full_active, full_label, ident_l).reshape(-1)
+
+    def _dense_cand(self, flat_l, g):
+        """Phase 2 (relax): per-edge source gather + candidates."""
+        prog = self.program
+        ident_l = jnp.asarray(prog.identity, flat_l.dtype)
+        src_l = jnp.take(flat_l, g["src_slot"], axis=0)
+        cand = prog.relax(src_l, g.get("weight"))
+        ident = jnp.asarray(prog.identity, cand.dtype)
+        cand = jnp.where(src_l == ident_l, ident, cand)
+        return jax.lax.optimization_barrier(cand)
+
+    def _dense_red(self, flat_l, cand, g):
+        """Phase 3 (reduce): scatter-free segment reduction (+ the
+        pair-lane delivery, which fetches and reduces in one go)."""
         sg, prog, lay = self.sg, self.program, self.tiles
-        # Mask inactive sources to the identity BEFORE the per-edge
-        # gather: one gather instead of two (the gather is ~90% of a
-        # dense iteration, PERF_NOTES.md), with identical semantics —
-        # relax(identity) stays absorbing for min/max programs.
-        ident_l = jnp.asarray(prog.identity, full_label.dtype)
-        flat_l = jnp.where(full_active, full_label, ident_l).reshape(-1)
+        ident_l = jnp.asarray(prog.identity, flat_l.dtype)
+        if lay is None:
+            red = segment_reduce(cand, g["dst_local"], sg.vpad + 1,
+                                 prog.reduce)[:sg.vpad]
+        else:
+            red = tiled_segment_reduce(
+                cand, lay, g["chunk_start"], g["last_chunk"],
+                g["rel_dst"], sg.vpad, prog.reduce,
+                method=("pallas"
+                        if self.reduce_method.startswith("pallas")
+                        else "xla"),
+                interpret=self.reduce_method == "pallas-interpret")
+        if self.pairs is not None:
+            from lux_tpu.ops.pairs import pair_partial
+            from lux_tpu.ops.tiled import combine_op
+
+            def msg(vals, w):
+                c = prog.relax(vals, w)
+                return jnp.where(vals == ident_l,
+                                 jnp.asarray(prog.identity, c.dtype), c)
+
+            pred = pair_partial(
+                self.pairs, flat_l, g["pair_rowbind"],
+                g["pair_rel"], g.get("pair_weight"),
+                g["pair_tile_pos"], prog.reduce, msg,
+                reduce_method=self.reduce_method)[:sg.vpad]
+            red = combine_op(prog.reduce)(red, pred)
+        return red
+
+    def _dense_update(self, old, red, g):
+        """Phase 4 (update): keep improvements, flag the new frontier."""
+        improved = self.program.better(red, old) & g["vmask"]
+        return jnp.where(improved, red, old), improved
+
+    _DENSE_KEYS = ("src_slot", "dst_local", "weight", "rel_dst",
+                   "chunk_start", "last_chunk", "chunk_tile", "vmask",
+                   "deg", "pair_rowbind", "pair_rel", "pair_weight",
+                   "pair_tile_pos")
+
+    def _dense_parts(self, label, active, full_label, full_active, g):
+        flat_l = self._dense_flat(full_label, full_active)
 
         def one(old, g):
-            src_l = jnp.take(flat_l, g["src_slot"], axis=0)
-            cand = prog.relax(src_l, g.get("weight"))
-            ident = jnp.asarray(prog.identity, cand.dtype)
-            cand = jnp.where(src_l == ident_l, ident, cand)
-            cand = jax.lax.optimization_barrier(cand)
-            if lay is None:
-                red = segment_reduce(cand, g["dst_local"], sg.vpad + 1,
-                                     prog.reduce)[:sg.vpad]
-            else:
-                red = tiled_segment_reduce(
-                    cand, lay, g["chunk_start"], g["last_chunk"],
-                    g["rel_dst"], sg.vpad, prog.reduce,
-                    method=("pallas"
-                            if self.reduce_method.startswith("pallas")
-                            else "xla"),
-                    interpret=self.reduce_method == "pallas-interpret")
-            if self.pairs is not None:
-                from lux_tpu.ops.pairs import pair_partial
-                from lux_tpu.ops.tiled import combine_op
+            cand = self._dense_cand(flat_l, g)
+            red = self._dense_red(flat_l, cand, g)
+            return self._dense_update(old, red, g)
 
-                def msg(vals, w):
-                    c = prog.relax(vals, w)
-                    return jnp.where(vals == ident_l,
-                                     jnp.asarray(prog.identity, c.dtype),
-                                     c)
-
-                pred = pair_partial(
-                    self.pairs, flat_l, g["pair_rowbind"],
-                    g["pair_rel"], g.get("pair_weight"),
-                    g["pair_tile_pos"], prog.reduce, msg,
-                    reduce_method=self.reduce_method)[:sg.vpad]
-                red = combine_op(prog.reduce)(red, pred)
-            improved = prog.better(red, old) & g["vmask"]
-            new = jnp.where(improved, red, old)
-            return new, improved
-
-        dense_keys = [k for k in ("src_slot", "dst_local", "weight",
-                                  "rel_dst", "chunk_start", "last_chunk",
-                                  "chunk_tile", "vmask", "deg",
-                                  "pair_rowbind", "pair_rel",
-                                  "pair_weight", "pair_tile_pos")
-                      if k in g]
-        return jax.vmap(one)(label, {k: g[k] for k in dense_keys})
+        g = {k: g[k] for k in self._DENSE_KEYS if k in g}
+        return jax.vmap(one)(label, g)
 
     # -- sparse iteration ----------------------------------------------
 
@@ -344,7 +364,7 @@ class PushEngine:
         graph_args = tuple(self.arrays[k] for k in keys)
         on_mesh = self.mesh is not None
         sg, prog = self.sg, self.program
-        use_sparse = self.enable_sparse and prog.reduce in ("min", "max")
+        use_sparse, sparse_limit = self._sparse_mode()
 
         def global_sum(x):
             s = jnp.sum(x)
@@ -374,10 +394,8 @@ class PushEngine:
             if not use_sparse:
                 return dense_body(label, active, g)
             # Reference heuristic: frontier > nv/16 -> dense/pull mode
-            # (sssp_gpu.cu:414), and the queue must fit.
-            q_fits = count <= jnp.int32(
-                min(self.queue_cap,
-                    max(1, sg.nv // self.sparse_threshold)))
+            # (sssp_gpu.cu:414), and the queue must fit (_sparse_mode).
+            q_fits = count <= jnp.int32(sparse_limit)
             return jax.lax.cond(
                 q_fits,
                 lambda: self._sparse_parts(label, active, g, gather_fn,
@@ -524,3 +542,117 @@ class PushEngine:
     def unpad(self, state) -> np.ndarray:
         from lux_tpu.parallel.multihost import fetch_global
         return self.sg.from_padded(fetch_global(state))
+
+    # -- per-iteration phase observability ----------------------------
+
+    @functools.cached_property
+    def _phase_jits(self):
+        """Per-phase compiled programs for DENSE iterations (exchange /
+        relax / reduce / update), each returning (output, scalar fence)
+        — see PullEngine._phase_jits.  Sparse iterations are timed as
+        one program (their latency is queue-sized, not phase-bound)."""
+        from lux_tpu.engine.phased import cksum, mesh_wrap
+
+        keys = sorted(self.arrays)
+        sg = self.sg
+        dkeys = [k for k in self._DENSE_KEYS if k in self.arrays]
+
+        def gdict(gargs):
+            g = dict(zip(keys, gargs))
+            return {k: g[k] for k in dkeys}
+
+        def exchange(label, active, *gargs):
+            full_l, full_a = label, active
+            if self.mesh is not None:
+                full_l = jax.lax.all_gather(label, PARTS_AXIS, tiled=True)
+                full_a = jax.lax.all_gather(active, PARTS_AXIS,
+                                            tiled=True)
+            flat_l = self._dense_flat(full_l, full_a)
+            return flat_l, cksum(flat_l)
+
+        def relax(flat_l, *gargs):
+            g = gdict(gargs)
+            cand = jax.vmap(
+                lambda gp: self._dense_cand(flat_l, gp))(g)
+            return cand, cksum(cand)
+
+        def reduce(flat_l, cand, *gargs):
+            g = gdict(gargs)
+            red = jax.vmap(
+                lambda c, gp: self._dense_red(flat_l, c, gp))(cand, g)
+            return red, cksum(red)
+
+        def update(label, red, *gargs):
+            g = gdict(gargs)
+            new, improved = jax.vmap(self._dense_update)(label, red, g)
+            # fence doubles as the NEW global frontier count (psum'd
+            # under the mesh wrap's pmin — identical on every device)
+            cnt = jnp.sum(improved.astype(jnp.float32))
+            if self.mesh is not None:
+                cnt = jax.lax.psum(cnt, PARTS_AXIS)
+            return (new, improved), cnt
+
+        fns = dict(exchange=exchange, relax=relax, reduce=reduce,
+                   update=update)
+        if self.mesh is not None:
+            P = PartitionSpec
+            S, R = P(PARTS_AXIS), P()
+            wrap = mesh_wrap(self.mesh, len(keys), S, R)
+            fns = dict(exchange=wrap(exchange, (S, S), R),
+                       relax=wrap(relax, (R,), S),
+                       reduce=wrap(reduce, (R, S), S),
+                       update=wrap(update, (S, S), (S, S)))
+        return {k: jax.jit(f) for k, f in fns.items()}
+
+    def _sparse_mode(self):
+        """Single source of truth for the sparse-vs-dense choice (also
+        traced inside the compiled step, _build's q_fits): returns
+        (usable, count_limit) — the reference's frontier > nv/16 pull
+        switch (sssp_gpu.cu:414) AND the queue capacity."""
+        usable = (self.enable_sparse
+                  and self.program.reduce in ("min", "max"))
+        limit = min(self.queue_cap,
+                    max(1, self.sg.nv // self.sparse_threshold)) \
+            if self.enable_sparse else 0
+        return usable, limit
+
+    def timed_phases(self, label, active, iters: int = 1):
+        """Instrumented stepwise iterations -> (label, active,
+        [{phase: seconds, 'frontier': count}]) — the analogue of the
+        reference's per-iteration loadTime/compTime/updateTime prints
+        (reference sssp_gpu.cu:513-518).  Dense iterations split into
+        exchange/relax/reduce/update; iterations the engine would run
+        sparse are timed as one 'sparse' entry.  Separate fenced
+        programs: use for relative weight, not GTEPS.  NOTE: like the
+        stepwise -verbose path, this instruments plain frontier
+        relaxation — a delta engine's timed converge runs the
+        delta-stepping bucket schedule instead."""
+        import time as _time
+
+        from lux_tpu.engine.phased import PhaseTimer
+        from lux_tpu.timing import fetch
+        jits = self._phase_jits
+        gargs = tuple(self.arrays[k] for k in sorted(self.arrays))
+        count = jax.jit(lambda a: jnp.sum(a.astype(jnp.int32)))
+        use_sparse, sparse_limit = self._sparse_mode()
+        report = []
+        cnt = int(fetch(count(active)))
+        for _ in range(iters):
+            t = {"frontier": cnt}
+            if use_sparse and cnt <= sparse_limit:
+                t0 = _time.perf_counter()
+                label, active, c = self.step(label, active)
+                cnt = int(fetch(c))
+                t["sparse"] = _time.perf_counter() - t0
+            else:
+                pt = PhaseTimer(fetch)
+                pt.t = t
+                flat_l = pt("exchange", jits["exchange"], label,
+                            active, *gargs)
+                cand = pt("relax", jits["relax"], flat_l, *gargs)
+                red = pt("reduce", jits["reduce"], flat_l, cand, *gargs)
+                label, active = pt("update", jits["update"], label,
+                                   red, *gargs)
+                cnt = int(pt.last_fence)    # update's fence = new count
+            report.append(t)
+        return label, active, report
