@@ -58,6 +58,12 @@ def build_graph(scale, ef, verbose, weighted=False):
     return g
 
 
+def _print_coverage(args, eng):
+    if args.verbose and eng.pairs is not None:
+        cov = eng.pairs.stats["coverage"]
+        print(f"# pair-lane coverage {cov * 100:.1f}%", file=sys.stderr)
+
+
 def bench_fused(eng, ne, ni, verbose):
     import numpy as np
 
@@ -92,19 +98,23 @@ def run_config(config, args):
                                     pair_threshold=pair_t,
                                     starts=starts)
         extra.update(relabel=True, pair_threshold=pair_t)
-        if args.verbose and eng.pairs is not None:
-            s = eng.pairs.stats
-            print(f"# pair-lane coverage {s['coverage'] * 100:.1f}%",
-                  file=sys.stderr)
+        _print_coverage(args, eng)
         gteps = bench_fused(eng, g.ne, args.ni, args.verbose) / 1e9
         name = f"pagerank_rmat{scale}"
     elif config == "colfilter":
         from lux_tpu.apps import colfilter
         g = build_graph(scale, args.ef, args.verbose, weighted=True)
-        # dot-path engine: pair delivery does not apply (needs_dst via
-        # MXU tiles); no relabel so the factorization keeps user ids
-        eng = colfilter.build_engine(g, num_parts=args.np)
-        extra.update(relabel=False, pair_threshold=None)
+        if pair_t is not None:
+            g2, _perm, starts = pair_relabel(g, args.np,
+                                             pair_threshold=pair_t)
+            eng = colfilter.build_engine(g2, num_parts=args.np,
+                                         pair_threshold=pair_t,
+                                         starts=starts)
+            extra.update(relabel=True, pair_threshold=pair_t)
+        else:
+            eng = colfilter.build_engine(g, num_parts=args.np)
+            extra.update(relabel=False, pair_threshold=None)
+        _print_coverage(args, eng)
         gteps = bench_fused(eng, g.ne, args.ni, args.verbose) / 1e9
         name = f"colfilter_rmat{scale}"
     else:
@@ -135,10 +145,7 @@ def run_config(config, args):
                 pair_threshold=pair_t, starts=starts)
             extra.update(relabel=True, pair_threshold=pair_t,
                          delta="auto" if weighted else None)
-        if args.verbose and eng.pairs is not None:
-            s = eng.pairs.stats
-            print(f"# pair-lane coverage {s['coverage'] * 100:.1f}%",
-                  file=sys.stderr)
+        _print_coverage(args, eng)
         labels, iters, elapsed = timed_converge(eng)
         if args.verbose:
             print(f"# converged in {iters} iterations, {elapsed:.2f}s",

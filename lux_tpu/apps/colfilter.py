@@ -54,12 +54,21 @@ def make_program(k: int = K, lam: float = LAMBDA,
 
 
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
-                 sg: ShardedGraph | None = None) -> PullEngine:
+                 sg: ShardedGraph | None = None,
+                 pair_threshold: int | None = None,
+                 starts=None) -> PullEngine:
+    """pair_threshold routes dense tile pairs through the blocked-
+    SDDMM pair path (ops/pairs.pair_partial_dot): one reshaped-row
+    fetch per pair row instead of a per-edge [*, K] row gather — best
+    after graph.pair_relabel, whose ``starts`` pass through here."""
     if g.weights is None:
         raise ValueError("collaborative filtering needs a weighted graph")
     if sg is None:
-        sg = ShardedGraph.build(g, num_parts)
-    return PullEngine(sg, make_program(), mesh=mesh)
+        sg = ShardedGraph.build(g, num_parts, starts=starts,
+                                pair_threshold=pair_threshold)
+    tile_e = 128 if pair_threshold is not None else 512
+    return PullEngine(sg, make_program(), mesh=mesh,
+                      pair_threshold=pair_threshold, tile_e=tile_e)
 
 
 def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):

@@ -47,8 +47,9 @@ def _common(ap: argparse.ArgumentParser):
     ap.add_argument("-pair", type=int, default=None, metavar="T",
                     help="enable pair-lane delivery with threshold T "
                          "(degree-relabels the graph internally; "
-                         "results are mapped back to input ids; "
-                         "ignored by colfilter)")
+                         "per-vertex results are mapped back to input "
+                         "ids where printed; colfilter's edge-wise "
+                         "RMSE/check need no mapping)")
     ap.add_argument("-phases", type=int, default=0, metavar="N",
                     help="after the timed run, run N instrumented "
                          "iterations and print the per-iteration "
@@ -258,20 +259,24 @@ def cmd_colfilter(argv):
     _common(ap)
     ap.add_argument("-ni", type=int, default=10)
     args = ap.parse_args(argv)
-    args.pair = None          # dot-path engine: pair delivery n/a
 
     from lux_tpu.apps import colfilter
 
     g = _load(args, weighted=True)
     mesh, num_parts = _mesh_and_parts(args)
-    sg = _build_sg(args, g, num_parts)
-    eng = colfilter.build_engine(g, num_parts, mesh, sg=sg)
+    g_run, _perm, starts = _relabel_for_pairs(args, g, num_parts)
+    sg = _build_sg(args, g_run, num_parts, starts)
+    eng = colfilter.build_engine(g_run, num_parts, mesh, sg=sg,
+                                 pair_threshold=args.pair)
     state, elapsed = timed_fused_run(eng, args.ni,
                                      trace_dir=args.profile)
     print(f"ELAPSED TIME = {elapsed:.7f} s")
     print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
     out = eng.unpad(state)
-    print(f"RMSE = {colfilter.rmse(g, out):.6f}")
+    # out is in the run graph's (possibly relabeled) vertex order;
+    # rmse is computed over edges, so the relabeled graph is the
+    # matching — and equivalent — choice
+    print(f"RMSE = {colfilter.rmse(g_run, out):.6f}")
     if args.phases:
         print("note: -phases is unavailable for the colfilter dot-path "
               "engine (fused MXU phases); use -profile for a trace")

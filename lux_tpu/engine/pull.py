@@ -139,6 +139,12 @@ class PullEngine:
             arrays["pair_tile_pos"] = dev(self.pairs.tile_pos)
             if self.pairs.weight is not None:
                 arrays["pair_weight"] = dev(self.pairs.weight)
+            if program.edge_value_from_dot is not None:
+                # the SDDMM pair path also fetches each row's dst tile
+                arrays["pair_row_tile"] = dev(self.pairs.row_tile)
+                arrays["pair_tile0"] = dev(
+                    (np.arange(sg.num_parts) *
+                     (sg.vpad // 128)).astype(np.int32)[:, None])
         if mesh is not None:
             arrays = shard_over_parts(mesh, arrays, sg.num_parts)
         self.arrays = arrays
@@ -159,10 +165,11 @@ class PullEngine:
 
         if layout != "tiled":
             raise ValueError("pair_threshold requires the tiled layout")
-        if program.needs_dst or program.edge_value_from_dot is not None:
+        if program.needs_dst and program.edge_value_from_dot is None:
             raise ValueError("pair_threshold supports programs whose "
                              "edge_value depends only on the source "
-                             "state (needs_dst=False)")
+                             "state, or on <src, dst> via "
+                             "edge_value_from_dot")
         sp, residual = plan_sharded_pairs(sg, threshold)
         self.pairs = sp                      # None if nothing dense
         return residual
@@ -306,6 +313,14 @@ class PullEngine:
         red = combine_chunks(partials, lay, g["chunk_start"],
                              g["last_chunk"], prog.reduce)
         red = red.reshape(n_tiles * W, Kdim)[:sg.vpad]
+        if self.pairs is not None:
+            from lux_tpu.ops.pairs import pair_partial_dot
+            pred = pair_partial_dot(
+                self.pairs, flat_state, g["pair_rowbind"],
+                g["pair_rel"], g["pair_weight"], g["pair_row_tile"],
+                g["pair_tile_pos"], g["pair_tile0"][0],
+                prog.edge_value_from_dot)
+            red = red + pred[:sg.vpad]
         return self._apply_epilogue(old_p, red, g)
 
     def _parts_step(self, local_state, full_state, g_local):

@@ -52,6 +52,9 @@ class PairPlan:
     residual: np.ndarray
     n_tiles: int
     stats: dict
+    # part-local dst tile of each row (pair_partial_dot fetches the
+    # row's destination tile block for the <src, dst> MXU dots)
+    row_tile: np.ndarray | None = None
 
 
 def quantize_depths(depth_sorted: np.ndarray,
@@ -214,10 +217,14 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
             classes.append((t0, cnt, int(L)))
         t0 += cnt
 
+    # slot s owns depth[s] rows for tile t_order[s], in slot order
+    row_tile = np.repeat(t_order.astype(np.int32), depth)
+
     plan = PairPlan(rowbind=rowbind, rel_dst=rel_dst, weight=weight,
                     classes=classes,
                     tile_order=t_order.astype(np.int32),
-                    residual=residual, n_tiles=n_tiles, stats={})
+                    residual=residual, n_tiles=n_tiles, stats={},
+                    row_tile=row_tile)
     ncov = int((~residual).sum())
     plan.stats = dict(ne=ne, covered=ncov, R=R,
                       coverage=ncov / max(ne, 1),
@@ -288,6 +295,7 @@ class StackedPairPlan:
     R: int
     Rp: int
     stats: dict
+    row_tile: np.ndarray | None = None  # int32 [P, Rp], dead rows -> 0
 
 
 def stack_pair_plans(plans: list, weighted: bool,
@@ -325,6 +333,7 @@ def stack_pair_plans(plans: list, weighted: bool,
     rel_dst = np.full((P, Rp, W), W, np.int32)
     wgt = np.zeros((P, Rp, W), np.float32) if weighted else None
     tile_pos = np.full((P, n_tiles), n_slots, np.int32)
+    row_tile = np.zeros((P, Rp), np.int32)
     for p, pl in enumerate(plans):
         prow = 0
         for (t0, c, L) in pl.classes:
@@ -333,6 +342,7 @@ def stack_pair_plans(plans: list, weighted: bool,
             rel_dst[p, rb:rb + c * L] = pl.rel_dst[prow:prow + c * L]
             if weighted:
                 wgt[p, rb:rb + c * L] = pl.weight[prow:prow + c * L]
+            row_tile[p, rb:rb + c * L] = pl.row_tile[prow:prow + c * L]
             tiles = pl.tile_order[t0:t0 + c]
             tile_pos[p, tiles] = sb + np.arange(c, dtype=np.int32)
             prow += c * L
@@ -343,7 +353,8 @@ def stack_pair_plans(plans: list, weighted: bool,
         rowbind=rowbind, rel_dst=rel_dst, weight=wgt, tile_pos=tile_pos,
         classes=classes, n_tiles=n_tiles, n_slots=n_slots, R=R, Rp=Rp,
         stats=dict(ne=ne, covered=cov, coverage=cov / max(ne, 1),
-                   inflation=P * Rp * W / max(cov, 1)))
+                   inflation=P * Rp * W / max(cov, 1)),
+        row_tile=row_tile)
 
 
 def cost_balanced_starts(g, num_parts: int, threshold: int,
@@ -454,7 +465,6 @@ def pair_partial(sp: StackedPairPlan, flat_state, rowbind, rel, weight,
     """
     import jax.numpy as jnp
 
-    from lux_tpu.ops.segment import identity_for
     from lux_tpu.ops.tiled import chunk_partials
 
     if flat_state.ndim != 1:
@@ -472,18 +482,95 @@ def pair_partial(sp: StackedPairPlan, flat_state, rowbind, rel, weight,
     else:
         partials = chunk_partials(vals, rel, W, kind)
     partials = partials[:sp.R]                       # drop pad rows
+    red2d = _class_combine(sp, partials, tile_pos, kind)
+    return red2d.reshape(-1)
+
+
+def _class_combine(sp: StackedPairPlan, partials, tile_pos, kind: str):
+    """Shared epilogue: per-class reshape-reduce of row partials
+    [R, W, ...] into slot results, trailing identity slot, then the
+    tile_pos take -> [n_tiles, W, ...]."""
+    import jax.numpy as jnp
+
+    from lux_tpu.ops.segment import identity_for
+
     ident = identity_for(kind, partials.dtype)
+    red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[kind]
     outs = []
     row0 = 0
     for (cnt, L) in sp.classes:
-        blk = partials[row0:row0 + cnt * L].reshape(cnt, L, W)
-        outs.append({"sum": jnp.sum, "min": jnp.min,
-                     "max": jnp.max}[kind](blk, axis=1))
+        blk = partials[row0:row0 + cnt * L].reshape(
+            (cnt, L) + partials.shape[1:])
+        outs.append(red(blk, axis=1))
         row0 += cnt * L
-    outs.append(jnp.full((1, W), ident, partials.dtype))
-    slots = jnp.concatenate(outs, axis=0)            # [n_slots + 1, W]
-    red2d = jnp.take(slots, tile_pos, axis=0)        # [n_tiles, W]
-    return red2d.reshape(-1)
+    outs.append(jnp.full((1,) + partials.shape[1:], ident,
+                         partials.dtype))
+    slots = jnp.concatenate(outs, axis=0)            # [n_slots + 1, ...]
+    return jnp.take(slots, tile_pos, axis=0)         # [n_tiles, ...]
+
+
+def pair_partial_dot(sp: StackedPairPlan, state, rowbind, rel, weight,
+                     row_tile, tile_pos, part_tile0, msg_dot_fn,
+                     block_rows: int = 256):
+    """Pair-lane delivery for VECTOR-state programs whose dst
+    dependence is only the inner product <src, dst>
+    (PullProgram.edge_value_from_dot, e.g. colfilter's SGD) — the
+    blocked-SDDMM formulation of matrix-factorization on the MXU:
+
+    per delivery row (one dense (src-tile, dst-tile) pair occurrence):
+      S = src tile block [128, K]   (ONE reshaped-row fetch — the
+                                     gather costs ~9 ns per ROW
+                                     regardless of width, PERF_NOTES)
+      T = dst tile block [128, K]   (one more row fetch)
+      D = S @ T^T                   (all (src-lane, dst-lane) dots)
+      dot[c] = D[c, rel[c]]         (lane compare-select)
+      msgs = msg_dot_fn(S, dot, w)  ((w - dot) * src for colfilter)
+      partial = onehot(rel)^T @ msgs  [128, K] to the row's dst tile
+
+    state: [n_state_rows * 128, K] all-gathered flat vertex state;
+    rowbind/rel/weight/row_tile/tile_pos: this part's rows of the
+    stacked arrays; part_tile0: global state2d row of this part's
+    tile 0 (= part index * vpad/128).  Rows are processed in
+    ``block_rows`` lax.map blocks to bound the [B, 128, 128]
+    intermediates.  Returns [n_tiles * 128, K] partial sum.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if weight is None:
+        raise ValueError("pair_partial_dot needs per-lane weights")
+    Kdim = state.shape[-1]
+    s3 = state.reshape(-1, W * Kdim)
+    Rp = rowbind.shape[0]
+    B = max(1, min(block_rows, Rp))
+    nB = -(-Rp // B)
+    Rpp = nB * B
+
+    def pad(x):
+        return jnp.pad(x, ((0, Rpp - Rp),) + ((0, 0),) * (x.ndim - 1))
+
+    lanes = jnp.arange(W, dtype=rel.dtype)
+
+    def block(args):
+        rb, rl, wt, rt = args
+        S = jnp.take(s3, rb, axis=0).reshape(-1, W, Kdim)
+        T = jnp.take(s3, part_tile0 + rt, axis=0).reshape(-1, W, Kdim)
+        D = jnp.einsum("rck,rwk->rcw", S, T,
+                       preferred_element_type=S.dtype)
+        mask = rl[..., None] == lanes                  # [B, 128, 128]
+        dot = jnp.sum(jnp.where(mask, D, 0), axis=-1)  # [B, 128]
+        msgs = msg_dot_fn(S, dot, wt)                  # [B, 128, K]
+        # dead lanes (rel == 128) match no output lane -> contribute 0
+        return jnp.einsum("rcw,rck->rwk", mask.astype(S.dtype), msgs)
+
+    partials = jax.lax.map(
+        block, (pad(rowbind).reshape(nB, B),
+                pad(rel).reshape(nB, B, W),
+                pad(weight).reshape(nB, B, W),
+                pad(row_tile).reshape(nB, B)))
+    partials = partials.reshape(Rpp, W, Kdim)[:sp.R]
+    red = _class_combine(sp, partials, tile_pos, "sum")
+    return red.reshape(-1, Kdim)
 
 
 def stacked_pair_reduce_numpy(sp: StackedPairPlan, p: int,

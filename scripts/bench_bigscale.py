@@ -1,0 +1,79 @@
+"""VERDICT r1 #8: prove the >=0.5B-edge build path on one chip.
+
+Generates RMAT{scale} with the native C++ generator, builds a
+multi-part ShardedGraph within host RAM, runs a few timed pagerank
+iterations on the real TPU, and prints one JSON line per stage plus
+the final GTEPS (driver methodology: loop-dependent fused run, host
+fetch fence).
+
+Usage:
+  PYTHONPATH=/root/repo:/root/.axon_site \
+      python scripts/bench_bigscale.py [scale=25] [np=4] [pair=0] [ni=3]
+
+pair > 0 additionally runs graph.pair_relabel + pair-lane delivery
+(slower host prep; measures the fast path at scale).
+"""
+
+from __future__ import annotations
+
+import json
+import resource
+import sys
+import time
+
+
+def log(stage, t0, **kw):
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    print(json.dumps(dict(stage=stage, secs=round(time.time() - t0, 1),
+                          peak_host_gb=round(peak, 1), **kw)),
+          flush=True)
+    return time.time()
+
+
+def main():
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+    np_parts = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    pair = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    ni = int(sys.argv[4]) if len(sys.argv) > 4 else 3
+
+    import numpy as np
+
+    from lux_tpu.apps import pagerank
+    from lux_tpu.convert import rmat_graph
+    from lux_tpu.graph import pair_relabel
+    from lux_tpu.timing import timed_fused_run
+
+    t = time.time()
+    g = rmat_graph(scale=scale, edge_factor=16, seed=0)
+    t = log("generate", t, nv=g.nv, ne=g.ne)
+
+    starts = None
+    if pair:
+        g, _perm, starts = pair_relabel(g, np_parts, pair_threshold=pair)
+        t = log("pair_relabel", t)
+
+    eng = pagerank.build_engine(g, num_parts=np_parts,
+                                pair_threshold=pair or None,
+                                starts=starts)
+    rep = eng.sg.memory_report()
+    t = log("build_engine", t,
+            vpad=eng.sg.vpad, epad=eng.sg.epad,
+            device_gb=round(rep["total_bytes"] / 1e9, 2),
+            pair_cov=(round(eng.pairs.stats["coverage"], 3)
+                      if eng.pairs is not None else None))
+
+    state, elapsed = timed_fused_run(eng, ni)
+    out = eng.unpad(state)
+    assert np.isfinite(out).all(), "non-finite result"
+    gteps = g.ne * ni / elapsed / 1e9
+    log("run", t, iters=ni, elapsed=round(elapsed, 2),
+        gteps=round(gteps, 4))
+    print(json.dumps({
+        "metric": f"pagerank_rmat{scale}_np{np_parts}_gteps_per_chip",
+        "value": round(gteps, 4), "unit": "GTEPS",
+        "vs_baseline": round(gteps, 4), "np": np_parts,
+        "scale": scale, "pair_threshold": pair or None}))
+
+
+if __name__ == "__main__":
+    main()
