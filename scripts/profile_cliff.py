@@ -1,0 +1,48 @@
+"""Find the big-scale cliff: RMAT25/np4 measured 184 ns/edge (no
+pair), vs ~18 at scale 23/np1.  Build one graph, time fused runs and
+the phase split across partition counts.
+
+Usage: PYTHONPATH=/root/repo:/root/.axon_site \
+    python scripts/profile_cliff.py [scale=24] [np list...]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    nps = [int(x) for x in sys.argv[2:]] or [1, 4]
+
+    from lux_tpu.apps import pagerank
+    from lux_tpu.convert import rmat_graph
+    from lux_tpu.timing import timed_fused_run
+
+    t0 = time.time()
+    g = rmat_graph(scale=scale, edge_factor=16, seed=0)
+    print(f"# graph {time.time() - t0:.0f}s ne={g.ne}", flush=True)
+
+    for np_parts in nps:
+        t0 = time.time()
+        eng = pagerank.build_engine(g, num_parts=np_parts)
+        print(f"# np={np_parts} build {time.time() - t0:.0f}s "
+              f"vpad={eng.sg.vpad} epad={eng.sg.epad} "
+              f"C={eng.tiles.n_chunks}", flush=True)
+        state, elapsed = timed_fused_run(eng, 3)
+        assert np.isfinite(eng.unpad(state)).all()
+        per_edge = elapsed / 3 / g.ne * 1e9
+        print(f"np={np_parts}: {elapsed / 3 * 1e3:.0f} ms/iter  "
+              f"{per_edge:.1f} ns/edge  "
+              f"({g.ne * 3 / elapsed / 1e9:.4f} GTEPS)", flush=True)
+        _s, rep = eng.timed_phases(eng.init_state(), 2)
+        for i, t in enumerate(rep):
+            print(f"  phases iter{i}: " +
+                  "  ".join(f"{k}={v * 1e3:.0f}ms"
+                            for k, v in t.items()), flush=True)
+        del eng, state
+
+
+if __name__ == "__main__":
+    main()
