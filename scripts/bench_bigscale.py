@@ -36,16 +36,26 @@ def main():
     pair = int(sys.argv[3]) if len(sys.argv) > 3 else 0
     ni = int(sys.argv[4]) if len(sys.argv) > 4 else 3
 
+    import os
+
     import numpy as np
 
     from lux_tpu.apps import pagerank
     from lux_tpu.convert import rmat_graph
-    from lux_tpu.graph import pair_relabel
+    from lux_tpu.format import write_lux
+    from lux_tpu.graph import Graph, pair_relabel
     from lux_tpu.timing import timed_fused_run
 
     t = time.time()
-    g = rmat_graph(scale=scale, edge_factor=16, seed=0)
-    t = log("generate", t, nv=g.nv, ne=g.ne)
+    cache = f"/tmp/rmat{scale}_ef16_s0.lux"
+    if os.path.exists(cache):
+        g = Graph.from_file(cache, use_native=True)
+        t = log("load_cached", t, nv=g.nv, ne=g.ne)
+    else:
+        g = rmat_graph(scale=scale, edge_factor=16, seed=0)
+        t = log("generate", t, nv=g.nv, ne=g.ne)
+        write_lux(cache, g.row_ptrs, g.col_idx, degrees=g.out_degrees)
+        t = log("cache_write", t)
 
     starts = None
     if pair:
