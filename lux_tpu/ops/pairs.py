@@ -35,7 +35,7 @@ class PairPlan:
     """Per-part pair-lane arrays (host numpy).
 
     rowbind   int32 [R]      global state2d row (= src tile) per row
-    rel_dst   int32 [R, 128] dst offset in [0,128), 128 = dead lane
+    rel_dst   int16 [R, 128] dst offset in [0,128), 128 = dead lane
     weight    f32 [R, 128] | None  per-lane edge weight (0 dead lanes)
     classes   [(tile_start, tile_count, depth)] for the combine; rows
               are tile-major in ``tile_order`` with per-tile depth
@@ -197,12 +197,12 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     assert (within + srt_rows <= depth[tile_pos[dts]]).all()
 
     rowbind = np.zeros(R, np.int32)
-    rel_dst = np.full((R, W), W, np.int32)
+    rel_dst = np.full((R, W), W, np.int16)
     rows = pair_base[pidx] + occ
     rowbind_rows = (src_slot[cov] // W).astype(np.int32)
     rowbind[rows] = rowbind_rows
     rel_dst[rows, src_slot[cov] % W] = (dst_local[cov] % W).astype(
-        np.int32)
+        np.int16)
     weight = None
     if weights is not None:
         weight = np.zeros((R, W), np.float32)
@@ -274,7 +274,7 @@ class StackedPairPlan:
     """Common-frame pair-lane arrays for all parts (host numpy).
 
     rowbind   int32 [P, Rp]       global state2d row per delivery row
-    rel_dst   int32 [P, Rp, 128]  dst offset in [0,128), 128 = dead
+    rel_dst   int16 [P, Rp, 128]  dst offset in [0,128), 128 = dead
     weight    f32 [P, Rp, 128] | None  per-lane edge weight
     tile_pos  int32 [P, n_tiles]  class slot of each part-local tile;
               tiles with no pair rows point at the trailing identity
@@ -330,7 +330,7 @@ def stack_pair_plans(plans: list, weighted: bool,
         r += c * L
 
     rowbind = np.zeros((P, Rp), np.int32)
-    rel_dst = np.full((P, Rp, W), W, np.int32)
+    rel_dst = np.full((P, Rp, W), W, np.int16)
     wgt = np.zeros((P, Rp, W), np.float32) if weighted else None
     tile_pos = np.full((P, n_tiles), n_slots, np.int32)
     row_tile = np.zeros((P, Rp), np.int32)

@@ -40,8 +40,12 @@ def _partial_kernel(vals_ref, rel_ref, out_ref, *, W: int, kind: str):
     rel = rel_ref[:]                                     # [B, E]
     B, E = vals.shape
     ident = identity_for(kind, vals.dtype)
-    lanes = jax.lax.broadcasted_iota(rel.dtype, (B, E, W), 2)
-    match = rel[:, :, None] == lanes
+    # compare in int32: rel rides HBM as int16 (it only holds 0..W);
+    # Mosaic's iota is 32-bit and its minor-dim broadcast insertion
+    # only supports 32-bit types, so widen BEFORE the reshape
+    rel32 = rel.astype(jnp.int32)                        # [B, E]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (B, E, W), 2)
+    match = rel32[:, :, None] == lanes
     masked = jnp.where(match, vals[:, :, None], ident)   # [B, E, W]
     if kind == "sum":
         out_ref[:] = jnp.sum(masked, axis=1)

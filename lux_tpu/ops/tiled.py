@@ -54,7 +54,9 @@ class TiledLayout:
     n_chunks: int               # padded chunk count C (max over parts)
     needs_scan: bool            # False when every tile fits in 1 chunk
     edge_gather: np.ndarray     # int64 [P, C, E] index into flat [epad]
-    rel_dst: np.ndarray         # int32 [P, C, E] in [0, W]; W = pad lane
+    rel_dst: np.ndarray         # int16 [P, C, E] in [0, W]; W = pad lane
+                                #   (int16: halves the second-largest
+                                #   device array; values are tiny)
     chunk_tile: np.ndarray      # int32 [P, C] owning tile; n_tiles = pad
     chunk_start: np.ndarray     # bool  [P, C] True at each tile's 1st chunk
     last_chunk: np.ndarray      # int32 [P, n_tiles] index of tile's last
@@ -93,7 +95,7 @@ class TiledLayout:
         global_needs_scan = any(x[2].max(initial=0) > 1 for x in sizing)
 
         edge_gather = np.zeros((P, C, E), dtype=np.int64)
-        rel_dst = np.full((P, C, E), W, dtype=np.int32)
+        rel_dst = np.full((P, C, E), W, dtype=np.int16)
         chunk_tile = np.full((P, C), n_tiles, dtype=np.int32)
         chunk_start = np.ones((P, C), dtype=bool)   # pad chunks isolated
         last_chunk = np.full((P, n_tiles), -1, dtype=np.int32)

@@ -31,7 +31,8 @@ def test_kernel_matches_numpy_oracle_and_xla(kind):
     rng = np.random.default_rng(11)
     C, E, W = 16, 64, 128
     vals = rng.random((C, E)).astype(np.float32)
-    rel = np.sort(rng.integers(0, W + 1, (C, E)), axis=1).astype(np.int32)
+    # int16 is what TiledLayout/PairPlan ship to the kernel
+    rel = np.sort(rng.integers(0, W + 1, (C, E)), axis=1).astype(np.int16)
     got = np.asarray(chunk_partials_pallas(vals, rel, W, kind,
                                            interpret=True))
     want = numpy_partials(vals, rel, W, kind)
