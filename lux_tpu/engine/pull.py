@@ -104,7 +104,8 @@ class PullEngine:
                  layout: str = "tiled", tile_w: int = 128,
                  tile_e: int = 512, use_mxu: bool = False,
                  reduce_method: str = "auto",
-                 pair_threshold: int | None = None):
+                 pair_threshold: int | None = None,
+                 pair_stream: bool | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -114,6 +115,8 @@ class PullEngine:
         if pair_threshold is not None:
             sg = self._setup_pairs(sg, pair_threshold, mesh, layout,
                                    program)
+        from lux_tpu.ops.pairs import resolve_pair_stream
+        self.pair_stream = resolve_pair_stream(pair_stream, self.pairs)
         if program.edge_value_from_dot is not None:
             if program.reduce != "sum":
                 raise ValueError(
@@ -177,10 +180,11 @@ class PullEngine:
     def _pair_red(self, flat_state, g):
         """Pair-lane delivery + reduce for one part -> [vpad] partial
         (identity where pairs contribute nothing)."""
-        from lux_tpu.ops.pairs import pair_partial
+        from lux_tpu.ops.pairs import pair_partial, pair_partial_streamed
 
         prog = self.program
-        red = pair_partial(
+        fn = pair_partial_streamed if self.pair_stream else pair_partial
+        red = fn(
             self.pairs, flat_state, g["pair_rowbind"], g["pair_rel"],
             g.get("pair_weight"), g["pair_tile_pos"], prog.reduce,
             lambda vals, w: prog.edge_value(vals, None, w),

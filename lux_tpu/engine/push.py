@@ -89,7 +89,8 @@ class PushEngine:
                  edge_budget: int | None = None,
                  delta: float | None = None,
                  reduce_method: str = "auto",
-                 pair_threshold: int | None = None):
+                 pair_threshold: int | None = None,
+                 pair_stream: bool | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -127,6 +128,8 @@ class PushEngine:
                 raise ValueError(
                     "pair_threshold requires the tiled layout")
             self.pairs, dense_sg = plan_sharded_pairs(sg, pair_threshold)
+        from lux_tpu.ops.pairs import resolve_pair_stream
+        self.pair_stream = resolve_pair_stream(pair_stream, self.pairs)
         dev = jnp.asarray if mesh is None else np.asarray
         arrays, self.tiles = build_graph_arrays(
             dense_sg, layout, needs_dst=False, tile_w=tile_w,
@@ -231,7 +234,8 @@ class PushEngine:
                         else "xla"),
                 interpret=self.reduce_method == "pallas-interpret")
         if self.pairs is not None:
-            from lux_tpu.ops.pairs import pair_partial
+            from lux_tpu.ops.pairs import (pair_partial,
+                                           pair_partial_streamed)
             from lux_tpu.ops.tiled import combine_op
 
             def msg(vals, w):
@@ -239,7 +243,9 @@ class PushEngine:
                 return jnp.where(vals == ident_l,
                                  jnp.asarray(prog.identity, c.dtype), c)
 
-            pred = pair_partial(
+            fn = (pair_partial_streamed if self.pair_stream
+                  else pair_partial)
+            pred = fn(
                 self.pairs, flat_l, g["pair_rowbind"],
                 g["pair_rel"], g.get("pair_weight"),
                 g["pair_tile_pos"], prog.reduce, msg,

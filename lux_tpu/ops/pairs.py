@@ -509,6 +509,108 @@ def _class_combine(sp: StackedPairPlan, partials, tile_pos, kind: str):
     return jnp.take(slots, tile_pos, axis=0)         # [n_tiles, ...]
 
 
+def pair_partial_streamed(sp: StackedPairPlan, flat_state, rowbind, rel,
+                          weight, tile_pos, kind: str, msg_fn,
+                          reduce_method: str = "xla",
+                          block_bytes: int = 64 << 20):
+    """Memory-bounded pair delivery: identical result to
+    ``pair_partial`` but the delivered f32 value rows and their
+    per-row partials never materialize beyond one scan block.
+
+    At RMAT25 x np4 the monolithic path's vals+partials are ~15 GB
+    (each Rp x 128 x f32) and the whole program OOMs a 16 GB chip
+    (PERF_NOTES); here each depth class (cnt slots x L contiguous
+    rows) is processed as a ``lax.scan`` over blocks of S whole slots
+    (S*L rows, sized to ``block_bytes``), each step fetching, reducing
+    and emitting per-SLOT results [S, 128] — the cross-row combine
+    happens inside the step, so live memory is one block regardless of
+    graph scale.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.ops.segment import identity_for
+    from lux_tpu.ops.tiled import chunk_partials
+
+    if flat_state.ndim != 1:
+        raise ValueError("pair delivery supports scalar vertex state "
+                         "only")
+    s2d = flat_state.reshape(-1, W)
+    red_axis = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[kind]
+
+    def slot_results(rb, rl, wt, S, L):
+        """[S*L] rows -> [S, 128] per-slot results (one block)."""
+        vals = jnp.take(s2d, rb, axis=0)               # [S*L, 128]
+        msgs = msg_fn(vals, wt)
+        B = msgs.shape[0]
+        # Pallas needs 8-row block granularity; small unaligned
+        # remainder blocks take the XLA formulation instead
+        if reduce_method.startswith("pallas") and B % 8 == 0:
+            from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+            p = chunk_partials_pallas(
+                msgs, rl, W, kind, block_c=64 if B % 64 == 0 else 8,
+                interpret=reduce_method == "pallas-interpret")
+        else:
+            if reduce_method.startswith("pallas"):
+                msgs = jax.lax.optimization_barrier(msgs)
+            p = chunk_partials(msgs, rl, W, kind)
+        return red_axis(p.reshape(S, L, W), axis=1)
+
+    outs = []
+    row0 = 0
+    for (cnt, L) in sp.classes:
+        # whole slots per block, >= 1, sized so vals fit block_bytes;
+        # keep S*L a multiple of the Pallas block granularity
+        S = max(1, min(cnt, block_bytes // max(1, L * W * 4)))
+        if L % 8 and S >= 8:
+            S -= S % 8
+        nB, rem = divmod(cnt, S)
+
+        def seg(lo, n):
+            sl = slice(row0 + lo * L, row0 + (lo + n) * L)
+            return (rowbind[sl], rel[sl],
+                    None if weight is None else weight[sl])
+
+        cls_out = []
+        if nB:
+            rb, rl, wt = seg(0, nB * S)
+            rb = rb.reshape(nB, S * L)
+            rl = rl.reshape(nB, S * L, W)
+            xs = (rb, rl) if wt is None else \
+                (rb, rl, wt.reshape(nB, S * L, W))
+
+            def step(_, x, S=S, L=L):
+                return None, slot_results(
+                    x[0], x[1], x[2] if len(x) > 2 else None, S, L)
+
+            _, reds = jax.lax.scan(step, None, xs)     # [nB, S, 128]
+            cls_out.append(reds.reshape(nB * S, W))
+        if rem:
+            rb, rl, wt = seg(nB * S, rem)
+            cls_out.append(slot_results(rb, rl, wt, rem, L))
+        outs.append(jnp.concatenate(cls_out, axis=0))
+        row0 += cnt * L
+    # identity slot in the MESSAGE dtype (msg_fn may promote), exactly
+    # like pair_partial's partials-dtype identity
+    out_dtype = outs[0].dtype
+    ident = identity_for(kind, out_dtype)
+    outs.append(jnp.full((1, W), ident, out_dtype))
+    slots = jnp.concatenate(outs, axis=0)              # [n_slots+1, W]
+    return jnp.take(slots, tile_pos, axis=0).reshape(-1)
+
+
+def resolve_pair_stream(pair_stream, pairs) -> bool:
+    """Streamed pair delivery (pair_partial_streamed) is the default:
+    measured FASTER than the monolithic path even at RMAT21
+    (0.124-0.127 vs 0.119-0.122 GTEPS, interleaved A/B) and its live
+    memory is one scan block instead of Rp x 128 x f32 vals+partials —
+    which OOM a 16 GB chip at RMAT25 (PERF_NOTES).  pair_stream=False
+    keeps the monolithic path (micro-graphs, debugging)."""
+    if pairs is None:
+        return False
+    return True if pair_stream is None else bool(pair_stream)
+
+
 def pair_partial_dot(sp: StackedPairPlan, state, rowbind, rel, weight,
                      row_tile, tile_pos, part_tile0, msg_dot_fn,
                      block_rows: int = 256):

@@ -353,3 +353,98 @@ def test_pair_relabel_preserves_results():
     got = np.empty_like(got_perm)
     got[perm] = got_perm
     np.testing.assert_allclose(got, plain, rtol=1e-5)
+
+
+@pytest.mark.parametrize("weighted,kind", [(False, "sum"),
+                                           (False, "min"),
+                                           (True, "sum")])
+def test_streamed_pair_partial_matches_monolithic(weighted, kind):
+    """pair_partial_streamed must agree bit-for-bit with pair_partial
+    — tiny block_bytes force multi-block scans plus remainders."""
+    import jax.numpy as jnp
+    from lux_tpu.graph import Graph, ShardedGraph
+    from lux_tpu.ops.pairs import (pair_partial, pair_partial_streamed,
+                                   plan_sharded_pairs)
+
+    rng = np.random.default_rng(21)
+    nv, ne = 512, 6000
+    src = rng.integers(0, 64, ne)          # dense hub structure
+    dst = rng.integers(0, nv, ne)
+    w = rng.integers(1, 5, ne).astype(np.int32) if weighted else None
+    g = Graph.from_edges(src, dst, nv, weights=w)
+    sg = ShardedGraph.build(g, 2, vpad_align=128)
+    sp, _res = plan_sharded_pairs(sg, threshold=4)
+    assert sp is not None and len(sp.classes) > 1
+
+    state = jnp.asarray(
+        rng.random(sg.num_parts * sg.vpad).astype(np.float32))
+    if weighted:
+        def msg(vals, wt):
+            return vals * wt
+    else:
+        def msg(vals, wt):
+            return vals
+
+    from lux_tpu.ops.pairs import stacked_pair_reduce_numpy
+    for p in range(sg.num_parts):
+        wgt = None if sp.weight is None else jnp.asarray(sp.weight[p])
+        args = (sp, state, jnp.asarray(sp.rowbind[p]),
+                jnp.asarray(sp.rel_dst[p]), wgt,
+                jnp.asarray(sp.tile_pos[p]), kind, msg)
+        want = np.asarray(pair_partial(*args))
+        got = np.asarray(pair_partial_streamed(*args,
+                                               block_bytes=1 << 14))
+        if kind == "min":
+            # order-insensitive: must agree exactly
+            np.testing.assert_array_equal(got, want)
+        else:
+            # sums associate in block order: ulp-level drift only
+            np.testing.assert_allclose(got, want, rtol=1e-5,
+                                       atol=1e-6)
+        # and both must match the float64 oracle
+        oracle = stacked_pair_reduce_numpy(
+            sp, p, np.asarray(state), kind,
+            msg=lambda v, w: msg(v, w) if weighted else msg(v, None))
+        np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-5)
+
+
+def test_engine_pair_stream_matches_default():
+    from lux_tpu.apps import pagerank, sssp
+    from lux_tpu.engine.pull import PullEngine
+    from lux_tpu.engine.push import PushEngine
+    from lux_tpu.convert import rmat_graph
+    from lux_tpu.graph import pair_relabel
+
+    from lux_tpu.graph import ShardedGraph
+
+    g = rmat_graph(scale=9, edge_factor=8, seed=6)
+    g2, _perm, starts = pair_relabel(g, 2, pair_threshold=4)
+    # pair_stream=False pins the MONOLITHIC path (streamed is the
+    # engine default) so the two implementations actually face off
+    base = PullEngine(ShardedGraph.build(g2, 2, starts=starts,
+                                         pair_threshold=4),
+                      pagerank.make_program(), pair_threshold=4,
+                      tile_e=128, pair_stream=False)
+    assert not base.pair_stream
+    want = base.unpad(base.run(base.init_state(), 4))
+
+    full = ShardedGraph.build(g2, 2, starts=starts, pair_threshold=4)
+    eng = PullEngine(full, pagerank.make_program(), pair_threshold=4,
+                     tile_e=128)
+    assert eng.pair_stream
+    got = eng.unpad(eng.run(eng.init_state(), 4))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    p_base = PushEngine(ShardedGraph.build(g2, 2, starts=starts,
+                                           pair_threshold=4),
+                        sssp.make_program(0), pair_threshold=4,
+                        pair_stream=False)
+    p_str = PushEngine(ShardedGraph.build(g2, 2, starts=starts,
+                                          pair_threshold=4),
+                       sssp.make_program(0), pair_threshold=4)
+    assert not p_base.pair_stream and p_str.pair_stream
+    l0, a0 = p_base.init_state()
+    l1, a1, _ = p_base.converge(l0, a0)
+    l0, a0 = p_str.init_state()
+    l2, a2, _ = p_str.converge(l0, a0)
+    np.testing.assert_array_equal(p_base.unpad(l1), p_str.unpad(l2))
