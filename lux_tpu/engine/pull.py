@@ -45,6 +45,14 @@ from lux_tpu.parallel.mesh import PARTS_AXIS, shard_over_parts
 # on v5e, within 3% of every size from 32 up)
 DOT_BLOCK_CHUNKS = 128
 
+# Stream the per-edge gather + chunk partials through lax.map blocks
+# once a part's edge messages would exceed this many bytes — the [C, E]
+# f32 temporary is what OOMs billion-edge single-chip runs (RMAT26 np8:
+# 16.9 GB asked of 15.75; see PERF_NOTES).  Small runs keep the fully
+# fused form.
+STREAM_MSG_BYTES = 1 << 30
+STREAM_BLOCK_CHUNKS = 4096
+
 
 def resolve_reduce_method(method: str) -> str:
     """'auto' picks the Pallas kernel on real TPUs and the portable
@@ -105,7 +113,8 @@ class PullEngine:
                  tile_e: int = 512, use_mxu: bool = False,
                  reduce_method: str = "auto",
                  pair_threshold: int | None = None,
-                 pair_stream: bool | None = None):
+                 pair_stream: bool | None = None,
+                 stream_msgs: bool | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -117,6 +126,11 @@ class PullEngine:
                                    program)
         from lux_tpu.ops.pairs import resolve_pair_stream
         self.pair_stream = resolve_pair_stream(pair_stream, self.pairs)
+        # auto: stream once one part's [C, E] f32 messages pass the
+        # budget (sg here is the pair residual when pairs are on)
+        self.stream_chunks = (sg.epad * 4 > STREAM_MSG_BYTES
+                              if stream_msgs is None
+                              else bool(stream_msgs))
         if program.edge_value_from_dot is not None:
             if program.reduce != "sum":
                 raise ValueError(
@@ -250,13 +264,70 @@ class PullEngine:
                         "pallas" if self.reduce_method.startswith("pallas")
                         else "xla"),
                 interpret=self.reduce_method == "pallas-interpret")
+        return self._combine_pairs(flat_state, red, g)
+
+    def _part_partials_streamed(self, flat_state, g):
+        """Gather + message + chunk partials in lax.map blocks over the
+        chunk axis -> [C, W] partials, bounding the [C, E] temporaries
+        that OOM billion-edge runs (needs_dst=False programs; the dot
+        path has its own blocking)."""
+        prog, lay = self.program, self.tiles
+        C, E = lay.n_chunks, lay.E
+        B = max(8, min(STREAM_BLOCK_CHUNKS, C))
+        nB, rem = divmod(C, B)
+        use_pallas = self.reduce_method.startswith("pallas")
+
+        def partial_block(src_b, rel_b, w_b):
+            vals = jnp.take(flat_state, src_b, axis=0)
+            msgs = prog.edge_value(vals, None, w_b)
+            if use_pallas and msgs.ndim == 2:   # scalar payloads only
+                from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+                return chunk_partials_pallas(
+                    msgs, rel_b, lay.W, prog.reduce,
+                    block_c=64 if msgs.shape[0] % 64 == 0 else 8,
+                    interpret=self.reduce_method == "pallas-interpret")
+            from lux_tpu.ops.tiled import chunk_partials
+            msgs = jax.lax.optimization_barrier(msgs)
+            return chunk_partials(msgs, rel_b, lay.W, prog.reduce,
+                                  use_mxu=self.use_mxu)
+
+        wgt = g.get("weight")
+        parts = []
+        if nB:
+            def seg(x):
+                return x[:nB * B].reshape((nB, B) + x.shape[1:])
+
+            xs = (seg(g["src_slot"]), seg(g["rel_dst"])) + \
+                (() if wgt is None else (seg(wgt),))
+            blocks = jax.lax.map(
+                lambda x: partial_block(x[0], x[1],
+                                        x[2] if len(x) > 2 else None),
+                xs)                       # [nB, B, W, ...]
+            parts.append(blocks.reshape((nB * B,) + blocks.shape[2:]))
+        if rem:
+            parts.append(partial_block(
+                g["src_slot"][nB * B:], g["rel_dst"][nB * B:],
+                None if wgt is None else wgt[nB * B:]))
+        return jnp.concatenate(parts, axis=0)
+
+    def _combine_pairs(self, flat_state, red, g):
         if self.pairs is not None:
-            pred = self._pair_red(flat_state, g)
-            red = combine_op(prog.reduce)(red, pred)
+            red = combine_op(self.program.reduce)(
+                red, self._pair_red(flat_state, g))
         return red
 
     def _part_step(self, flat_state, old_p, g):
         """g: dict of this part's graph arrays."""
+        prog, sg, lay = self.program, self.sg, self.tiles
+        if (self.stream_chunks and lay is not None
+                and not prog.needs_dst):
+            from lux_tpu.ops.tiled import combine_partials
+            partials = self._part_partials_streamed(flat_state, g)
+            red = combine_partials(partials, lay, g["chunk_start"],
+                                   g["last_chunk"], sg.vpad,
+                                   prog.reduce)
+            red = self._combine_pairs(flat_state, red, g)
+            return self._apply_epilogue(old_p, red, g)
         msgs = self._part_msgs(flat_state, old_p, g)
         red = self._part_reduce(flat_state, msgs, g)
         return self._apply_epilogue(old_p, red, g)

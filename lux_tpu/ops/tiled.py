@@ -201,6 +201,17 @@ def combine_chunks(partials, layout: TiledLayout, chunk_start, last_chunk,
     return jnp.where(empty, ident, out)
 
 
+def combine_partials(partials, layout: TiledLayout, chunk_start,
+                     last_chunk, vpad: int, kind: str):
+    """Per-chunk partials [C, W, ...] -> flat [vpad, ...] (the shared
+    tail of tiled_segment_reduce, also used by the streamed engines
+    that produce partials block-wise)."""
+    tiles = combine_chunks(partials, layout, chunk_start, last_chunk,
+                           kind)
+    flatshape = (layout.n_tiles * layout.W,) + tiles.shape[2:]
+    return tiles.reshape(flatshape)[:vpad]
+
+
 def tiled_segment_reduce(vals, layout: TiledLayout, chunk_start,
                          last_chunk, rel_dst, vpad: int, kind: str,
                          use_mxu: bool = False, method: str = "xla",
@@ -221,7 +232,5 @@ def tiled_segment_reduce(vals, layout: TiledLayout, chunk_start,
     else:
         partials = chunk_partials(vals, rel_dst, layout.W, kind,
                                   use_mxu=use_mxu)
-    tiles = combine_chunks(partials, layout, chunk_start, last_chunk,
-                           kind)
-    flatshape = (layout.n_tiles * layout.W,) + tiles.shape[2:]
-    return tiles.reshape(flatshape)[:vpad]
+    return combine_partials(partials, layout, chunk_start, last_chunk,
+                            vpad, kind)
