@@ -32,6 +32,15 @@ class PartCtx:
     ne: int
 
 
+def vmask_of(g, vpad: int):
+    """Valid-vertex mask derived from the per-part counts ``nvp``
+    graph array ([1] per part under vmap -> [vpad]; [rows, 1] stacked
+    -> [rows, vpad]) — shipped as one int32 per part instead of a
+    [rows, vpad] bool array (68 MB of the RMAT26 single-chip fit)."""
+    import jax.numpy as jnp
+    return jnp.arange(vpad, dtype=jnp.int32) < g["nvp"]
+
+
 @dataclasses.dataclass(frozen=True)
 class PullProgram:
     """Dense gather-apply program (the reference's pull model,

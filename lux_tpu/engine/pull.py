@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
-from lux_tpu.engine.program import PartCtx, PullProgram
+from lux_tpu.engine.program import PartCtx, PullProgram, vmask_of
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
 from lux_tpu.ops.tiled import (TiledLayout, combine_chunks,
@@ -51,7 +51,7 @@ DOT_BLOCK_CHUNKS = 128
 # 16.9 GB asked of 15.75; see PERF_NOTES).  Small runs keep the fully
 # fused form.
 STREAM_MSG_BYTES = 1 << 30
-STREAM_BLOCK_CHUNKS = 4096
+STREAM_BLOCK_CHUNKS = 1024
 
 
 def resolve_reduce_method(method: str) -> str:
@@ -75,7 +75,12 @@ def build_graph_arrays(sg: ShardedGraph, layout: str, needs_dst: bool,
     with ``shard_over_parts`` directly (one H2D per shard), instead of
     staging everything through the default device first."""
     dev = jnp.asarray if device else np.asarray
-    common = dict(deg=dev(sg.deg_padded), vmask=dev(sg.vmask))
+    # the valid-vertex mask is DERIVED on device from the per-part
+    # counts (iota < nvp) instead of shipping a [rows, vpad] bool
+    # array — 68 MB of the RMAT26 single-chip fit (PERF_NOTES)
+    common = dict(deg=dev(sg.deg_padded),
+                  nvp=dev(sg.nv_part[sg.part_ids()].astype(
+                      np.int32)[:, None]))
     if layout == "flat":
         arrays = dict(src_slot=dev(sg.src_slot),
                       dst_local=dev(sg.dst_local), **common)
@@ -126,9 +131,13 @@ class PullEngine:
                                    program)
         from lux_tpu.ops.pairs import resolve_pair_stream
         self.pair_stream = resolve_pair_stream(pair_stream, self.pairs)
-        # auto: stream once one part's [C, E] f32 messages pass the
-        # budget (sg here is the pair residual when pairs are on)
-        self.stream_chunks = (sg.epad * 4 > STREAM_MSG_BYTES
+        # auto: stream once the [rows, C, E] f32 message temporary
+        # passes the budget — vmap materializes EVERY materialized
+        # part's messages together (sg here is the pair residual when
+        # pairs are on; mesh devices hold rows/ndev of this, so the
+        # estimate is conservative there)
+        rows = len(sg.part_ids())
+        self.stream_chunks = (rows * sg.epad * 4 > STREAM_MSG_BYTES
                               if stream_msgs is None
                               else bool(stream_msgs))
         if program.edge_value_from_dot is not None:
@@ -218,10 +227,10 @@ class PullEngine:
 
     def _apply_epilogue(self, old_p, red, g):
         sg, prog = self.sg, self.program
-        ctx = PartCtx(deg=g["deg"], vmask=g["vmask"], nv=sg.nv, ne=sg.ne)
+        vm = vmask_of(g, sg.vpad)
+        ctx = PartCtx(deg=g["deg"], vmask=vm, nv=sg.nv, ne=sg.ne)
         new = prog.apply(old_p, red, ctx)
-        keep = g["vmask"].reshape(g["vmask"].shape +
-                                  (1,) * (new.ndim - 1))
+        keep = vm.reshape(vm.shape + (1,) * (new.ndim - 1))
         return jnp.where(keep, new, old_p)
 
     def _part_msgs(self, flat_state, old_p, g):
@@ -282,9 +291,13 @@ class PullEngine:
             msgs = prog.edge_value(vals, None, w_b)
             if use_pallas and msgs.ndim == 2:   # scalar payloads only
                 from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+                # the kernel's [bc, E, W] masked intermediate must fit
+                # scoped VMEM (~16 MB): bc=64 fits E<=128 (pair-residual
+                # tile_e), E=512 needs bc=8
+                bc = 64 if E * 64 * lay.W * 4 <= (8 << 20) else 8
                 return chunk_partials_pallas(
                     msgs, rel_b, lay.W, prog.reduce,
-                    block_c=64 if msgs.shape[0] % 64 == 0 else 8,
+                    block_c=bc if msgs.shape[0] % bc == 0 else 8,
                     interpret=self.reduce_method == "pallas-interpret")
             from lux_tpu.ops.tiled import chunk_partials
             msgs = jax.lax.optimization_barrier(msgs)

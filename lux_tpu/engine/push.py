@@ -50,6 +50,7 @@ import numpy as np
 from jax.sharding import PartitionSpec
 
 from lux_tpu.engine import frontier as fr
+from lux_tpu.engine.program import vmask_of
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
 from lux_tpu.ops.tiled import tiled_segment_reduce
@@ -255,11 +256,12 @@ class PushEngine:
 
     def _dense_update(self, old, red, g):
         """Phase 4 (update): keep improvements, flag the new frontier."""
-        improved = self.program.better(red, old) & g["vmask"]
+        improved = (self.program.better(red, old)
+                    & vmask_of(g, self.sg.vpad))
         return jnp.where(improved, red, old), improved
 
     _DENSE_KEYS = ("src_slot", "dst_local", "weight", "rel_dst",
-                   "chunk_start", "last_chunk", "chunk_tile", "vmask",
+                   "chunk_start", "last_chunk", "chunk_tile", "nvp",
                    "deg", "pair_rowbind", "pair_rel", "pair_weight",
                    "pair_tile_pos")
 
@@ -331,7 +333,7 @@ class PushEngine:
         else:
             new_label, improved, done = jax.vmap(relax_part)(
                 label, g["src_ids"], g["src_off"], g["ss_dst"], ssw)
-        improved = improved & g["vmask"]
+        improved = improved & vmask_of(g, sg.vpad)
 
         # 4. clear the globally-agreed processed prefix of the queue;
         #    everything else stays active (truncation safety).

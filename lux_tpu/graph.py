@@ -537,10 +537,14 @@ class ShardedGraph:
         return out
 
     def memory_report(self) -> dict:
-        """HBM bytes needed per part — the analogue of the reference's
-        startup memory advisor (reference pagerank.cc:60-85)."""
-        edge_bytes = self.epad * (4 + 4 + (4 if self.weighted else 0))
-        vert_bytes = self.vpad * (4 + 4 + 1) + (self.vpad + 1) * 4
+        """HBM bytes for the default TILED engine layout per part —
+        the analogue of the reference's startup memory advisor
+        (reference pagerank.cc:60-85).  (The flat oracle layout ships
+        int32 dst_local instead of int16 rel, +2 B/edge.)"""
+        # src_slot int32 + rel_dst int16 (+ f32 weights)
+        edge_bytes = self.epad * (4 + 2 + (4 if self.weighted else 0))
+        # state f32 + deg int32 (vmask derives from a scalar on device)
+        vert_bytes = self.vpad * (4 + 4)
         return {
             "num_parts": self.num_parts,
             "edge_bytes_per_part": edge_bytes,

@@ -8,10 +8,14 @@ fetch fence).
 
 Usage:
   PYTHONPATH=/root/repo:/root/.axon_site \
-      python scripts/bench_bigscale.py [scale=25] [np=4] [pair=0] [ni=3]
+      python scripts/bench_bigscale.py [scale=25] [np=4] [pair=0] [ni=3] \
+                                       [tile_e=0]
 
 pair > 0 additionally runs graph.pair_relabel + pair-lane delivery
-(slower host prep; measures the fast path at scale).
+(slower host prep; measures the fast path at scale).  tile_e=0 uses
+the engine default (512; 128 for the pair residual); bigger values
+halve the [P, C, 128] partials temporary but grow per-tile chunk
+padding — measured NET WORSE at RMAT26 (PERF_NOTES).
 """
 
 from __future__ import annotations
@@ -35,6 +39,7 @@ def main():
     np_parts = int(sys.argv[2]) if len(sys.argv) > 2 else 4
     pair = int(sys.argv[3]) if len(sys.argv) > 3 else 0
     ni = int(sys.argv[4]) if len(sys.argv) > 4 else 3
+    tile_e = int(sys.argv[5]) if len(sys.argv) > 5 else 0
 
     import os
 
@@ -64,7 +69,8 @@ def main():
 
     eng = pagerank.build_engine(g, num_parts=np_parts,
                                 pair_threshold=pair or None,
-                                starts=starts)
+                                starts=starts,
+                                tile_e=tile_e or None)
     rep = eng.sg.memory_report()
     t = log("build_engine", t,
             vpad=eng.sg.vpad, epad=eng.sg.epad,
