@@ -35,8 +35,9 @@ from jax.sharding import PartitionSpec
 from lux_tpu.engine.program import PartCtx, PullProgram, vmask_of
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
-from lux_tpu.ops.tiled import (TiledLayout, combine_chunks,
-                               combine_op, tiled_segment_reduce)
+from lux_tpu.ops.tiled import (STREAM_MSG_BYTES, TiledLayout,
+                               combine_chunks, combine_op,
+                               tiled_segment_reduce)
 from lux_tpu.parallel.mesh import PARTS_AXIS, shard_over_parts
 
 
@@ -45,13 +46,6 @@ from lux_tpu.parallel.mesh import PARTS_AXIS, shard_over_parts
 # on v5e, within 3% of every size from 32 up)
 DOT_BLOCK_CHUNKS = 128
 
-# Stream the per-edge gather + chunk partials through lax.map blocks
-# once a part's edge messages would exceed this many bytes — the [C, E]
-# f32 temporary is what OOMs billion-edge single-chip runs (RMAT26 np8:
-# 16.9 GB asked of 15.75; see PERF_NOTES).  Small runs keep the fully
-# fused form.
-STREAM_MSG_BYTES = 1 << 30
-STREAM_BLOCK_CHUNKS = 1024
 
 
 def resolve_reduce_method(method: str) -> str:
@@ -275,71 +269,37 @@ class PullEngine:
                 interpret=self.reduce_method == "pallas-interpret")
         return self._combine_pairs(flat_state, red, g)
 
-    def _part_partials_streamed(self, flat_state, g):
-        """Gather + message + chunk partials in lax.map blocks over the
-        chunk axis -> [C, W] partials, bounding the [C, E] temporaries
-        that OOM billion-edge runs (needs_dst=False programs; the dot
-        path has its own blocking)."""
-        prog, lay = self.program, self.tiles
-        C, E = lay.n_chunks, lay.E
-        B = max(8, min(STREAM_BLOCK_CHUNKS, C))
-        nB, rem = divmod(C, B)
-        use_pallas = self.reduce_method.startswith("pallas")
-
-        def partial_block(src_b, rel_b, w_b):
-            vals = jnp.take(flat_state, src_b, axis=0)
-            msgs = prog.edge_value(vals, None, w_b)
-            if use_pallas and msgs.ndim == 2:   # scalar payloads only
-                from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
-                # the kernel's [bc, E, W] masked intermediate must fit
-                # scoped VMEM (~16 MB): bc=64 fits E<=128 (pair-residual
-                # tile_e), E=512 needs bc=8
-                bc = 64 if E * 64 * lay.W * 4 <= (8 << 20) else 8
-                return chunk_partials_pallas(
-                    msgs, rel_b, lay.W, prog.reduce,
-                    block_c=bc if msgs.shape[0] % bc == 0 else 8,
-                    interpret=self.reduce_method == "pallas-interpret")
-            from lux_tpu.ops.tiled import chunk_partials
-            msgs = jax.lax.optimization_barrier(msgs)
-            return chunk_partials(msgs, rel_b, lay.W, prog.reduce,
-                                  use_mxu=self.use_mxu)
-
-        wgt = g.get("weight")
-        parts = []
-        if nB:
-            def seg(x):
-                return x[:nB * B].reshape((nB, B) + x.shape[1:])
-
-            xs = (seg(g["src_slot"]), seg(g["rel_dst"])) + \
-                (() if wgt is None else (seg(wgt),))
-            blocks = jax.lax.map(
-                lambda x: partial_block(x[0], x[1],
-                                        x[2] if len(x) > 2 else None),
-                xs)                       # [nB, B, W, ...]
-            parts.append(blocks.reshape((nB * B,) + blocks.shape[2:]))
-        if rem:
-            parts.append(partial_block(
-                g["src_slot"][nB * B:], g["rel_dst"][nB * B:],
-                None if wgt is None else wgt[nB * B:]))
-        return jnp.concatenate(parts, axis=0)
-
     def _combine_pairs(self, flat_state, red, g):
         if self.pairs is not None:
             red = combine_op(self.program.reduce)(
                 red, self._pair_red(flat_state, g))
         return red
 
+    @property
+    def _streams(self) -> bool:
+        return (self.stream_chunks and self.tiles is not None
+                and not self.program.needs_dst)
+
+    def _part_red_streamed(self, flat_state, g):
+        """Gather + message + partials in chunk blocks (ops/tiled.
+        streamed_chunk_partials), combined to [vpad] with the pair
+        contribution — the billion-edge form of gather+reduce."""
+        from lux_tpu.ops.tiled import (combine_partials,
+                                       streamed_chunk_partials)
+        prog, sg, lay = self.program, self.sg, self.tiles
+        partials = streamed_chunk_partials(
+            flat_state, g["src_slot"], g["rel_dst"], g.get("weight"),
+            lay, prog.reduce,
+            lambda vals, w: prog.edge_value(vals, None, w),
+            self.reduce_method, use_mxu=self.use_mxu)
+        red = combine_partials(partials, lay, g["chunk_start"],
+                               g["last_chunk"], sg.vpad, prog.reduce)
+        return self._combine_pairs(flat_state, red, g)
+
     def _part_step(self, flat_state, old_p, g):
         """g: dict of this part's graph arrays."""
-        prog, sg, lay = self.program, self.sg, self.tiles
-        if (self.stream_chunks and lay is not None
-                and not prog.needs_dst):
-            from lux_tpu.ops.tiled import combine_partials
-            partials = self._part_partials_streamed(flat_state, g)
-            red = combine_partials(partials, lay, g["chunk_start"],
-                                   g["last_chunk"], sg.vpad,
-                                   prog.reduce)
-            red = self._combine_pairs(flat_state, red, g)
+        if self._streams:
+            red = self._part_red_streamed(flat_state, g)
             return self._apply_epilogue(old_p, red, g)
         msgs = self._part_msgs(flat_state, old_p, g)
         red = self._part_reduce(flat_state, msgs, g)
@@ -567,21 +527,40 @@ class PullEngine:
                 lambda m, gp: self._part_reduce(flat, m, gp))(msgs, g)
             return red, cksum(red)
 
+        def gather_reduce(flat, state, *gargs):
+            # the streamed step fuses gather+message+reduce per chunk
+            # block — instrument it as ONE phase so the report reflects
+            # what the compiled step actually runs (and stays within
+            # the memory bound streaming exists for)
+            g = dict(zip(keys, gargs))
+            red = jax.vmap(
+                lambda gp: self._part_red_streamed(flat, gp))(g)
+            return red, cksum(red)
+
         def apply(state, red, *gargs):
             g = dict(zip(keys, gargs))
             new = jax.vmap(self._apply_epilogue)(state, red, g)
             return new, cksum(new)
 
-        fns = dict(exchange=exchange, gather=gather, reduce=reduce,
-                   apply=apply)
+        if self._streams:
+            fns = dict(exchange=exchange, gather_reduce=gather_reduce,
+                       apply=apply)
+            specs = dict(exchange=((0,), 1), gather_reduce=((1, 0), 0),
+                         apply=((0, 0), 0))
+        else:
+            fns = dict(exchange=exchange, gather=gather, reduce=reduce,
+                       apply=apply)
+            specs = dict(exchange=((0,), 1), gather=((1, 0), 0),
+                         reduce=((1, 0), 0), apply=((0, 0), 0))
         if self.mesh is not None:
             P = PartitionSpec
             S, R = P(PARTS_AXIS), P()
             wrap = mesh_wrap(self.mesh, len(keys), S, R)
-            fns = dict(exchange=wrap(exchange, (S,), R),
-                       gather=wrap(gather, (R, S), S),
-                       reduce=wrap(reduce, (R, S), S),
-                       apply=wrap(apply, (S, S), S))
+            fns = {name: wrap(fn,
+                              tuple(R if r else S
+                                    for r in specs[name][0]),
+                              R if specs[name][1] else S)
+                   for name, fn in fns.items()}
         return {k: jax.jit(f) for k, f in fns.items()}
 
     def timed_phases(self, state, iters: int = 1):
@@ -601,8 +580,12 @@ class PullEngine:
         for _ in range(iters):
             pt = PhaseTimer(fetch)
             flat = pt("exchange", jits["exchange"], state, *gargs)
-            msgs = pt("gather", jits["gather"], flat, state, *gargs)
-            red = pt("reduce", jits["reduce"], flat, msgs, *gargs)
+            if "gather_reduce" in jits:   # streamed step: one phase
+                red = pt("gather_reduce", jits["gather_reduce"], flat,
+                         state, *gargs)
+            else:
+                msgs = pt("gather", jits["gather"], flat, state, *gargs)
+                red = pt("reduce", jits["reduce"], flat, msgs, *gargs)
             state = pt("apply", jits["apply"], state, red, *gargs)
             report.append(pt.t)
         return state, report

@@ -91,7 +91,8 @@ class PushEngine:
                  delta: float | None = None,
                  reduce_method: str = "auto",
                  pair_threshold: int | None = None,
-                 pair_stream: bool | None = None):
+                 pair_stream: bool | None = None,
+                 stream_msgs: bool | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -130,7 +131,15 @@ class PushEngine:
                     "pair_threshold requires the tiled layout")
             self.pairs, dense_sg = plan_sharded_pairs(sg, pair_threshold)
         from lux_tpu.ops.pairs import resolve_pair_stream
+        from lux_tpu.ops.tiled import STREAM_MSG_BYTES
         self.pair_stream = resolve_pair_stream(pair_stream, self.pairs)
+        # stream the dense iterations' gather+relax+partials once the
+        # [rows, C, E] candidate temporary passes the budget (same
+        # billion-edge OOM as the pull engine; PERF_NOTES ledger)
+        rows = len(sg.part_ids())
+        self.stream_chunks = (rows * dense_sg.epad * 4 > STREAM_MSG_BYTES
+                              if stream_msgs is None
+                              else bool(stream_msgs))
         dev = jnp.asarray if mesh is None else np.asarray
         arrays, self.tiles = build_graph_arrays(
             dense_sg, layout, needs_dst=False, tile_w=tile_w,
@@ -220,10 +229,29 @@ class PushEngine:
 
     def _dense_red(self, flat_l, cand, g):
         """Phase 3 (reduce): scatter-free segment reduction (+ the
-        pair-lane delivery, which fetches and reduces in one go)."""
+        pair-lane delivery, which fetches and reduces in one go).
+        cand=None: stream gather+relax+partials in chunk blocks
+        (billion-edge memory mode; PERF_NOTES ledger)."""
         sg, prog, lay = self.sg, self.program, self.tiles
         ident_l = jnp.asarray(prog.identity, flat_l.dtype)
-        if lay is None:
+
+        def msg(vals, w):
+            # relax + mask masked-source candidates back to the
+            # identity (shared by the streamed and pair deliveries)
+            c = prog.relax(vals, w)
+            return jnp.where(vals == ident_l,
+                             jnp.asarray(prog.identity, c.dtype), c)
+
+        if cand is None:
+            from lux_tpu.ops.tiled import (combine_partials,
+                                           streamed_chunk_partials)
+            partials = streamed_chunk_partials(
+                flat_l, g["src_slot"], g["rel_dst"], g.get("weight"),
+                lay, prog.reduce, msg, self.reduce_method)
+            red = combine_partials(partials, lay, g["chunk_start"],
+                                   g["last_chunk"], sg.vpad,
+                                   prog.reduce)
+        elif lay is None:
             red = segment_reduce(cand, g["dst_local"], sg.vpad + 1,
                                  prog.reduce)[:sg.vpad]
         else:
@@ -238,11 +266,6 @@ class PushEngine:
             from lux_tpu.ops.pairs import (pair_partial,
                                            pair_partial_streamed)
             from lux_tpu.ops.tiled import combine_op
-
-            def msg(vals, w):
-                c = prog.relax(vals, w)
-                return jnp.where(vals == ident_l,
-                                 jnp.asarray(prog.identity, c.dtype), c)
 
             fn = (pair_partial_streamed if self.pair_stream
                   else pair_partial)
@@ -265,11 +288,16 @@ class PushEngine:
                    "deg", "pair_rowbind", "pair_rel", "pair_weight",
                    "pair_tile_pos")
 
+    @property
+    def _streams(self) -> bool:
+        return self.stream_chunks and self.tiles is not None
+
     def _dense_parts(self, label, active, full_label, full_active, g):
         flat_l = self._dense_flat(full_label, full_active)
+        stream = self._streams
 
         def one(old, g):
-            cand = self._dense_cand(flat_l, g)
+            cand = None if stream else self._dense_cand(flat_l, g)
             red = self._dense_red(flat_l, cand, g)
             return self._dense_update(old, red, g)
 
@@ -590,6 +618,15 @@ class PushEngine:
                 lambda c, gp: self._dense_red(flat_l, c, gp))(cand, g)
             return red, cksum(red)
 
+        def relax_reduce(flat_l, *gargs):
+            # streamed engines fuse gather+relax+partials per chunk
+            # block; instrument it as ONE phase so the report matches
+            # the compiled step (and keeps its memory bound)
+            g = gdict(gargs)
+            red = jax.vmap(
+                lambda gp: self._dense_red(flat_l, None, gp))(g)
+            return red, cksum(red)
+
         def update(label, red, *gargs):
             g = gdict(gargs)
             new, improved = jax.vmap(self._dense_update)(label, red, g)
@@ -600,16 +637,23 @@ class PushEngine:
                 cnt = jax.lax.psum(cnt, PARTS_AXIS)
             return (new, improved), cnt
 
-        fns = dict(exchange=exchange, relax=relax, reduce=reduce,
-                   update=update)
+        streams = self._streams
+        if streams:
+            fns = dict(exchange=exchange, relax_reduce=relax_reduce,
+                       update=update)
+        else:
+            fns = dict(exchange=exchange, relax=relax, reduce=reduce,
+                       update=update)
         if self.mesh is not None:
             P = PartitionSpec
             S, R = P(PARTS_AXIS), P()
             wrap = mesh_wrap(self.mesh, len(keys), S, R)
             fns = dict(exchange=wrap(exchange, (S, S), R),
-                       relax=wrap(relax, (R,), S),
-                       reduce=wrap(reduce, (R, S), S),
-                       update=wrap(update, (S, S), (S, S)))
+                       update=wrap(update, (S, S), (S, S)),
+                       **({"relax_reduce": wrap(relax_reduce, (R,), S)}
+                          if streams else
+                          {"relax": wrap(relax, (R,), S),
+                           "reduce": wrap(reduce, (R, S), S)}))
         return {k: jax.jit(f) for k, f in fns.items()}
 
     def _sparse_mode(self):
@@ -657,8 +701,13 @@ class PushEngine:
                 pt.t = t
                 flat_l = pt("exchange", jits["exchange"], label,
                             active, *gargs)
-                cand = pt("relax", jits["relax"], flat_l, *gargs)
-                red = pt("reduce", jits["reduce"], flat_l, cand, *gargs)
+                if "relax_reduce" in jits:   # streamed: one phase
+                    red = pt("relax_reduce", jits["relax_reduce"],
+                             flat_l, *gargs)
+                else:
+                    cand = pt("relax", jits["relax"], flat_l, *gargs)
+                    red = pt("reduce", jits["reduce"], flat_l, cand,
+                             *gargs)
                 label, active = pt("update", jits["update"], label,
                                    red, *gargs)
                 cnt = int(pt.last_fence)    # update's fence = new count

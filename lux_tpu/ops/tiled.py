@@ -201,6 +201,70 @@ def combine_chunks(partials, layout: TiledLayout, chunk_start, last_chunk,
     return jnp.where(empty, ident, out)
 
 
+# lax.map block size for streamed_chunk_partials (chunks per block)
+STREAM_BLOCK_CHUNKS = 1024
+
+# Engines stream the gather + partials once the [rows, C, E] f32
+# message/candidate temporary would exceed this many bytes — it is
+# what OOMs billion-edge single-chip runs (PERF_NOTES RMAT26 ledger).
+STREAM_MSG_BYTES = 1 << 30
+
+
+def streamed_chunk_partials(flat_state, src_slot, rel_dst, weight,
+                            layout: TiledLayout, kind: str, msg_fn,
+                            reduce_method: str, use_mxu: bool = False,
+                            block_chunks: int = STREAM_BLOCK_CHUNKS):
+    """Gather + message + per-chunk partials for ONE part, streamed in
+    lax.map blocks over the chunk axis -> [C, W, ...] partials.
+
+    Bounds the [C, E] message/gather temporaries that OOM billion-edge
+    single-chip runs (PERF_NOTES RMAT26 ledger).  msg_fn(vals [B, E,
+    ...], weight [B, E]|None) -> messages; dead lanes are masked by
+    rel == W downstream.  Shared by the pull engine's step and the
+    push engine's dense iterations."""
+    C, E, W = layout.n_chunks, layout.E, layout.W
+    B = max(8, min(block_chunks, C))
+    nB, rem = divmod(C, B)
+    use_pallas = reduce_method.startswith("pallas")
+
+    def partial_block(src_b, rel_b, w_b):
+        vals = jnp.take(flat_state, src_b, axis=0)
+        msgs = msg_fn(vals, w_b)
+        if use_pallas and msgs.ndim == 2:   # scalar payloads only
+            from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+            # the kernel's [bc, E, W] masked intermediate must fit
+            # scoped VMEM (~16 MB): bc=64 fits E<=128 (pair-residual
+            # tile_e), E=512 needs bc=8
+            bc = 64 if E * 64 * W * 4 <= (8 << 20) else 8
+            return chunk_partials_pallas(
+                msgs, rel_b, W, kind,
+                block_c=bc if msgs.shape[0] % bc == 0 else 8,
+                interpret=reduce_method == "pallas-interpret")
+        # keep the (serial, expensive) gather out of the W-wide
+        # broadcast consumer on EVERY non-kernel path (see the barrier
+        # note in PullEngine._part_msgs)
+        msgs = jax.lax.optimization_barrier(msgs)
+        return chunk_partials(msgs, rel_b, W, kind, use_mxu=use_mxu)
+
+    parts = []
+    if nB:
+        def seg(x):
+            return x[:nB * B].reshape((nB, B) + x.shape[1:])
+
+        xs = (seg(src_slot), seg(rel_dst)) + \
+            (() if weight is None else (seg(weight),))
+        blocks = jax.lax.map(
+            lambda x: partial_block(x[0], x[1],
+                                    x[2] if len(x) > 2 else None),
+            xs)                           # [nB, B, W, ...]
+        parts.append(blocks.reshape((nB * B,) + blocks.shape[2:]))
+    if rem:
+        parts.append(partial_block(
+            src_slot[nB * B:], rel_dst[nB * B:],
+            None if weight is None else weight[nB * B:]))
+    return jnp.concatenate(parts, axis=0)
+
+
 def combine_partials(partials, layout: TiledLayout, chunk_start,
                      last_chunk, vpad: int, kind: str):
     """Per-chunk partials [C, W, ...] -> flat [vpad, ...] (the shared

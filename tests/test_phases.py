@@ -82,3 +82,28 @@ def test_cli_phases_flag(tmp_path, capsys, graph):
     assert rc == 0
     out = capsys.readouterr().out
     assert "frontier=" in out
+
+
+def test_phases_streamed_engines(graph):
+    """Streamed engines report the fused gather_reduce/relax_reduce
+    phase and still advance state identically."""
+    import numpy as np
+    from lux_tpu.apps import pagerank, sssp
+    from lux_tpu.engine.pull import PullEngine
+    from lux_tpu.engine.push import PushEngine
+    from lux_tpu.graph import ShardedGraph
+
+    eng = PullEngine(ShardedGraph.build(graph, 2),
+                     pagerank.make_program(), stream_msgs=True)
+    want = eng.run(eng.init_state(), 2, fused=False)
+    state, rep = eng.timed_phases(eng.init_state(), 2)
+    np.testing.assert_allclose(np.asarray(state), np.asarray(want),
+                               rtol=1e-6)
+    assert set(rep[0]) == {"exchange", "gather_reduce", "apply"}
+
+    p = PushEngine(ShardedGraph.build(graph, 2), sssp.make_program(0),
+                   enable_sparse=False, stream_msgs=True)
+    label, active = p.init_state()
+    label, active, rep = p.timed_phases(label, active, 2)
+    assert set(rep[0]) == {"frontier", "exchange", "relax_reduce",
+                           "update"}
