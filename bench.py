@@ -13,15 +13,22 @@ vs_baseline is against the north-star target of 1 GTEPS/chip
 comparability (degree relabel, pair-lane threshold, partitions) is
 recorded in the line.
 
-Configs (-config; default "pagerank" is what the driver records):
+Variance discipline: the tunnel's run-to-run spread (0.095-0.127 on
+identical binaries, PERF_NOTES) exceeds a whole round's optimization
+gains, so every config runs the TIMED REGION ``-repeats`` times
+(default 3; build/compile excluded) and reports the MEDIAN, with the
+per-repeat samples recorded in the JSON line.
+
+Configs (-config runs one):
   pagerank        PageRank, pull model, fixed iterations   (BASELINE #1/#4)
   cc              Connected Components, push, to convergence (BASELINE #2)
   sssp            SSSP/BFS hops, push, to convergence        (BASELINE #3)
   sssp-delta      weighted SSSP, delta-stepping frontier     (BASELINE #3)
   colfilter       SGD matrix factorization, weighted pull    (BASELINE #5)
 
--all runs every config (one JSON line each, pagerank LAST so a
-line-parsing driver still records the headline metric).
+By DEFAULT every config runs (one JSON line each, pagerank LAST so a
+line-parsing driver still records the headline metric as its tail
+line).
 """
 
 from __future__ import annotations
@@ -30,6 +37,7 @@ import argparse
 import json
 import sys
 import time
+from statistics import median
 
 # The same preprocessing is applied at EVERY partition count so
 # single-chip and multi-chip GTEPS stay apples-to-apples (round-1
@@ -70,28 +78,43 @@ def _print_coverage(args, eng):
         print(f"# pair-lane coverage {cov * 100:.1f}%", file=sys.stderr)
 
 
-def bench_fused(eng, ne, ni, verbose):
+def bench_fused(eng, ne, ni, verbose, repeats):
+    """GTEPS samples over ``repeats`` timed fused runs (ONE warmup/
+    compile up front inside timed_fused_run; each repeat re-times only
+    the fused loop)."""
     import numpy as np
 
     from lux_tpu.timing import timed_fused_run
 
     t0 = time.perf_counter()
-    state, elapsed = timed_fused_run(eng, ni)
+    state, elapsed = timed_fused_run(eng, ni, repeats=repeats)
     if verbose:
-        print(f"# ran ({time.perf_counter() - t0:.1f}s total, "
-              f"{elapsed:.2f}s timed)", file=sys.stderr)
+        times = " ".join(f"{e:.2f}s" for e in elapsed)
+        print(f"# {repeats} timed runs ({time.perf_counter() - t0:.1f}s"
+              f" total): {times}", file=sys.stderr)
     # the benched result must be sane, or the GTEPS line is meaningless
     assert np.isfinite(eng.unpad(state)).all(), "non-finite bench result"
-    return ne * ni / elapsed
+    return [ne * ni / e for e in elapsed]
+
+
+def bench_converge(eng, ne, verbose, repeats):
+    """GTEPS samples over ``repeats`` timed whole-run converges."""
+    from lux_tpu.timing import timed_converge
+
+    labels, iters, elapsed = timed_converge(eng, repeats=repeats)
+    if verbose:
+        times = " ".join(f"{e:.2f}s" for e in elapsed)
+        print(f"# converged in {iters} iterations; {repeats} timed "
+              f"runs: {times}", file=sys.stderr)
+    return [ne * iters / e for e in elapsed]
 
 
 def run_config(config, args):
-    """Returns (gteps, extra json fields)."""
+    """Returns (name, gteps samples list, extra json fields)."""
     pair_t = args.pair if args.pair > 0 else None
     import numpy as np
 
     from lux_tpu.graph import pair_relabel
-    from lux_tpu.timing import timed_converge
 
     scale = args.scale or DEFAULT_SHAPE[config][0]
     ef = args.ef or DEFAULT_SHAPE[config][1]
@@ -106,7 +129,8 @@ def run_config(config, args):
                                     starts=starts)
         extra.update(relabel=True, pair_threshold=pair_t)
         _print_coverage(args, eng)
-        gteps = bench_fused(eng, g.ne, args.ni, args.verbose) / 1e9
+        samples = bench_fused(eng, g.ne, args.ni, args.verbose,
+                              args.repeats)
         name = f"pagerank_rmat{scale}"
     elif config == "colfilter":
         from lux_tpu.apps import colfilter
@@ -122,7 +146,8 @@ def run_config(config, args):
             eng = colfilter.build_engine(g, num_parts=args.np)
             extra.update(relabel=False, pair_threshold=None)
         _print_coverage(args, eng)
-        gteps = bench_fused(eng, g.ne, args.ni, args.verbose) / 1e9
+        samples = bench_fused(eng, g.ne, args.ni, args.verbose,
+                              args.repeats)
         name = f"colfilter_rmat{scale}"
     else:
         from lux_tpu.apps import components, sssp
@@ -153,32 +178,33 @@ def run_config(config, args):
             extra.update(relabel=True, pair_threshold=pair_t,
                          delta="auto" if weighted else None)
         _print_coverage(args, eng)
-        labels, iters, elapsed = timed_converge(eng)
-        if args.verbose:
-            print(f"# converged in {iters} iterations, {elapsed:.2f}s",
-                  file=sys.stderr)
-        gteps = g.ne * iters / elapsed / 1e9
+        samples = bench_converge(eng, g.ne, args.verbose, args.repeats)
         name = f"{config.replace('-', '_')}_rmat{scale}"
-    return name, gteps, extra
+    return name, [s / 1e9 for s in samples], extra
 
 
-def emit(name, gteps, extra):
+def emit(name, samples, extra):
+    gteps = median(samples)
     result = {
         "metric": f"{name}_gteps_per_chip",
         "value": round(gteps, 4),
         "unit": "GTEPS",
         "vs_baseline": round(gteps / 1.0, 4),
+        "samples": [round(s, 4) for s in samples],
         **extra,
     }
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("-config", default="pagerank",
-                    choices=list(DEFAULT_SHAPE))
+    ap.add_argument("-config", default=None,
+                    choices=list(DEFAULT_SHAPE),
+                    help="run ONE config (default: all five, "
+                         "pagerank last)")
     ap.add_argument("-all", action="store_true",
-                    help="run every config (pagerank last)")
+                    help="run every config (pagerank last; the "
+                         "default when -config is not given)")
     ap.add_argument("-scale", type=int, default=0,
                     help="RMAT scale (nv = 2**scale; 0 = per-config "
                          "default)")
@@ -189,14 +215,21 @@ def main() -> int:
     ap.add_argument("-np", type=int, default=1, help="partitions")
     ap.add_argument("-pair", type=int, default=PAIR_THRESHOLD,
                     help="pair-lane threshold (0 disables)")
+    ap.add_argument("-repeats", type=int, default=3,
+                    help="timed repeats per config; the JSON line "
+                         "reports the median (tunnel variance exceeds "
+                         "round-over-round gains, PERF_NOTES)")
     ap.add_argument("-verbose", action="store_true")
     args = ap.parse_args()
+    if args.repeats < 1:
+        ap.error("-repeats must be >= 1")
 
-    configs = (["cc", "sssp", "sssp-delta", "colfilter", "pagerank"]
-               if args.all else [args.config])
+    configs = ([args.config] if args.config and not args.all
+               else ["cc", "sssp", "sssp-delta", "colfilter",
+                     "pagerank"])
     for config in configs:
-        name, gteps, extra = run_config(config, args)
-        emit(name, gteps, extra)
+        name, samples, extra = run_config(config, args)
+        emit(name, samples, extra)
     return 0
 
 

@@ -159,8 +159,8 @@ def cmd_pagerank(argv):
               f"residual {res:.3e})")
         print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
     else:
-        state, elapsed = timed_fused_run(eng, args.ni,
-                                         trace_dir=args.profile)
+        state, [elapsed] = timed_fused_run(eng, args.ni,
+                                           trace_dir=args.profile)
         print(f"ELAPSED TIME = {elapsed:.7f} s")
         print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
 
@@ -216,8 +216,8 @@ def _push_app(argv, prog_name):
         eng = components.build_engine(g_run, num_parts=num_parts,
                                       mesh=mesh, sg=sg,
                                       pair_threshold=args.pair)
-    labels, iters, elapsed = timed_converge(eng, verbose=args.verbose,
-                                            trace_dir=args.profile)
+    labels, iters, [elapsed] = timed_converge(
+        eng, verbose=args.verbose, trace_dir=args.profile)
     print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
     print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
 
@@ -268,8 +268,8 @@ def cmd_colfilter(argv):
     sg = _build_sg(args, g_run, num_parts, starts)
     eng = colfilter.build_engine(g_run, num_parts, mesh, sg=sg,
                                  pair_threshold=args.pair)
-    state, elapsed = timed_fused_run(eng, args.ni,
-                                     trace_dir=args.profile)
+    state, [elapsed] = timed_fused_run(eng, args.ni,
+                                       trace_dir=args.profile)
     print(f"ELAPSED TIME = {elapsed:.7f} s")
     print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
     out = eng.unpad(state)

@@ -631,8 +631,11 @@ class PushEngine:
             g = gdict(gargs)
             new, improved = jax.vmap(self._dense_update)(label, red, g)
             # fence doubles as the NEW global frontier count (psum'd
-            # under the mesh wrap's pmin — identical on every device)
-            cnt = jnp.sum(improved.astype(jnp.float32))
+            # under the mesh wrap's pmin — identical on every device).
+            # int32 keeps it exact past 2^24 active vertices (float32
+            # would round, misreporting 'frontier' and possibly the
+            # next iteration's sparse/dense classification)
+            cnt = jnp.sum(improved.astype(jnp.int32))
             if self.mesh is not None:
                 cnt = jax.lax.psum(cnt, PARTS_AXIS)
             return (new, improved), cnt

@@ -432,6 +432,10 @@ class ShardedGraph:
             max_deg = 0
             for r, p in enumerate(self.part_ids()):
                 nep = int(self.ne_part[p])
+                # the compressed index narrows edge offsets to int32
+                # (src_off, and the cumsum'd off in expand_frontier);
+                # safe because nep <= epad and build() rejects epad >=
+                # int32 max (the ValueError guard in ShardedGraph.build)
                 # global src of each real edge: src_slot is part-major
                 # slot; invert the slot translation
                 slot = self.src_slot[r, :nep].astype(np.int64)

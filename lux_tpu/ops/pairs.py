@@ -591,8 +591,10 @@ def pair_partial_streamed(sp: StackedPairPlan, flat_state, rowbind, rel,
         outs.append(jnp.concatenate(cls_out, axis=0))
         row0 += cnt * L
     # identity slot in the MESSAGE dtype (msg_fn may promote), exactly
-    # like pair_partial's partials-dtype identity
-    out_dtype = outs[0].dtype
+    # like pair_partial's partials-dtype identity; with zero classes
+    # (plan_sharded_pairs normally returns None first) fall back to
+    # the state dtype so the identity take still works
+    out_dtype = outs[0].dtype if outs else flat_state.dtype
     ident = identity_for(kind, out_dtype)
     outs.append(jnp.full((1, W), ident, out_dtype))
     slots = jnp.concatenate(outs, axis=0)              # [n_slots+1, W]

@@ -26,44 +26,50 @@ def _trace_ctx(trace_dir):
     return trace(trace_dir)
 
 
-def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None):
-    """Warm up a pull engine with the SAME static iteration count
+def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None,
+                    repeats: int = 1):
+    """Warm up a pull engine ONCE with the SAME static iteration count
     (num_iters is a static jit arg — a different count would recompile
-    inside the timed region), then time a fresh fused run.  When
-    trace_dir is set, a profiler trace captures ONLY the timed run
-    (warmup and compilation are excluded).
+    inside the timed region), then time ``repeats`` fresh fused runs.
+    When trace_dir is set, a profiler trace captures ONLY the timed
+    runs (warmup and compilation are excluded).
 
-    Returns (final_state, elapsed_seconds).
+    Returns (final_state, [elapsed_seconds per repeat]).
     """
     state = eng.init_state()
     state = eng.run(state, num_iters)
     fetch(state)
-    state = eng.init_state()
+    elapsed = []
     with _trace_ctx(trace_dir):
-        t0 = time.perf_counter()
-        state = eng.run(state, num_iters)
-        fetch(state)
-        elapsed = time.perf_counter() - t0
+        for _ in range(repeats):
+            state = eng.init_state()
+            t0 = time.perf_counter()
+            state = eng.run(state, num_iters)
+            fetch(state)
+            elapsed.append(time.perf_counter() - t0)
     return state, elapsed
 
 
 def timed_converge(eng, max_iters=None, verbose: bool = False,
-                   trace_dir: str | None = None):
-    """Warm up a push engine's converge program (printing per-iteration
-    frontier sizes during the warmup pass when verbose), then time a
-    fresh whole-run converge; a trace_dir captures only the timed run.
-    Returns (labels, iters, elapsed)."""
+                   trace_dir: str | None = None, repeats: int = 1):
+    """Warm up a push engine's converge program ONCE (printing
+    per-iteration frontier sizes during the warmup pass when verbose),
+    then time ``repeats`` fresh whole-run converges; a trace_dir
+    captures only the timed runs.
+    Returns (labels, iters, [elapsed_seconds per repeat])."""
     if verbose:
         eng.run(max_iters=max_iters, verbose=True)   # stepwise, printed
     label, active = eng.init_state()
     l2, a2, _ = eng.converge(label, active, max_iters)  # compile
     fetch(l2)
-    label, active = eng.init_state()
+    elapsed = []
     with _trace_ctx(trace_dir):
-        t0 = time.perf_counter()
-        label, active, iters = eng.converge(label, active, max_iters)
-        iters = int(fetch(iters))
-        elapsed = time.perf_counter() - t0
+        for _ in range(repeats):
+            label, active = eng.init_state()
+            t0 = time.perf_counter()
+            label, active, iters = eng.converge(label, active, max_iters)
+            iters = int(fetch(iters))
+            elapsed.append(time.perf_counter() - t0)
     return eng.unpad(label), iters, elapsed
 
 

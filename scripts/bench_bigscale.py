@@ -78,7 +78,7 @@ def main():
             pair_cov=(round(eng.pairs.stats["coverage"], 3)
                       if eng.pairs is not None else None))
 
-    state, elapsed = timed_fused_run(eng, ni)
+    state, [elapsed] = timed_fused_run(eng, ni)
     out = eng.unpad(state)
     assert np.isfinite(out).all(), "non-finite result"
     gteps = g.ne * ni / elapsed / 1e9

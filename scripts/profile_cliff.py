@@ -30,7 +30,7 @@ def main():
         print(f"# np={np_parts} build {time.time() - t0:.0f}s "
               f"vpad={eng.sg.vpad} epad={eng.sg.epad} "
               f"C={eng.tiles.n_chunks}", flush=True)
-        state, elapsed = timed_fused_run(eng, 3)
+        state, [elapsed] = timed_fused_run(eng, 3)
         assert np.isfinite(eng.unpad(state)).all()
         per_edge = elapsed / 3 / g.ne * 1e9
         print(f"np={np_parts}: {elapsed / 3 * 1e3:.0f} ms/iter  "
