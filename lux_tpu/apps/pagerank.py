@@ -48,18 +48,22 @@ def make_program(dtype=jnp.float32) -> PullProgram:
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  dtype=jnp.float32, sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
-                 starts=None, tile_e: int | None = None) -> PullEngine:
+                 starts=None, tile_e: int | None = None,
+                 exchange: str = "gather") -> PullEngine:
     """starts: partition cut points (e.g. from graph.pair_relabel for
     balanced multi-part pair delivery).  tile_e default: 128 with pair
     delivery (residual edges are sparse; shorter chunks waste far
-    fewer padded gather slots), else 512."""
+    fewer padded gather slots), else 512.  exchange='owner' switches
+    to owner-side message generation (ops/owner.py) — the fast path
+    once the state table outgrows ~64 MB."""
     if sg is None:
         sg = ShardedGraph.build(g, num_parts, starts=starts,
                                 pair_threshold=pair_threshold)
     if tile_e is None:
         tile_e = 128 if pair_threshold is not None else 512
     return PullEngine(sg, make_program(dtype), mesh=mesh,
-                      pair_threshold=pair_threshold, tile_e=tile_e)
+                      pair_threshold=pair_threshold, tile_e=tile_e,
+                      exchange=exchange)
 
 
 

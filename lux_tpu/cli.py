@@ -50,6 +50,14 @@ def _common(ap: argparse.ArgumentParser):
                          "per-vertex results are mapped back to input "
                          "ids where printed; colfilter's edge-wise "
                          "RMSE/check need no mapping)")
+    ap.add_argument("-exchange", default="gather",
+                    choices=["gather", "owner"],
+                    help="pull-engine state exchange: 'gather' "
+                         "(all-gather + per-edge gather from the full "
+                         "table) or 'owner' (per-source-part gathers "
+                         "from own shards + reduce_scatter; the fast "
+                         "path once state outgrows ~64 MB — "
+                         "PERF_NOTES.md; pagerank only for now)")
     ap.add_argument("-phases", type=int, default=0, metavar="N",
                     help="after the timed run, run N instrumented "
                          "iterations and print the per-iteration "
@@ -96,6 +104,13 @@ def _print_phases(report):
         split = "  ".join(f"{k}={v * 1e3:7.2f}ms" for k, v in t.items()
                           if k != "frontier")
         print(f"iter {i}:{extra}  {split}")
+
+
+def _warn_exchange_ignored(args):
+    """-exchange is a pull-engine (pagerank) knob for now."""
+    if args.exchange != "gather":
+        print(f"note: -exchange {args.exchange} applies to the pull "
+              f"engine (pagerank) only; ignored here")
 
 
 def _relabel_for_pairs(args, g, num_parts):
@@ -150,7 +165,8 @@ def cmd_pagerank(argv):
     g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
     sg = _build_sg(args, g_run, num_parts, starts)
     eng = pagerank.build_engine(g_run, num_parts, mesh, sg=sg,
-                                pair_threshold=args.pair)
+                                pair_threshold=args.pair,
+                                exchange=args.exchange)
     if args.tol is not None:
         from lux_tpu.timing import timed_run_until
         state, iters, res, elapsed = timed_run_until(
@@ -195,6 +211,7 @@ def _push_app(argv, prog_name):
     from lux_tpu.apps import components, sssp
 
     weighted = prog_name == "sssp" and args.weighted
+    _warn_exchange_ignored(args)
     g = _load(args, weighted=weighted)
     mesh, num_parts = _mesh_and_parts(args)
     g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
@@ -262,6 +279,7 @@ def cmd_colfilter(argv):
 
     from lux_tpu.apps import colfilter
 
+    _warn_exchange_ignored(args)
     g = _load(args, weighted=True)
     mesh, num_parts = _mesh_and_parts(args)
     g_run, _perm, starts = _relabel_for_pairs(args, g, num_parts)

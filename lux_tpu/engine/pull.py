@@ -60,6 +60,17 @@ def resolve_reduce_method(method: str) -> str:
     raise ValueError(f"unknown reduce_method {method!r}")
 
 
+def common_graph_arrays(sg: ShardedGraph, dev):
+    """deg + nvp, the apply-epilogue arrays every layout needs.  The
+    valid-vertex mask is DERIVED on device from the per-part counts
+    (iota < nvp, see program.vmask_of's [rows, 1] int32 convention)
+    instead of shipping a [rows, vpad] bool array — 68 MB of the
+    RMAT26 single-chip fit (PERF_NOTES)."""
+    return dict(deg=dev(sg.deg_padded),
+                nvp=dev(sg.nv_part[sg.part_ids()].astype(
+                    np.int32)[:, None]))
+
+
 def build_graph_arrays(sg: ShardedGraph, layout: str, needs_dst: bool,
                        tile_w: int, tile_e: int, device: bool = True):
     """Per-part graph arrays (all leading dim num_parts) for either
@@ -69,12 +80,7 @@ def build_graph_arrays(sg: ShardedGraph, layout: str, needs_dst: bool,
     with ``shard_over_parts`` directly (one H2D per shard), instead of
     staging everything through the default device first."""
     dev = jnp.asarray if device else np.asarray
-    # the valid-vertex mask is DERIVED on device from the per-part
-    # counts (iota < nvp) instead of shipping a [rows, vpad] bool
-    # array — 68 MB of the RMAT26 single-chip fit (PERF_NOTES)
-    common = dict(deg=dev(sg.deg_padded),
-                  nvp=dev(sg.nv_part[sg.part_ids()].astype(
-                      np.int32)[:, None]))
+    common = common_graph_arrays(sg, dev)
     if layout == "flat":
         arrays = dict(src_slot=dev(sg.src_slot),
                       dst_local=dev(sg.dst_local), **common)
@@ -113,12 +119,29 @@ class PullEngine:
                  reduce_method: str = "auto",
                  pair_threshold: int | None = None,
                  pair_stream: bool | None = None,
-                 stream_msgs: bool | None = None):
+                 stream_msgs: bool | None = None,
+                 exchange: str = "gather",
+                 owner_tile_e: int = 256):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
                 f"{mesh.devices.size}")
+        if exchange not in ("gather", "owner"):
+            raise ValueError(f"unknown exchange {exchange!r}")
+        if exchange == "owner" and (
+                program.needs_dst
+                or program.edge_value_from_dot is not None):
+            raise ValueError(
+                "exchange='owner' supports programs whose edge_value "
+                "depends only on the source state (owner-side parts "
+                "hold no destination state)")
         _check_local_parts(sg, mesh, pair_threshold)
+        if exchange == "owner" and sg.local_parts is not None:
+            raise NotImplementedError(
+                "owner exchange is not yet supported with per-host "
+                "local-parts builds (the layout needs every part's "
+                "edges)")
+        self.exchange = exchange
         self.pairs = None
         if pair_threshold is not None:
             sg = self._setup_pairs(sg, pair_threshold, mesh, layout,
@@ -149,10 +172,25 @@ class PullEngine:
         self.use_mxu = use_mxu
         self.reduce_method = resolve_reduce_method(reduce_method)
         dev = jnp.asarray if mesh is None else np.asarray
-        arrays, self.tiles = build_graph_arrays(
-            sg, layout,
-            program.needs_dst or program.edge_value_from_dot is not None,
-            tile_w, tile_e, device=mesh is None)
+        if exchange == "owner":
+            from lux_tpu.ops.owner import OwnerLayout
+            self.owner = OwnerLayout.build(sg, E=owner_tile_e)
+            self.tiles = None
+            arrays = dict(
+                **common_graph_arrays(sg, dev),
+                own_src=dev(self.owner.src_local),
+                own_rel=dev(self.owner.rel_dst),
+                own_cs=dev(self.owner.chunk_start),
+                own_lc=dev(self.owner.last_chunk))
+            if self.owner.weight is not None:
+                arrays["own_w"] = dev(self.owner.weight)
+        else:
+            self.owner = None
+            arrays, self.tiles = build_graph_arrays(
+                sg, layout,
+                program.needs_dst
+                or program.edge_value_from_dot is not None,
+                tile_w, tile_e, device=mesh is None)
         if self.pairs is not None:
             arrays["pair_rowbind"] = dev(self.pairs.rowbind)
             arrays["pair_rel"] = dev(self.pairs.rel_dst)
@@ -235,9 +273,11 @@ class PullEngine:
             if lay is None:
                 dst_idx = jnp.minimum(g["dst_local"], sg.vpad - 1)
             else:
-                dst_idx = jnp.minimum(
+                # pad lanes carry rel -1 (int8 marker): clip keeps the
+                # garbage gather in range; the reduce masks it anyway
+                dst_idx = jnp.clip(
                     g["chunk_tile"][:, None] * lay.W + g["rel_dst"],
-                    sg.vpad - 1)
+                    0, sg.vpad - 1)
             dst_vals = jnp.take(old_p, dst_idx, axis=0)
         else:
             dst_vals = None
@@ -382,6 +422,107 @@ class PullEngine:
         return jax.vmap(lambda old, g: step(flat, old, g))(
             local_state, g_local)
 
+    # -- owner-side exchange (ops/owner.py) ---------------------------
+
+    _OWNER_SCAN_KEYS = ("own_src", "own_rel", "own_cs", "own_lc",
+                        "own_w")
+
+    def _msg_dtype(self, state):
+        """Message dtype without running edge_value (abstract eval)."""
+        probe_w = (jax.ShapeDtypeStruct((1, 1), jnp.float32)
+                   if self.sg.weighted else None)
+        probe_s = jax.ShapeDtypeStruct((1, 1) + state.shape[2:],
+                                       state.dtype)
+        return jax.eval_shape(
+            lambda s, w: self.program.edge_value(s, None, w),
+            probe_s, probe_w).dtype
+
+    def _owner_contribs(self, state_rows, g):
+        """lax.scan over the locally-held SOURCE parts: each step
+        gathers from ONE [vpad] state shard (the scan is what makes
+        the XLA emitter see the small table — a vmapped batched
+        gather still pays the big-table rate, scripts/
+        profile_owner.py) and folds its [G, W] tile partials into the
+        accumulated contribution to every destination part."""
+        from lux_tpu.ops.owner import owner_part_tiles
+        from lux_tpu.ops.segment import identity_for
+
+        sg, prog, lay = self.sg, self.program, self.owner
+        P = sg.num_parts
+        ntw = lay.n_tiles * lay.W
+        comb = combine_op(prog.reduce)
+        skeys = [k for k in self._OWNER_SCAN_KEYS if k in g]
+        xs = (state_rows,) + tuple(g[k] for k in skeys)
+
+        def step(acc, x):
+            st_s, src, rel, cs, lc = x[:5]
+            w = x[5] if len(x) > 5 else None
+            tiles = owner_part_tiles(
+                lay, st_s, src, rel, w, cs, lc, prog.reduce,
+                lambda vals, wt: prog.edge_value(vals, None, wt),
+                self.reduce_method, use_mxu=self.use_mxu)
+            contrib = tiles.reshape((P, ntw) + tiles.shape[2:])
+            return comb(acc, contrib), None
+
+        dt = self._msg_dtype(state_rows)
+        acc0 = jnp.full((P, ntw) + state_rows.shape[2:],
+                        identity_for(prog.reduce, dt), dt)
+        if self.mesh is not None:
+            # the scan folds in device-varying contributions; the
+            # constant initial carry must be marked varying too (VMA)
+            acc0 = jax.lax.pcast(acc0, (PARTS_AXIS,), to="varying")
+        acc, _ = jax.lax.scan(step, acc0, xs)
+        return acc
+
+    def _owner_exchange(self, acc):
+        """Route accumulated contributions [P, ntw, ...] to their
+        destination parts.  Single device: identity (every dst row is
+        local).  Mesh: reduce_scatter over ICI — ``psum_scatter`` for
+        sum, ``all_to_all`` + local combine for min/max (the TPU-
+        native replacement for the whole-region all_gather, reference
+        pull_model.inl:454-461)."""
+        if self.mesh is None:
+            return acc
+        if self.program.reduce == "sum":
+            return jax.lax.psum_scatter(
+                acc, PARTS_AXIS, scatter_dimension=0, tiled=True)
+        recv = jax.lax.all_to_all(acc, PARTS_AXIS, split_axis=0,
+                                  concat_axis=0, tiled=True)
+        ndev = self.mesh.devices.size
+        rows = self.sg.num_parts // ndev
+        red = recv.reshape((ndev, rows) + recv.shape[1:])
+        return {"min": jnp.min, "max": jnp.max}[self.program.reduce](
+            red, axis=0)
+
+    def _owner_apply(self, state_rows, red_rows, flat_state, g):
+        """Pair contribution + apply epilogue, vmapped over the local
+        destination parts.  flat_state (full [P*vpad, ...] table) is
+        None when no pair delivery needs it."""
+
+        def per_part(old_p, red_p, gp):
+            if flat_state is not None:
+                red_p = self._combine_pairs(flat_state, red_p, gp)
+            return self._apply_epilogue(old_p, red_p, gp)
+
+        return jax.vmap(per_part)(state_rows, red_rows, g)
+
+    def _owner_step(self, state, g):
+        """One owner-exchange iteration for the locally-held rows
+        (single device: all parts; under shard_map: this device's)."""
+        sg = self.sg
+        acc = self._owner_contribs(state, g)
+        red = self._owner_exchange(acc)[:, :sg.vpad]
+        flat = None
+        if self.pairs is not None:
+            # pair rows are fetched from the FULL table (row-granular
+            # fetches, not subject to the element-gather big-table
+            # tax); on the mesh the all_gather exists only for them
+            full = (state if self.mesh is None else
+                    jax.lax.all_gather(state, PARTS_AXIS, tiled=True))
+            flat = full.reshape((sg.num_parts * sg.vpad,) +
+                                full.shape[2:])
+        return self._owner_apply(state, red, flat, g)
+
     # -- full step over all parts -------------------------------------
 
     def _build_step(self):
@@ -395,6 +536,26 @@ class PullEngine:
         keys = sorted(self.arrays)
         self._graph_keys = keys
         self.graph_args = tuple(self.arrays[k] for k in keys)
+
+        if self.exchange == "owner":
+            if self.mesh is None:
+                def core(state, *gargs):
+                    return self._owner_step(state,
+                                            dict(zip(keys, gargs)))
+            else:
+                P = PartitionSpec
+
+                @functools.partial(
+                    jax.shard_map, mesh=self.mesh,
+                    in_specs=(P(PARTS_AXIS),) * (1 + len(keys)),
+                    out_specs=P(PARTS_AXIS))
+                def core(state, *gargs):
+                    return self._owner_step(state,
+                                            dict(zip(keys, gargs)))
+
+            self._step_core = core
+            jitted = jax.jit(core, donate_argnums=0)
+            return lambda state: jitted(state, *self.graph_args)
 
         if self.mesh is None:
             def core(state, *gargs):
@@ -507,6 +668,37 @@ class PullEngine:
         keys = self._graph_keys
         sg = self.sg
 
+        if self.exchange == "owner":
+            # owner mode has no separable gather: generation (scan
+            # over source parts, small-shard gathers) and the
+            # reduce_scatter exchange are one fused phase by design
+            def gen_exchange(state, *gargs):
+                g = dict(zip(keys, gargs))
+                acc = self._owner_contribs(state, g)
+                red = self._owner_exchange(acc)[:, :sg.vpad]
+                return red, cksum(red)
+
+            def owner_apply(state, red, *gargs):
+                g = dict(zip(keys, gargs))
+                flat = None
+                if self.pairs is not None:
+                    full = (state if self.mesh is None else
+                            jax.lax.all_gather(state, PARTS_AXIS,
+                                               tiled=True))
+                    flat = full.reshape((sg.num_parts * sg.vpad,) +
+                                        full.shape[2:])
+                new = self._owner_apply(state, red, flat, g)
+                return new, cksum(new)
+
+            fns = dict(gen_exchange=gen_exchange, apply=owner_apply)
+            if self.mesh is not None:
+                P = PartitionSpec
+                S, R = P(PARTS_AXIS), P()
+                wrap = mesh_wrap(self.mesh, len(keys), S, R)
+                fns = dict(gen_exchange=wrap(gen_exchange, (S,), S),
+                           apply=wrap(owner_apply, (S, S), S))
+            return {k: jax.jit(f) for k, f in fns.items()}
+
         def exchange(state, *gargs):
             full = state
             if self.mesh is not None:
@@ -579,6 +771,12 @@ class PullEngine:
         report = []
         for _ in range(iters):
             pt = PhaseTimer(fetch)
+            if "gen_exchange" in jits:    # owner exchange: two phases
+                red = pt("gen_exchange", jits["gen_exchange"], state,
+                         *gargs)
+                state = pt("apply", jits["apply"], state, red, *gargs)
+                report.append(pt.t)
+                continue
             flat = pt("exchange", jits["exchange"], state, *gargs)
             if "gather_reduce" in jits:   # streamed step: one phase
                 red = pt("gather_reduce", jits["gather_reduce"], flat,

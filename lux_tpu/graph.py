@@ -118,7 +118,7 @@ def degree_relabel(g: Graph):
 
 def pair_relabel(g: Graph, num_parts: int = 1,
                  pair_threshold: int = 16, gather_cost: float = 9.0,
-                 pair_cost: float = 2.5):
+                 pair_cost: float = 2.5, vpad_cap: float = 1.2):
     """Degree-sort, then DEAL whole 128-vertex tiles to parts by
     greedy cost balancing (LPT over degree-ordered tiles).
 
@@ -139,6 +139,14 @@ def pair_relabel(g: Graph, num_parts: int = 1,
     tile-aligned, so part-local pair structure equals the global
     tiling): an in-edge in a dense (src-tile, dst-tile) pair costs
     ``pair_cost`` ns, any other ``gather_cost`` ns (PERF_NOTES.md).
+
+    ``vpad_cap`` bounds each part's TILE COUNT at ceil(cap * mean)
+    during the dealing: pure cost-LPT measured a 2.5x vpad blowup at
+    RMAT25/np=4 (state padding, exchange bytes and the owner-side
+    gather's per-shard table size all scale with the WORST part, and
+    a shard past ~64 MB re-enters the big-table gather tax —
+    PERF_NOTES round-3 #3); the cap trades a sliver of cost balance
+    for 2x+ smaller padding.
 
     Returns (relabeled graph, perm, starts) with perm[new] = old and
     ``starts`` the partition cut points to pass to ShardedGraph.build
@@ -173,12 +181,16 @@ def pair_relabel(g: Graph, num_parts: int = 1,
                           gather_cost)
         tile_cost = np.bincount(d2 // Wt, weights=cost_e,
                                 minlength=n_tiles)
+        cap = max(1, int(np.ceil(vpad_cap * full / P)))
         load = np.zeros(P)
+        tiles_held = np.zeros(P, np.int64)
         owner = np.empty(full, np.int64)
-        for t in range(full):                     # LPT greedy
-            p = int(np.argmin(load))
+        for t in range(full):                     # capped LPT greedy
+            masked = np.where(tiles_held < cap, load, np.inf)
+            p = int(np.argmin(masked))
             owner[t] = p
             load[p] += tile_cost[t]
+            tiles_held[p] += 1
         part_tiles = [np.nonzero(owner == p)[0] for p in range(P)]
     else:
         part_tiles = [np.arange(p, full, P) for p in range(P)]
@@ -544,9 +556,9 @@ class ShardedGraph:
         """HBM bytes for the default TILED engine layout per part —
         the analogue of the reference's startup memory advisor
         (reference pagerank.cc:60-85).  (The flat oracle layout ships
-        int32 dst_local instead of int16 rel, +2 B/edge.)"""
-        # src_slot int32 + rel_dst int16 (+ f32 weights)
-        edge_bytes = self.epad * (4 + 2 + (4 if self.weighted else 0))
+        int32 dst_local instead of int8 rel, +3 B/edge.)"""
+        # src_slot int32 + rel_dst int8 (+ f32 weights)
+        edge_bytes = self.epad * (4 + 1 + (4 if self.weighted else 0))
         # state f32 + deg int32 (vmask derives from a scalar on device)
         vert_bytes = self.vpad * (4 + 4)
         return {

@@ -1,0 +1,208 @@
+"""Owner-side (source-part-major) edge layout for the pull exchange.
+
+The pull engine's default exchange makes the FULL vertex state visible
+to every part and gathers per edge from the flattened ``[P*vpad]``
+table — the analogue of the reference's whole-region READ_ONLY
+requirement (reference pull_model.inl:454-461).  Past ~64-128 MB of
+table the XLA gather emitter steps from ~8.8 to ~14.6 ns/elem
+(scripts/profile_bigtable.py; a step, not locality decay — sorted
+indices are WORSE), which capped every round-2 big-graph number at
+~27 ns/edge.
+
+This module flips the exchange to OWNER-SIDE message generation — the
+structural cousin of the reference's per-source-part push processing
+(reference sssp_gpu.cu:422-459, one CUDA stream per source part):
+
+- Edges are re-laid SRC-part-major: each source part's out-edges are
+  sorted by global destination tile (dst part x 128-vertex tile) and
+  chunked exactly like ops/tiled.py, but with ``src_local`` indices
+  into the part's OWN ``[vpad]`` state shard.
+- Each source part gathers only from its own shard (< 64 MB/part at
+  any scale with enough parts) and reduces its messages into
+  per-destination-tile partials ``[G, W]`` — its contribution to
+  EVERY destination part.
+- Contributions combine across source parts: on one chip a
+  ``lax.scan`` accumulates them (measured 7.8-9.1 ns/elem vs 14.7 for
+  both the flat AND the vmapped-batched gather — the scan is what
+  makes the emitter see the small table, scripts/profile_owner.py);
+  on a mesh they ride a ``psum_scatter`` (sum) or ``all_to_all`` +
+  local combine (min/max) over ICI, replacing the per-iteration
+  all_gather entirely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from lux_tpu.ops.tiled import STREAM_MSG_BYTES
+
+
+def _ceil_div(a, b):
+    return -(-a // b)
+
+
+@dataclasses.dataclass
+class OwnerLayout:
+    """Host-side src-part-major chunk plan (stacked over src parts).
+
+    Attribute names n_chunks/E/W/needs_scan match TiledLayout so the
+    shared device helpers (streamed_chunk_partials, combine_chunks)
+    accept either."""
+
+    W: int                      # vertices per destination tile
+    E: int                      # edges per chunk
+    n_tiles: int                # dst tiles per PART = ceil(vpad / W)
+    G: int                      # global dst tiles = num_parts * n_tiles
+    n_chunks: int               # padded per-src-part chunk count C
+    needs_scan: bool
+    src_local: np.ndarray       # int32 [P, C, E] into own shard; pad->0
+    rel_dst: np.ndarray         # int8 [P, C, E] in [0, W); -1 = pad
+    weight: np.ndarray | None   # float32 [P, C, E]
+    chunk_start: np.ndarray     # bool [P, C] True at each tile's 1st chunk
+    last_chunk: np.ndarray      # int32 [P, G]; -1 for edge-less tiles
+    stats: dict
+
+    @classmethod
+    def build(cls, sg, E: int = 256) -> "OwnerLayout":
+        """Re-lay a ShardedGraph's edges src-part-major (host, once).
+
+        Chunks bind to one global dst tile each, so per-(src-part,
+        dst-tile) edge counts round up to E — smaller E wastes fewer
+        padded gather slots when parts spread a tile's in-edges
+        thinly (the inflation is reported in ``stats``)."""
+        if sg.local_parts is not None:
+            raise NotImplementedError(
+                "owner-side layout needs every part's edges; build the "
+                "ShardedGraph without parts= (multi-host local rows)")
+        P, vpad, W = sg.num_parts, sg.vpad, 128
+        n_tiles = max(1, _ceil_div(vpad, W))
+        G = P * n_tiles
+
+        # per-edge (src part, src local, global dst tile, rel) rows,
+        # then ONE stable sort by (src part, dst tile)
+        key_l, srcl_l, rel_l, w_l = [], [], [], []
+        for r in range(P):
+            nep = int(sg.ne_part[r])
+            slot = sg.src_slot[r, :nep].astype(np.int64)
+            s = slot // vpad
+            srcl_l.append((slot - s * vpad).astype(np.int32))
+            dst = sg.dst_local[r, :nep].astype(np.int64)
+            gt = r * n_tiles + (dst // W)
+            key_l.append(s * G + gt)
+            rel_l.append((dst % W).astype(np.int8))
+            if sg.weighted:
+                w_l.append(sg.edge_weight[r, :nep])
+        key = np.concatenate(key_l) if key_l else np.empty(0, np.int64)
+        del key_l
+        srcl = np.concatenate(srcl_l) if srcl_l else np.empty(0, np.int32)
+        del srcl_l
+        rel = np.concatenate(rel_l) if rel_l else np.empty(0, np.int8)
+        del rel_l
+        wgt = np.concatenate(w_l) if w_l else None
+        del w_l
+        order = np.argsort(key, kind="stable")
+        key = key[order]
+        srcl = srcl[order]
+        rel = rel[order]
+        if wgt is not None:
+            wgt = wgt[order]
+        del order
+        s_of = key // G
+        bounds = np.searchsorted(s_of, np.arange(P + 1))
+
+        # chunk counts per src part (sizing pass)
+        per_part = []
+        for s in range(P):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            uniq_g, counts = np.unique(key[lo:hi] - s * np.int64(G),
+                                       return_counts=True)
+            per_part.append((lo, uniq_g.astype(np.int64), counts))
+        C = max(1, max((int(_ceil_div(c, E).sum())
+                        for _, _, c in per_part), default=1))
+        C = _ceil_div(C, 8) * 8          # Pallas block granularity
+        needs_scan = any((_ceil_div(c, E) > 1).any()
+                         for _, _, c in per_part if c.size)
+
+        src_local = np.zeros((P, C, E), dtype=np.int32)
+        rel_dst = np.full((P, C, E), -1, dtype=np.int8)
+        weight = (np.zeros((P, C, E), dtype=np.float32)
+                  if sg.weighted else None)
+        chunk_start = np.ones((P, C), dtype=bool)   # pad chunks isolated
+        last_chunk = np.full((P, G), -1, dtype=np.int32)
+
+        lanes = np.arange(E, dtype=np.int64)
+        used = 0
+        for s, (lo, uniq_g, counts) in enumerate(per_part):
+            if not counts.size:
+                continue
+            n_ch = _ceil_div(counts, E)
+            nc = int(n_ch.sum())
+            used += nc
+            # chunk -> position in this part's sorted edge slice
+            ci = np.repeat(np.arange(len(uniq_g)), n_ch)  # chunk->tile idx
+            tile_lo = lo + np.concatenate(([0], np.cumsum(counts)[:-1]))
+            tile_hi = tile_lo + counts
+            tile_first = np.concatenate(([0], np.cumsum(n_ch)[:-1]))
+            cj = np.arange(nc, dtype=np.int64) - tile_first[ci]
+            start = tile_lo[ci] + cj * E
+            idx = start[:, None] + lanes[None, :]          # [nc, E]
+            valid = idx < tile_hi[ci][:, None]
+            idx = np.where(valid, idx, lo)
+            src_local[s, :nc] = np.where(valid, srcl[idx], 0)
+            rel_dst[s, :nc] = np.where(valid, rel[idx], -1)
+            if weight is not None:
+                weight[s, :nc] = np.where(valid, wgt[idx], 0)
+            chunk_start[s, :nc] = cj == 0
+            last_chunk[s, uniq_g] = (tile_first + n_ch - 1).astype(
+                np.int32)
+
+        stats = dict(slots=P * C * E, used_chunks=used,
+                     inflation=round(P * C * E / max(1, sg.ne), 3),
+                     chunk_inflation=round(used * E / max(1, sg.ne), 3))
+        return cls(W=W, E=E, n_tiles=n_tiles, G=G, n_chunks=C,
+                   needs_scan=needs_scan, src_local=src_local,
+                   rel_dst=rel_dst, weight=weight,
+                   chunk_start=chunk_start, last_chunk=last_chunk,
+                   stats=stats)
+
+    def streams(self) -> bool:
+        """Stream gather+partials in lax.map blocks once one src
+        part's [C, E] f32 message temporary passes the shared budget
+        (same rule the dst-major engines use)."""
+        return self.n_chunks * self.E * 4 > STREAM_MSG_BYTES
+
+
+def owner_part_tiles(lay: OwnerLayout, state_s, src, rel, weight, cs,
+                     lc, kind: str, msg_fn, reduce_method: str,
+                     use_mxu: bool = False):
+    """One source part's contribution: gather from its OWN shard
+    ``state_s [vpad, ...]``, message, chunk-reduce, and combine into
+    per-global-tile results ``[G, W, ...]`` (identity where the part
+    contributes nothing)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.ops.tiled import (chunk_partials, combine_chunks,
+                                   streamed_chunk_partials)
+
+    if lay.streams():
+        partials = streamed_chunk_partials(
+            state_s, src, rel, weight, lay, kind, msg_fn, reduce_method,
+            use_mxu=use_mxu)
+    else:
+        vals = jnp.take(state_s, src, axis=0)
+        msgs = msg_fn(vals, weight)
+        if reduce_method.startswith("pallas") and msgs.ndim == 2:
+            from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+            partials = chunk_partials_pallas(
+                msgs, rel, lay.W, kind,
+                interpret=reduce_method == "pallas-interpret")
+        else:
+            # keep the (serial, expensive) gather out of the W-wide
+            # broadcast consumer (see PullEngine._part_msgs)
+            msgs = jax.lax.optimization_barrier(msgs)
+            partials = chunk_partials(msgs, rel, lay.W, kind,
+                                      use_mxu=use_mxu)
+    return combine_chunks(partials, lay, cs, lc, kind)     # [G, W, ...]

@@ -15,7 +15,7 @@ edges keep the regular gather path.
 
 Row layout: pair (s, t) with maximum per-source multiplicity m gets m
 rows; occurrence o of source lane c carries the o-th edge (s*128+c ->
-t*128+rel).  Unused lanes carry rel = 128 (the reduce's pad marker).
+t*128+rel).  Unused lanes carry rel = -1 (matches no lane; int8).
 Rows are grouped per destination tile and depth-classed so the
 cross-row combine is a static reshape-reduce, like experiments/router.py's
 slotted classes.
@@ -35,11 +35,11 @@ class PairPlan:
     """Per-part pair-lane arrays (host numpy).
 
     rowbind   int32 [R]      global state2d row (= src tile) per row
-    rel_dst   int16 [R, 128] dst offset in [0,128), 128 = dead lane
+    rel_dst   int8 [R, 128] dst offset in [0,128), -1 = dead lane
     weight    f32 [R, 128] | None  per-lane edge weight (0 dead lanes)
     classes   [(tile_start, tile_count, depth)] for the combine; rows
               are tile-major in ``tile_order`` with per-tile depth
-              padded to the class depth (dead rows are all-128)
+              padded to the class depth (dead rows are all -1)
     tile_order int32 [n_tiles] part-local dst tile of each class slot
     residual  bool [ne_part]  True for edges NOT covered by pairs
     """
@@ -101,8 +101,11 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     pair = st * n_tiles + dt
     order = np.argsort(pair, kind="stable")
     pp = pair[order]
-    starts = np.concatenate(
+    # a part with zero edges has zero pairs (starts must then be [0],
+    # not [0, 0], so the pp[starts[:-1]] lookups below stay in bounds)
+    starts = (np.concatenate(
         ([0], np.nonzero(pp[1:] != pp[:-1])[0] + 1, [ne]))
+        if ne else np.zeros(1, np.int64))
     sizes = np.diff(starts)
     pair_id = np.repeat(np.arange(len(sizes)), sizes)
 
@@ -197,12 +200,12 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     assert (within + srt_rows <= depth[tile_pos[dts]]).all()
 
     rowbind = np.zeros(R, np.int32)
-    rel_dst = np.full((R, W), W, np.int16)
+    rel_dst = np.full((R, W), -1, np.int8)
     rows = pair_base[pidx] + occ
     rowbind_rows = (src_slot[cov] // W).astype(np.int32)
     rowbind[rows] = rowbind_rows
     rel_dst[rows, src_slot[cov] % W] = (dst_local[cov] % W).astype(
-        np.int16)
+        np.int8)
     weight = None
     if weights is not None:
         weight = np.zeros((R, W), np.float32)
@@ -251,8 +254,8 @@ def pair_reduce_numpy(plan: PairPlan, state_flat: np.ndarray,
             for r in range(row0 + i * L, row0 + (i + 1) * L):
                 lanes = plan.rel_dst[r]
                 for c in range(W):
-                    w = lanes[c]
-                    if w < W:
+                    w = int(lanes[c])   # int8 + python-int arithmetic
+                    if 0 <= w < W:
                         out[tile * W + w] = op(out[tile * W + w],
                                                vals[r, c])
         row0 += cnt * L
@@ -274,7 +277,7 @@ class StackedPairPlan:
     """Common-frame pair-lane arrays for all parts (host numpy).
 
     rowbind   int32 [P, Rp]       global state2d row per delivery row
-    rel_dst   int16 [P, Rp, 128]  dst offset in [0,128), 128 = dead
+    rel_dst   int8 [P, Rp, 128]  dst offset in [0,128), -1 = dead
     weight    f32 [P, Rp, 128] | None  per-lane edge weight
     tile_pos  int32 [P, n_tiles]  class slot of each part-local tile;
               tiles with no pair rows point at the trailing identity
@@ -330,7 +333,7 @@ def stack_pair_plans(plans: list, weighted: bool,
         r += c * L
 
     rowbind = np.zeros((P, Rp), np.int32)
-    rel_dst = np.full((P, Rp, W), W, np.int16)
+    rel_dst = np.full((P, Rp, W), -1, np.int8)
     wgt = np.zeros((P, Rp, W), np.float32) if weighted else None
     tile_pos = np.full((P, n_tiles), n_slots, np.int32)
     row_tile = np.zeros((P, Rp), np.int32)
@@ -461,7 +464,7 @@ def pair_partial(sp: StackedPairPlan, flat_state, rowbind, rel, weight,
     gathered whole state); rowbind/rel/weight/tile_pos: this part's
     rows of the stacked arrays; msg_fn(vals [R,128],
     weight [R,128]|None) -> per-edge messages (dead lanes carry
-    garbage, masked by rel == 128).
+    garbage, masked by rel == -1).
     """
     import jax.numpy as jnp
 
@@ -670,7 +673,7 @@ def pair_partial_dot(sp: StackedPairPlan, state, rowbind, rel, weight,
         mask = rl[..., None] == lanes                  # [B, 128, 128]
         dot = jnp.sum(jnp.where(mask, D, 0), axis=-1)  # [B, 128]
         msgs = msg_dot_fn(S, dot, wt)                  # [B, 128, K]
-        # dead lanes (rel == 128) match no output lane -> contribute 0
+        # dead lanes (rel == -1) match no output lane -> contribute 0
         return jnp.einsum("rcw,rck->rwk", mask.astype(S.dtype), msgs)
 
     partials = jax.lax.map(
@@ -715,8 +718,9 @@ def stacked_pair_reduce_numpy(sp: StackedPairPlan, p: int,
                                 rb + (slot - sb + 1) * L):
                     lanes = sp.rel_dst[p, rr]
                     for col in range(W):
-                        if lanes[col] < W:
-                            out[t * W + lanes[col]] = op(
-                                out[t * W + lanes[col]], vals[rr, col])
+                        w = int(lanes[col])
+                        if 0 <= w < W:
+                            out[t * W + w] = op(
+                                out[t * W + w], vals[rr, col])
                 break
     return out

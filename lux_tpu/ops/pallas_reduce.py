@@ -5,7 +5,7 @@ the reference's CUB BlockScan + atomic scatter CTA pattern
 (reference pagerank_gpu.cu:49-102, sssp_gpu.cu:148-244; SURVEY.md
 §3.3).  It consumes the tiled chunk layout of ops/tiled.py: edge
 messages ``vals [C, E]`` with relative destinations ``rel_dst [C, E]``
-in ``[0, W]`` (W = padding lane) and produces per-chunk partials
+in ``[0, W)`` (negative = padding lane) and produces per-chunk partials
 ``[C, W]``, which ops/tiled.combine_chunks folds into vertex tiles.
 
 Why a kernel instead of the XLA broadcast-compare reduction
@@ -40,9 +40,10 @@ def _partial_kernel(vals_ref, rel_ref, out_ref, *, W: int, kind: str):
     rel = rel_ref[:]                                     # [B, E]
     B, E = vals.shape
     ident = identity_for(kind, vals.dtype)
-    # compare in int32: rel rides HBM as int16 (it only holds 0..W);
-    # Mosaic's iota is 32-bit and its minor-dim broadcast insertion
-    # only supports 32-bit types, so widen BEFORE the reshape
+    # compare in int32: rel rides HBM as int8 (valid lanes 0..W-1,
+    # pad -1 — matches nothing); Mosaic's iota is 32-bit and its
+    # minor-dim broadcast insertion only supports 32-bit types, so
+    # widen BEFORE the reshape
     rel32 = rel.astype(jnp.int32)                        # [B, E]
     lanes = jax.lax.broadcasted_iota(jnp.int32, (B, E, W), 2)
     match = rel32[:, :, None] == lanes

@@ -54,9 +54,12 @@ class TiledLayout:
     n_chunks: int               # padded chunk count C (max over parts)
     needs_scan: bool            # False when every tile fits in 1 chunk
     edge_gather: np.ndarray     # int64 [P, C, E] index into flat [epad]
-    rel_dst: np.ndarray         # int16 [P, C, E] in [0, W]; W = pad lane
-                                #   (int16: halves the second-largest
-                                #   device array; values are tiny)
+    rel_dst: np.ndarray         # int8 [P, C, E] in [0, W); -1 = pad
+                                #   lane (int8: quarters the second-
+                                #   largest device array; valid values
+                                #   are 0..127 and the pad marker only
+                                #   needs to MATCH NO LANE, so -1
+                                #   serves where W=128 cannot fit)
     chunk_tile: np.ndarray      # int32 [P, C] owning tile; n_tiles = pad
     chunk_start: np.ndarray     # bool  [P, C] True at each tile's 1st chunk
     last_chunk: np.ndarray      # int32 [P, n_tiles] index of tile's last
@@ -95,7 +98,7 @@ class TiledLayout:
         global_needs_scan = any(x[2].max(initial=0) > 1 for x in sizing)
 
         edge_gather = np.zeros((P, C, E), dtype=np.int64)
-        rel_dst = np.full((P, C, E), W, dtype=np.int16)
+        rel_dst = np.full((P, C, E), -1, dtype=np.int8)
         chunk_tile = np.full((P, C), n_tiles, dtype=np.int32)
         chunk_start = np.ones((P, C), dtype=bool)   # pad chunks isolated
         last_chunk = np.full((P, n_tiles), -1, dtype=np.int32)
@@ -117,7 +120,7 @@ class TiledLayout:
             idx = np.where(valid, idx, 0)
             edge_gather[p, :nc] = idx
             rel_dst[p, :nc] = np.where(
-                valid, dst_local[p][idx] - (ct * W)[:, None], W)
+                valid, dst_local[p][idx] - (ct * W)[:, None], -1)
             chunk_tile[p, :nc] = ct
             chunk_start[p, :nc] = cj == 0
             last_chunk[p] = np.where(n_ch > 0, np.cumsum(n_ch) - 1, -1)
@@ -220,7 +223,7 @@ def streamed_chunk_partials(flat_state, src_slot, rel_dst, weight,
     Bounds the [C, E] message/gather temporaries that OOM billion-edge
     single-chip runs (PERF_NOTES RMAT26 ledger).  msg_fn(vals [B, E,
     ...], weight [B, E]|None) -> messages; dead lanes are masked by
-    rel == W downstream.  Shared by the pull engine's step and the
+    rel == -1 (matching no output lane) downstream.  Shared by the pull engine's step and the
     push engine's dense iterations."""
     C, E, W = layout.n_chunks, layout.E, layout.W
     B = max(8, min(block_chunks, C))
