@@ -33,7 +33,7 @@ def make_program() -> PushProgram:
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
-                 starts=None) -> PushEngine:
+                 starts=None, exchange: str = "gather") -> PushEngine:
     """pair_threshold enables pair-lane delivery on dense iterations
     (best after graph.pair_relabel, passing its ``starts`` through;
     labels are vertex ids, so map results back through the relabel
@@ -42,7 +42,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
         sg = ShardedGraph.build(g, num_parts, starts=starts,
                                 pair_threshold=pair_threshold)
     return PushEngine(sg, make_program(), mesh=mesh,
-                      pair_threshold=pair_threshold)
+                      pair_threshold=pair_threshold, exchange=exchange)
 
 
 def run(g: Graph, num_parts: int = 1, mesh=None, max_iters=None,

@@ -68,7 +68,7 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                  delta: float | str | None = None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
-                 starts=None) -> PushEngine:
+                 starts=None, exchange: str = "gather") -> PushEngine:
     """delta: bucket width for delta-stepping priority ordering
     (weighted runs); "auto" picks a heuristic; None disables (plain
     Bellman-Ford frontier relaxation).  pair_threshold enables pair-
@@ -82,7 +82,8 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
         sg = ShardedGraph.build(g, num_parts, starts=starts,
                                 pair_threshold=pair_threshold)
     return PushEngine(sg, make_program(start_vertex, weighted), mesh=mesh,
-                      delta=delta, pair_threshold=pair_threshold)
+                      delta=delta, pair_threshold=pair_threshold,
+                      exchange=exchange)
 
 
 def run(g: Graph, start_vertex: int = 0, num_parts: int = 1, mesh=None,

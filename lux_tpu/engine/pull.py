@@ -424,9 +424,6 @@ class PullEngine:
 
     # -- owner-side exchange (ops/owner.py) ---------------------------
 
-    _OWNER_SCAN_KEYS = ("own_src", "own_rel", "own_cs", "own_lc",
-                        "own_w")
-
     def _msg_dtype(self, state):
         """Message dtype without running edge_value (abstract eval)."""
         probe_w = (jax.ShapeDtypeStruct((1, 1), jnp.float32)
@@ -438,61 +435,29 @@ class PullEngine:
             probe_s, probe_w).dtype
 
     def _owner_contribs(self, state_rows, g):
-        """lax.scan over the locally-held SOURCE parts: each step
-        gathers from ONE [vpad] state shard (the scan is what makes
-        the XLA emitter see the small table — a vmapped batched
-        gather still pays the big-table rate, scripts/
-        profile_owner.py) and folds its [G, W] tile partials into the
-        accumulated contribution to every destination part."""
-        from lux_tpu.ops.owner import owner_part_tiles
-        from lux_tpu.ops.segment import identity_for
+        """Per-source-part contributions (ops/owner.owner_contribs)."""
+        from lux_tpu.ops.owner import owner_contribs
 
-        sg, prog, lay = self.sg, self.program, self.owner
-        P = sg.num_parts
-        ntw = lay.n_tiles * lay.W
-        comb = combine_op(prog.reduce)
-        skeys = [k for k in self._OWNER_SCAN_KEYS if k in g]
-        xs = (state_rows,) + tuple(g[k] for k in skeys)
-
-        def step(acc, x):
-            st_s, src, rel, cs, lc = x[:5]
-            w = x[5] if len(x) > 5 else None
-            tiles = owner_part_tiles(
-                lay, st_s, src, rel, w, cs, lc, prog.reduce,
-                lambda vals, wt: prog.edge_value(vals, None, wt),
-                self.reduce_method, use_mxu=self.use_mxu)
-            contrib = tiles.reshape((P, ntw) + tiles.shape[2:])
-            return comb(acc, contrib), None
-
-        dt = self._msg_dtype(state_rows)
-        acc0 = jnp.full((P, ntw) + state_rows.shape[2:],
-                        identity_for(prog.reduce, dt), dt)
-        if self.mesh is not None:
-            # the scan folds in device-varying contributions; the
-            # constant initial carry must be marked varying too (VMA)
-            acc0 = jax.lax.pcast(acc0, (PARTS_AXIS,), to="varying")
-        acc, _ = jax.lax.scan(step, acc0, xs)
-        return acc
+        prog = self.program
+        from lux_tpu.ops.owner import OWNER_SCAN_KEYS
+        skeys = [k for k in OWNER_SCAN_KEYS if k in g]
+        return owner_contribs(
+            self.owner, state_rows, tuple(g[k] for k in skeys),
+            prog.reduce,
+            lambda vals, wt: prog.edge_value(vals, None, wt),
+            self._msg_dtype(state_rows), self.sg.num_parts,
+            self.reduce_method,
+            varying_axis=None if self.mesh is None else PARTS_AXIS,
+            use_mxu=self.use_mxu)
 
     def _owner_exchange(self, acc):
-        """Route accumulated contributions [P, ntw, ...] to their
-        destination parts.  Single device: identity (every dst row is
-        local).  Mesh: reduce_scatter over ICI — ``psum_scatter`` for
-        sum, ``all_to_all`` + local combine for min/max (the TPU-
-        native replacement for the whole-region all_gather, reference
-        pull_model.inl:454-461)."""
-        if self.mesh is None:
-            return acc
-        if self.program.reduce == "sum":
-            return jax.lax.psum_scatter(
-                acc, PARTS_AXIS, scatter_dimension=0, tiled=True)
-        recv = jax.lax.all_to_all(acc, PARTS_AXIS, split_axis=0,
-                                  concat_axis=0, tiled=True)
-        ndev = self.mesh.devices.size
-        rows = self.sg.num_parts // ndev
-        red = recv.reshape((ndev, rows) + recv.shape[1:])
-        return {"min": jnp.min, "max": jnp.max}[self.program.reduce](
-            red, axis=0)
+        """Reduce-scatter of contributions (ops/owner.owner_exchange)."""
+        from lux_tpu.ops.owner import owner_exchange
+
+        return owner_exchange(
+            acc, self.program.reduce,
+            axis=None if self.mesh is None else PARTS_AXIS,
+            ndev=1 if self.mesh is None else self.mesh.devices.size)
 
     def _owner_apply(self, state_rows, red_rows, flat_state, g):
         """Pair contribution + apply epilogue, vmapped over the local
@@ -799,11 +764,10 @@ def _check_local_parts(sg, mesh, pair_threshold):
         raise ValueError(
             "a ShardedGraph built with parts= (multi-host local rows) "
             "requires a mesh")
-    if pair_threshold is not None:
-        raise NotImplementedError(
-            "pair-lane delivery is not yet supported with per-host "
-            "local-parts builds (the pair planner needs every part's "
-            "edges)")
+    # pair_threshold IS supported with local-parts builds: the pair
+    # planner lays each process's rows out against a process-group-
+    # allreduced common depth profile (plan_sharded_pairs)
+    del pair_threshold
     from lux_tpu.parallel.mesh import local_part_rows
     expect = local_part_rows(mesh, sg.num_parts)
     got = list(np.asarray(sg.local_parts))

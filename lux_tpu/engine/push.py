@@ -92,7 +92,9 @@ class PushEngine:
                  reduce_method: str = "auto",
                  pair_threshold: int | None = None,
                  pair_stream: bool | None = None,
-                 stream_msgs: bool | None = None):
+                 stream_msgs: bool | None = None,
+                 exchange: str = "gather",
+                 owner_tile_e: int = 256):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -101,6 +103,14 @@ class PushEngine:
                                          build_graph_arrays,
                                          resolve_reduce_method)
         _check_local_parts(sg, mesh, pair_threshold)
+        if exchange not in ("gather", "owner"):
+            raise ValueError(f"unknown exchange {exchange!r}")
+        if exchange == "owner" and sg.local_parts is not None:
+            raise NotImplementedError(
+                "owner exchange is not yet supported with per-host "
+                "local-parts builds (the layout needs every part's "
+                "edges)")
+        self.exchange = exchange
         if delta is not None:
             if program.reduce != "min":
                 raise ValueError("delta-stepping requires a 'min' program")
@@ -141,9 +151,28 @@ class PushEngine:
                               if stream_msgs is None
                               else bool(stream_msgs))
         dev = jnp.asarray if mesh is None else np.asarray
-        arrays, self.tiles = build_graph_arrays(
-            dense_sg, layout, needs_dst=False, tile_w=tile_w,
-            tile_e=tile_e, device=mesh is None)
+        if exchange == "owner":
+            # dense iterations run owner-side (ops/owner.py): per-
+            # source-part small-shard gathers + reduce_scatter replace
+            # the label all_gather + big-table gather; the sparse path
+            # below is unchanged (queue exchange is already O(queue))
+            from lux_tpu.engine.pull import common_graph_arrays
+            from lux_tpu.ops.owner import OwnerLayout
+            self.owner = OwnerLayout.build(dense_sg, E=owner_tile_e)
+            self.tiles = None
+            arrays = dict(
+                **common_graph_arrays(dense_sg, dev),
+                own_src=dev(self.owner.src_local),
+                own_rel=dev(self.owner.rel_dst),
+                own_cs=dev(self.owner.chunk_start),
+                own_lc=dev(self.owner.last_chunk))
+            if self.owner.weight is not None:
+                arrays["own_w"] = dev(self.owner.weight)
+        else:
+            self.owner = None
+            arrays, self.tiles = build_graph_arrays(
+                dense_sg, layout, needs_dst=False, tile_w=tile_w,
+                tile_e=tile_e, device=mesh is None)
         if self.pairs is not None:
             arrays["pair_rowbind"] = dev(self.pairs.rowbind)
             arrays["pair_rel"] = dev(self.pairs.rel_dst)
@@ -233,14 +262,9 @@ class PushEngine:
         cand=None: stream gather+relax+partials in chunk blocks
         (billion-edge memory mode; PERF_NOTES ledger)."""
         sg, prog, lay = self.sg, self.program, self.tiles
-        ident_l = jnp.asarray(prog.identity, flat_l.dtype)
-
-        def msg(vals, w):
-            # relax + mask masked-source candidates back to the
-            # identity (shared by the streamed and pair deliveries)
-            c = prog.relax(vals, w)
-            return jnp.where(vals == ident_l,
-                             jnp.asarray(prog.identity, c.dtype), c)
+        # relax + mask masked-source candidates back to the identity
+        # (shared by the streamed, pair and owner deliveries)
+        msg = self._owner_msg(flat_l.dtype)
 
         if cand is None:
             from lux_tpu.ops.tiled import (combine_partials,
@@ -303,6 +327,78 @@ class PushEngine:
 
         g = {k: g[k] for k in self._DENSE_KEYS if k in g}
         return jax.vmap(one)(label, g)
+
+    # -- dense iteration, owner-side exchange (ops/owner.py) -----------
+
+    def _owner_msg(self, label_dtype):
+        """relax + mask identity-source candidates back to the
+        identity (same contract as _dense_cand/_dense_red's msg)."""
+        prog = self.program
+        ident_l = jnp.asarray(prog.identity, label_dtype)
+
+        def msg(vals, w):
+            c = prog.relax(vals, w)
+            return jnp.where(vals == ident_l,
+                             jnp.asarray(prog.identity, c.dtype), c)
+
+        return msg
+
+    def _dense_parts_owner(self, label, active, g):
+        """One dense iteration with owner-side message generation:
+        each LOCAL source part masks its own label shard (inactive ->
+        identity, exactly _dense_flat's one-gather trick applied per
+        shard), gathers from it under the lax.scan, and routes
+        per-dst-part candidates through the all_to_all exchange —
+        no label/active all_gather at all (except for pair rows)."""
+        from lux_tpu.ops.owner import owner_contribs, owner_exchange
+
+        sg, prog = self.sg, self.program
+        on_mesh = self.mesh is not None
+        ident_l = jnp.asarray(prog.identity, label.dtype)
+        masked = jnp.where(active, label, ident_l)
+        msg = self._owner_msg(label.dtype)
+        msg_dtype = jax.eval_shape(
+            msg, jax.ShapeDtypeStruct((1, 1), label.dtype),
+            (jax.ShapeDtypeStruct((1, 1), jnp.float32)
+             if "own_w" in g else None)).dtype
+        from lux_tpu.ops.owner import OWNER_SCAN_KEYS
+        skeys = [k for k in OWNER_SCAN_KEYS if k in g]
+        acc = owner_contribs(
+            self.owner, masked, tuple(g[k] for k in skeys),
+            prog.reduce, msg, msg_dtype, sg.num_parts,
+            self.reduce_method,
+            varying_axis=PARTS_AXIS if on_mesh else None)
+        red = owner_exchange(
+            acc, prog.reduce,
+            axis=PARTS_AXIS if on_mesh else None,
+            ndev=1 if not on_mesh else self.mesh.devices.size)
+        red = red[:, :sg.vpad]
+        if self.pairs is not None:
+            # pair rows fetch from the FULL masked table (row-granular
+            # fetches); the all_gather survives only for them
+            from lux_tpu.ops.pairs import (pair_partial,
+                                           pair_partial_streamed)
+            from lux_tpu.ops.tiled import combine_op
+
+            full = (masked if not on_mesh else
+                    jax.lax.all_gather(masked, PARTS_AXIS, tiled=True))
+            flat_l = full.reshape(-1)
+            fn = (pair_partial_streamed if self.pair_stream
+                  else pair_partial)
+
+            def pair_one(gp):
+                return fn(self.pairs, flat_l, gp["pair_rowbind"],
+                          gp["pair_rel"], gp.get("pair_weight"),
+                          gp["pair_tile_pos"], prog.reduce, msg,
+                          reduce_method=self.reduce_method)[:sg.vpad]
+
+            pkeys = [k for k in ("pair_rowbind", "pair_rel",
+                                 "pair_weight", "pair_tile_pos")
+                     if k in g]
+            pred = jax.vmap(pair_one)({k: g[k] for k in pkeys})
+            red = combine_op(prog.reduce)(red, pred)
+        gd = {k: g[k] for k in self._DENSE_KEYS if k in g}
+        return jax.vmap(self._dense_update)(label, red, gd)
 
     # -- sparse iteration ----------------------------------------------
 
@@ -419,6 +515,8 @@ class PushEngine:
             return x
 
         def dense_body(label, active, g):
+            if self.exchange == "owner":
+                return self._dense_parts_owner(label, active, g)
             if on_mesh:
                 full_l = jax.lax.all_gather(label, PARTS_AXIS, tiled=True)
                 full_a = jax.lax.all_gather(active, PARTS_AXIS, tiled=True)
@@ -597,6 +695,28 @@ class PushEngine:
             g = dict(zip(keys, gargs))
             return {k: g[k] for k in dkeys}
 
+        if self.exchange == "owner":
+            # owner mode has no separable gather phase: generation
+            # (scan over source parts) + reduce_scatter are one fused
+            # phase; update keeps its frontier-count fence
+            def gen_exchange(label, active, *gargs):
+                g = dict(zip(keys, gargs))
+                new, improved = self._dense_parts_owner(label, active,
+                                                        g)
+                cnt = jnp.sum(improved.astype(jnp.int32))
+                if self.mesh is not None:
+                    cnt = jax.lax.psum(cnt, PARTS_AXIS)
+                return (new, improved), cnt
+
+            fns = dict(gen_exchange=gen_exchange)
+            if self.mesh is not None:
+                P = PartitionSpec
+                S, R = P(PARTS_AXIS), P()
+                wrap = mesh_wrap(self.mesh, len(keys), S, R)
+                fns = dict(gen_exchange=wrap(gen_exchange, (S, S),
+                                             (S, S)))
+            return {k: jax.jit(f) for k, f in fns.items()}
+
         def exchange(label, active, *gargs):
             full_l, full_a = label, active
             if self.mesh is not None:
@@ -699,6 +819,12 @@ class PushEngine:
                 label, active, c = self.step(label, active)
                 cnt = int(fetch(c))
                 t["sparse"] = _time.perf_counter() - t0
+            elif "gen_exchange" in jits:      # owner dense: one phase
+                pt = PhaseTimer(fetch)
+                pt.t = t
+                label, active = pt("gen_exchange", jits["gen_exchange"],
+                                   label, active, *gargs)
+                cnt = int(pt.last_fence)
             else:
                 pt = PhaseTimer(fetch)
                 pt.t = t

@@ -174,6 +174,75 @@ class OwnerLayout:
         return self.n_chunks * self.E * 4 > STREAM_MSG_BYTES
 
 
+# graph-array dict keys holding the owner scan inputs, in the
+# POSITIONAL order owner_contribs' scan_arrays expects:
+# (src, rel, chunk_start, last_chunk[, weight])
+OWNER_SCAN_KEYS = ("own_src", "own_rel", "own_cs", "own_lc", "own_w")
+
+
+def owner_contribs(lay: OwnerLayout, state_rows, scan_arrays,
+                   kind: str, msg_fn, msg_dtype, num_parts: int,
+                   reduce_method: str, varying_axis=None,
+                   use_mxu: bool = False):
+    """lax.scan over the locally-held SOURCE parts: each step gathers
+    from ONE [vpad, ...] state shard (the scan is what makes the XLA
+    emitter see the small table — a vmapped batched gather still pays
+    the big-table rate, scripts/profile_owner.py) and folds its
+    [G, W] tile partials into the accumulated contribution
+    ``[num_parts, n_tiles*W, ...]`` to every destination part.
+
+    scan_arrays: (src, rel, chunk_start, last_chunk[, weight]) with
+    the local-row leading dim.  varying_axis: mesh axis name when
+    called under shard_map (marks the identity carry device-varying)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.ops.segment import identity_for
+    from lux_tpu.ops.tiled import combine_op
+
+    ntw = lay.n_tiles * lay.W
+    comb = combine_op(kind)
+
+    def step(acc, x):
+        st_s, src, rel, cs, lc = x[:5]
+        w = x[5] if len(x) > 5 else None
+        tiles = owner_part_tiles(lay, st_s, src, rel, w, cs, lc, kind,
+                                 msg_fn, reduce_method, use_mxu=use_mxu)
+        contrib = tiles.reshape((num_parts, ntw) + tiles.shape[2:])
+        return comb(acc, contrib), None
+
+    acc0 = jnp.full((num_parts, ntw) + state_rows.shape[2:],
+                    identity_for(kind, msg_dtype), msg_dtype)
+    if varying_axis is not None:
+        # the scan folds in device-varying contributions; the constant
+        # initial carry must be marked varying too (VMA)
+        acc0 = jax.lax.pcast(acc0, (varying_axis,), to="varying")
+    acc, _ = jax.lax.scan(step, acc0, (state_rows,) + tuple(scan_arrays))
+    return acc
+
+
+def owner_exchange(acc, kind: str, axis=None, ndev: int = 1):
+    """Route accumulated contributions [P, ntw, ...] to their
+    destination parts.  axis=None (single device): identity — every
+    dst row is already local.  On a mesh: reduce_scatter over ICI —
+    ``psum_scatter`` for sum, ``all_to_all`` + local combine for
+    min/max (the TPU-native replacement for the whole-region
+    all_gather, reference pull_model.inl:454-461)."""
+    import jax
+    import jax.numpy as jnp
+
+    if axis is None:
+        return acc
+    if kind == "sum":
+        return jax.lax.psum_scatter(acc, axis, scatter_dimension=0,
+                                    tiled=True)
+    recv = jax.lax.all_to_all(acc, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    rows = acc.shape[0] // ndev
+    red = recv.reshape((ndev, rows) + recv.shape[1:])
+    return {"min": jnp.min, "max": jnp.max}[kind](red, axis=0)
+
+
 def owner_part_tiles(lay: OwnerLayout, state_s, src, rel, weight, cs,
                      lc, kind: str, msg_fn, reduce_method: str,
                      use_mxu: bool = False):

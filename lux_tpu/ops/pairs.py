@@ -393,63 +393,92 @@ def plan_sharded_pairs(sg, threshold: int):
     should run on.  Returns (StackedPairPlan | None, residual_sg);
     None when no pair anywhere meets the threshold (residual is ``sg``
     itself).  Works for any num_parts; requires vpad % 128 == 0
-    (build the ShardedGraph with vpad_align=128)."""
+    (build the ShardedGraph with vpad_align=128).
+
+    Multi-host local-parts builds (sg.local_parts set): each process
+    plans only its OWN rows, but against a process-group-allreduced
+    common depth profile (multihost.allreduce_host — the s_pad-style
+    agreement push uses, push.py), so every process compiles the SAME
+    class structure and row shapes."""
     import dataclasses as _dc
 
     if sg.vpad % W:
         raise ValueError("pair delivery needs vpad % 128 == 0; build "
                          "the ShardedGraph with vpad_align=128")
     P = sg.num_parts
+    rows = sg.part_ids()          # global part id per materialized row
+    R = len(rows)
+    local = sg.local_parts is not None
 
-    def plan_part(p, slot_depths=None, profile_only=False):
-        nep = int(sg.ne_part[p])
-        wp = (np.asarray(sg.edge_weight[p, :nep])
+    def plan_row(r, slot_depths=None, profile_only=False):
+        nep = int(sg.ne_part[rows[r]])
+        wp = (np.asarray(sg.edge_weight[r, :nep])
               if sg.weighted and not profile_only else None)
         return build_pair_plan(
-            sg.src_slot[p, :nep], sg.dst_local[p, :nep], sg.vpad,
+            sg.src_slot[r, :nep], sg.dst_local[r, :nep], sg.vpad,
             threshold=threshold, weights=wp, slot_depths=slot_depths,
             profile_only=profile_only)
 
-    if P > 1:
+    if P > 1 or local:
         # Pass 1 (cheap, profile-only): per-part sorted row-count
         # profiles.  Pass 2: lay every part out against the
         # elementwise-max profile so classes are IDENTICAL across
-        # parts and stacking pads no rows beyond the max profile.
-        # (Per-depth max-count stacking of heterogeneous profiles
-        # measured 3.4x row inflation at RMAT21/np=4.)
-        profiles = [plan_part(p, profile_only=True) for p in range(P)]
-        if sum(int(prof.sum()) for prof in profiles) == 0:
+        # parts (and processes) and stacking pads no rows beyond the
+        # max profile.  (Per-depth max-count stacking of heterogeneous
+        # profiles measured 3.4x row inflation at RMAT21/np=4.)
+        profiles = [plan_row(r, profile_only=True) for r in range(R)]
+        prof_max = (np.maximum.reduce(profiles) if profiles
+                    else np.zeros(sg.vpad // W, np.int64))
+        total = sum(int(prof.sum()) for prof in profiles)
+        if local:
+            from lux_tpu.parallel.multihost import allreduce_host
+            prof_max = allreduce_host(prof_max, "max")
+            total = int(allreduce_host(np.int64(total), "sum"))
+        if total == 0:
             return None, sg             # no pair anywhere dense enough
-        common = quantize_depths(np.maximum.reduce(profiles))
-        plans = [plan_part(p, slot_depths=common) for p in range(P)]
+        common = quantize_depths(prof_max)
+        plans = [plan_row(r, slot_depths=common) for r in range(R)]
     else:
-        plans = [plan_part(0)]
+        plans = [plan_row(0)]
         if plans[0].stats["covered"] == 0:
             return None, sg
 
     sp = stack_pair_plans(plans, sg.weighted)
 
     ne_r = [int(pl.residual.sum()) for pl in plans]
-    epad_r = max(128, -(-max(ne_r) // 128) * 128)
-    src_slot = np.zeros((P, epad_r), np.int32)
-    dst_local = np.full((P, epad_r), sg.vpad, np.int32)
-    ew = np.zeros((P, epad_r), np.float32) if sg.weighted else None
-    row_ptr_local = np.zeros((P, sg.vpad + 1), np.int32)
-    for p, pl in enumerate(plans):
-        nep = int(sg.ne_part[p])
+    if local:
+        # residual shapes (epad_r) and global metadata must agree
+        # across processes; rows are disjoint, so max merges counts
+        from lux_tpu.parallel.multihost import allreduce_host
+        ne_part_r = np.zeros(P, np.int64)
+        ne_part_r[np.asarray(rows)] = ne_r
+        ne_part_r = allreduce_host(ne_part_r, "max")
+    else:
+        ne_part_r = np.asarray(ne_r, np.int64)
+    epad_r = max(128, -(-int(ne_part_r.max(initial=0)) // 128) * 128)
+    src_slot = np.zeros((R, epad_r), np.int32)
+    dst_local = np.full((R, epad_r), sg.vpad, np.int32)
+    ew = np.zeros((R, epad_r), np.float32) if sg.weighted else None
+    row_ptr_local = np.zeros((R, sg.vpad + 1), np.int32)
+    for r, pl in enumerate(plans):
+        nep = int(sg.ne_part[rows[r]])
         res = pl.residual
-        nr = ne_r[p]
-        src_slot[p, :nr] = sg.src_slot[p, :nep][res]
-        r_dst = sg.dst_local[p, :nep][res]
-        dst_local[p, :nr] = r_dst
+        nr = ne_r[r]
+        src_slot[r, :nr] = sg.src_slot[r, :nep][res]
+        r_dst = sg.dst_local[r, :nep][res]
+        dst_local[r, :nr] = r_dst
         if ew is not None:
-            ew[p, :nr] = sg.edge_weight[p, :nep][res]
+            ew[r, :nr] = sg.edge_weight[r, :nep][res]
         counts = np.bincount(r_dst, minlength=sg.vpad)
-        row_ptr_local[p, 1:] = np.cumsum(counts).astype(np.int32)
+        row_ptr_local[r, 1:] = np.cumsum(counts).astype(np.int32)
+    # NOTE: a local-parts residual keeps the FULL graph's
+    # row_ptr_global, so sizing_row_ptr() (chunk geometry) is an
+    # overestimate of the residual's chunks — consistent across
+    # processes, just padded; pad chunks are isolated identities.
     residual = _dc.replace(
         sg, src_slot=src_slot, dst_local=dst_local, edge_weight=ew,
         row_ptr_local=row_ptr_local,
-        ne_part=np.asarray(ne_r, np.int64), epad=epad_r,
+        ne_part=ne_part_r, epad=epad_r,
         _src_sorted_cache=None)
     return sp, residual
 

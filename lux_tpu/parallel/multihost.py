@@ -89,6 +89,22 @@ def fetch_global(x):
     return np.asarray(jax.device_get(x))
 
 
+def allreduce_host(x, op: str = "max"):
+    """Elementwise allreduce of a HOST numpy value across the process
+    group (planning-time agreement, e.g. the pair planner's common
+    depth profile — the analogue of the reference's identical host-
+    side Graph ctor on every node).  Single-process: identity."""
+    import jax
+    import numpy as np
+
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+    stacked = multihost_utils.process_allgather(x)   # [nproc, ...]
+    return {"max": np.max, "sum": np.sum}[op](stacked, axis=0)
+
+
 def process_parts(num_parts: int) -> range:
     """The contiguous range of partition ids this host is responsible
     for loading (partition i lives on global device i * P / num_parts).

@@ -1,0 +1,95 @@
+"""Coverage experiment (VERDICT r2 #4, PERF_NOTES round-3 #2): does
+re-sorting the degree-sorted TAIL by dominant source tile densify
+(src-tile, dst-tile) pairs at the same threshold?
+
+Under a plain degree sort, a tail vertex's in-edges come mostly from
+hub tiles, but degree-ordering scatters vertices with the SAME hub
+neighborhood across dst tiles.  Grouping tail vertices by their
+dominant (most frequent) src tile packs them into shared dst tiles,
+raising pair multiplicity.
+
+Pure host computation (no TPU): coverage = fraction of edges whose
+(src//128, dst//128) pair holds >= threshold edges.
+
+Usage: python scripts/exp_tailsort.py [scale ef threshold head_tiles]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+scale = int(sys.argv[1]) if len(sys.argv) > 1 else 21
+ef = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+threshold = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+head_tiles = int(sys.argv[4]) if len(sys.argv) > 4 else 512
+W = 128
+
+from lux_tpu.convert import rmat_edges
+
+t0 = time.time()
+src, dst, nv = rmat_edges(scale=scale, edge_factor=ef, seed=0)
+print(f"graph nv={nv} ne={len(src)} ({time.time() - t0:.0f}s)",
+      flush=True)
+
+
+def coverage(rank):
+    s = rank[src] // W
+    d = rank[dst] // W
+    n_t = -(-nv // W)
+    key = s * np.int64(n_t) + d
+    _u, inv, cnt = np.unique(key, return_inverse=True,
+                             return_counts=True)
+    cov = float((cnt[inv] >= threshold).mean())
+    rows = cnt[cnt >= threshold]
+    # lane-inflation proxy: delivered rows ~ sum over dense pairs of
+    # max-multiplicity ~ cnt/unique srcs; report edges/pair instead
+    return cov, float(rows.mean()) if len(rows) else 0.0
+
+
+deg = (np.bincount(src, minlength=nv) + np.bincount(dst, minlength=nv))
+by_deg = np.argsort(-deg, kind="stable")
+rank0 = np.empty(nv, np.int64)
+rank0[by_deg] = np.arange(nv)
+cov0, epp0 = coverage(rank0)
+print(f"degree sort:      coverage {cov0 * 100:5.1f}%  "
+      f"(edges/dense-pair {epp0:.0f})", flush=True)
+
+# tail re-sort: vertices past the head keep only their degree ORDER
+# WITHIN groups keyed by dominant src tile (tiles under rank0)
+head_v = head_tiles * W
+s0 = rank0[src]
+d0 = rank0[dst]
+tail_mask_e = d0 >= head_v                  # edges into tail vertices
+t0 = time.time()
+# dominant src tile per tail DST vertex: mode over its in-edges
+key = d0[tail_mask_e] * np.int64(1 << 32) + (s0[tail_mask_e] // W)
+ks = np.sort(key)
+newg = np.ones(len(ks), bool)
+newg[1:] = ks[1:] != ks[:-1]
+grp = np.cumsum(newg) - 1
+grp_cnt = np.bincount(grp)
+grp_v = (ks[newg] >> 32).astype(np.int64)         # tail dst vertex
+grp_t = (ks[newg] & ((1 << 32) - 1)).astype(np.int64)  # src tile
+# per vertex: the src tile with max count
+order = np.lexsort((-grp_cnt, grp_v))             # by v, count desc
+first = np.ones(len(order), bool)
+gv = grp_v[order]
+first[1:] = gv[1:] != gv[:-1]
+dom_tile = np.full(nv, -1, np.int64)
+dom_tile[gv[first]] = grp_t[order][first]
+print(f"dominant tiles ({time.time() - t0:.0f}s)", flush=True)
+
+tail_vs = np.arange(head_v, nv)                   # rank0 positions
+dom = dom_tile[tail_vs]                           # -1 = no in-edges
+# stable sort tail positions by dominant tile (keeps degree order
+# within a group); -1 group (no in-edges) sinks to the end
+sort_key = np.where(dom < 0, np.int64(1 << 40), dom)
+tail_order = tail_vs[np.argsort(sort_key, kind="stable")]
+new_pos = np.concatenate([np.arange(head_v), tail_order])
+# new_pos[i] = rank0-position placed at new position i; build rank1
+rank1 = np.empty(nv, np.int64)
+rank1[by_deg[new_pos]] = np.arange(nv)
+cov1, epp1 = coverage(rank1)
+print(f"tail src-tile sort: coverage {cov1 * 100:5.1f}%  "
+      f"(edges/dense-pair {epp1:.0f})", flush=True)

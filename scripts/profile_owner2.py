@@ -43,56 +43,54 @@ lc = jnp.asarray(
 
 
 def bench(name, per_part):
-    def run(s0):
+    # big arrays MUST be jit arguments — closed-over constants hang /
+    # 413 the remote compiler (CLAUDE.md)
+    def run(s0, src_a, rel_a, cs_a, lc_a):
         def body(_, c):
             acc, t = c
             def step(a, x):
-                return a + per_part(x[0], x[1], x[2]), None
+                return a + per_part(x[0], x[1], x[2], x[3], x[4]), None
             out, _ = jax.lax.scan(step, jnp.float32(0),
-                                  (t, src, rel))
+                                  (t, src_a, rel_a, cs_a, lc_a))
             return (acc + out, t + out * 1e-30)
         return jax.lax.fori_loop(0, K, body,
                                  (jnp.float32(0), s0))[0]
 
     r = jax.jit(run)
-    float(r(state))
+    float(r(state, src, rel, cs, lc))
     t0 = time.perf_counter()
-    float(r(state))
+    float(r(state, src, rel, cs, lc))
     dt = (time.perf_counter() - t0) / K
     print(f"{name:10s} {dt * 1e3:8.0f} ms  ({dt / slots * 1e9:5.2f} "
           f"ns/slot)", flush=True)
 
 
-def g_only(st, sr, rl):
+def g_only(st, sr, rl, cs_r, lc_r):
     return jnp.sum(jnp.take(st, sr, axis=0))
 
 
-def g_partials(st, sr, rl):
+def _partials(st, sr, rl):
     from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
     from lux_tpu.ops.tiled import chunk_partials
     vals = jnp.take(st, sr, axis=0)
     if method == "pallas":
-        p = chunk_partials_pallas(vals, rl, W, "sum")
-    else:
-        vals = jax.lax.optimization_barrier(vals)
-        p = chunk_partials(vals, rl, W, "sum")
-    return jnp.sum(p)
+        return chunk_partials_pallas(vals, rl, W, "sum")
+    vals = jax.lax.optimization_barrier(vals)
+    return chunk_partials(vals, rl, W, "sum")
+
+
+def g_partials(st, sr, rl, cs_r, lc_r):
+    return jnp.sum(_partials(st, sr, rl))
 
 
 class _Lay:
     needs_scan = True
 
 
-def g_combine(st, sr, rl):
-    from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
-    from lux_tpu.ops.tiled import chunk_partials, combine_chunks
-    vals = jnp.take(st, sr, axis=0)
-    if method == "pallas":
-        p = chunk_partials_pallas(vals, rl, W, "sum")
-    else:
-        vals = jax.lax.optimization_barrier(vals)
-        p = chunk_partials(vals, rl, W, "sum")
-    tiles = combine_chunks(p, _Lay, cs[0], lc[0], "sum")
+def g_combine(st, sr, rl, cs_r, lc_r):
+    from lux_tpu.ops.tiled import combine_chunks
+    p = _partials(st, sr, rl)
+    tiles = combine_chunks(p, _Lay, cs_r, lc_r, "sum")
     return jnp.sum(tiles)
 
 

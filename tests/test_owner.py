@@ -157,6 +157,106 @@ def test_owner_phases(graph):
                                rtol=1e-6)
 
 
+def _hub_start(graph):
+    src, _dst = graph.edge_arrays()
+    return int(np.bincount(src, minlength=graph.nv).argmax())
+
+
+def test_push_owner_dense_only(graph):
+    """Dense iterations forced every step (enable_sparse=False): the
+    whole convergence runs through the owner exchange."""
+    from lux_tpu.apps import sssp
+    from lux_tpu.engine.push import PushEngine
+
+    start = _hub_start(graph)
+    want = sssp.reference_sssp(graph, start)
+    eng = PushEngine(ShardedGraph.build(graph, 4),
+                     sssp.make_program(start), enable_sparse=False,
+                     exchange="owner")
+    dist, iters = eng.run()
+    assert iters > 1
+    np.testing.assert_array_equal(dist.astype(np.int64), want)
+
+
+def test_push_owner_sparse_mix(graph):
+    """Adaptive sparse/dense switching with the owner dense branch."""
+    from lux_tpu.apps import sssp
+
+    start = _hub_start(graph)
+    want = sssp.reference_sssp(graph, start)
+    eng = sssp.build_engine(graph, start_vertex=start, num_parts=4,
+                            exchange="owner")
+    dist, _iters = eng.run()
+    np.testing.assert_array_equal(dist.astype(np.int64), want)
+
+
+def test_push_owner_mesh(graph):
+    from lux_tpu.apps import sssp
+    from lux_tpu.engine.push import PushEngine
+
+    start = _hub_start(graph)
+    want = sssp.reference_sssp(graph, start)
+    mesh = make_mesh(8)
+    eng = PushEngine(ShardedGraph.build(graph, 8),
+                     sssp.make_program(start), mesh=mesh,
+                     enable_sparse=False, exchange="owner")
+    dist, _iters = eng.run()
+    np.testing.assert_array_equal(dist.astype(np.int64), want)
+
+
+def test_push_owner_cc_with_pairs(graph):
+    from lux_tpu.apps import components
+
+    src, dst = graph.edge_arrays()
+    s2, d2 = components.symmetrize(src, dst)
+    gc = Graph.from_edges(s2, d2, graph.nv)
+    want = components.reference_components(gc)
+    g2, perm, starts = pair_relabel(gc, 4, pair_threshold=8)
+    eng = components.build_engine(g2, num_parts=4, pair_threshold=8,
+                                  starts=starts, exchange="owner")
+    labels, _iters = eng.run()
+    rank = np.empty(graph.nv, np.int64)
+    rank[perm] = np.arange(graph.nv)
+
+    def canon(lab):
+        # canonical partition id: classes numbered by first occurrence
+        # (label VALUES differ between spaces; the partition must not)
+        _u, first, inv = np.unique(lab, return_index=True,
+                                   return_inverse=True)
+        return np.argsort(np.argsort(first))[inv]
+
+    # same partition into components (labels live in relabeled space)
+    np.testing.assert_array_equal(canon(labels[rank]), canon(want))
+
+
+def test_push_owner_weighted(graph):
+    from lux_tpu.apps import sssp
+
+    src, dst = graph.edge_arrays()
+    rng = np.random.default_rng(1)
+    w = rng.integers(1, 6, len(src)).astype(np.int32)
+    gw = Graph.from_edges(src, dst, graph.nv, weights=w)
+    start = _hub_start(graph)
+    want = sssp.reference_sssp(gw, start, weighted=True)
+    eng = sssp.build_engine(gw, start_vertex=start, num_parts=4,
+                            weighted=True, exchange="owner")
+    dist, _iters = eng.run()
+    np.testing.assert_allclose(dist, want)
+
+
+def test_push_owner_phases(graph):
+    from lux_tpu.apps import sssp
+    from lux_tpu.engine.push import PushEngine
+
+    start = _hub_start(graph)
+    eng = PushEngine(ShardedGraph.build(graph, 4),
+                     sssp.make_program(start), enable_sparse=False,
+                     exchange="owner")
+    label, active = eng.init_state()
+    _l, _a, rep = eng.timed_phases(label, active, 2)
+    assert all("gen_exchange" in r for r in rep)
+
+
 def test_owner_rejects_needs_dst(graph):
     prog = pagerank.make_program()
     bad = PullProgram(reduce=prog.reduce, edge_value=prog.edge_value,
