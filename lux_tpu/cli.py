@@ -52,12 +52,13 @@ def _common(ap: argparse.ArgumentParser):
                          "RMSE/check need no mapping)")
     ap.add_argument("-exchange", default="gather",
                     choices=["gather", "owner"],
-                    help="pull-engine state exchange: 'gather' "
-                         "(all-gather + per-edge gather from the full "
-                         "table) or 'owner' (per-source-part gathers "
-                         "from own shards + reduce_scatter; the fast "
-                         "path once state outgrows ~64 MB — "
-                         "PERF_NOTES.md; pagerank only for now)")
+                    help="state exchange for pagerank/sssp/cc: "
+                         "'gather' (all-gather + per-edge gather from "
+                         "the full table) or 'owner' (per-source-part "
+                         "gathers from own shards + reduce_scatter; "
+                         "the fast path once state outgrows ~64 MB — "
+                         "PERF_NOTES.md; colfilter's dot path has its "
+                         "own dst-free machinery and ignores this)")
     ap.add_argument("-phases", type=int, default=0, metavar="N",
                     help="after the timed run, run N instrumented "
                          "iterations and print the per-iteration "
@@ -107,10 +108,11 @@ def _print_phases(report):
 
 
 def _warn_exchange_ignored(args):
-    """-exchange is a pull-engine (pagerank) knob for now."""
+    """colfilter's dot path has its own dst-free delivery; -exchange
+    does not apply there."""
     if args.exchange != "gather":
-        print(f"note: -exchange {args.exchange} applies to the pull "
-              f"engine (pagerank) only; ignored here")
+        print(f"note: -exchange {args.exchange} does not apply to "
+              f"colfilter's dot path; ignored")
 
 
 def _relabel_for_pairs(args, g, num_parts):
@@ -211,7 +213,6 @@ def _push_app(argv, prog_name):
     from lux_tpu.apps import components, sssp
 
     weighted = prog_name == "sssp" and args.weighted
-    _warn_exchange_ignored(args)
     g = _load(args, weighted=weighted)
     mesh, num_parts = _mesh_and_parts(args)
     g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
@@ -228,21 +229,19 @@ def _push_app(argv, prog_name):
         eng = sssp.build_engine(g_run, start_vertex=start,
                                 num_parts=num_parts, mesh=mesh,
                                 weighted=weighted, delta=delta, sg=sg,
-                                pair_threshold=args.pair)
+                                pair_threshold=args.pair,
+                                exchange=args.exchange)
     else:
         eng = components.build_engine(g_run, num_parts=num_parts,
                                       mesh=mesh, sg=sg,
-                                      pair_threshold=args.pair)
+                                      pair_threshold=args.pair,
+                                      exchange=args.exchange)
     labels, iters, [elapsed] = timed_converge(
         eng, verbose=args.verbose, trace_dir=args.profile)
     print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
     print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
 
     if args.phases:
-        if eng.delta is not None:
-            print("note: -phases instruments plain frontier "
-                  "relaxation; the timed converge path above ran "
-                  "delta-stepping")
         lab0, act0 = eng.init_state()
         _l, _a, rep = eng.timed_phases(lab0, act0, args.phases)
         _print_phases(rep)

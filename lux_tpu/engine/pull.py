@@ -346,8 +346,12 @@ class PullEngine:
         return self._apply_epilogue(old_p, red, g)
 
     def _part_step_dot(self, flat_state, old_p, g):
-        """Tiled-layout step for programs whose dst dependence is only
-        the inner product <src, dst> (program.edge_value_from_dot).
+        red = self._part_dot_red(flat_state, old_p, g)
+        return self._apply_epilogue(old_p, red, g)
+
+    def _part_dot_red(self, flat_state, old_p, g):
+        """Tiled-layout reduction for programs whose dst dependence is
+        only the inner product <src, dst> (program.edge_value_from_dot).
 
         The dst row-gather (~9 ns/edge, 75% of a colfilter iteration)
         is replaced by MXU matmuls against the chunk's destination
@@ -409,7 +413,7 @@ class PullEngine:
                 g["pair_tile_pos"], g["pair_tile0"][0],
                 prog.edge_value_from_dot)
             red = red + pred[:sg.vpad]
-        return self._apply_epilogue(old_p, red, g)
+        return red
 
     def _parts_step(self, local_state, full_state, g_local):
         """vmap _part_step over this device's parts."""
@@ -476,6 +480,11 @@ class PullEngine:
         (single device: all parts; under shard_map: this device's)."""
         sg = self.sg
         acc = self._owner_contribs(state, g)
+        # keep the apply epilogue from fusing back into the scan: the
+        # separate phased programs measured 6.5 s/iter at RMAT25 where
+        # the combined step ran 8.6-12.5 s in the SAME process; the
+        # barrier restores the phase boundary XLA otherwise erases
+        acc = jax.lax.optimization_barrier(acc)
         red = self._owner_exchange(acc)[:, :sg.vpad]
         flat = None
         if self.pairs is not None:
@@ -627,11 +636,50 @@ class PullEngine:
         split is honest at the cost of materializing phase outputs."""
         from lux_tpu.engine.phased import cksum, mesh_wrap
 
-        if self.program.edge_value_from_dot is not None:
-            raise NotImplementedError(
-                "phase timing is not available for dot-path programs")
         keys = self._graph_keys
         sg = self.sg
+
+        if (self.program.edge_value_from_dot is not None
+                and self.tiles is not None):
+            # dot-path programs (colfilter): the src gather, MXU tile
+            # dots and one-hot reduction are one lax.map pipeline by
+            # design, so they time as ONE 'dot_reduce' phase — closing
+            # the round-2 hole where this raised NotImplementedError
+            def dot_exchange(state, *gargs):
+                full = state
+                if self.mesh is not None:
+                    full = jax.lax.all_gather(state, PARTS_AXIS,
+                                              tiled=True)
+                flat = full.reshape((sg.num_parts * sg.vpad,) +
+                                    full.shape[2:])
+                return flat, cksum(flat)
+
+            def dot_reduce(flat, state, *gargs):
+                g = dict(zip(keys, gargs))
+                red = jax.vmap(
+                    lambda old, gp: self._part_dot_red(flat, old, gp))(
+                    state, g)
+                return red, cksum(red)
+
+            def dot_apply(state, red, *gargs):
+                g = dict(zip(keys, gargs))
+                new = jax.vmap(self._apply_epilogue)(state, red, g)
+                return new, cksum(new)
+
+            fns = dict(exchange=dot_exchange, dot_reduce=dot_reduce,
+                       apply=dot_apply)
+            if self.mesh is not None:
+                P = PartitionSpec
+                S, R = P(PARTS_AXIS), P()
+                wrap = mesh_wrap(self.mesh, len(keys), S, R)
+                fns = dict(exchange=wrap(dot_exchange, (S,), R),
+                           dot_reduce=wrap(dot_reduce, (R, S), S),
+                           apply=wrap(dot_apply, (S, S), S))
+            return {k: jax.jit(f) for k, f in fns.items()}
+        if self.program.edge_value_from_dot is not None:
+            raise NotImplementedError(
+                "phase timing needs the tiled layout for dot-path "
+                "programs")
 
         if self.exchange == "owner":
             # owner mode has no separable gather: generation (scan
@@ -743,7 +791,10 @@ class PullEngine:
                 report.append(pt.t)
                 continue
             flat = pt("exchange", jits["exchange"], state, *gargs)
-            if "gather_reduce" in jits:   # streamed step: one phase
+            if "dot_reduce" in jits:      # dot path: one reduce phase
+                red = pt("dot_reduce", jits["dot_reduce"], flat,
+                         state, *gargs)
+            elif "gather_reduce" in jits:  # streamed step: one phase
                 red = pt("gather_reduce", jits["gather_reduce"], flat,
                          state, *gargs)
             else:

@@ -287,19 +287,23 @@ class PushEngine:
                         else "xla"),
                 interpret=self.reduce_method == "pallas-interpret")
         if self.pairs is not None:
-            from lux_tpu.ops.pairs import (pair_partial,
-                                           pair_partial_streamed)
             from lux_tpu.ops.tiled import combine_op
-
-            fn = (pair_partial_streamed if self.pair_stream
-                  else pair_partial)
-            pred = fn(
-                self.pairs, flat_l, g["pair_rowbind"],
-                g["pair_rel"], g.get("pair_weight"),
-                g["pair_tile_pos"], prog.reduce, msg,
-                reduce_method=self.reduce_method)[:sg.vpad]
-            red = combine_op(prog.reduce)(red, pred)
+            red = combine_op(prog.reduce)(
+                red, self._pair_red(flat_l, g, msg))
         return red
+
+    def _pair_red(self, flat_l, g, msg):
+        """Pair-lane delivery for one part -> [vpad] partial (shared
+        by the gather- and owner-exchange dense paths)."""
+        from lux_tpu.ops.pairs import (pair_partial,
+                                       pair_partial_streamed)
+
+        fn = pair_partial_streamed if self.pair_stream else pair_partial
+        return fn(
+            self.pairs, flat_l, g["pair_rowbind"], g["pair_rel"],
+            g.get("pair_weight"), g["pair_tile_pos"],
+            self.program.reduce, msg,
+            reduce_method=self.reduce_method)[:self.sg.vpad]
 
     def _dense_update(self, old, red, g):
         """Phase 4 (update): keep improvements, flag the new frontier."""
@@ -376,26 +380,17 @@ class PushEngine:
         if self.pairs is not None:
             # pair rows fetch from the FULL masked table (row-granular
             # fetches); the all_gather survives only for them
-            from lux_tpu.ops.pairs import (pair_partial,
-                                           pair_partial_streamed)
             from lux_tpu.ops.tiled import combine_op
 
             full = (masked if not on_mesh else
                     jax.lax.all_gather(masked, PARTS_AXIS, tiled=True))
             flat_l = full.reshape(-1)
-            fn = (pair_partial_streamed if self.pair_stream
-                  else pair_partial)
-
-            def pair_one(gp):
-                return fn(self.pairs, flat_l, gp["pair_rowbind"],
-                          gp["pair_rel"], gp.get("pair_weight"),
-                          gp["pair_tile_pos"], prog.reduce, msg,
-                          reduce_method=self.reduce_method)[:sg.vpad]
-
             pkeys = [k for k in ("pair_rowbind", "pair_rel",
                                  "pair_weight", "pair_tile_pos")
                      if k in g]
-            pred = jax.vmap(pair_one)({k: g[k] for k in pkeys})
+            pred = jax.vmap(
+                lambda gp: self._pair_red(flat_l, gp, msg))(
+                {k: g[k] for k in pkeys})
             red = combine_op(prog.reduce)(red, pred)
         gd = {k: g[k] for k in self._DENSE_KEYS if k in g}
         return jax.vmap(self._dense_update)(label, red, gd)
@@ -791,54 +786,137 @@ class PushEngine:
             if self.enable_sparse else 0
         return usable, limit
 
-    def timed_phases(self, label, active, iters: int = 1):
-        """Instrumented stepwise iterations -> (label, active,
-        [{phase: seconds, 'frontier': count}]) — the analogue of the
-        reference's per-iteration loadTime/compTime/updateTime prints
-        (reference sssp_gpu.cu:513-518).  Dense iterations split into
-        exchange/relax/reduce/update; iterations the engine would run
-        sparse are timed as one 'sparse' entry.  Separate fenced
-        programs: use for relative weight, not GTEPS.  NOTE: like the
-        stepwise -verbose path, this instruments plain frontier
-        relaxation — a delta engine's timed converge runs the
-        delta-stepping bucket schedule instead."""
+    def _relax_once(self, label, active, cnt, t, jits, gargs):
+        """One instrumented relaxation of ``active``, recording phase
+        seconds into ``t``.  Returns (label, na, new_count) where
+        ``na`` is the raw improvement/queue-residue mask — the plain
+        schedule uses it as the next frontier directly; the delta
+        schedule merges it into its own active set."""
         import time as _time
 
         from lux_tpu.engine.phased import PhaseTimer
         from lux_tpu.timing import fetch
+
+        use_sparse, sparse_limit = self._sparse_mode()
+        if use_sparse and cnt <= sparse_limit:
+            t0 = _time.perf_counter()
+            label, na, c = self.step(label, active)
+            cnt = int(fetch(c))
+            t["sparse"] = _time.perf_counter() - t0
+            return label, na, cnt
+        pt = PhaseTimer(fetch)
+        pt.t = t
+        if "gen_exchange" in jits:            # owner dense: one phase
+            label, na = pt("gen_exchange", jits["gen_exchange"],
+                           label, active, *gargs)
+            return label, na, int(pt.last_fence)
+        flat_l = pt("exchange", jits["exchange"], label, active, *gargs)
+        if "relax_reduce" in jits:            # streamed: one phase
+            red = pt("relax_reduce", jits["relax_reduce"], flat_l,
+                     *gargs)
+        else:
+            cand = pt("relax", jits["relax"], flat_l, *gargs)
+            red = pt("reduce", jits["reduce"], flat_l, cand, *gargs)
+        label, na = pt("update", jits["update"], label, red, *gargs)
+        return label, na, int(pt.last_fence)  # update fence = count
+
+    def timed_phases(self, label, active, iters: int = 1):
+        """Instrumented stepwise iterations -> (label, active,
+        [{phase: seconds, 'frontier': count}]) — the analogue of the
+        reference's per-iteration per-part loadTime/compTime/updateTime
+        prints (reference sssp_gpu.cu:513-518).  Dense iterations split
+        into exchange/relax/reduce/update (owner exchange:
+        gen_exchange); iterations the engine would run sparse are timed
+        as one 'sparse' entry.  Delta engines instrument the ACTUAL
+        delta-stepping bucket schedule (each entry also records the
+        bucket bound and how many relax-free bucket advances preceded
+        it).  Separate fenced programs: use for relative weight, not
+        GTEPS."""
+        from lux_tpu.timing import fetch
         jits = self._phase_jits
         gargs = tuple(self.arrays[k] for k in sorted(self.arrays))
+        if self.delta is not None:
+            return self._timed_phases_delta(label, active, iters, jits,
+                                            gargs)
         count = jax.jit(lambda a: jnp.sum(a.astype(jnp.int32)))
-        use_sparse, sparse_limit = self._sparse_mode()
         report = []
         cnt = int(fetch(count(active)))
         for _ in range(iters):
             t = {"frontier": cnt}
-            if use_sparse and cnt <= sparse_limit:
-                t0 = _time.perf_counter()
-                label, active, c = self.step(label, active)
-                cnt = int(fetch(c))
-                t["sparse"] = _time.perf_counter() - t0
-            elif "gen_exchange" in jits:      # owner dense: one phase
-                pt = PhaseTimer(fetch)
-                pt.t = t
-                label, active = pt("gen_exchange", jits["gen_exchange"],
-                                   label, active, *gargs)
-                cnt = int(pt.last_fence)
-            else:
-                pt = PhaseTimer(fetch)
-                pt.t = t
-                flat_l = pt("exchange", jits["exchange"], label,
-                            active, *gargs)
-                if "relax_reduce" in jits:   # streamed: one phase
-                    red = pt("relax_reduce", jits["relax_reduce"],
-                             flat_l, *gargs)
-                else:
-                    cand = pt("relax", jits["relax"], flat_l, *gargs)
-                    red = pt("reduce", jits["reduce"], flat_l, cand,
-                             *gargs)
-                label, active = pt("update", jits["update"], label,
-                                   red, *gargs)
-                cnt = int(pt.last_fence)    # update's fence = new count
+            label, active, cnt = self._relax_once(label, active, cnt,
+                                                  t, jits, gargs)
             report.append(t)
+        return label, active, report
+
+    def _timed_phases_delta(self, label, active, iters, jits, gargs):
+        """Instrumented DELTA-STEPPING iterations: replicates the
+        compiled converge's bucket schedule (relax the current bucket
+        [*, B); advance B past the active minimum when the bucket
+        frontier empties) with host-orchestrated fenced phases —
+        closing the round-2 observability hole where -phases timed a
+        different algorithm than the delta bench ran."""
+        from lux_tpu.timing import fetch
+
+        prog = self.program
+        ident = prog.identity
+        ldt = np.asarray(ident).dtype
+        delta_v = np.asarray(self.delta, ldt)
+
+        @jax.jit
+        def act_stats(lbl, act):
+            am = jnp.min(jnp.where(act, lbl, jnp.asarray(ident,
+                                                         lbl.dtype)))
+            return am, jnp.sum(act.astype(jnp.int32))
+
+        @jax.jit
+        def front_of(lbl, act, B):
+            front = act & (lbl < B)
+            return front, jnp.sum(front.astype(jnp.int32))
+
+        # split the merge around the relax: the sparse step DONATES
+        # its active (= front) buffer, so compute act & ~front before
+        # relaxing and OR the improvements in after
+        @jax.jit
+        def without_front(act, front):
+            return act & ~front
+
+        @jax.jit
+        def with_improved(act_wo, na):
+            return act_wo | na
+
+        def advance(am):
+            # strict progress, exactly like the compiled path
+            nb = am + delta_v
+            if np.issubdtype(ldt, np.inexact):
+                nb = max(nb, np.nextafter(am, np.asarray(np.inf, ldt)))
+            return np.asarray(nb, ldt)
+
+        report = []
+        am, tot = (np.asarray(fetch(x)) for x in act_stats(label,
+                                                           active))
+        B = advance(am)
+        n_adv = 0
+        it = 0
+        while it < iters and int(tot) > 0:
+            front, cnt = front_of(label, active, jnp.asarray(B, ldt))
+            cnt = int(fetch(cnt))
+            if cnt == 0:
+                am, tot = (np.asarray(fetch(x))
+                           for x in act_stats(label, active))
+                if int(tot) == 0:
+                    break
+                B = advance(am)
+                n_adv += 1
+                continue
+            t = {"frontier": cnt, "bucket": float(B),
+                 "advances": n_adv}
+            n_adv = 0
+            act_wo = without_front(active, front)
+            label, na, _c = self._relax_once(label, front, cnt, t,
+                                             jits, gargs)
+            active = with_improved(act_wo, na)
+            _am, tot = (np.asarray(fetch(x))
+                        for x in act_stats(label, active))
+            report.append(t)
+            it += 1
         return label, active, report

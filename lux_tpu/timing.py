@@ -21,6 +21,33 @@ def fetch(x) -> np.ndarray:
     return np.asarray(jax.device_get(x))
 
 
+def _cksum(*leaves):
+    import jax.numpy as jnp
+    return sum(jnp.sum(leaf.reshape(-1)[:8].astype(jnp.float32))
+               for leaf in leaves)
+
+
+_cksum_jit = None
+
+
+def fence(x) -> None:
+    """Tunnel-safe completion fence that ships O(1) bytes: fetches a
+    tiny checksum DEPENDENT on ``x`` instead of ``x`` itself.  A full
+    ``fetch`` of a multi-GB state bills its device->host transfer
+    (~60-300 MB/s through the tunnel) to whatever is being timed —
+    measured as seconds/iteration of phantom cost at RMAT25.
+
+    One module-level jitted checksum: repeat calls with the same leaf
+    shapes hit the jit cache, so no (remote) compile lands inside a
+    timed window after the warmup call."""
+    import jax
+
+    global _cksum_jit
+    if _cksum_jit is None:
+        _cksum_jit = jax.jit(_cksum)
+    fetch(_cksum_jit(*jax.tree.leaves(x)))
+
+
 def _trace_ctx(trace_dir):
     from lux_tpu.profiling import trace
     return trace(trace_dir)
@@ -38,14 +65,15 @@ def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None,
     """
     state = eng.init_state()
     state = eng.run(state, num_iters)
-    fetch(state)
+    fence(state)
     elapsed = []
     with _trace_ctx(trace_dir):
         for _ in range(repeats):
             state = eng.init_state()
+            fence(state)       # H2D upload is async: keep it untimed
             t0 = time.perf_counter()
             state = eng.run(state, num_iters)
-            fetch(state)
+            fence(state)       # O(1)-byte fence, not a state download
             elapsed.append(time.perf_counter() - t0)
     return state, elapsed
 
@@ -61,11 +89,12 @@ def timed_converge(eng, max_iters=None, verbose: bool = False,
         eng.run(max_iters=max_iters, verbose=True)   # stepwise, printed
     label, active = eng.init_state()
     l2, a2, _ = eng.converge(label, active, max_iters)  # compile
-    fetch(l2)
+    fence(l2)
     elapsed = []
     with _trace_ctx(trace_dir):
         for _ in range(repeats):
             label, active = eng.init_state()
+            fence((label, active))   # keep the async upload untimed
             t0 = time.perf_counter()
             label, active, iters = eng.converge(label, active, max_iters)
             iters = int(fetch(iters))
@@ -81,8 +110,9 @@ def timed_run_until(eng, tol: float, max_iters: int,
     captures only the timed run.  Returns (state, iters, residual,
     elapsed)."""
     s0, _it, _res = eng.run_until(eng.init_state(), tol, max_iters=1)
-    fetch(s0)
+    fence(s0)
     state0 = eng.init_state()
+    fence(state0)              # keep the async upload untimed
     with _trace_ctx(trace_dir):
         t0 = time.perf_counter()
         state, it, res = eng.run_until(state0, tol, max_iters)

@@ -9,7 +9,7 @@ fetch fence).
 Usage:
   PYTHONPATH=/root/repo:/root/.axon_site \
       python scripts/bench_bigscale.py [scale=25] [np=4] [pair=0] [ni=3] \
-                                       [tile_e=0]
+                                       [tile_e=0] [exchange=gather]
 
 pair > 0 additionally runs graph.pair_relabel + pair-lane delivery
 (slower host prep; measures the fast path at scale).  tile_e=0 uses
@@ -40,6 +40,7 @@ def main():
     pair = int(sys.argv[3]) if len(sys.argv) > 3 else 0
     ni = int(sys.argv[4]) if len(sys.argv) > 4 else 3
     tile_e = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+    exchange = sys.argv[6] if len(sys.argv) > 6 else "gather"
 
     import os
 
@@ -70,7 +71,8 @@ def main():
     eng = pagerank.build_engine(g, num_parts=np_parts,
                                 pair_threshold=pair or None,
                                 starts=starts,
-                                tile_e=tile_e or None)
+                                tile_e=tile_e or None,
+                                exchange=exchange)
     rep = eng.sg.memory_report()
     t = log("build_engine", t,
             vpad=eng.sg.vpad, epad=eng.sg.epad,
@@ -88,7 +90,8 @@ def main():
         "metric": f"pagerank_rmat{scale}_np{np_parts}_gteps_per_chip",
         "value": round(gteps, 4), "unit": "GTEPS",
         "vs_baseline": round(gteps, 4), "np": np_parts,
-        "scale": scale, "pair_threshold": pair or None}))
+        "scale": scale, "pair_threshold": pair or None,
+        "exchange": exchange}))
 
 
 if __name__ == "__main__":

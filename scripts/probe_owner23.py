@@ -55,10 +55,31 @@ print(f"owner engine ({time.time() - t0:.0f}s) stats={eng.owner.stats} "
       flush=True)
 
 # phase split (separate fenced programs; relative weights)
-_s, rep = eng.timed_phases(eng.init_state(), 3)
-for i, t in enumerate(rep):
-    print(f"iter {i}: " + "  ".join(f"{k}={v * 1e3:7.1f}ms"
-                                    for k, v in t.items()), flush=True)
+if "-no-phases" not in sys.argv:
+    _s, rep = eng.timed_phases(eng.init_state(), 3)
+    for i, t in enumerate(rep):
+        print(f"iter {i}: " + "  ".join(f"{k}={v * 1e3:7.1f}ms"
+                                        for k, v in t.items()),
+              flush=True)
+
+from lux_tpu.timing import fence
+
+if "-stepwise" in sys.argv:
+    # per-iteration jitted steps (async dispatch, one final fence) —
+    # isolates the fori_loop program from the step program
+    state = eng.init_state()
+    state = eng.step(state)
+    fence(state)                       # compile + settle
+    state = eng.init_state()
+    fence(state)
+    t0 = time.time()
+    for _ in range(ni):
+        state = eng.step(state)
+    fence(state)
+    el = time.time() - t0
+    print(f"owner stepwise: {el / ni * 1e3:.0f} ms/iter  "
+          f"{el / ni / g2.ne * 1e9:.1f} ns/edge  "
+          f"{g2.ne * ni / el / 1e9:.4f} GTEPS", flush=True)
 
 # fused timing
 state, [el] = timed_fused_run(eng, ni)
