@@ -100,10 +100,11 @@ def _print_phases(report):
     """Per-iteration phase table — the analogue of the reference's
     -verbose per-iteration loadTime/compTime/updateTime prints
     (reference sssp_gpu.cu:513-518)."""
+    META = ("frontier", "bucket", "advances")   # counters, not times
     for i, t in enumerate(report):
-        extra = (f" frontier={t['frontier']}" if "frontier" in t else "")
+        extra = "".join(f" {k}={t[k]:g}" for k in META if k in t)
         split = "  ".join(f"{k}={v * 1e3:7.2f}ms" for k, v in t.items()
-                          if k != "frontier")
+                          if k not in META)
         print(f"iter {i}:{extra}  {split}")
 
 
@@ -295,8 +296,8 @@ def cmd_colfilter(argv):
     # matching — and equivalent — choice
     print(f"RMSE = {colfilter.rmse(g_run, out):.6f}")
     if args.phases:
-        print("note: -phases is unavailable for the colfilter dot-path "
-              "engine (fused MXU phases); use -profile for a trace")
+        _state, rep = eng.timed_phases(eng.init_state(), args.phases)
+        _print_phases(rep)
     if args.check:
         from lux_tpu.device_check import check_colfilter_device
         res = check_colfilter_device(sg, out, mesh=eng.mesh)

@@ -22,8 +22,10 @@ from lux_tpu.parallel.mesh import PARTS_AXIS
 
 
 def cksum(x):
-    """Tiny fence scalar: depends on the phase output, costs nothing."""
-    return jnp.sum(x.reshape(-1)[:8].astype(jnp.float32))
+    """Tiny fence scalar: depends on the phase output, costs nothing
+    (the same first-8-elements convention as lux_tpu.timing.fence)."""
+    from lux_tpu.timing import _cksum
+    return _cksum(x)
 
 
 def mesh_wrap(mesh, n_graph_args, parts_spec, repl_spec):

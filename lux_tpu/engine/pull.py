@@ -480,11 +480,6 @@ class PullEngine:
         (single device: all parts; under shard_map: this device's)."""
         sg = self.sg
         acc = self._owner_contribs(state, g)
-        # keep the apply epilogue from fusing back into the scan: the
-        # separate phased programs measured 6.5 s/iter at RMAT25 where
-        # the combined step ran 8.6-12.5 s in the SAME process; the
-        # barrier restores the phase boundary XLA otherwise erases
-        acc = jax.lax.optimization_barrier(acc)
         red = self._owner_exchange(acc)[:, :sg.vpad]
         flat = None
         if self.pairs is not None:

@@ -15,10 +15,12 @@ import time
 
 import numpy as np
 
-scale = int(sys.argv[1]) if len(sys.argv) > 1 else 23
-nparts = int(sys.argv[2]) if len(sys.argv) > 2 else 4
-owner_E = int(sys.argv[3]) if len(sys.argv) > 3 else 256
-ni = int(sys.argv[4]) if len(sys.argv) > 4 else 6
+args = [a for a in sys.argv[1:] if not a.startswith("-")]
+flags = {a for a in sys.argv[1:] if a.startswith("-")}
+scale = int(args[0]) if len(args) > 0 else 23
+nparts = int(args[1]) if len(args) > 1 else 4
+owner_E = int(args[2]) if len(args) > 2 else 256
+ni = int(args[3]) if len(args) > 3 else 6
 
 from lux_tpu.apps import pagerank
 from lux_tpu.convert import rmat_graph
@@ -55,7 +57,7 @@ print(f"owner engine ({time.time() - t0:.0f}s) stats={eng.owner.stats} "
       flush=True)
 
 # phase split (separate fenced programs; relative weights)
-if "-no-phases" not in sys.argv:
+if "-no-phases" not in flags:
     _s, rep = eng.timed_phases(eng.init_state(), 3)
     for i, t in enumerate(rep):
         print(f"iter {i}: " + "  ".join(f"{k}={v * 1e3:7.1f}ms"
@@ -64,7 +66,7 @@ if "-no-phases" not in sys.argv:
 
 from lux_tpu.timing import fence
 
-if "-stepwise" in sys.argv:
+if "-stepwise" in flags:
     # per-iteration jitted steps (async dispatch, one final fence) —
     # isolates the fori_loop program from the step program
     state = eng.init_state()
