@@ -49,7 +49,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  dtype=jnp.float32, sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
                  starts=None, tile_e: int | None = None,
-                 exchange: str = "gather") -> PullEngine:
+                 exchange: str = "gather",
+                 owner_tile_e: int | None = None) -> PullEngine:
     """starts: partition cut points (e.g. from graph.pair_relabel for
     balanced multi-part pair delivery).  tile_e default: 128 with pair
     delivery (residual edges are sparse; shorter chunks waste far
@@ -61,9 +62,10 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                                 pair_threshold=pair_threshold)
     if tile_e is None:
         tile_e = 128 if pair_threshold is not None else 512
+    kw = {} if owner_tile_e is None else dict(owner_tile_e=owner_tile_e)
     return PullEngine(sg, make_program(dtype), mesh=mesh,
                       pair_threshold=pair_threshold, tile_e=tile_e,
-                      exchange=exchange)
+                      exchange=exchange, **kw)
 
 
 

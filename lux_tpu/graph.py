@@ -118,7 +118,8 @@ def degree_relabel(g: Graph):
 
 def pair_relabel(g: Graph, num_parts: int = 1,
                  pair_threshold: int = 16, gather_cost: float = 9.0,
-                 pair_cost: float = 2.5, vpad_cap: float = 1.2):
+                 pair_cost: float = 2.5, vpad_cap: float = 1.2,
+                 verbose: bool = False):
     """Degree-sort, then DEAL whole 128-vertex tiles to parts by
     greedy cost balancing (LPT over degree-ordered tiles).
 
@@ -152,10 +153,20 @@ def pair_relabel(g: Graph, num_parts: int = 1,
     ``starts`` the partition cut points to pass to ShardedGraph.build
     (tile-aligned; a partial trailing tile is placed last).
     """
+    import time as _time
+
+    def _tick(t0, stage):
+        if verbose:
+            print(f"# pair_relabel/{stage}: {_time.time() - t0:.1f}s",
+                  flush=True)
+        return _time.time()
+
+    t0 = _time.time()
     src, dst = g.edge_arrays()
     deg = (np.bincount(src, minlength=g.nv)
            + np.bincount(dst, minlength=g.nv))
     by_deg = np.argsort(-deg, kind="stable")      # degree position -> old
+    t0 = _tick(t0, "edges+degree_sort")
     Wt = 128
     n_tiles = -(-g.nv // Wt)
     full = n_tiles - 1 if g.nv % Wt else n_tiles
@@ -181,6 +192,7 @@ def pair_relabel(g: Graph, num_parts: int = 1,
                           gather_cost)
         tile_cost = np.bincount(d2 // Wt, weights=cost_e,
                                 minlength=n_tiles)
+        t0 = _tick(t0, "pair_histogram")
         cap = max(1, int(np.ceil(vpad_cap * full / P)))
         load = np.zeros(P)
         tiles_held = np.zeros(P, np.int64)
@@ -208,7 +220,9 @@ def pair_relabel(g: Graph, num_parts: int = 1,
     perm = by_deg[vert_order]                     # new -> old
     rank = np.empty(g.nv, np.int64)
     rank[perm] = np.arange(g.nv)
+    t0 = _tick(t0, "lpt_dealing")
     g2 = Graph.from_edges(rank[src], rank[dst], g.nv, weights=g.weights)
+    _tick(t0, "rebuild_csc")
     return g2, perm, starts
 
 

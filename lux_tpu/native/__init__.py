@@ -91,6 +91,9 @@ def _bind(lib):
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
         ctypes.c_double, ctypes.c_double, ctypes.c_double,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.lux_argsort_u64.restype = ctypes.c_int
+    lib.lux_argsort_u64.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -174,3 +177,36 @@ def rmat_csc(scale: int, edge_factor: int = 16, seed: int = 0,
         col_idx.ctypes.data_as(ctypes.c_void_p),
         degrees.ctypes.data_as(ctypes.c_void_p)), "rmat_csc")
     return row_ptrs, col_idx, degrees
+
+
+def argsort_u64(keys, threads: int | None = None):
+    """Stable parallel radix argsort of non-negative int64/uint64 keys
+    (sort.cc).  Single-core hosts run at numpy-radix speed; pod hosts
+    scale with cores (PERF_NOTES round-3 #4).  Returns int64 perm."""
+    keys = np.ascontiguousarray(keys)
+    if keys.dtype == np.int64:
+        if keys.size and int(keys.min()) < 0:
+            raise ValueError("argsort_u64 needs non-negative keys")
+        keys = keys.view(np.uint64)
+    elif keys.dtype != np.uint64:
+        raise ValueError(f"argsort_u64: unsupported dtype {keys.dtype}")
+    if threads is None:
+        threads = min(16, os.cpu_count() or 1)
+    out = np.empty(keys.size, np.int64)
+    lib = _load_lib()
+    _check(lib.lux_argsort_u64(
+        keys.ctypes.data_as(ctypes.c_void_p), keys.size, int(threads),
+        out.ctypes.data_as(ctypes.c_void_p)), "lux_argsort_u64")
+    return out
+
+
+def best_argsort(keys):
+    """Stable argsort of non-negative int64 keys picking the winning
+    backend: the parallel native radix sort on multi-core hosts (pods;
+    PERF_NOTES round-3 #4), numpy's single-threaded radix elsewhere
+    (measured ~2x faster than the native sort at 1 thread)."""
+    n_cpu = os.cpu_count() or 1
+    if n_cpu >= 4 and available():
+        return argsort_u64(keys, threads=min(16, n_cpu))
+    import numpy as _np
+    return _np.argsort(keys, kind="stable")

@@ -1,0 +1,130 @@
+// Parallel radix argsort for the host prep pipeline.
+//
+// The framework's big host-side costs at billion-edge scale are int64
+// key argsorts (pair_relabel's pair histogram, edges_to_csc's
+// (dst, src) order, OwnerLayout's (src-part, dst-tile) order —
+// PERF_NOTES round-3 #4); numpy's radix sort is single-threaded.
+// This is a pthread LSD radix argsort over 8-bit digits: per pass,
+// per-thread histograms over a block of the input, an exclusive scan
+// over (digit, thread) for stable placement, then a scatter pass.
+// One CPU runs at numpy-comparable speed; pod hosts with many cores
+// scale near-linearly (the reference's converter leans on big host
+// RAM + cores the same way, reference tools/converter.cc:85-98).
+//
+// C ABI (ctypes): lux_argsort_u64(keys, n, threads, perm_out).
+// perm_out must hold n int64; keys are NOT modified.
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <pthread.h>
+#include <vector>
+
+namespace {
+
+struct PassArgs {
+  const uint64_t* keys;       // key of ORIGINAL index i
+  const int64_t* src;         // current permutation (input order)
+  int64_t* dst;               // output permutation
+  int64_t lo, hi;             // this thread's slice of src
+  int shift;
+  int64_t* hist;              // [256] this thread's digit histogram
+  int64_t* offs;              // [256] this thread's placement offsets
+};
+
+void* hist_pass(void* p) {
+  auto* a = static_cast<PassArgs*>(p);
+  std::memset(a->hist, 0, 256 * sizeof(int64_t));
+  for (int64_t i = a->lo; i < a->hi; i++) {
+    a->hist[(a->keys[a->src[i]] >> a->shift) & 0xff]++;
+  }
+  return nullptr;
+}
+
+void* scatter_pass(void* p) {
+  auto* a = static_cast<PassArgs*>(p);
+  for (int64_t i = a->lo; i < a->hi; i++) {
+    int64_t v = a->src[i];
+    int d = (a->keys[v] >> a->shift) & 0xff;
+    a->dst[a->offs[d]++] = v;
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" int lux_argsort_u64(const uint64_t* keys, int64_t n,
+                               int threads, int64_t* perm_out) {
+  if (n < 0 || threads < 1) return 1;
+  if (threads > 256) threads = 256;
+  // uninitialized scratch (a vector would zero-fill 8 GB at scale)
+  std::unique_ptr<int64_t[]> tmp(new int64_t[n]);
+  int64_t* cur = perm_out;
+  int64_t* nxt = tmp.get();
+  for (int64_t i = 0; i < n; i++) cur[i] = i;
+
+  std::vector<int64_t> hist(static_cast<size_t>(threads) * 256);
+  std::vector<int64_t> offs(static_cast<size_t>(threads) * 256);
+  std::vector<PassArgs> args(threads);
+  std::vector<pthread_t> tid(threads);
+  std::vector<char> created(threads, 0);
+  int64_t chunk = (n + threads - 1) / threads;
+
+  for (int pass = 0; pass < 8; pass++) {
+    int shift = pass * 8;
+    for (int t = 0; t < threads; t++) {
+      int64_t lo = t * chunk;
+      int64_t hi = lo + chunk < n ? lo + chunk : n;
+      if (lo > n) lo = n;
+      args[t] = PassArgs{keys, cur, nxt, lo, hi, shift,
+                         &hist[static_cast<size_t>(t) * 256],
+                         &offs[static_cast<size_t>(t) * 256]};
+      // run inline on pthread_create failure (EAGAIN on loaded
+      // hosts) — joining an uninitialized handle is UB
+      if (threads <= 1 || pthread_create(&tid[t], nullptr, hist_pass,
+                                         &args[t]) != 0) {
+        hist_pass(&args[t]);
+        created[t] = false;
+      } else {
+        created[t] = true;
+      }
+    }
+    for (int t = 0; t < threads; t++)
+      if (created[t]) pthread_join(tid[t], nullptr);
+    // all keys in one digit bucket => the pass is the identity
+    // permutation; skip the scatter (typical keys leave the top
+    // bytes zero, halving the passes or better)
+    bool trivial = false;
+    for (int d = 0; d < 256 && !trivial; d++) {
+      int64_t tot = 0;
+      for (int t = 0; t < threads; t++)
+        tot += hist[static_cast<size_t>(t) * 256 + d];
+      if (tot == n) trivial = true;
+    }
+    if (trivial) continue;
+    // exclusive scan in (digit, thread) order => stable placement
+    int64_t run = 0;
+    for (int d = 0; d < 256; d++) {
+      for (int t = 0; t < threads; t++) {
+        offs[static_cast<size_t>(t) * 256 + d] = run;
+        run += hist[static_cast<size_t>(t) * 256 + d];
+      }
+    }
+    for (int t = 0; t < threads; t++) {
+      if (threads <= 1 || pthread_create(&tid[t], nullptr, scatter_pass,
+                                         &args[t]) != 0) {
+        scatter_pass(&args[t]);
+        created[t] = false;
+      } else {
+        created[t] = true;
+      }
+    }
+    for (int t = 0; t < threads; t++)
+      if (created[t]) pthread_join(tid[t], nullptr);
+    std::swap(cur, nxt);
+  }
+  // trivial-pass skips can leave the result in the scratch buffer
+  if (cur != perm_out)
+    std::memcpy(perm_out, cur, static_cast<size_t>(n) * sizeof(int64_t));
+  return 0;
+}

@@ -102,7 +102,8 @@ class OwnerLayout:
         del rel_l
         wgt = np.concatenate(w_l) if w_l else None
         del w_l
-        order = np.argsort(key, kind="stable")
+        from lux_tpu import native
+        order = native.best_argsort(key)   # parallel on pod hosts
         key = key[order]
         srcl = srcl[order]
         rel = rel[order]

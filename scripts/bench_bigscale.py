@@ -9,7 +9,8 @@ fetch fence).
 Usage:
   PYTHONPATH=/root/repo:/root/.axon_site \
       python scripts/bench_bigscale.py [scale=25] [np=4] [pair=0] [ni=3] \
-                                       [tile_e=0] [exchange=gather]
+                                       [tile_e=0] [exchange=gather] \
+                                       [owner_tile_e=256]
 
 pair > 0 additionally runs graph.pair_relabel + pair-lane delivery
 (slower host prep; measures the fast path at scale).  tile_e=0 uses
@@ -41,6 +42,7 @@ def main():
     ni = int(sys.argv[4]) if len(sys.argv) > 4 else 3
     tile_e = int(sys.argv[5]) if len(sys.argv) > 5 else 0
     exchange = sys.argv[6] if len(sys.argv) > 6 else "gather"
+    owner_e = int(sys.argv[7]) if len(sys.argv) > 7 else 0
 
     import os
 
@@ -72,7 +74,8 @@ def main():
                                 pair_threshold=pair or None,
                                 starts=starts,
                                 tile_e=tile_e or None,
-                                exchange=exchange)
+                                exchange=exchange,
+                                owner_tile_e=owner_e or None)
     rep = eng.sg.memory_report()
     t = log("build_engine", t,
             vpad=eng.sg.vpad, epad=eng.sg.epad,

@@ -140,3 +140,27 @@ def test_rmat_graph_runs_apps():
     g = rmat_graph(9, 4, seed=3)
     ranks = pagerank.run(g, 5, num_parts=2)
     assert np.isfinite(ranks).all() and ranks.shape == (g.nv,)
+
+
+def test_argsort_u64_matches_numpy():
+    """Parity for the parallel radix argsort (sort.cc): stable-equal
+    to np.argsort for full-range and bounded (pass-skipping) keys,
+    at 1 and several threads."""
+    import numpy as np
+
+    from lux_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(7)
+    for hi in (1 << 60, 1 << 20, 8):
+        keys = rng.integers(0, hi, 100_000).astype(np.int64)
+        want = np.argsort(keys, kind="stable")
+        for threads in (1, 3, 8):
+            got = native.argsort_u64(keys, threads=threads)
+            np.testing.assert_array_equal(got, want)
+    # empty + single
+    assert native.argsort_u64(np.empty(0, np.int64)).size == 0
+    np.testing.assert_array_equal(
+        native.argsort_u64(np.asarray([5], np.int64)), [0])
