@@ -186,9 +186,19 @@ def pair_relabel(g: Graph, num_parts: int = 1,
         rank0[by_deg] = np.arange(g.nv)
         s2, d2 = rank0[src], rank0[dst]
         key = (s2 // Wt) * np.int64(n_tiles) + d2 // Wt
-        _uniq, inv, cnt = np.unique(key, return_inverse=True,
-                                    return_counts=True)
-        cost_e = np.where(cnt[inv] >= pair_threshold, pair_cost,
+        # per-edge pair multiplicity without np.unique's inverse
+        # machinery: one (parallelizable) argsort + group boundaries
+        from lux_tpu import native
+        order0 = native.best_argsort(key)
+        ks = key[order0]
+        newg = np.ones(len(ks), bool)
+        newg[1:] = ks[1:] != ks[:-1]
+        gid = np.cumsum(newg) - 1
+        cnt = np.bincount(gid)
+        mult = np.empty(len(ks), np.int64)
+        mult[order0] = cnt[gid]                 # per-edge multiplicity
+        del order0, ks, newg, gid
+        cost_e = np.where(mult >= pair_threshold, pair_cost,
                           gather_cost)
         tile_cost = np.bincount(d2 // Wt, weights=cost_e,
                                 minlength=n_tiles)
