@@ -33,7 +33,7 @@ def make_program() -> PushProgram:
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
-                 starts=None, exchange: str = "gather") -> PushEngine:
+                 starts=None, exchange: str = "auto") -> PushEngine:
     """pair_threshold enables pair-lane delivery on dense iterations
     (best after graph.pair_relabel, passing its ``starts`` through;
     labels are vertex ids, so map results back through the relabel

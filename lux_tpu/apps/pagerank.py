@@ -49,7 +49,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  dtype=jnp.float32, sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
                  starts=None, tile_e: int | None = None,
-                 exchange: str = "gather",
+                 exchange: str = "auto",
                  owner_tile_e: int | None = None) -> PullEngine:
     """starts: partition cut points (e.g. from graph.pair_relabel for
     balanced multi-part pair delivery).  tile_e default: 128 with pair

@@ -68,7 +68,7 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                  delta: float | str | None = None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
-                 starts=None, exchange: str = "gather") -> PushEngine:
+                 starts=None, exchange: str = "auto") -> PushEngine:
     """delta: bucket width for delta-stepping priority ordering
     (weighted runs); "auto" picks a heuristic; None disables (plain
     Bellman-Ford frontier relaxation).  pair_threshold enables pair-

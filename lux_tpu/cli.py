@@ -50,15 +50,17 @@ def _common(ap: argparse.ArgumentParser):
                          "per-vertex results are mapped back to input "
                          "ids where printed; colfilter's edge-wise "
                          "RMSE/check need no mapping)")
-    ap.add_argument("-exchange", default="gather",
-                    choices=["gather", "owner"],
+    ap.add_argument("-exchange", default="auto",
+                    choices=["auto", "gather", "owner"],
                     help="state exchange for pagerank/sssp/cc: "
                          "'gather' (all-gather + per-edge gather from "
-                         "the full table) or 'owner' (per-source-part "
+                         "the full table), 'owner' (per-source-part "
                          "gathers from own shards + reduce_scatter; "
-                         "the fast path once state outgrows ~64 MB — "
-                         "PERF_NOTES.md; colfilter's dot path has its "
-                         "own dst-free machinery and ignores this)")
+                         "2x+ once state outgrows ~64 MB — "
+                         "PERF_NOTES.md), or 'auto' (owner above a "
+                         "96 MB state table; the default).  "
+                         "colfilter's dot path has its own dst-free "
+                         "machinery and ignores this")
     ap.add_argument("-phases", type=int, default=0, metavar="N",
                     help="after the timed run, run N instrumented "
                          "iterations and print the per-iteration "
@@ -111,7 +113,7 @@ def _print_phases(report):
 def _warn_exchange_ignored(args):
     """colfilter's dot path has its own dst-free delivery; -exchange
     does not apply there."""
-    if args.exchange != "gather":
+    if args.exchange not in ("gather", "auto"):
         print(f"note: -exchange {args.exchange} does not apply to "
               f"colfilter's dot path; ignored")
 

@@ -60,6 +60,40 @@ def resolve_reduce_method(method: str) -> str:
     raise ValueError(f"unknown reduce_method {method!r}")
 
 
+# auto exchange: go owner-side once the flat state table passes this
+# many bytes — the measured XLA gather emitter step sits at ~64-128 MB
+# (scripts/profile_bigtable.py), so 96 MB splits the band; below it the
+# owner layout's chunk padding isn't worth carrying
+OWNER_AUTO_BYTES = 96 << 20
+
+
+def resolve_exchange(exchange: str, sg: ShardedGraph, program,
+                     itemsize: int | None = None) -> str:
+    """'auto' picks 'owner' when the program qualifies (source-only
+    edge values, all parts materialized) and the state table would
+    pay the big-table gather tax; 'gather' otherwise.
+
+    itemsize: bytes per state element for the table estimate.  Push
+    engines pass the label dtype's; pull defaults to 4 (f32) — a
+    conservative-enough stand-in since pull programs may carry any
+    trailing dims the estimate cannot see anyway."""
+    if exchange == "auto":
+        if itemsize is None:
+            ident = getattr(program, "identity", None)
+            itemsize = (np.asarray(ident).dtype.itemsize
+                        if ident is not None else 4)
+        # works for Pull AND Push programs (push has no dst/dot hooks)
+        eligible = (not getattr(program, "needs_dst", False)
+                    and getattr(program, "edge_value_from_dot",
+                                None) is None
+                    and sg.local_parts is None)
+        big = sg.num_parts * sg.vpad * itemsize > OWNER_AUTO_BYTES
+        return "owner" if (eligible and big) else "gather"
+    if exchange not in ("gather", "owner"):
+        raise ValueError(f"unknown exchange {exchange!r}")
+    return exchange
+
+
 def common_graph_arrays(sg: ShardedGraph, dev):
     """deg + nvp, the apply-epilogue arrays every layout needs.  The
     valid-vertex mask is DERIVED on device from the per-part counts
@@ -120,14 +154,13 @@ class PullEngine:
                  pair_threshold: int | None = None,
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
-                 exchange: str = "gather",
+                 exchange: str = "auto",
                  owner_tile_e: int = 256):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
                 f"{mesh.devices.size}")
-        if exchange not in ("gather", "owner"):
-            raise ValueError(f"unknown exchange {exchange!r}")
+        exchange = resolve_exchange(exchange, sg, program)
         if exchange == "owner" and (
                 program.needs_dst
                 or program.edge_value_from_dot is not None):

@@ -93,7 +93,7 @@ class PushEngine:
                  pair_threshold: int | None = None,
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
-                 exchange: str = "gather",
+                 exchange: str = "auto",
                  owner_tile_e: int = 256):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
@@ -101,10 +101,10 @@ class PushEngine:
                 f"{mesh.devices.size}")
         from lux_tpu.engine.pull import (_check_local_parts,
                                          build_graph_arrays,
+                                         resolve_exchange,
                                          resolve_reduce_method)
         _check_local_parts(sg, mesh, pair_threshold)
-        if exchange not in ("gather", "owner"):
-            raise ValueError(f"unknown exchange {exchange!r}")
+        exchange = resolve_exchange(exchange, sg, program)
         if exchange == "owner" and sg.local_parts is not None:
             raise NotImplementedError(
                 "owner exchange is not yet supported with per-host "

@@ -26,7 +26,7 @@ def main():
 
     for np_parts in nps:
         t0 = time.time()
-        eng = pagerank.build_engine(g, num_parts=np_parts)
+        eng = pagerank.build_engine(g, num_parts=np_parts, exchange="gather")
         print(f"# np={np_parts} build {time.time() - t0:.0f}s "
               f"vpad={eng.sg.vpad} epad={eng.sg.epad} "
               f"C={eng.tiles.n_chunks}", flush=True)

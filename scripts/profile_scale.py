@@ -57,7 +57,7 @@ def main(scales):
     for scale in scales:
         g = rmat_graph(scale=scale, edge_factor=16, seed=0)
         g2, _ = degree_relabel(g)
-        eng = pagerank.build_engine(g2, num_parts=1, pair_threshold=16)
+        eng = pagerank.build_engine(g2, num_parts=1, pair_threshold=16, exchange="gather")
         sp = eng.pairs
         lay = eng.tiles
         print(f"--- scale {scale}: ne={g.ne} "
@@ -72,7 +72,7 @@ def main(scales):
             *eng.graph_args)
 
         # no-pair engine on the same relabeled graph
-        eng0 = pagerank.build_engine(g2, num_parts=1)
+        eng0 = pagerank.build_engine(g2, num_parts=1, exchange="gather")
         t_nopair = timed_scalar_loop(
             lambda s, *a: eng0._step_core(s, *a), eng0.init_state(),
             *eng0.graph_args)

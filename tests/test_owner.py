@@ -284,3 +284,37 @@ def test_owner_layout_covers_every_edge(graph):
                         lay.rel_dst[s, c][lanes]))
     n_edges = sum(len(x[2]) for x in got)
     assert n_edges == sg.ne
+
+
+def test_resolve_exchange_auto(graph):
+    """The auto rule: owner above the 96 MB state-table threshold for
+    eligible programs; gather below it and for every ineligible
+    shape (dst-dependent, dot-path, local-parts)."""
+    import dataclasses
+
+    from lux_tpu.engine.pull import OWNER_AUTO_BYTES, resolve_exchange
+
+    sg = ShardedGraph.build(graph, 4)
+    prog = pagerank.make_program()
+    assert resolve_exchange("auto", sg, prog) == "gather"  # tiny table
+    needed = OWNER_AUTO_BYTES // (sg.num_parts * 4) + 1
+    big = dataclasses.replace(sg, vpad=needed)
+    assert resolve_exchange("auto", big, prog) == "owner"
+    # ineligible: dst-dependent edge values
+    bad = PullProgram(reduce=prog.reduce, edge_value=prog.edge_value,
+                      apply=prog.apply, init=prog.init, needs_dst=True)
+    assert resolve_exchange("auto", big, bad) == "gather"
+    # ineligible: dot-path programs
+    dot = PullProgram(reduce=prog.reduce, edge_value=prog.edge_value,
+                      apply=prog.apply, init=prog.init,
+                      edge_value_from_dot=lambda s, d, w: s)
+    assert resolve_exchange("auto", big, dot) == "gather"
+    # push programs route through the same rule via their identity
+    from lux_tpu.apps import sssp
+    pprog = sssp.make_program(0)
+    assert resolve_exchange("auto", big, pprog) == "owner"
+    # explicit values pass through; unknowns raise
+    assert resolve_exchange("gather", big, prog) == "gather"
+    assert resolve_exchange("owner", sg, prog) == "owner"
+    with pytest.raises(ValueError, match="unknown exchange"):
+        resolve_exchange("bogus", sg, prog)
