@@ -42,7 +42,8 @@ def make_program(dtype=jnp.float32) -> PullProgram:
         return state.astype(np.dtype(dtype))
 
     return PullProgram(reduce="sum", edge_value=edge_value, apply=apply,
-                       init=init, needs_dst=False)
+                       init=init, needs_dst=False,
+                       state_bytes=np.dtype(dtype).itemsize)
 
 
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,

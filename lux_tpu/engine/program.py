@@ -65,6 +65,11 @@ class PullProgram:
                 computes the dot on the MXU from the destination TILE
                 (dst values are tile-positional, so the ~9 ns/edge dst
                 row-gather disappears; see PullEngine._part_step_dot).
+    state_bytes bytes per VERTEX of the iterated state (itemsize x
+                trailing dims), e.g. 80 for colfilter's [vpad, 20]
+                f32.  Feeds resolve_exchange's state-table size
+                estimate (the big-table gather cliff is in BYTES);
+                None -> assume 4 (scalar f32).
     """
     reduce: str
     edge_value: Callable
@@ -72,3 +77,4 @@ class PullProgram:
     init: Callable
     needs_dst: bool = False
     edge_value_from_dot: Callable | None = None
+    state_bytes: int | None = None

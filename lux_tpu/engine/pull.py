@@ -73,11 +73,12 @@ def resolve_exchange(exchange: str, sg: ShardedGraph, program,
     edge values, all parts materialized) and the state table would
     pay the big-table gather tax; 'gather' otherwise.
 
-    itemsize: bytes per state element for the table estimate.  Push
-    engines pass the label dtype's; pull defaults to 4 (f32) — a
-    conservative-enough stand-in since pull programs may carry any
-    trailing dims the estimate cannot see anyway."""
+    itemsize: bytes per VERTEX for the table estimate (itemsize x
+    trailing dims).  Default: the program's ``state_bytes`` (pull) or
+    its ``identity`` dtype's itemsize (push); 4 when neither exists."""
     if exchange == "auto":
+        if itemsize is None:
+            itemsize = getattr(program, "state_bytes", None)
         if itemsize is None:
             ident = getattr(program, "identity", None)
             itemsize = (np.asarray(ident).dtype.itemsize

@@ -161,6 +161,11 @@ def pair_relabel(g: Graph, num_parts: int = 1,
                   flush=True)
         return _time.time()
 
+    if vpad_cap < 1:
+        # cap * P must cover every full tile, or the LPT's all-capped
+        # argmin would dump the remainder on part 0 uncapped AND
+        # unbalanced
+        raise ValueError(f"vpad_cap={vpad_cap} must be >= 1")
     t0 = _time.time()
     src, dst = g.edge_arrays()
     deg = (np.bincount(src, minlength=g.nv)

@@ -76,6 +76,11 @@ class TiledLayout:
         ``row_ptr_local`` holds only a process's local parts — chunk
         count and scan-necessity are program SHAPE/structure and must
         be identical on every process of a multi-host run."""
+        if W > 128:
+            raise ValueError(
+                f"tile width W={W} > 128: rel_dst is int8 (valid lane "
+                f"offsets 0..127, -1 = pad) and wider tiles would wrap "
+                f"offsets >= 128 negative, silently dropping edges")
         P = row_ptr_local.shape[0]
         n_tiles = max(1, _ceil_div(vpad, W))
 

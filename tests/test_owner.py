@@ -318,3 +318,10 @@ def test_resolve_exchange_auto(graph):
     assert resolve_exchange("owner", sg, prog) == "owner"
     with pytest.raises(ValueError, match="unknown exchange"):
         resolve_exchange("bogus", sg, prog)
+    # wide-payload programs declare state_bytes: the table estimate
+    # sees the trailing dims and triggers owner K-times earlier
+    wide = dataclasses.replace(prog, state_bytes=80)
+    midpad = OWNER_AUTO_BYTES // (sg.num_parts * 80) + 1
+    mid = dataclasses.replace(sg, vpad=midpad)
+    assert resolve_exchange("auto", mid, prog) == "gather"
+    assert resolve_exchange("auto", mid, wide) == "owner"

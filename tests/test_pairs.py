@@ -518,3 +518,39 @@ def test_streamed_msgs_vector_payload():
     np.testing.assert_allclose(
         np.asarray(fast.step(fast.init_state())),
         np.asarray(base.step(base.init_state())), rtol=1e-6)
+
+
+def test_streamed_empty_classes_plan():
+    """Direct callers may hand pair_partial_streamed a plan with NO
+    classes (plan_sharded_pairs returns None first, but the function
+    must not IndexError on the degenerate shape): every tile resolves
+    to the trailing identity slot (ADVICE r2 #3 / VERDICT r3 #8)."""
+    import jax.numpy as jnp
+    from lux_tpu.ops.pairs import (StackedPairPlan, pair_partial,
+                                   pair_partial_streamed)
+
+    n_tiles = 3
+    sp = StackedPairPlan(
+        rowbind=np.zeros((1, 0), np.int32),
+        rel_dst=np.full((1, 0, W), -1, np.int8), weight=None,
+        tile_pos=np.full((1, n_tiles), 0, np.int32), classes=[],
+        n_tiles=n_tiles, n_slots=0, R=0, Rp=0, stats={})
+    flat = jnp.arange(n_tiles * W, dtype=jnp.float32)
+    for fn in (pair_partial, pair_partial_streamed):
+        out = np.asarray(fn(sp, flat, jnp.asarray(sp.rowbind[0]),
+                            jnp.asarray(sp.rel_dst[0]), None,
+                            jnp.asarray(sp.tile_pos[0]), "sum",
+                            lambda v, w: v))
+        assert out.shape == (n_tiles * W,)
+        np.testing.assert_array_equal(out, 0.0)
+
+
+def test_pair_relabel_rejects_bad_vpad_cap():
+    """vpad_cap < 1 cannot cover every full tile: the capped LPT's
+    argmin over an all-inf mask would silently dump the remainder on
+    part 0 (ADVICE r3)."""
+    from lux_tpu.graph import pair_relabel
+
+    g = _skewed_graph(7, 4 * W, 3000)
+    with pytest.raises(ValueError, match="vpad_cap"):
+        pair_relabel(g, 2, pair_threshold=4, vpad_cap=0.5)

@@ -115,3 +115,19 @@ def test_rmat_roundtrip_int():
             sg.vpad, "min"))
         want = _oracle(msgs[p], sg, p, "min")
         np.testing.assert_array_equal(got, want)
+
+
+def test_rejects_wide_tiles():
+    """rel_dst is int8 (lane offsets 0..127, -1 pad): W > 128 would
+    wrap offsets negative and silently drop edges (ADVICE r3)."""
+    import pytest
+    from lux_tpu.graph import Graph, ShardedGraph
+    from lux_tpu.ops.tiled import TiledLayout
+
+    rng = np.random.default_rng(3)
+    g = Graph.from_edges(rng.integers(0, 300, 2000),
+                         rng.integers(0, 300, 2000), 300)
+    sg = ShardedGraph.build(g, 2)
+    with pytest.raises(ValueError, match="W=256 > 128"):
+        TiledLayout.build(sg.row_ptr_local, sg.dst_local, sg.vpad,
+                          W=256, E=64)
