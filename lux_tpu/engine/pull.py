@@ -70,8 +70,9 @@ OWNER_AUTO_BYTES = 96 << 20
 def resolve_exchange(exchange: str, sg: ShardedGraph, program,
                      itemsize: int | None = None) -> str:
     """'auto' picks 'owner' when the program qualifies (source-only
-    edge values, all parts materialized) and the state table would
-    pay the big-table gather tax; 'gather' otherwise.
+    edge values; full AND multi-host local-parts builds both qualify)
+    and the state table would pay the big-table gather tax; 'gather'
+    otherwise.
 
     itemsize: bytes per VERTEX for the table estimate (itemsize x
     trailing dims).  Default: the program's ``state_bytes`` (pull) or
@@ -86,8 +87,7 @@ def resolve_exchange(exchange: str, sg: ShardedGraph, program,
         # works for Pull AND Push programs (push has no dst/dot hooks)
         eligible = (not getattr(program, "needs_dst", False)
                     and getattr(program, "edge_value_from_dot",
-                                None) is None
-                    and sg.local_parts is None)
+                                None) is None)
         big = sg.num_parts * sg.vpad * itemsize > OWNER_AUTO_BYTES
         return "owner" if (eligible and big) else "gather"
     if exchange not in ("gather", "owner"):
@@ -170,11 +170,6 @@ class PullEngine:
                 "depends only on the source state (owner-side parts "
                 "hold no destination state)")
         _check_local_parts(sg, mesh, pair_threshold)
-        if exchange == "owner" and sg.local_parts is not None:
-            raise NotImplementedError(
-                "owner exchange is not yet supported with per-host "
-                "local-parts builds (the layout needs every part's "
-                "edges)")
         self.exchange = exchange
         self.pairs = None
         if pair_threshold is not None:

@@ -105,11 +105,6 @@ class PushEngine:
                                          resolve_reduce_method)
         _check_local_parts(sg, mesh, pair_threshold)
         exchange = resolve_exchange(exchange, sg, program)
-        if exchange == "owner" and sg.local_parts is not None:
-            raise NotImplementedError(
-                "owner exchange is not yet supported with per-host "
-                "local-parts builds (the layout needs every part's "
-                "edges)")
         self.exchange = exchange
         if delta is not None:
             if program.reduce != "min":

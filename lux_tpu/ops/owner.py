@@ -49,7 +49,11 @@ class OwnerLayout:
 
     Attribute names n_chunks/E/W/needs_scan match TiledLayout so the
     shared device helpers (streamed_chunk_partials, combine_chunks)
-    accept either."""
+    accept either.
+
+    Array leading dim R = MATERIALIZED src-part rows: all num_parts on
+    a full build, this process's local parts on a multi-host build
+    (row i is sg.part_ids()[i], not global part i)."""
 
     W: int                      # vertices per destination tile
     E: int                      # edges per chunk
@@ -57,11 +61,11 @@ class OwnerLayout:
     G: int                      # global dst tiles = num_parts * n_tiles
     n_chunks: int               # padded per-src-part chunk count C
     needs_scan: bool
-    src_local: np.ndarray       # int32 [P, C, E] into own shard; pad->0
-    rel_dst: np.ndarray         # int8 [P, C, E] in [0, W); -1 = pad
-    weight: np.ndarray | None   # float32 [P, C, E]
-    chunk_start: np.ndarray     # bool [P, C] True at each tile's 1st chunk
-    last_chunk: np.ndarray      # int32 [P, G]; -1 for edge-less tiles
+    src_local: np.ndarray       # int32 [R, C, E] into own shard; pad->0
+    rel_dst: np.ndarray         # int8 [R, C, E] in [0, W); -1 = pad
+    weight: np.ndarray | None   # float32 [R, C, E]
+    chunk_start: np.ndarray     # bool [R, C] True at each tile's 1st chunk
+    last_chunk: np.ndarray      # int32 [R, G]; -1 for edge-less tiles
     stats: dict
 
     @classmethod
@@ -71,37 +75,54 @@ class OwnerLayout:
         Chunks bind to one global dst tile each, so per-(src-part,
         dst-tile) edge counts round up to E — smaller E wastes fewer
         padded gather slots when parts spread a tile's in-edges
-        thinly (the inflation is reported in ``stats``)."""
-        if sg.local_parts is not None:
-            raise NotImplementedError(
-                "owner-side layout needs every part's edges; build the "
-                "ShardedGraph without parts= (multi-host local rows)")
+        thinly (the inflation is reported in ``stats``).
+
+        Multi-host local-parts builds (sg.local_parts set): the
+        materialized rows are keyed by DESTINATION part, but the owner
+        layout needs edges keyed by SOURCE part — a planning-time
+        edge exchange streams every dst part's row across the process
+        group (``_local_src_edges``) and each process keeps only the
+        edges its own source parts emit; chunk geometry (C,
+        needs_scan) is then agreed with a host allreduce, exactly how
+        ``plan_sharded_pairs`` agrees on the depth profile.  The
+        result's leading dim is the LOCAL row count (the analogue of
+        the reference's per-node region instances,
+        reference push_model.inl:8-51)."""
         P, vpad, W = sg.num_parts, sg.vpad, 128
         n_tiles = max(1, _ceil_div(vpad, W))
         G = P * n_tiles
+        local = sg.local_parts is not None
+        own_rows = np.asarray(sg.part_ids(), np.int64)
+        R = len(own_rows)
 
-        # per-edge (src part, src local, global dst tile, rel) rows,
-        # then ONE stable sort by (src part, dst tile)
-        key_l, srcl_l, rel_l, w_l = [], [], [], []
-        for r in range(P):
-            nep = int(sg.ne_part[r])
-            slot = sg.src_slot[r, :nep].astype(np.int64)
-            s = slot // vpad
-            srcl_l.append((slot - s * vpad).astype(np.int32))
-            dst = sg.dst_local[r, :nep].astype(np.int64)
-            gt = r * n_tiles + (dst // W)
-            key_l.append(s * G + gt)
-            rel_l.append((dst % W).astype(np.int8))
-            if sg.weighted:
-                w_l.append(sg.edge_weight[r, :nep])
-        key = np.concatenate(key_l) if key_l else np.empty(0, np.int64)
-        del key_l
-        srcl = np.concatenate(srcl_l) if srcl_l else np.empty(0, np.int32)
-        del srcl_l
-        rel = np.concatenate(rel_l) if rel_l else np.empty(0, np.int8)
-        del rel_l
-        wgt = np.concatenate(w_l) if w_l else None
-        del w_l
+        if local:
+            key, srcl, rel, wgt = _local_src_edges(sg, n_tiles, G)
+        else:
+            # per-edge (src part, src local, global dst tile, rel)
+            # rows, then ONE stable sort by (src part, dst tile)
+            key_l, srcl_l, rel_l, w_l = [], [], [], []
+            for r in range(P):
+                nep = int(sg.ne_part[r])
+                slot = sg.src_slot[r, :nep].astype(np.int64)
+                s = slot // vpad
+                srcl_l.append((slot - s * vpad).astype(np.int32))
+                dst = sg.dst_local[r, :nep].astype(np.int64)
+                gt = r * n_tiles + (dst // W)
+                key_l.append(s * G + gt)
+                rel_l.append((dst % W).astype(np.int8))
+                if sg.weighted:
+                    w_l.append(sg.edge_weight[r, :nep])
+            key = (np.concatenate(key_l) if key_l
+                   else np.empty(0, np.int64))
+            del key_l
+            srcl = (np.concatenate(srcl_l) if srcl_l
+                    else np.empty(0, np.int32))
+            del srcl_l
+            rel = (np.concatenate(rel_l) if rel_l
+                   else np.empty(0, np.int8))
+            del rel_l
+            wgt = np.concatenate(w_l) if w_l else None
+            del w_l
         from lux_tpu import native
         order = native.best_argsort(key)   # parallel on pod hosts
         key = key[order]
@@ -111,27 +132,33 @@ class OwnerLayout:
             wgt = wgt[order]
         del order
         s_of = key // G
-        bounds = np.searchsorted(s_of, np.arange(P + 1))
 
-        # chunk counts per src part (sizing pass)
+        # chunk counts per OWNED src part (sizing pass); geometry is
+        # program shape, so multi-host builds allreduce it global
         per_part = []
-        for s in range(P):
-            lo, hi = int(bounds[s]), int(bounds[s + 1])
-            uniq_g, counts = np.unique(key[lo:hi] - s * np.int64(G),
+        for p in own_rows:
+            lo, hi = (int(np.searchsorted(s_of, p)),
+                      int(np.searchsorted(s_of, p + 1)))
+            uniq_g, counts = np.unique(key[lo:hi] - p * np.int64(G),
                                        return_counts=True)
             per_part.append((lo, uniq_g.astype(np.int64), counts))
         C = max(1, max((int(_ceil_div(c, E).sum())
                         for _, _, c in per_part), default=1))
-        C = _ceil_div(C, 8) * 8          # Pallas block granularity
         needs_scan = any((_ceil_div(c, E) > 1).any()
                          for _, _, c in per_part if c.size)
+        if local:
+            from lux_tpu.parallel.multihost import allreduce_host
+            C = int(allreduce_host(np.int64(C), "max"))
+            needs_scan = bool(allreduce_host(np.int64(needs_scan),
+                                             "max"))
+        C = _ceil_div(C, 8) * 8          # Pallas block granularity
 
-        src_local = np.zeros((P, C, E), dtype=np.int32)
-        rel_dst = np.full((P, C, E), -1, dtype=np.int8)
-        weight = (np.zeros((P, C, E), dtype=np.float32)
+        src_local = np.zeros((R, C, E), dtype=np.int32)
+        rel_dst = np.full((R, C, E), -1, dtype=np.int8)
+        weight = (np.zeros((R, C, E), dtype=np.float32)
                   if sg.weighted else None)
-        chunk_start = np.ones((P, C), dtype=bool)   # pad chunks isolated
-        last_chunk = np.full((P, G), -1, dtype=np.int32)
+        chunk_start = np.ones((R, C), dtype=bool)   # pad chunks isolated
+        last_chunk = np.full((R, G), -1, dtype=np.int32)
 
         lanes = np.arange(E, dtype=np.int64)
         used = 0
@@ -159,9 +186,14 @@ class OwnerLayout:
             last_chunk[s, uniq_g] = (tile_first + n_ch - 1).astype(
                 np.int32)
 
-        stats = dict(slots=P * C * E, used_chunks=used,
+        # on local-parts builds the slot/used counts cover only this
+        # process's rows; ne is global, so the ratios are per-process
+        # estimates there (each process owns P/nproc of both)
+        stats = dict(slots=R * C * E, used_chunks=used,
                      inflation=round(P * C * E / max(1, sg.ne), 3),
-                     chunk_inflation=round(used * E / max(1, sg.ne), 3))
+                     chunk_inflation=round(
+                         (P // max(1, R)) * used * E / max(1, sg.ne),
+                         3))
         return cls(W=W, E=E, n_tiles=n_tiles, G=G, n_chunks=C,
                    needs_scan=needs_scan, src_local=src_local,
                    rel_dst=rel_dst, weight=weight,
@@ -173,6 +205,94 @@ class OwnerLayout:
         part's [C, E] f32 message temporary passes the shared budget
         (same rule the dst-major engines use)."""
         return self.n_chunks * self.E * 4 > STREAM_MSG_BYTES
+
+
+def _local_src_edges(sg, n_tiles: int, G: int):
+    """Planning-time edge exchange for multi-host owner builds: stream
+    every destination part's edge row across the process group and
+    keep only the edges whose SOURCE part this process owns.
+
+    Returns (key, srcl, rel, wgt) in the same per-edge encoding the
+    single-host build produces (key = src_part * G + global dst tile).
+    Per-row ``process_allgather`` shapes come from the GLOBAL
+    ``ne_part`` metadata, so every process participates with identical
+    shapes.  Peak memory is O(nproc x one part's edges); total traffic
+    is O(ne x nproc) — a one-shot planning cost, the analogue of the
+    reference building its whole-graph CSR on every node
+    (reference pull_model.inl:253-320)."""
+    import jax
+
+    P, vpad, W = sg.num_parts, sg.vpad, 128
+    own = np.asarray(sg.local_parts, np.int64)
+    own_mask = np.zeros(P, bool)
+    own_mask[own] = True
+    local_row = {int(p): i for i, p in enumerate(own)}
+    nproc = jax.process_count()
+    holders = np.full(P, -1, np.int64)
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+        # part -> holding process: allgather the row lists once.
+        # process_allgather needs identical shapes, so every process
+        # must hold the SAME NUMBER of parts (process_parts enforces
+        # this; int32 — see the x64-truncation note below)
+        lp = multihost_utils.process_allgather(
+            own.astype(np.int32))                       # [nproc, R]
+        for q in range(nproc):
+            holders[np.asarray(lp[q], np.int64)] = q
+    else:
+        holders[own] = 0
+    if (holders < 0).any():
+        # an uncovered part's zero placeholder would otherwise be
+        # mistaken for real (vertex-0 -> tile-0) edges of src part 0
+        raise ValueError("local_parts rows do not cover every "
+                         "partition across the process group")
+
+    key_l, srcl_l, rel_l, w_l = [], [], [], []
+    for r in range(P):
+        nep = int(sg.ne_part[r])        # global metadata: same shape
+        if nep == 0:                    # on every process
+            continue
+        rows = 3 if sg.weighted else 2
+        if r in local_row:
+            i = local_row[r]
+            # [rows, nep] int32 — NOT a packed int64: jax collectives
+            # truncate int64 to int32 unless jax_enable_x64 is on.
+            # Weights ride along bit-cast to int32: one collective
+            # per part instead of two
+            both = np.empty((rows, nep), np.int32)
+            both[0] = sg.src_slot[i, :nep]
+            both[1] = sg.dst_local[i, :nep]
+            if sg.weighted:
+                both[2] = np.asarray(sg.edge_weight[i, :nep],
+                                     np.float32).view(np.int32)
+        else:
+            both = np.zeros((rows, nep), np.int32)
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+            q = int(holders[r])
+            both = np.asarray(
+                multihost_utils.process_allgather(both)[q])
+        wrow = both[2].view(np.float32) if sg.weighted else None
+        slot = both[0].astype(np.int64)
+        dst = both[1].astype(np.int64)
+        s = slot // vpad
+        keep = own_mask[s]
+        if not keep.any():
+            continue
+        s = s[keep]
+        slot = slot[keep]
+        dst = dst[keep]
+        key_l.append(s * G + (r * n_tiles + dst // W))
+        srcl_l.append((slot - s * vpad).astype(np.int32))
+        rel_l.append((dst % W).astype(np.int8))
+        if wrow is not None:
+            w_l.append(wrow[keep])
+    key = np.concatenate(key_l) if key_l else np.empty(0, np.int64)
+    srcl = np.concatenate(srcl_l) if srcl_l else np.empty(0, np.int32)
+    rel = np.concatenate(rel_l) if rel_l else np.empty(0, np.int8)
+    wgt = (np.concatenate(w_l) if w_l
+           else (np.empty(0, np.float32) if sg.weighted else None))
+    return key, srcl, rel, wgt
 
 
 # graph-array dict keys holding the owner scan inputs, in the

@@ -108,6 +108,26 @@ def main():
     res = device_check.check_sssp_device(sg, label, mesh=mesh)
     assert res.ok and res.checked == sg.ne, res
 
+    # 5. the OWNER exchange on per-host local-parts builds (round-3
+    #    VERDICT missing #3): the planning-time edge exchange streams
+    #    dst-part rows across the process group, each process lays out
+    #    only its SOURCE parts, and the per-iteration reduce_scatter
+    #    replaces the state all_gather — across 2 real processes.
+    eng7 = PullEngine(sg, pagerank.make_program(), mesh=mesh,
+                      exchange="owner")
+    assert eng7.owner.src_local.shape[0] == len(list(local))
+    s7 = eng7.run(eng7.init_state(), 5)
+    np.testing.assert_allclose(eng7.unpad(s7), want_pr, rtol=2e-5)
+
+    #    and the push engine's owner-side dense iterations (min
+    #    labels ride the all_to_all exchange)
+    eng8 = PushEngine(sg, sssp.make_program(0), mesh=mesh,
+                      exchange="owner", enable_sparse=False)
+    lab8, act8 = eng8.init_state()
+    lab8, act8, _it8 = eng8.converge(lab8, act8)
+    np.testing.assert_array_equal(
+        eng8.unpad(lab8).astype(np.int64), want_ds)
+
     print(f"MP_OK pid={pid}", flush=True)
 
 

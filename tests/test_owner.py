@@ -325,3 +325,77 @@ def test_resolve_exchange_auto(graph):
     mid = dataclasses.replace(sg, vpad=midpad)
     assert resolve_exchange("auto", mid, prog) == "gather"
     assert resolve_exchange("auto", mid, wide) == "owner"
+
+
+def test_owner_local_parts_build_matches_full(graph):
+    """A single-process parts=range(P) build takes the multi-host
+    path (_local_src_edges + allreduced geometry) and must produce
+    byte-identical layout arrays to the full build: the edge stream
+    visits dst parts in the same order the full build concatenates
+    them (VERDICT r3 missing #3)."""
+    from lux_tpu.ops.owner import OwnerLayout
+
+    P = 8
+    full = ShardedGraph.build(graph, P)
+    loc = ShardedGraph.build(graph, P, parts=range(P))
+    assert loc.local_parts is not None
+    lay_f = OwnerLayout.build(full, E=64)
+    lay_l = OwnerLayout.build(loc, E=64)
+    assert (lay_f.n_chunks, lay_f.needs_scan, lay_f.G) == \
+        (lay_l.n_chunks, lay_l.needs_scan, lay_l.G)
+    np.testing.assert_array_equal(lay_f.src_local, lay_l.src_local)
+    np.testing.assert_array_equal(lay_f.rel_dst, lay_l.rel_dst)
+    np.testing.assert_array_equal(lay_f.chunk_start, lay_l.chunk_start)
+    np.testing.assert_array_equal(lay_f.last_chunk, lay_l.last_chunk)
+
+
+def test_owner_local_parts_engine(graph, ref5):
+    """exchange='owner' on a local-parts build (the multi-host code
+    path, degenerate single-process cover) matches the oracle, and
+    'auto' no longer silently degrades to gather there."""
+    from lux_tpu.engine.pull import resolve_exchange
+
+    mesh = make_mesh(8)
+    sg = ShardedGraph.build(graph, 8, parts=range(8))
+    eng = PullEngine(sg, pagerank.make_program(), mesh=mesh,
+                     exchange="owner")
+    out = eng.unpad(eng.run(eng.init_state(), 5))
+    np.testing.assert_allclose(out, ref5, rtol=1e-5, atol=1e-8)
+    # the auto rule now treats local-parts builds as eligible
+    import dataclasses
+    from lux_tpu.engine.pull import OWNER_AUTO_BYTES
+    big = dataclasses.replace(
+        sg, vpad=OWNER_AUTO_BYTES // (sg.num_parts * 4) + 1)
+    assert resolve_exchange("auto", big,
+                            pagerank.make_program()) == "owner"
+
+
+def test_owner_local_parts_push(graph):
+    """The push engine's owner-side dense iterations on a local-parts
+    build (components: max-reduce rides the all_to_all exchange)."""
+    from lux_tpu.apps import components
+    from lux_tpu.engine.push import PushEngine
+    from lux_tpu.graph import Graph as _G
+
+    s, d = components.symmetrize(*graph.edge_arrays())
+    g = _G.from_edges(s, d, graph.nv)
+    want = components.reference_components(g)
+    mesh = make_mesh(8)
+    sg = ShardedGraph.build(g, 8, parts=range(8))
+    eng = PushEngine(sg, components.make_program(), mesh=mesh,
+                     exchange="owner", enable_sparse=False)
+    label, active = eng.init_state()
+    label, active, _it = eng.converge(label, active)
+    np.testing.assert_array_equal(
+        eng.unpad(label).astype(np.int64), want)
+
+
+def test_owner_local_parts_rejects_partial_cover(graph):
+    """A direct OwnerLayout.build on a local build whose rows do not
+    cover every partition must fail loudly — uncovered parts' zero
+    placeholders would otherwise be mistaken for real edges."""
+    from lux_tpu.ops.owner import OwnerLayout
+
+    sg = ShardedGraph.build(graph, 8, parts=range(4))
+    with pytest.raises(ValueError, match="cover every"):
+        OwnerLayout.build(sg, E=64)
