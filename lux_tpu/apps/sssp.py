@@ -57,10 +57,21 @@ def make_program(start_vertex: int, weighted: bool = False) -> PushProgram:
 
 
 def default_delta(g: Graph) -> float:
-    """Bucket width heuristic: mean edge weight (Meyer & Sanders
-    suggest ~max_weight/max_degree; the mean is robust for the
-    power-law graphs the reference benchmarks)."""
-    return float(np.mean(np.asarray(g.weights, np.float64))) or 1.0
+    """Bucket width heuristic: the smallest positive edge weight,
+    floored at mean/16.
+
+    Measured sweep at the bench shape (RMAT21 ef16, weights 1..5,
+    PERF_NOTES round 4): width=min (1.0) -> 0.1498 GTEPS beats the
+    old mean-width (3.0 -> 0.1455) and plain weighted frontiers
+    (0.1297).  Near-settled narrow buckets maximize the fraction of
+    USEFUL relaxations when every engine iteration is fixed-shape;
+    the mean/16 floor stops degenerate widths (near-zero float
+    weights) from turning the run into relax-free bucket advances."""
+    w = np.asarray(g.weights, np.float64)
+    pos = w[w > 0]
+    if not pos.size:
+        return 1.0
+    return float(max(pos.min(), np.mean(w) / 16.0))
 
 
 def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,

@@ -168,9 +168,14 @@ def pair_relabel(g: Graph, num_parts: int = 1,
         raise ValueError(f"vpad_cap={vpad_cap} must be >= 1")
     t0 = _time.time()
     src, dst = g.edge_arrays()
+    # uint32 endpoint arrays: the whole pipeline below is billion-edge
+    # host prep, and every avoided int64 temporary is 8 GB at RMAT26
+    src = src.astype(np.uint32)
+    dst = dst.astype(np.uint32)
     deg = (np.bincount(src, minlength=g.nv)
            + np.bincount(dst, minlength=g.nv))
     by_deg = np.argsort(-deg, kind="stable")      # degree position -> old
+    del deg
     t0 = _tick(t0, "edges+degree_sort")
     Wt = 128
     n_tiles = -(-g.nv // Wt)
@@ -187,26 +192,34 @@ def pair_relabel(g: Graph, num_parts: int = 1,
 
     if P > 1 and full:
         # estimated per-tile in-edge cost in the DEGREE-SORTED tiling
-        rank0 = np.empty(g.nv, np.int64)
-        rank0[by_deg] = np.arange(g.nv)
-        s2, d2 = rank0[src], rank0[dst]
-        key = (s2 // Wt) * np.int64(n_tiles) + d2 // Wt
+        rank0 = np.empty(g.nv, np.uint32)
+        rank0[by_deg] = np.arange(g.nv, dtype=np.uint32)
+        s2t = (rank0[src] // Wt).astype(np.int64)     # src tile
+        d2t = (rank0[dst] // Wt).astype(np.int32)     # dst tile
+        key = s2t * np.int64(n_tiles)
+        key += d2t
+        del s2t
         # per-edge pair multiplicity without np.unique's inverse
         # machinery: one (parallelizable) argsort + group boundaries
         from lux_tpu import native
         order0 = native.best_argsort(key)
         ks = key[order0]
+        del key
         newg = np.ones(len(ks), bool)
         newg[1:] = ks[1:] != ks[:-1]
-        gid = np.cumsum(newg) - 1
+        del ks
+        gid = (np.cumsum(newg) - 1).astype(np.int32)
         cnt = np.bincount(gid)
-        mult = np.empty(len(ks), np.int64)
-        mult[order0] = cnt[gid]                 # per-edge multiplicity
-        del order0, ks, newg, gid
-        cost_e = np.where(mult >= pair_threshold, pair_cost,
-                          gather_cost)
-        tile_cost = np.bincount(d2 // Wt, weights=cost_e,
-                                minlength=n_tiles)
+        is_pair = np.empty(len(gid), bool)            # per-edge dense?
+        is_pair[order0] = cnt[gid] >= pair_threshold
+        del order0, newg, gid, cnt
+        # per-tile cost without a float64 per-edge array: count the
+        # pair-served edges per dst tile, price the two classes
+        pair_by_tile = np.bincount(d2t[is_pair], minlength=n_tiles)
+        all_by_tile = np.bincount(d2t, minlength=n_tiles)
+        del d2t, is_pair
+        tile_cost = (pair_cost * pair_by_tile
+                     + gather_cost * (all_by_tile - pair_by_tile))
         t0 = _tick(t0, "pair_histogram")
         cap = max(1, int(np.ceil(vpad_cap * full / P)))
         load = np.zeros(P)
@@ -233,10 +246,14 @@ def pair_relabel(g: Graph, num_parts: int = 1,
                   np.arange(Wt)[None, :]).reshape(-1)
     vert_order = vert_order[vert_order < g.nv]    # clip partial tile
     perm = by_deg[vert_order]                     # new -> old
-    rank = np.empty(g.nv, np.int64)
-    rank[perm] = np.arange(g.nv)
+    rank = np.empty(g.nv, np.uint32)
+    rank[perm] = np.arange(g.nv, dtype=np.uint32)
     t0 = _tick(t0, "lpt_dealing")
-    g2 = Graph.from_edges(rank[src], rank[dst], g.nv, weights=g.weights)
+    ns = rank[src]
+    del src
+    nd = rank[dst]
+    del dst, rank
+    g2 = Graph.from_edges(ns, nd, g.nv, weights=g.weights)
     _tick(t0, "rebuild_csc")
     return g2, perm, starts
 

@@ -139,8 +139,20 @@ class OwnerLayout:
         for p in own_rows:
             lo, hi = (int(np.searchsorted(s_of, p)),
                       int(np.searchsorted(s_of, p + 1)))
-            uniq_g, counts = np.unique(key[lo:hi] - p * np.int64(G),
-                                       return_counts=True)
+            # key[lo:hi] is already sorted (the global argsort):
+            # group boundaries by a diff pass — np.unique would
+            # RE-SORT the slice (measured a large slice of the
+            # big-graph build time, round 4)
+            ks = key[lo:hi] - p * np.int64(G)
+            if ks.size:
+                newg = np.ones(len(ks), bool)
+                newg[1:] = ks[1:] != ks[:-1]
+                b = np.nonzero(newg)[0]
+                uniq_g = ks[b]
+                counts = np.diff(np.concatenate((b, [len(ks)])))
+            else:
+                uniq_g = np.empty(0, np.int64)
+                counts = np.empty(0, np.int64)
             per_part.append((lo, uniq_g.astype(np.int64), counts))
         C = max(1, max((int(_ceil_div(c, E).sum())
                         for _, _, c in per_part), default=1))

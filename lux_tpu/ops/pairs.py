@@ -57,6 +57,33 @@ class PairPlan:
     row_tile: np.ndarray | None = None
 
 
+def occurrence_index(pair: np.ndarray, slot: np.ndarray) -> np.ndarray:
+    """Per-element occurrence counter within each (pair, slot) group:
+    the o-th edge of a (pair id, source slot) pair gets o (any order).
+
+    Overflow-safe: pair ids reach ~(num_state_rows * n_tiles), which
+    passes 2^31 at RMAT25/np4 — a packed ``pair * 2^32 + slot`` key
+    silently wraps mod 2^64 there, aliasing distinct groups and
+    DROPPING the aliased edges at delivery time (two edges written to
+    one (row, lane)).  Two stable radix passes (lexsort semantics:
+    slot minor, pair major) never form a product."""
+    from lux_tpu import native
+
+    o1 = native.best_argsort(np.asarray(slot, np.int64))
+    p1 = np.asarray(pair, np.int64)[o1]
+    o2 = native.best_argsort(p1)
+    srt = o1[o2]
+    ps, ss = np.asarray(pair, np.int64)[srt], np.asarray(
+        slot, np.int64)[srt]
+    newg = np.ones(len(srt), bool)
+    newg[1:] = (ps[1:] != ps[:-1]) | (ss[1:] != ss[:-1])
+    pos = np.arange(len(srt))
+    gst = np.maximum.accumulate(np.where(newg, pos, 0))
+    occ = np.empty(len(srt), np.int64)
+    occ[srt] = pos - gst
+    return occ
+
+
 def quantize_depths(depth_sorted: np.ndarray,
                     levels_growth: float = 1.35) -> np.ndarray:
     """Round a descending per-slot row-count profile up to the fixed
@@ -71,25 +98,38 @@ def quantize_depths(depth_sorted: np.ndarray,
     return lev[np.searchsorted(lev, depth_sorted)]
 
 
-def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
-                    vpad: int, threshold: int = 8,
-                    max_occ: int = 128,
-                    levels_growth: float = 1.35,
-                    weights: np.ndarray | None = None,
-                    slot_depths: np.ndarray | None = None,
-                    profile_only: bool = False):
-    """src_slot: int [ne] global padded state slots (state2d row =
-    slot // 128); dst_local: int [ne] part-local dst in [0, vpad);
-    vpad must be a multiple of 128.  weights (optional, [ne]) are laid
-    out per lane so weighted programs get each delivered edge's weight
-    next to its value.
+@dataclasses.dataclass
+class PairAnalysis:
+    """The threshold-dependent (but layout-independent) half of pair
+    planning: everything through the sorted per-tile depth profile.
+    plan_sharded_pairs computes it ONCE per part and reuses it for
+    both the profile pass and the final layout — at billion-edge
+    scale the analysis is several argsorts of the whole edge list,
+    previously paid twice (round-4 host-prep work)."""
 
-    slot_depths (optional, [n_tiles] descending, ladder-quantized):
-    lay rows out against this EXTERNAL per-slot depth profile instead
-    of the part's own — every part of a multi-part graph laid out
-    against the elementwise-max profile gets IDENTICAL classes, so
-    stacking pads no rows beyond the max profile (see
-    plan_sharded_pairs)."""
+    ne: int
+    n_tiles: int
+    residual: np.ndarray       # bool [ne]
+    cov: np.ndarray            # int32 [n_cov] covered edge idx
+    occ: np.ndarray            # int32 [n_cov] occurrence in (pair,slot)
+    pidx: np.ndarray           # int32 [n_cov] dense selected-pair id
+    nrows_pair: np.ndarray     # int64 [n_sel]
+    pair_dt: np.ndarray        # int64 [n_sel] dst tile of each pair
+    tile_sort: np.ndarray      # int64 [n_sel]
+    t_order: np.ndarray        # int64 [n_tiles]
+    depth_sorted: np.ndarray   # int64 [n_tiles] descending
+    # NOTE: src_slot/dst_local are deliberately NOT stored —
+    # plan_sharded_pairs holds every part's analysis simultaneously,
+    # and int64 copies of the edge arrays would cost tens of GB at
+    # billion-edge scale (build_pair_plan re-derives them from its
+    # own parameters); cov/occ/pidx are int32 (epad < 2^31 is a
+    # ShardedGraph.build invariant)
+
+
+def analyze_pairs(src_slot: np.ndarray, dst_local: np.ndarray,
+                  vpad: int, threshold: int = 8,
+                  max_occ: int = 128) -> PairAnalysis:
+    """See build_pair_plan; this is its sorting/selection half."""
     assert vpad % W == 0
     ne = len(dst_local)
     n_tiles = vpad // W
@@ -116,15 +156,7 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
 
     # occurrence index of each covered edge within (pair, src lane)
     cov = order[esel_sorted]                      # original edge idx
-    key = pair[cov] * (np.int64(1) << 32) + src_slot[cov]
-    srt = np.argsort(key, kind="stable")
-    ks = key[srt]
-    newg = np.ones(len(ks), bool)
-    newg[1:] = ks[1:] != ks[:-1]
-    pos = np.arange(len(ks))
-    gst = np.maximum.accumulate(np.where(newg, pos, 0))
-    occ = np.empty(len(ks), np.int64)
-    occ[srt] = pos - gst
+    occ = occurrence_index(pair[cov], src_slot[cov])
 
     # Optional occurrence-depth cap (edges beyond it ride the residual
     # gather).  Measured on RMAT21: capping LOSES — deep-occurrence
@@ -133,19 +165,9 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     keep = occ < max_occ
     if not keep.all():
         # mark dropped edges residual; rebuild cov/occ on the kept set
-        dropped = np.zeros(len(cov), bool)
-        dropped[srt] = ~keep
-        residual[cov[dropped]] = True
-        cov = cov[~dropped]
-        k2 = np.argsort(pair[cov] * (np.int64(1) << 32) + src_slot[cov],
-                        kind="stable")
-        ks2 = (pair[cov] * (np.int64(1) << 32) + src_slot[cov])[k2]
-        ng2 = np.ones(len(ks2), bool)
-        ng2[1:] = ks2[1:] != ks2[:-1]
-        pos2 = np.arange(len(ks2))
-        gst2 = np.maximum.accumulate(np.where(ng2, pos2, 0))
-        occ = np.empty(len(ks2), np.int64)
-        occ[k2] = pos2 - gst2
+        residual[cov[~keep]] = True
+        cov = cov[keep]
+        occ = occurrence_index(pair[cov], src_slot[cov])
 
     # per-pair row count = max occurrence + 1 (pair ids of the
     # possibly-reduced covered set, via the sorted unique pair keys)
@@ -166,11 +188,47 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     np.add.at(rows_by_tile, pair_dt, nrows_pair)
     t_order = np.argsort(-rows_by_tile, kind="stable")
     depth_sorted = rows_by_tile[t_order]
-    if profile_only:
-        # first pass of plan_sharded_pairs: only the sorted per-tile
-        # row-count profile is needed to derive the common frame —
-        # skip materializing the [R, 128] row arrays entirely
-        return depth_sorted
+    return PairAnalysis(
+        ne=ne, n_tiles=n_tiles, residual=residual,
+        cov=cov.astype(np.int32), occ=occ.astype(np.int32),
+        pidx=pidx.astype(np.int32),
+        nrows_pair=nrows_pair, pair_dt=pair_dt, tile_sort=tile_sort,
+        t_order=t_order, depth_sorted=depth_sorted)
+
+
+def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
+                    vpad: int, threshold: int = 8,
+                    max_occ: int = 128,
+                    levels_growth: float = 1.35,
+                    weights: np.ndarray | None = None,
+                    slot_depths: np.ndarray | None = None,
+                    analysis: PairAnalysis | None = None):
+    """src_slot: int [ne] global padded state slots (state2d row =
+    slot // 128); dst_local: int [ne] part-local dst in [0, vpad);
+    vpad must be a multiple of 128.  weights (optional, [ne]) are laid
+    out per lane so weighted programs get each delivered edge's weight
+    next to its value.
+
+    slot_depths (optional, [n_tiles] descending, ladder-quantized):
+    lay rows out against this EXTERNAL per-slot depth profile instead
+    of the part's own — every part of a multi-part graph laid out
+    against the elementwise-max profile gets IDENTICAL classes, so
+    stacking pads no rows beyond the max profile (see
+    plan_sharded_pairs).
+
+    analysis: a precomputed analyze_pairs result for these arrays
+    (must match threshold/max_occ) — skips the sorting half."""
+    if analysis is None:
+        analysis = analyze_pairs(src_slot, dst_local, vpad,
+                                 threshold=threshold, max_occ=max_occ)
+    a = analysis
+    ne, n_tiles = a.ne, a.n_tiles
+    src_slot = np.asarray(src_slot, np.int64)
+    dst_local = np.asarray(dst_local, np.int64)
+    residual, cov, occ, pidx = a.residual, a.cov, a.occ, a.pidx
+    nrows_pair, pair_dt = a.nrows_pair, a.pair_dt
+    tile_sort, t_order, depth_sorted = (a.tile_sort, a.t_order,
+                                        a.depth_sorted)
 
     if slot_depths is None:
         depth = quantize_depths(depth_sorted, levels_growth)
@@ -195,7 +253,7 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     newt[1:] = dts[1:] != dts[:-1]
     grp_base = np.maximum.accumulate(np.where(newt, cum, 0))
     within = cum - grp_base
-    pair_base = np.zeros(len(sel_ids), np.int64)
+    pair_base = np.zeros(len(nrows_pair), np.int64)
     pair_base[tile_sort] = row_off_tile[tile_pos[dts]] + within
     assert (within + srt_rows <= depth[tile_pos[dts]]).all()
 
@@ -206,6 +264,15 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     rowbind[rows] = rowbind_rows
     rel_dst[rows, src_slot[cov] % W] = (dst_local[cov] % W).astype(
         np.int8)
+    # every covered edge must own a distinct (row, lane) — a colliding
+    # write means a planner bug silently DROPPED an edge (the int64
+    # occurrence-key wrap at RMAT25/np4 scale did exactly that before
+    # occurrence_index); count the delivered lanes, loudly
+    delivered = int(np.count_nonzero(rel_dst != -1))
+    if delivered != len(cov):
+        raise AssertionError(
+            f"pair plan dropped {len(cov) - delivered} of {len(cov)} "
+            f"covered edges (colliding (row, lane) writes)")
     weight = None
     if weights is not None:
         weight = np.zeros((R, W), np.float32)
@@ -410,26 +477,33 @@ def plan_sharded_pairs(sg, threshold: int):
     R = len(rows)
     local = sg.local_parts is not None
 
-    def plan_row(r, slot_depths=None, profile_only=False):
+    def plan_row(r, slot_depths=None, analysis=None):
         nep = int(sg.ne_part[rows[r]])
         wp = (np.asarray(sg.edge_weight[r, :nep])
-              if sg.weighted and not profile_only else None)
+              if sg.weighted else None)
         return build_pair_plan(
             sg.src_slot[r, :nep], sg.dst_local[r, :nep], sg.vpad,
             threshold=threshold, weights=wp, slot_depths=slot_depths,
-            profile_only=profile_only)
+            analysis=analysis)
 
     if P > 1 or local:
-        # Pass 1 (cheap, profile-only): per-part sorted row-count
+        # Pass 1: per-part analyses (the expensive sorting half, done
+        # ONCE and reused by the layout pass) yield sorted row-count
         # profiles.  Pass 2: lay every part out against the
         # elementwise-max profile so classes are IDENTICAL across
         # parts (and processes) and stacking pads no rows beyond the
         # max profile.  (Per-depth max-count stacking of heterogeneous
         # profiles measured 3.4x row inflation at RMAT21/np=4.)
-        profiles = [plan_row(r, profile_only=True) for r in range(R)]
-        prof_max = (np.maximum.reduce(profiles) if profiles
-                    else np.zeros(sg.vpad // W, np.int64))
-        total = sum(int(prof.sum()) for prof in profiles)
+        analyses = []
+        for r in range(R):
+            nep = int(sg.ne_part[rows[r]])
+            analyses.append(analyze_pairs(
+                sg.src_slot[r, :nep], sg.dst_local[r, :nep], sg.vpad,
+                threshold=threshold))
+        prof_max = (np.maximum.reduce(
+            [a.depth_sorted for a in analyses]) if analyses
+            else np.zeros(sg.vpad // W, np.int64))
+        total = sum(int(a.depth_sorted.sum()) for a in analyses)
         if local:
             from lux_tpu.parallel.multihost import allreduce_host
             prof_max = allreduce_host(prof_max, "max")
@@ -437,7 +511,11 @@ def plan_sharded_pairs(sg, threshold: int):
         if total == 0:
             return None, sg             # no pair anywhere dense enough
         common = quantize_depths(prof_max)
-        plans = [plan_row(r, slot_depths=common) for r in range(R)]
+        plans = []
+        for r in range(R):
+            plans.append(plan_row(r, slot_depths=common,
+                                  analysis=analyses[r]))
+            analyses[r] = None          # release the per-part arrays
     else:
         plans = [plan_row(0)]
         if plans[0].stats["covered"] == 0:

@@ -554,3 +554,40 @@ def test_pair_relabel_rejects_bad_vpad_cap():
     g = _skewed_graph(7, 4 * W, 3000)
     with pytest.raises(ValueError, match="vpad_cap"):
         pair_relabel(g, 2, pair_threshold=4, vpad_cap=0.5)
+
+
+def test_occurrence_index_no_int64_alias():
+    """pair ids past 2^31 (real at RMAT25/np4) must not alias: the old
+    packed pair*2^32+slot key wrapped mod 2^64, merging groups that
+    share a slot and differ by exactly k*2^32 in pair id — dropping
+    edges at delivery.  occurrence_index must keep them separate."""
+    from lux_tpu.ops.pairs import occurrence_index
+
+    base = np.int64(25_000_000_000)           # > 2^32: wraps if packed
+    pair = np.array([base, base + (1 << 32), base, base + (1 << 32),
+                     base], np.int64)
+    slot = np.array([7, 7, 7, 7, 7], np.int64)
+    occ = occurrence_index(pair, slot)
+    # group {0,2,4} -> 0,1,2 and group {1,3} -> 0,1 (any order within)
+    assert sorted(occ[[0, 2, 4]].tolist()) == [0, 1, 2]
+    assert sorted(occ[[1, 3]].tolist()) == [0, 1]
+
+
+def test_pair_plan_occurrence_cap_path():
+    """Duplicate (multigraph) edges past max_occ ride the residual;
+    the kept set re-derives occurrences (the cap-rebuild path) and
+    the delivered-lane invariant holds."""
+    from lux_tpu.ops.pairs import build_pair_plan
+
+    ne_dup = 40
+    src = np.full(ne_dup, 3, np.int64)        # one (pair, slot) group
+    dst = np.full(ne_dup, 5, np.int64)
+    # plus a normal dense pair to keep the plan non-trivial
+    src2 = np.arange(16, dtype=np.int64)
+    dst2 = np.arange(16, dtype=np.int64) + 128
+    plan = build_pair_plan(np.concatenate([src, src2]),
+                           np.concatenate([dst, dst2]),
+                           vpad=256, threshold=8, max_occ=8)
+    # 8 of the 40 duplicates kept, 32 residual; dense pair fully kept
+    assert int(plan.residual.sum()) == 32
+    assert plan.stats["covered"] == 8 + 16
