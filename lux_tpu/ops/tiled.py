@@ -185,28 +185,95 @@ def _reduce_axis(x, axis, kind):
         x, axis=axis)
 
 
+# combine_chunks switches to the BLOCKED segmented scan once the
+# chunk axis passes this length: jax.lax.associative_scan over
+# [C, W] materializes O(log C) tree levels of BOTH tuple operands, ~2
+# * log2(C) * C * W * 4 bytes of program memory — measured as the
+# 11.17 GB "program" term that OOM'd the 16 GB chip at C~1.4M/part
+# (RMAT26 pair residual; also the round-3 E=128/scale-26 worker
+# crash).  The blocked form scans SCAN_BLOCK-chunk slices with a
+# carry, so live memory is one block's tree + the [C, W] output.
+SCAN_BLOCK_CHUNKS = 16384
+SCAN_BLOCKED_ABOVE = 1 << 17
+
+
+def _segscan(partials, flags, kind):
+    """Flag-reset segmented combine along axis 0 (within one block).
+    flags broadcast [C, 1...] bool; True = position starts a segment."""
+    comb = _combine(kind)
+
+    def op(a, b):
+        va, fa = a
+        vb, fb = b
+        return jnp.where(fb, vb, comb(va, vb)), fa | fb
+
+    vals, _ = jax.lax.associative_scan(
+        op, (partials, jnp.broadcast_to(flags, partials.shape)))
+    return vals
+
+
 def combine_chunks(partials, layout: TiledLayout, chunk_start, last_chunk,
                    kind: str):
     """Segmented combine of per-chunk partials [C, W, ...] into tile
     results [n_tiles, W, ...]; chunk_start/last_chunk are this part's
     rows of the layout arrays (device)."""
     if layout.needs_scan:
+        C = partials.shape[0]
         flags = chunk_start.reshape(
             chunk_start.shape + (1,) * (partials.ndim - 1))
-        comb = _combine(kind)
-
-        def op(a, b):
-            va, fa = a
-            vb, fb = b
-            return jnp.where(fb, vb, comb(va, vb)), fa | fb
-
-        partials, _ = jax.lax.associative_scan(
-            op, (partials, jnp.broadcast_to(flags, partials.shape)))
+        if C <= SCAN_BLOCKED_ABOVE:
+            partials = _segscan(partials, flags, kind)
+        else:
+            partials = _segscan_blocked(partials, chunk_start, kind)
     ident = identity_for(kind, partials.dtype)
     out = jnp.take(partials, jnp.maximum(last_chunk, 0), axis=0)
     empty = (last_chunk < 0).reshape(
         last_chunk.shape + (1,) * (out.ndim - 1))
     return jnp.where(empty, ident, out)
+
+
+def _segscan_blocked(partials, chunk_start, kind,
+                     block: int | None = None):
+    """Blocked segmented combine: lax.scan over SCAN_BLOCK-chunk
+    slices; each step runs the in-block associative scan, then folds
+    the carry (the previous block's running value) into every
+    position BEFORE the block's first segment flag.  Identical result
+    to the monolithic scan with O(block) live tree memory."""
+    if block is None:
+        # read at call time so tests can shrink the module constant
+        block = SCAN_BLOCK_CHUNKS
+    comb = _combine(kind)
+    C = partials.shape[0]
+    trail = partials.shape[1:]
+    nB = _ceil_div(C, block)
+    Cp = nB * block
+    ident = identity_for(kind, partials.dtype)
+    if Cp != C:
+        # pad chunks are isolated identity segments (same convention
+        # as the layout's pad chunks)
+        partials = jnp.concatenate(
+            [partials, jnp.full((Cp - C,) + trail, ident,
+                                partials.dtype)], axis=0)
+        chunk_start = jnp.concatenate(
+            [chunk_start, jnp.ones(Cp - C, bool)], axis=0)
+
+    def step(carry, x):
+        p_b, f_b = x
+        fb = f_b.reshape(f_b.shape + (1,) * len(trail))
+        inner = _segscan(p_b, fb, kind)
+        # positions with NO flag at-or-before them continue the
+        # previous block's segment
+        absorb = jnp.cumsum(f_b.astype(jnp.int32)) == 0
+        ab = absorb.reshape(absorb.shape + (1,) * len(trail))
+        out = jnp.where(ab, comb(carry, inner), inner)
+        return out[-1], out
+
+    carry0 = jnp.full(trail, ident, partials.dtype)
+    _, blocks = jax.lax.scan(
+        step, carry0,
+        (partials.reshape((nB, block) + trail),
+         chunk_start.reshape(nB, block)))
+    return blocks.reshape((Cp,) + trail)[:C]
 
 
 # lax.map block size for streamed_chunk_partials (chunks per block)
