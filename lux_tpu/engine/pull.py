@@ -213,6 +213,11 @@ class PullEngine:
                 own_lc=dev(self.owner.last_chunk))
             if self.owner.weight is not None:
                 arrays["own_w"] = dev(self.owner.weight)
+            if self.owner.streams():
+                # fused streamed combine: never materializes [C, W]
+                ep, ii = self.owner.extract_plan()
+                arrays["own_ep"] = dev(ep)
+                arrays["own_ii"] = dev(ii)
         else:
             self.owner = None
             arrays, self.tiles = build_graph_arrays(
@@ -472,10 +477,8 @@ class PullEngine:
         from lux_tpu.ops.owner import owner_contribs
 
         prog = self.program
-        from lux_tpu.ops.owner import OWNER_SCAN_KEYS
-        skeys = [k for k in OWNER_SCAN_KEYS if k in g]
         return owner_contribs(
-            self.owner, state_rows, tuple(g[k] for k in skeys),
+            self.owner, state_rows, g,
             prog.reduce,
             lambda vals, wt: prog.edge_value(vals, None, wt),
             self._msg_dtype(state_rows), self.sg.num_parts,

@@ -163,6 +163,11 @@ class PushEngine:
                 own_lc=dev(self.owner.last_chunk))
             if self.owner.weight is not None:
                 arrays["own_w"] = dev(self.owner.weight)
+            if self.owner.streams():
+                # fused streamed combine: never materializes [C, W]
+                ep, ii = self.owner.extract_plan()
+                arrays["own_ep"] = dev(ep)
+                arrays["own_ii"] = dev(ii)
         else:
             self.owner = None
             arrays, self.tiles = build_graph_arrays(
@@ -360,10 +365,8 @@ class PushEngine:
             msg, jax.ShapeDtypeStruct((1, 1), label.dtype),
             (jax.ShapeDtypeStruct((1, 1), jnp.float32)
              if "own_w" in g else None)).dtype
-        from lux_tpu.ops.owner import OWNER_SCAN_KEYS
-        skeys = [k for k in OWNER_SCAN_KEYS if k in g]
         acc = owner_contribs(
-            self.owner, masked, tuple(g[k] for k in skeys),
+            self.owner, masked, g,
             prog.reduce, msg, msg_dtype, sg.num_parts,
             self.reduce_method,
             varying_axis=PARTS_AXIS if on_mesh else None)

@@ -218,6 +218,25 @@ class OwnerLayout:
         (same rule the dst-major engines use)."""
         return self.n_chunks * self.E * 4 > STREAM_MSG_BYTES
 
+    def extract_plan(self):
+        """Per-src-part extraction indices for the FUSED streamed
+        combine (ops/tiled.streamed_chunk_combined) — avoids the two
+        [C, W] temporaries that push billion-edge owner programs past
+        HBM (PERF_NOTES round 4).  Returns (extr_pos [R, nB, L],
+        inv_idx [R, G]) numpy.
+
+        The extraction width L is program shape: on multi-process
+        runs it is allreduced across the group, exactly like C."""
+        import jax
+
+        from lux_tpu.ops.tiled import (build_extract_plan,
+                                       extract_plan_width)
+        L = extract_plan_width(self.last_chunk, self.n_chunks)
+        if jax.process_count() > 1:
+            from lux_tpu.parallel.multihost import allreduce_host
+            L = int(allreduce_host(np.int64(L), "max"))
+        return build_extract_plan(self.last_chunk, self.n_chunks, L=L)
+
 
 def _local_src_edges(sg, n_tiles: int, G: int):
     """Planning-time edge exchange for multi-host owner builds: stream
@@ -307,13 +326,14 @@ def _local_src_edges(sg, n_tiles: int, G: int):
     return key, srcl, rel, wgt
 
 
-# graph-array dict keys holding the owner scan inputs, in the
-# POSITIONAL order owner_contribs' scan_arrays expects:
-# (src, rel, chunk_start, last_chunk[, weight])
-OWNER_SCAN_KEYS = ("own_src", "own_rel", "own_cs", "own_lc", "own_w")
+# graph-array dict keys holding the owner scan inputs (all leading-
+# dim local src rows); own_w only on weighted graphs, own_ep/own_ii
+# only when the layout streams (the fused-combine extraction plan)
+OWNER_SCAN_KEYS = ("own_src", "own_rel", "own_cs", "own_lc", "own_w",
+                   "own_ep", "own_ii")
 
 
-def owner_contribs(lay: OwnerLayout, state_rows, scan_arrays,
+def owner_contribs(lay: OwnerLayout, state_rows, g: dict,
                    kind: str, msg_fn, msg_dtype, num_parts: int,
                    reduce_method: str, varying_axis=None,
                    use_mxu: bool = False):
@@ -324,9 +344,10 @@ def owner_contribs(lay: OwnerLayout, state_rows, scan_arrays,
     [G, W] tile partials into the accumulated contribution
     ``[num_parts, n_tiles*W, ...]`` to every destination part.
 
-    scan_arrays: (src, rel, chunk_start, last_chunk[, weight]) with
-    the local-row leading dim.  varying_axis: mesh axis name when
-    called under shard_map (marks the identity carry device-varying)."""
+    g: graph-array dict; the OWNER_SCAN_KEYS present in it ride the
+    scan with the local-row leading dim.  varying_axis: mesh axis name
+    when called under shard_map (marks the identity carry
+    device-varying)."""
     import jax
     import jax.numpy as jnp
 
@@ -335,12 +356,15 @@ def owner_contribs(lay: OwnerLayout, state_rows, scan_arrays,
 
     ntw = lay.n_tiles * lay.W
     comb = combine_op(kind)
+    xs = {k: g[k] for k in OWNER_SCAN_KEYS if k in g}
 
     def step(acc, x):
-        st_s, src, rel, cs, lc = x[:5]
-        w = x[5] if len(x) > 5 else None
-        tiles = owner_part_tiles(lay, st_s, src, rel, w, cs, lc, kind,
-                                 msg_fn, reduce_method, use_mxu=use_mxu)
+        st_s, d = x
+        tiles = owner_part_tiles(
+            lay, st_s, d["own_src"], d["own_rel"], d.get("own_w"),
+            d["own_cs"], d["own_lc"], kind, msg_fn, reduce_method,
+            use_mxu=use_mxu, extr_pos=d.get("own_ep"),
+            inv_idx=d.get("own_ii"), varying_axis=varying_axis)
         contrib = tiles.reshape((num_parts, ntw) + tiles.shape[2:])
         return comb(acc, contrib), None
 
@@ -350,7 +374,7 @@ def owner_contribs(lay: OwnerLayout, state_rows, scan_arrays,
         # the scan folds in device-varying contributions; the constant
         # initial carry must be marked varying too (VMA)
         acc0 = jax.lax.pcast(acc0, (varying_axis,), to="varying")
-    acc, _ = jax.lax.scan(step, acc0, (state_rows,) + tuple(scan_arrays))
+    acc, _ = jax.lax.scan(step, acc0, (state_rows, xs))
     return acc
 
 
@@ -378,17 +402,29 @@ def owner_exchange(acc, kind: str, axis=None, ndev: int = 1):
 
 def owner_part_tiles(lay: OwnerLayout, state_s, src, rel, weight, cs,
                      lc, kind: str, msg_fn, reduce_method: str,
-                     use_mxu: bool = False):
+                     use_mxu: bool = False, extr_pos=None,
+                     inv_idx=None, varying_axis=None):
     """One source part's contribution: gather from its OWN shard
     ``state_s [vpad, ...]``, message, chunk-reduce, and combine into
     per-global-tile results ``[G, W, ...]`` (identity where the part
-    contributes nothing)."""
+    contributes nothing).
+
+    extr_pos/inv_idx (this part's rows of OwnerLayout.extract_plan):
+    run the FUSED streamed combine, which never materializes the
+    [C, W] running values."""
     import jax
     import jax.numpy as jnp
 
     from lux_tpu.ops.tiled import (chunk_partials, combine_chunks,
+                                   streamed_chunk_combined,
                                    streamed_chunk_partials)
 
+    if extr_pos is not None:
+        return streamed_chunk_combined(
+            state_s, src, rel, weight, lay, kind, msg_fn,
+            reduce_method, cs, extr_pos, inv_idx, lc,
+            use_mxu=use_mxu,
+            varying_axis=varying_axis)                 # [G, W, ...]
     if lay.streams():
         partials = streamed_chunk_partials(
             state_s, src, rel, weight, lay, kind, msg_fn, reduce_method,

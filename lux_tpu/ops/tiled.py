@@ -285,6 +285,32 @@ STREAM_BLOCK_CHUNKS = 1024
 STREAM_MSG_BYTES = 1 << 30
 
 
+def _block_partials(flat_state, src_b, rel_b, w_b, msg_fn, kind: str,
+                    E: int, W: int, reduce_method: str,
+                    use_mxu: bool):
+    """One chunk block's gather + message + per-chunk partials
+    [B, E, ...] -> [B, W, ...] (shared by the streamed partial and
+    FUSED streamed combine paths — keep the Pallas VMEM sizing and
+    the barrier rationale in ONE place)."""
+    vals = jnp.take(flat_state, src_b, axis=0)
+    msgs = msg_fn(vals, w_b)
+    if reduce_method.startswith("pallas") and msgs.ndim == 2:
+        from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
+        # the kernel's [bc, E, W] masked intermediate must fit
+        # scoped VMEM (~16 MB): bc=64 fits E<=128 (pair-residual
+        # tile_e), E=512 needs bc=8
+        bc = 64 if E * 64 * W * 4 <= (8 << 20) else 8
+        return chunk_partials_pallas(
+            msgs, rel_b, W, kind,
+            block_c=bc if msgs.shape[0] % bc == 0 else 8,
+            interpret=reduce_method == "pallas-interpret")
+    # keep the (serial, expensive) gather out of the W-wide
+    # broadcast consumer on EVERY non-kernel path (see the barrier
+    # note in PullEngine._part_msgs)
+    msgs = jax.lax.optimization_barrier(msgs)
+    return chunk_partials(msgs, rel_b, W, kind, use_mxu=use_mxu)
+
+
 def streamed_chunk_partials(flat_state, src_slot, rel_dst, weight,
                             layout: TiledLayout, kind: str, msg_fn,
                             reduce_method: str, use_mxu: bool = False,
@@ -300,26 +326,10 @@ def streamed_chunk_partials(flat_state, src_slot, rel_dst, weight,
     C, E, W = layout.n_chunks, layout.E, layout.W
     B = max(8, min(block_chunks, C))
     nB, rem = divmod(C, B)
-    use_pallas = reduce_method.startswith("pallas")
 
     def partial_block(src_b, rel_b, w_b):
-        vals = jnp.take(flat_state, src_b, axis=0)
-        msgs = msg_fn(vals, w_b)
-        if use_pallas and msgs.ndim == 2:   # scalar payloads only
-            from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
-            # the kernel's [bc, E, W] masked intermediate must fit
-            # scoped VMEM (~16 MB): bc=64 fits E<=128 (pair-residual
-            # tile_e), E=512 needs bc=8
-            bc = 64 if E * 64 * W * 4 <= (8 << 20) else 8
-            return chunk_partials_pallas(
-                msgs, rel_b, W, kind,
-                block_c=bc if msgs.shape[0] % bc == 0 else 8,
-                interpret=reduce_method == "pallas-interpret")
-        # keep the (serial, expensive) gather out of the W-wide
-        # broadcast consumer on EVERY non-kernel path (see the barrier
-        # note in PullEngine._part_msgs)
-        msgs = jax.lax.optimization_barrier(msgs)
-        return chunk_partials(msgs, rel_b, W, kind, use_mxu=use_mxu)
+        return _block_partials(flat_state, src_b, rel_b, w_b, msg_fn,
+                               kind, E, W, reduce_method, use_mxu)
 
     parts = []
     if nB:
@@ -338,6 +348,152 @@ def streamed_chunk_partials(flat_state, src_slot, rel_dst, weight,
             src_slot[nB * B:], rel_dst[nB * B:],
             None if weight is None else weight[nB * B:]))
     return jnp.concatenate(parts, axis=0)
+
+
+def build_extract_plan(last_chunk_rows: np.ndarray, C: int,
+                       block: int | None = None,
+                       L: int | None = None):
+    """Host-side plan for extracting per-tile results from the FUSED
+    streamed combine (streamed_chunk_combined) without materializing
+    the [C, W] running values: for each ``block``-chunk slice, the
+    in-block positions of the tiles whose LAST chunk falls in it.
+
+    last_chunk_rows: int32 [R, n_tiles] (-1 = edge-less tile).
+    Returns (extr_pos int32 [R, nB, L], inv_idx int32 [R, n_tiles]):
+    the fused scan emits rows at extr_pos each step (pad -> 0, never
+    selected), stacking to [nB, L, W]; tile t's result is flat row
+    inv_idx[t] (pad -> 0; edge-less tiles are masked by the caller's
+    existing last_chunk < 0 identity rule).  L is the max last-chunk
+    count of any (row, block) — it is PROGRAM SHAPE, so multi-host
+    callers must pass an allreduced value (OwnerLayout.extract_plan
+    does); default = this build's max."""
+    lc = np.asarray(last_chunk_rows, np.int64)
+    R, n_tiles = lc.shape
+    if block is None:
+        # read at call time: must match streamed_chunk_combined's
+        # block (both default to the module constant)
+        block = STREAM_BLOCK_CHUNKS
+    nB = max(1, _ceil_div(C, block))
+    need = extract_plan_width(lc, C, block)
+    if L is None:
+        L = need
+    elif L < need:
+        raise ValueError(f"extract width L={L} < this build's {need}")
+    extr_pos = np.zeros((R, nB, L), np.int32)
+    inv_idx = np.zeros((R, n_tiles), np.int32)
+    for r in range(R):
+        live = np.nonzero(lc[r] >= 0)[0]
+        if not live.size:
+            continue
+        c = lc[r][live]
+        b = c // block
+        order = np.argsort(b, kind="stable")
+        bs = b[order]
+        newb = np.ones(len(bs), bool)
+        newb[1:] = bs[1:] != bs[:-1]
+        pos = np.arange(len(bs))
+        gst = np.maximum.accumulate(np.where(newb, pos, 0))
+        slot = pos - gst                     # rank within block
+        extr_pos[r, bs, slot] = (c[order] - bs * block).astype(np.int32)
+        inv_idx[r, live[order]] = (bs * L + slot).astype(np.int32)
+    return extr_pos, inv_idx
+
+
+def extract_plan_width(last_chunk_rows: np.ndarray, C: int,
+                       block: int | None = None) -> int:
+    """Max last-chunks per (row, block) — the L this build needs."""
+    lc = np.asarray(last_chunk_rows, np.int64)
+    if block is None:
+        block = STREAM_BLOCK_CHUNKS
+    nB = max(1, _ceil_div(C, block))
+    best = 1
+    for r in range(lc.shape[0]):
+        live = lc[r] >= 0
+        if live.any():
+            cnt = np.bincount(lc[r][live] // block, minlength=nB)
+            best = max(best, int(cnt.max()))
+    return best
+
+
+def streamed_chunk_combined(flat_state, src_slot, rel_dst, weight,
+                            layout, kind: str, msg_fn,
+                            reduce_method: str, chunk_start,
+                            extr_pos, inv_idx, last_chunk,
+                            use_mxu: bool = False,
+                            block_chunks: int | None = None,
+                            varying_axis=None):
+    """Fused streamed gather + message + per-chunk partials +
+    BLOCKED segmented combine + last-chunk extraction for ONE part:
+    returns per-tile results [n_tiles(, ...) * W] shaped [n_tiles, W,
+    ...] WITHOUT ever materializing the [C, W] running values — the
+    two [C, W] temporaries (stacked partials + combined output) are
+    what pushes billion-edge owner programs past HBM even with the
+    blocked scan (PERF_NOTES round 4).
+
+    extr_pos/inv_idx: this part's rows of build_extract_plan(...,
+    block=block_chunks); chunk_start bool [C]; last_chunk int32
+    [n_tiles] (only its < 0 mask is used here).  The scan carries the
+    running segmented value across blocks exactly like
+    _segscan_blocked and emits only each block's last-chunk rows."""
+    C, E, W = layout.n_chunks, layout.E, layout.W
+    if block_chunks is None:
+        block_chunks = STREAM_BLOCK_CHUNKS
+    B = max(8, min(block_chunks, C))
+    nB = _ceil_div(C, B)
+    Cp = nB * B
+    comb = _combine(kind)
+
+    def pad_c(x, fill):
+        if Cp == C:
+            return x
+        return jnp.concatenate(
+            [x, jnp.full((Cp - C,) + x.shape[1:], fill, x.dtype)],
+            axis=0)
+
+    src_slot = pad_c(src_slot, 0)
+    rel_dst = pad_c(rel_dst, -1)
+    if weight is not None:
+        weight = pad_c(weight, 0)
+    chunk_start = pad_c(chunk_start, True)
+
+    def partial_block(src_b, rel_b, w_b):
+        return _block_partials(flat_state, src_b, rel_b, w_b, msg_fn,
+                               kind, E, W, reduce_method, use_mxu)
+
+    msg_aval = jax.eval_shape(
+        lambda: msg_fn(jnp.take(flat_state, src_slot[:1], axis=0),
+                       None if weight is None else weight[:1]))
+    ident = identity_for(kind, msg_aval.dtype)
+    trail = msg_aval.shape[2:]
+
+    def step(carry, x):
+        src_b, rel_b, f_b, ep = x[:4]
+        w_b = x[4] if len(x) > 4 else None
+        partials = partial_block(src_b, rel_b, w_b)   # [B, W, ...]
+        fb = f_b.reshape(f_b.shape + (1,) * (partials.ndim - 1))
+        inner = _segscan(partials, fb, kind)
+        absorb = jnp.cumsum(f_b.astype(jnp.int32)) == 0
+        ab = absorb.reshape(absorb.shape + (1,) * (partials.ndim - 1))
+        out = jnp.where(ab, comb(carry, inner), inner)
+        return out[-1], jnp.take(out, ep, axis=0)     # [L, W, ...]
+
+    def seg(x):
+        return x.reshape((nB, B) + x.shape[1:])
+
+    xs = (seg(src_slot), seg(rel_dst), seg(chunk_start), extr_pos)
+    if weight is not None:
+        xs = xs + (seg(weight),)
+    carry0 = jnp.full((W,) + trail, ident, msg_aval.dtype)
+    if varying_axis is not None:
+        # under shard_map the constant initial carry must be marked
+        # device-varying (the scan folds in sharded contributions)
+        carry0 = jax.lax.pcast(carry0, (varying_axis,), to="varying")
+    _, ys = jax.lax.scan(step, carry0, xs)            # [nB, L, W, ...]
+    flatys = ys.reshape((-1,) + ys.shape[2:])
+    out = jnp.take(flatys, inv_idx, axis=0)           # [n_tiles, W, ..]
+    empty = (last_chunk < 0).reshape(
+        last_chunk.shape + (1,) * (out.ndim - 1))
+    return jnp.where(empty, ident, out)
 
 
 def combine_partials(partials, layout: TiledLayout, chunk_start,

@@ -399,3 +399,68 @@ def test_owner_local_parts_rejects_partial_cover(graph):
     sg = ShardedGraph.build(graph, 8, parts=range(4))
     with pytest.raises(ValueError, match="cover every"):
         OwnerLayout.build(sg, E=64)
+
+
+def test_owner_fused_streamed_combine(graph, ref5, monkeypatch):
+    """Force the fused streamed combine (streamed_chunk_combined):
+    gather+message+partials+segmented combine+extraction in one scan,
+    never materializing [C, W] — the RMAT27 HBM enabler (PERF_NOTES
+    round 4).  Must match the unfused owner engine and the oracle."""
+    import lux_tpu.ops.owner as owner_mod
+    import lux_tpu.ops.tiled as tiled
+
+    monkeypatch.setattr(owner_mod, "STREAM_MSG_BYTES", 1)
+    monkeypatch.setattr(tiled, "STREAM_BLOCK_CHUNKS", 16)
+    eng = PullEngine(ShardedGraph.build(graph, 4),
+                     pagerank.make_program(), exchange="owner",
+                     owner_tile_e=32)
+    assert "own_ep" in eng.arrays          # fused path engaged
+    out = eng.unpad(eng.run(eng.init_state(), 5))
+    np.testing.assert_allclose(out, ref5, rtol=1e-5, atol=1e-8)
+
+
+def test_owner_fused_weighted_min(monkeypatch):
+    """Fused combine with weights + min-reduce (all_to_all family)."""
+    import lux_tpu.ops.owner as owner_mod
+    import lux_tpu.ops.tiled as tiled
+    from lux_tpu.apps import sssp
+    from lux_tpu.engine.push import PushEngine
+
+    rng = np.random.default_rng(3)
+    nv, ne = 600, 5000
+    src = rng.integers(0, nv, ne)
+    dst = rng.integers(0, nv, ne)
+    w = rng.integers(1, 6, ne).astype(np.int32)
+    g = Graph.from_edges(src, dst, nv, weights=w)
+    deg = np.bincount(src, minlength=nv)
+    hub = int(deg.argmax())
+    want = sssp.reference_sssp(g, hub, weighted=True)
+
+    monkeypatch.setattr(owner_mod, "STREAM_MSG_BYTES", 1)
+    monkeypatch.setattr(tiled, "STREAM_BLOCK_CHUNKS", 16)
+    eng = PushEngine(ShardedGraph.build(g, 4),
+                     sssp.make_program(hub, weighted=True),
+                     exchange="owner", enable_sparse=False,
+                     owner_tile_e=32)
+    assert "own_ep" in eng.arrays
+    label, active = eng.init_state()
+    label, active, _it = eng.converge(label, active)
+    got = eng.unpad(label).astype(np.float64)
+    got[~np.isfinite(want)] = np.inf
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_owner_fused_mesh(graph, ref5, monkeypatch):
+    """Fused combine under shard_map (scan xs sharded over parts)."""
+    import lux_tpu.ops.owner as owner_mod
+    import lux_tpu.ops.tiled as tiled
+
+    monkeypatch.setattr(owner_mod, "STREAM_MSG_BYTES", 1)
+    monkeypatch.setattr(tiled, "STREAM_BLOCK_CHUNKS", 16)
+    mesh = make_mesh(8)
+    eng = PullEngine(ShardedGraph.build(graph, 8),
+                     pagerank.make_program(), mesh=mesh,
+                     exchange="owner", owner_tile_e=32)
+    assert "own_ep" in eng.arrays
+    out = eng.unpad(eng.run(eng.init_state(), 5))
+    np.testing.assert_allclose(out, ref5, rtol=1e-5, atol=1e-8)
