@@ -215,9 +215,9 @@ class PullEngine:
                 arrays["own_w"] = dev(self.owner.weight)
             if self.owner.streams():
                 # fused streamed combine: never materializes [C, W]
-                ep, ii = self.owner.extract_plan()
+                ep, et = self.owner.extract_plan()
                 arrays["own_ep"] = dev(ep)
-                arrays["own_ii"] = dev(ii)
+                arrays["own_et"] = dev(et)
         else:
             self.owner = None
             arrays, self.tiles = build_graph_arrays(

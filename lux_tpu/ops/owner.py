@@ -223,7 +223,7 @@ class OwnerLayout:
         combine (ops/tiled.streamed_chunk_combined) — avoids the two
         [C, W] temporaries that push billion-edge owner programs past
         HBM (PERF_NOTES round 4).  Returns (extr_pos [R, nB, L],
-        inv_idx [R, G]) numpy.
+        extr_tile [R, nB, L]) numpy.
 
         The extraction width L is program shape: on multi-process
         runs it is allreduced across the group, exactly like C."""
@@ -327,10 +327,10 @@ def _local_src_edges(sg, n_tiles: int, G: int):
 
 
 # graph-array dict keys holding the owner scan inputs (all leading-
-# dim local src rows); own_w only on weighted graphs, own_ep/own_ii
+# dim local src rows); own_w only on weighted graphs, own_ep/own_et
 # only when the layout streams (the fused-combine extraction plan)
 OWNER_SCAN_KEYS = ("own_src", "own_rel", "own_cs", "own_lc", "own_w",
-                   "own_ep", "own_ii")
+                   "own_ep", "own_et")
 
 
 def owner_contribs(lay: OwnerLayout, state_rows, g: dict,
@@ -364,7 +364,7 @@ def owner_contribs(lay: OwnerLayout, state_rows, g: dict,
             lay, st_s, d["own_src"], d["own_rel"], d.get("own_w"),
             d["own_cs"], d["own_lc"], kind, msg_fn, reduce_method,
             use_mxu=use_mxu, extr_pos=d.get("own_ep"),
-            inv_idx=d.get("own_ii"), varying_axis=varying_axis)
+            extr_tile=d.get("own_et"), varying_axis=varying_axis)
         contrib = tiles.reshape((num_parts, ntw) + tiles.shape[2:])
         return comb(acc, contrib), None
 
@@ -403,13 +403,13 @@ def owner_exchange(acc, kind: str, axis=None, ndev: int = 1):
 def owner_part_tiles(lay: OwnerLayout, state_s, src, rel, weight, cs,
                      lc, kind: str, msg_fn, reduce_method: str,
                      use_mxu: bool = False, extr_pos=None,
-                     inv_idx=None, varying_axis=None):
+                     extr_tile=None, varying_axis=None):
     """One source part's contribution: gather from its OWN shard
     ``state_s [vpad, ...]``, message, chunk-reduce, and combine into
     per-global-tile results ``[G, W, ...]`` (identity where the part
     contributes nothing).
 
-    extr_pos/inv_idx (this part's rows of OwnerLayout.extract_plan):
+    extr_pos/extr_tile (this part's rows of OwnerLayout.extract_plan):
     run the FUSED streamed combine, which never materializes the
     [C, W] running values."""
     import jax
@@ -422,7 +422,7 @@ def owner_part_tiles(lay: OwnerLayout, state_s, src, rel, weight, cs,
     if extr_pos is not None:
         return streamed_chunk_combined(
             state_s, src, rel, weight, lay, kind, msg_fn,
-            reduce_method, cs, extr_pos, inv_idx, lc,
+            reduce_method, cs, extr_pos, extr_tile, lc,
             use_mxu=use_mxu,
             varying_axis=varying_axis)                 # [G, W, ...]
     if lay.streams():

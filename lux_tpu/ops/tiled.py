@@ -359,11 +359,14 @@ def build_extract_plan(last_chunk_rows: np.ndarray, C: int,
     in-block positions of the tiles whose LAST chunk falls in it.
 
     last_chunk_rows: int32 [R, n_tiles] (-1 = edge-less tile).
-    Returns (extr_pos int32 [R, nB, L], inv_idx int32 [R, n_tiles]):
-    the fused scan emits rows at extr_pos each step (pad -> 0, never
-    selected), stacking to [nB, L, W]; tile t's result is flat row
-    inv_idx[t] (pad -> 0; edge-less tiles are masked by the caller's
-    existing last_chunk < 0 identity rule).  L is the max last-chunk
+    Returns (extr_pos int32 [R, nB, L], extr_tile int32 [R, nB, L]):
+    at each scan step the fused combine reads the block's running
+    values at extr_pos (pad -> 0) and SCATTERS them into the carried
+    [n_tiles + 1, W] output at extr_tile (pad -> n_tiles, the trash
+    row) — carrying the output instead of stacking per-block rows,
+    because runs of single-chunk tiles (sparse tails) make every
+    chunk a last chunk and a stacked emission degenerates to the very
+    [C, W] array this path exists to avoid.  L is the max last-chunk
     count of any (row, block) — it is PROGRAM SHAPE, so multi-host
     callers must pass an allreduced value (OwnerLayout.extract_plan
     does); default = this build's max."""
@@ -380,7 +383,7 @@ def build_extract_plan(last_chunk_rows: np.ndarray, C: int,
     elif L < need:
         raise ValueError(f"extract width L={L} < this build's {need}")
     extr_pos = np.zeros((R, nB, L), np.int32)
-    inv_idx = np.zeros((R, n_tiles), np.int32)
+    extr_tile = np.full((R, nB, L), n_tiles, np.int32)
     for r in range(R):
         live = np.nonzero(lc[r] >= 0)[0]
         if not live.size:
@@ -395,8 +398,8 @@ def build_extract_plan(last_chunk_rows: np.ndarray, C: int,
         gst = np.maximum.accumulate(np.where(newb, pos, 0))
         slot = pos - gst                     # rank within block
         extr_pos[r, bs, slot] = (c[order] - bs * block).astype(np.int32)
-        inv_idx[r, live[order]] = (bs * L + slot).astype(np.int32)
-    return extr_pos, inv_idx
+        extr_tile[r, bs, slot] = live[order].astype(np.int32)
+    return extr_pos, extr_tile
 
 
 def extract_plan_width(last_chunk_rows: np.ndarray, C: int,
@@ -418,23 +421,26 @@ def extract_plan_width(last_chunk_rows: np.ndarray, C: int,
 def streamed_chunk_combined(flat_state, src_slot, rel_dst, weight,
                             layout, kind: str, msg_fn,
                             reduce_method: str, chunk_start,
-                            extr_pos, inv_idx, last_chunk,
+                            extr_pos, extr_tile, last_chunk,
                             use_mxu: bool = False,
                             block_chunks: int | None = None,
                             varying_axis=None):
     """Fused streamed gather + message + per-chunk partials +
     BLOCKED segmented combine + last-chunk extraction for ONE part:
-    returns per-tile results [n_tiles(, ...) * W] shaped [n_tiles, W,
-    ...] WITHOUT ever materializing the [C, W] running values — the
-    two [C, W] temporaries (stacked partials + combined output) are
-    what pushes billion-edge owner programs past HBM even with the
-    blocked scan (PERF_NOTES round 4).
+    returns per-tile results [n_tiles, W, ...] WITHOUT ever
+    materializing the [C, W] running values — the two [C, W]
+    temporaries (stacked partials + combined output) are what pushes
+    billion-edge owner programs past HBM even with the blocked scan
+    (PERF_NOTES round 4).
 
-    extr_pos/inv_idx: this part's rows of build_extract_plan(...,
+    extr_pos/extr_tile: this part's rows of build_extract_plan(...,
     block=block_chunks); chunk_start bool [C]; last_chunk int32
     [n_tiles] (only its < 0 mask is used here).  The scan carries the
     running segmented value across blocks exactly like
-    _segscan_blocked and emits only each block's last-chunk rows."""
+    _segscan_blocked PLUS the [n_tiles + 1, W] output, scattering
+    each block's last-chunk rows into it (the trailing trash row
+    absorbs pad slots) — the carried output is written in place by
+    XLA, so live memory stays one block plus one result."""
     C, E, W = layout.n_chunks, layout.E, layout.W
     if block_chunks is None:
         block_chunks = STREAM_BLOCK_CHUNKS
@@ -467,30 +473,38 @@ def streamed_chunk_combined(flat_state, src_slot, rel_dst, weight,
     trail = msg_aval.shape[2:]
 
     def step(carry, x):
-        src_b, rel_b, f_b, ep = x[:4]
-        w_b = x[4] if len(x) > 4 else None
+        run, acc = carry
+        src_b, rel_b, f_b, ep, et = x[:5]
+        w_b = x[5] if len(x) > 5 else None
         partials = partial_block(src_b, rel_b, w_b)   # [B, W, ...]
         fb = f_b.reshape(f_b.shape + (1,) * (partials.ndim - 1))
         inner = _segscan(partials, fb, kind)
         absorb = jnp.cumsum(f_b.astype(jnp.int32)) == 0
         ab = absorb.reshape(absorb.shape + (1,) * (partials.ndim - 1))
-        out = jnp.where(ab, comb(carry, inner), inner)
-        return out[-1], jnp.take(out, ep, axis=0)     # [L, W, ...]
+        out = jnp.where(ab, comb(run, inner), inner)
+        # each tile's last chunk occurs exactly once across all
+        # blocks: a plain set into the carried output (pad slots land
+        # in the trailing trash row)
+        acc = acc.at[et].set(jnp.take(out, ep, axis=0))
+        return (out[-1], acc), None
 
     def seg(x):
         return x.reshape((nB, B) + x.shape[1:])
 
-    xs = (seg(src_slot), seg(rel_dst), seg(chunk_start), extr_pos)
+    xs = (seg(src_slot), seg(rel_dst), seg(chunk_start), extr_pos,
+          extr_tile)
     if weight is not None:
         xs = xs + (seg(weight),)
-    carry0 = jnp.full((W,) + trail, ident, msg_aval.dtype)
+    n_tiles = last_chunk.shape[0]
+    run0 = jnp.full((W,) + trail, ident, msg_aval.dtype)
+    acc0 = jnp.full((n_tiles + 1, W) + trail, ident, msg_aval.dtype)
     if varying_axis is not None:
         # under shard_map the constant initial carry must be marked
         # device-varying (the scan folds in sharded contributions)
-        carry0 = jax.lax.pcast(carry0, (varying_axis,), to="varying")
-    _, ys = jax.lax.scan(step, carry0, xs)            # [nB, L, W, ...]
-    flatys = ys.reshape((-1,) + ys.shape[2:])
-    out = jnp.take(flatys, inv_idx, axis=0)           # [n_tiles, W, ..]
+        run0 = jax.lax.pcast(run0, (varying_axis,), to="varying")
+        acc0 = jax.lax.pcast(acc0, (varying_axis,), to="varying")
+    (_, acc), _ = jax.lax.scan(step, (run0, acc0), xs)
+    out = acc[:n_tiles]                               # [n_tiles, W, ..]
     empty = (last_chunk < 0).reshape(
         last_chunk.shape + (1,) * (out.ndim - 1))
     return jnp.where(empty, ident, out)
