@@ -88,6 +88,8 @@ class OwnerLayout:
         result's leading dim is the LOCAL row count (the analogue of
         the reference's per-node region instances,
         reference push_model.inl:8-51)."""
+        from lux_tpu.ops.tiled import warn_sub128_tile
+        warn_sub128_tile(E)
         P, vpad, W = sg.num_parts, sg.vpad, 128
         n_tiles = max(1, _ceil_div(vpad, W))
         G = P * n_tiles

@@ -43,6 +43,22 @@ def _ceil_div(a: int, b: int) -> int:
     return (a + b - 1) // b
 
 
+def warn_sub128_tile(E: int) -> None:
+    """Warn on chunk widths that leave the minor dim under the TPU
+    tile: [.., C, E] edge arrays with E % 128 pad the minor dim to
+    128 (2x HBM at E=64) AND the compiler inserts relayout copies of
+    the whole arrays — measured as the difference between fitting and
+    OOMing a 16 GB chip (PERF_NOTES round 4).  Shared by TiledLayout
+    and OwnerLayout, which stack edges in the same shape."""
+    if E % 128:
+        import warnings
+        warnings.warn(
+            f"chunk width E={E} is not a multiple of 128: TPU tiled "
+            f"layouts pad the minor dim to 128 and relayout-copy the "
+            f"edge arrays (PERF_NOTES round 4); use multiples of 128",
+            stacklevel=3)
+
+
 @dataclasses.dataclass
 class TiledLayout:
     """Host-side chunk plan for one partitioned graph (stacked over
@@ -81,6 +97,7 @@ class TiledLayout:
                 f"tile width W={W} > 128: rel_dst is int8 (valid lane "
                 f"offsets 0..127, -1 = pad) and wider tiles would wrap "
                 f"offsets >= 128 negative, silently dropping edges")
+        warn_sub128_tile(E)
         P = row_ptr_local.shape[0]
         n_tiles = max(1, _ceil_div(vpad, W))
 
