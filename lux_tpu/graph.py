@@ -200,19 +200,23 @@ def pair_relabel(g: Graph, num_parts: int = 1,
         key += d2t
         del s2t
         # per-edge pair multiplicity without np.unique's inverse
-        # machinery: one (parallelizable) argsort + group boundaries
+        # machinery: one FUSED radix sort carrying the edge index as
+        # payload (sequential passes, no argsort random reads and no
+        # key/index gathers — native.sort_kv, PERF_NOTES round 4),
+        # then group boundaries on the sorted keys
         from lux_tpu import native
-        order0 = native.best_argsort(key)
-        ks = key[order0]
+        idx = np.arange(len(key),
+                        dtype=np.uint32 if len(key) < 2**32
+                        else np.int64)
+        native.sort_kv(key, (idx,))
+        newg = np.ones(len(key), bool)
+        newg[1:] = key[1:] != key[:-1]
         del key
-        newg = np.ones(len(ks), bool)
-        newg[1:] = ks[1:] != ks[:-1]
-        del ks
         gid = (np.cumsum(newg) - 1).astype(np.int32)
         cnt = np.bincount(gid)
         is_pair = np.empty(len(gid), bool)            # per-edge dense?
-        is_pair[order0] = cnt[gid] >= pair_threshold
-        del order0, newg, gid, cnt
+        is_pair[idx] = cnt[gid] >= pair_threshold
+        del idx, newg, gid, cnt
         # per-tile cost without a float64 per-edge array: count the
         # pair-served edges per dst tile, price the two classes
         pair_by_tile = np.bincount(d2t[is_pair], minlength=n_tiles)

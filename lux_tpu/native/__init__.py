@@ -94,6 +94,12 @@ def _bind(lib):
     lib.lux_argsort_u64.restype = ctypes.c_int
     lib.lux_argsort_u64.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p]
+    lib.lux_sort_kv_u64.restype = ctypes.c_int
+    lib.lux_sort_kv_u64.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int32)]
 
 
 def available() -> bool:
@@ -198,6 +204,74 @@ def argsort_u64(keys, threads: int | None = None):
         keys.ctypes.data_as(ctypes.c_void_p), keys.size, int(threads),
         out.ctypes.data_as(ctypes.c_void_p)), "lux_argsort_u64")
     return out
+
+
+def sort_kv(keys, payloads=(), threads: int | None = None) -> None:
+    """Fused stable radix sort IN PLACE: sorts non-negative int64/
+    uint64 ``keys`` and carries each array in ``payloads`` (same
+    length; element size 1/2/4/8) through the same permutation
+    (sort.cc lux_sort_kv_u64).
+
+    This replaces the argsort + one-random-gather-per-array pattern of
+    the billion-edge host-prep pipelines (pair_relabel's histogram,
+    edges_to_csc, OwnerLayout.build — PERF_NOTES round-4 host prep):
+    every radix pass reads sequentially and writes 256 bucketed
+    streams, where an argsort pays random key reads per pass and the
+    callers then pay one random gather PER payload.  Falls back to
+    numpy argsort + in-place takes when the native library is
+    unavailable."""
+    keys = _as_u64_inplace(keys)
+    n = keys.size
+    if len(payloads) > 4:            # sort.cc kMaxPay; keep the numpy
+        raise ValueError(            # fallback behaviorally identical
+            f"sort_kv supports at most 4 payloads, got {len(payloads)}")
+    for p in payloads:
+        if not isinstance(p, np.ndarray) or not p.flags.c_contiguous:
+            raise ValueError("sort_kv payloads must be contiguous "
+                             "numpy arrays")
+        if p.shape != (n,):
+            raise ValueError("sort_kv payloads must match keys' length")
+        if p.dtype.itemsize not in (1, 2, 4, 8):
+            raise ValueError(f"unsupported payload itemsize "
+                             f"{p.dtype.itemsize}")
+    if n == 0:
+        return
+    if not available():
+        order = np.argsort(keys, kind="stable")
+        keys[:] = keys[order]
+        for p in payloads:
+            p[:] = p[order]
+        return
+    if threads is None:
+        threads = min(16, os.cpu_count() or 1)
+    lib = _load_lib()
+    key_tmp = np.empty(n, np.uint64)
+    pay_tmp = [np.empty(n, p.dtype) for p in payloads]
+    npay = len(payloads)
+    PtrArr = ctypes.c_void_p * max(1, npay)
+    pays = PtrArr(*[p.ctypes.data for p in payloads])
+    tmps = PtrArr(*[p.ctypes.data for p in pay_tmp])
+    sizes = (ctypes.c_int32 * max(1, npay))(
+        *[p.dtype.itemsize for p in payloads])
+    _check(lib.lux_sort_kv_u64(
+        keys.ctypes.data_as(ctypes.c_void_p),
+        key_tmp.ctypes.data_as(ctypes.c_void_p),
+        n, int(threads), npay, pays, tmps, sizes), "lux_sort_kv_u64")
+
+
+def _as_u64_inplace(keys):
+    """Validate keys for the in-place native sort: contiguous int64
+    (non-negative) or uint64; returns a uint64 VIEW of the same
+    memory."""
+    if not isinstance(keys, np.ndarray) or not keys.flags.c_contiguous:
+        raise ValueError("sort_kv keys must be a contiguous numpy array")
+    if keys.dtype == np.int64:
+        if keys.size and int(keys.min()) < 0:
+            raise ValueError("sort_kv needs non-negative keys")
+        return keys.view(np.uint64)
+    if keys.dtype != np.uint64:
+        raise ValueError(f"sort_kv: unsupported key dtype {keys.dtype}")
+    return keys
 
 
 def best_argsort(keys):

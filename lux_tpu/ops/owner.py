@@ -126,13 +126,11 @@ class OwnerLayout:
             wgt = np.concatenate(w_l) if w_l else None
             del w_l
         from lux_tpu import native
-        order = native.best_argsort(key)   # parallel on pod hosts
-        key = key[order]
-        srcl = srcl[order]
-        rel = rel[order]
-        if wgt is not None:
-            wgt = wgt[order]
-        del order
+        # fused radix sort: key + every edge payload move together —
+        # no argsort permutation array and no post-sort gathers
+        # (native.sort_kv; parallel on pod hosts, PERF_NOTES round 4)
+        native.sort_kv(key, (srcl, rel) + (() if wgt is None
+                                           else (wgt,)))
         s_of = key // G
 
         # chunk counts per OWNED src part (sizing pass); geometry is
