@@ -65,22 +65,26 @@ def occurrence_index(pair: np.ndarray, slot: np.ndarray) -> np.ndarray:
     passes 2^31 at RMAT25/np4 — a packed ``pair * 2^32 + slot`` key
     silently wraps mod 2^64 there, aliasing distinct groups and
     DROPPING the aliased edges at delivery time (two edges written to
-    one (row, lane)).  Two stable radix passes (lexsort semantics:
-    slot minor, pair major) never form a product."""
+    one (row, lane)).  Two stable FUSED radix passes (lexsort
+    semantics: slot minor, pair major; native.sort_kv carries the
+    companion key and the edge index as payloads — no argsort
+    permutation reads, no post-sort gathers) never form a product."""
     from lux_tpu import native
 
-    o1 = native.best_argsort(np.asarray(slot, np.int64))
-    p1 = np.asarray(pair, np.int64)[o1]
-    o2 = native.best_argsort(p1)
-    srt = o1[o2]
-    ps, ss = np.asarray(pair, np.int64)[srt], np.asarray(
-        slot, np.int64)[srt]
-    newg = np.ones(len(srt), bool)
-    newg[1:] = (ps[1:] != ps[:-1]) | (ss[1:] != ss[:-1])
-    pos = np.arange(len(srt))
+    n = len(slot)
+    # unconditional copies: sort_kv permutes IN PLACE and callers
+    # keep using their arrays
+    ks = np.array(slot, dtype=np.int64)
+    kp = np.array(pair, dtype=np.int64)
+    idx = np.arange(n, dtype=np.int64)
+    native.sort_kv(ks, (kp, idx))        # stable by slot
+    native.sort_kv(kp, (ks, idx))        # then stable by pair
+    newg = np.ones(n, bool)
+    newg[1:] = (kp[1:] != kp[:-1]) | (ks[1:] != ks[:-1])
+    pos = np.arange(n)
     gst = np.maximum.accumulate(np.where(newg, pos, 0))
-    occ = np.empty(len(srt), np.int64)
-    occ[srt] = pos - gst
+    occ = np.empty(n, np.int64)
+    occ[idx] = pos - gst
     return occ
 
 
@@ -139,8 +143,13 @@ def analyze_pairs(src_slot: np.ndarray, dst_local: np.ndarray,
     st = src_slot // W
     dt = dst_local // W
     pair = st * n_tiles + dt
-    order = np.argsort(pair, kind="stable")
-    pp = pair[order]
+    # fused radix sort carrying the edge index: replaces argsort +
+    # key gather on the whole edge list (native.sort_kv, PERF_NOTES
+    # round-4 host prep)
+    pp = pair.copy()
+    order = np.arange(ne, dtype=np.int64)
+    from lux_tpu import native
+    native.sort_kv(pp, (order,))
     # a part with zero edges has zero pairs (starts must then be [0],
     # not [0, 0], so the pp[starts[:-1]] lookups below stay in bounds)
     starts = (np.concatenate(
