@@ -274,13 +274,3 @@ def _as_u64_inplace(keys):
     return keys
 
 
-def best_argsort(keys):
-    """Stable argsort of non-negative int64 keys picking the winning
-    backend: the parallel native radix sort on multi-core hosts (pods;
-    PERF_NOTES round-3 #4), numpy's single-threaded radix elsewhere
-    (measured ~2x faster than the native sort at 1 thread)."""
-    n_cpu = os.cpu_count() or 1
-    if n_cpu >= 4 and available():
-        return argsort_u64(keys, threads=min(16, n_cpu))
-    import numpy as _np
-    return _np.argsort(keys, kind="stable")

@@ -1,0 +1,136 @@
+"""Analytic mesh-scaling model priced from measured chip constants.
+
+This environment exposes ONE physical TPU chip, so multi-chip GTEPS
+cannot be *measured* here; the multi-chip path is correctness-tested
+on virtual meshes (``__graft_entry__.dryrun_multichip``,
+tests/test_multidevice.py) but its economics would otherwise be a
+hope.  This module prices a mesh run of the pull engine from
+constants measured on the real chip (PERF_NOTES.md), so the scaling
+claim is an auditable calculation:
+
+- compute is per-edge work measured at the owner-exchange slot rate
+  (the scan keeps every shard at the small-table gather rate
+  regardless of total state size -- the whole point of the owner
+  layout, PERF_NOTES "scale-25 decomposition"), and it divides by the
+  chip count because parts that a single chip must scan SEQUENTIALLY
+  run on their own chips on a mesh;
+- communication is the owner exchange's ``psum_scatter`` (plus the
+  pair rows' state ``all_gather`` when composed), a fixed
+  O(state-table) byte volume per chip per iteration that does NOT
+  grow with the mesh -- so efficiency is compute-bound until the
+  per-chip edge share gets small.
+
+The model is CALIBRATED: tests/test_scalemodel.py reproduces the
+recorded single-chip configurations (RMAT25/26 owner and pair+owner
+runs, PERF_NOTES round 3/4) from their recorded layout stats.  The
+mesh projections in PERF_NOTES' round-4 table come from
+``project_table``.
+
+Reference anchor: Lux scales by adding GPUs/nodes to the same
+binaries (/root/reference/README.md:33-38); this is the TPU-native
+pricing of the same move over ICI instead of GASNet.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# Measured v5e constants (PERF_NOTES.md).  ns figures are per unit of
+# the named work on ONE chip; they are flat across the scales measured
+# (scale 21-26) because the owner layout pins the gather to the
+# small-shard regime and pair rows are row-granular.
+OWNER_SLOT_NS = 9.92     # scan gather + pallas partials + combine,
+                         # per padded owner slot ("profile_owner" table)
+GATHER_SMALL_NS = 8.96   # per-edge gather, state table <= ~64 MB
+GATHER_BIG_NS = 14.6     # per-edge gather past the emitter step
+BIG_TABLE_BYTES = 96e6   # auto-exchange threshold (engine/pull.py)
+PAIR_ROW_NS = 150.0      # per delivered 128-lane pair row
+STATE_NS_PER_VERTEX = 6.0  # apply + epilogues, per padded vertex
+                           # (the ~0.2 s/iter residual in the RMAT25
+                           # np=4 decomposition)
+# ICI: one v5e link direction (public scaling-book figure).  The
+# conclusions are insensitive to 2-4x error here -- comm is permille
+# of compute at the scales this engine targets.
+ICI_BYTES_PER_S = 4.5e10
+
+
+@dataclass
+class Projection:
+    chips: int
+    compute_s: float       # per chip, per iteration
+    comm_s: float          # per chip, per iteration
+    iter_s: float          # compute + comm (no overlap assumed)
+    gteps: float           # aggregate: ne / iter_s
+    gteps_per_chip: float  # driver metric: aggregate / chips
+    efficiency: float      # vs perfect linear scaling of 1 chip
+
+    def row(self) -> str:
+        return (f"| {self.chips} | {self.compute_s:.3f} | "
+                f"{self.comm_s * 1e3:.1f} | {self.gteps:.3f} | "
+                f"{self.gteps_per_chip:.4f} | "
+                f"{self.efficiency * 100:.0f}% |")
+
+
+def project_pull(ne: int, nv: int, chips: int, *,
+                 exchange: str = "owner",
+                 chunk_inflation: float = 1.2,
+                 pair_coverage: float = 0.0,
+                 pair_row_inflation: float = 1.0,
+                 state_bytes_per_vertex: int = 4,
+                 ici_bytes_per_s: float = ICI_BYTES_PER_S) -> Projection:
+    """Price one pull-engine iteration on a ``chips``-device mesh.
+
+    ``chunk_inflation``/``pair_coverage``/``pair_row_inflation`` come
+    from the layout stats the engines already report
+    (OwnerLayout.stats; StackedPairPlan.stats "coverage"/"inflation");
+    pass a measured configuration's stats to price its mesh run.
+    """
+    if exchange not in ("owner", "gather"):
+        raise ValueError(f"unknown exchange {exchange!r}")
+    cov = pair_coverage
+    pair_rows = ne * cov * pair_row_inflation / 128.0
+    residual_ne = ne * (1.0 - cov)
+    state_bytes = nv * state_bytes_per_vertex
+
+    if exchange == "owner":
+        # every shard stays at the small-table rate; padded slots are
+        # the unit of residual work
+        edge_ns = residual_ne * chunk_inflation * OWNER_SLOT_NS
+        # psum_scatter of per-dst-part partials: each chip ships
+        # (P-1)/P of one state table per iteration
+        comm_bytes = state_bytes * (chips - 1) / chips
+    else:
+        per_chip_table = state_bytes  # all_gather materializes it all
+        rate = (GATHER_BIG_NS if per_chip_table > BIG_TABLE_BYTES
+                else GATHER_SMALL_NS)
+        edge_ns = residual_ne * rate
+        comm_bytes = state_bytes * (chips - 1) / chips
+    if cov > 0.0 and exchange == "owner":
+        # pair rows read 128-wide state rows from an all_gather kept
+        # only for them (row fetches do not pay the big-table step);
+        # the gather path feeds pairs from its one existing all_gather
+        comm_bytes += state_bytes * (chips - 1) / chips
+
+    compute_ns = (edge_ns + pair_rows * PAIR_ROW_NS) / chips \
+        + nv * STATE_NS_PER_VERTEX / chips
+    compute_s = compute_ns * 1e-9
+    comm_s = comm_bytes / ici_bytes_per_s
+    iter_s = compute_s + comm_s
+    gteps = ne / iter_s / 1e9
+
+    one = (edge_ns + pair_rows * PAIR_ROW_NS
+           + nv * STATE_NS_PER_VERTEX) * 1e-9
+    eff = (gteps / chips) / (ne / one / 1e9)
+    return Projection(chips=chips, compute_s=compute_s, comm_s=comm_s,
+                      iter_s=iter_s, gteps=gteps,
+                      gteps_per_chip=gteps / chips, efficiency=eff)
+
+
+def project_table(ne: int, nv: int, chip_counts=(1, 4, 8, 16, 64),
+                  **kw) -> str:
+    """Markdown projection table for PERF_NOTES."""
+    lines = ["| chips | compute s/iter | comm ms/iter | GTEPS "
+             "| GTEPS/chip | efficiency |",
+             "|---|---|---|---|---|---|"]
+    lines += [project_pull(ne, nv, c, **kw).row() for c in chip_counts]
+    return "\n".join(lines)

@@ -1,0 +1,56 @@
+"""Calibration tests: the mesh-scaling model must reproduce the
+RECORDED single-chip measurements (PERF_NOTES round 3/4) from their
+recorded layout stats before its multi-chip projections mean
+anything.  chips=1 prices exactly the measured situation: one chip
+scans every part sequentially."""
+
+import pytest
+
+from lux_tpu.scalemodel import project_pull, project_table
+
+RMAT25_NE, RMAT25_NV = 2**25 * 16, 2**25
+RMAT26_NE, RMAT26_NV = 2**26 * 16, 2**26
+
+
+def test_calibration_rmat25_pair_owner():
+    # RMAT25 np=4 pair(16)+owner(E=128): measured 5.13 s/iter,
+    # 0.1046 GTEPS; stats: 45% coverage, 6.88x row inflation, 338M
+    # owner slots over the 295M-edge residual (PERF_NOTES round 4)
+    p = project_pull(RMAT25_NE, RMAT25_NV, chips=1,
+                     chunk_inflation=338 / 295, pair_coverage=0.45,
+                     pair_row_inflation=6.88)
+    assert p.iter_s == pytest.approx(5.13, rel=0.15)
+    assert p.gteps == pytest.approx(0.1046, rel=0.15)
+
+
+def test_calibration_rmat26_owner():
+    # RMAT26 np=8 owner(E=256) no-pair: measured 0.0675 GTEPS;
+    # 1.6B padded slots over 1.07B edges (PERF_NOTES rounds 3-4)
+    p = project_pull(RMAT26_NE, RMAT26_NV, chips=1,
+                     chunk_inflation=1.49)
+    assert p.gteps == pytest.approx(0.0675, rel=0.15)
+
+
+def test_mesh_scaling_shape():
+    # the economics the mesh is FOR: compute divides by chips, comm
+    # stays O(state table) per chip -- near-linear until the per-chip
+    # edge share shrinks toward the comm floor
+    one = project_pull(RMAT26_NE, RMAT26_NV, 1, chunk_inflation=1.49)
+    eight = project_pull(RMAT26_NE, RMAT26_NV, 8, chunk_inflation=1.49)
+    sixtyfour = project_pull(RMAT26_NE, RMAT26_NV, 64,
+                             chunk_inflation=1.49)
+    assert eight.gteps == pytest.approx(8 * one.gteps, rel=0.05)
+    assert sixtyfour.efficiency > 0.90
+    assert sixtyfour.comm_s < 0.05 * sixtyfour.compute_s
+    # comm volume per chip is flat in the mesh size, never growing
+    assert sixtyfour.comm_s < 2 * eight.comm_s
+
+
+def test_rejects_unknown_exchange():
+    with pytest.raises(ValueError):
+        project_pull(RMAT25_NE, RMAT25_NV, 4, exchange="shuffle")
+
+
+def test_table_renders():
+    t = project_table(RMAT26_NE, RMAT26_NV, chunk_inflation=1.49)
+    assert t.count("\n") == 6 and "| 64 |" in t
