@@ -22,9 +22,10 @@ claim is an auditable calculation:
 
 The model is CALIBRATED: tests/test_scalemodel.py reproduces the
 recorded single-chip configurations (RMAT25/26 owner and pair+owner
-runs, PERF_NOTES round 3/4) from their recorded layout stats.  The
-mesh projections in PERF_NOTES' round-4 table come from
-``project_table``.
+runs, PERF_NOTES round 3/4) from their recorded layout stats.
+``project_table`` renders the markdown mesh-projection table; the
+PERF_NOTES "per-chip ceiling" section records its output for the
+flagship configurations.
 
 Reference anchor: Lux scales by adding GPUs/nodes to the same
 binaries (/root/reference/README.md:33-38); this is the TPU-native
@@ -87,6 +88,15 @@ def project_pull(ne: int, nv: int, chips: int, *,
     """
     if exchange not in ("owner", "gather"):
         raise ValueError(f"unknown exchange {exchange!r}")
+    if not 0.0 <= pair_coverage <= 1.0:
+        raise ValueError(f"pair_coverage must be in [0, 1], "
+                         f"got {pair_coverage}")
+    if chunk_inflation < 1.0:
+        raise ValueError(f"chunk_inflation is padded/real slots and "
+                         f"cannot be < 1, got {chunk_inflation}")
+    if pair_row_inflation < 1.0:
+        raise ValueError(f"pair_row_inflation is delivered/ideal rows "
+                         f"and cannot be < 1, got {pair_row_inflation}")
     cov = pair_coverage
     pair_rows = ne * cov * pair_row_inflation / 128.0
     residual_ne = ne * (1.0 - cov)

@@ -44,6 +44,30 @@ def test_pull_phases_advance_like_step(graph, np_mesh, pair):
         assert all(v >= 0 for v in t.values())
 
 
+def test_flat_dot_path_phases(graph):
+    """Dot-path programs (colfilter) on the FLAT layout run the
+    generic gather pipeline, so the generic phases time them — the
+    round-4 stub raised NotImplementedError here."""
+    from lux_tpu.apps import colfilter
+    from lux_tpu.engine.pull import PullEngine
+    from lux_tpu.graph import ShardedGraph
+
+    rng = np.random.default_rng(3)
+    g = graph
+    gw = Graph(nv=g.nv, ne=g.ne, row_ptrs=g.row_ptrs, col_idx=g.col_idx,
+               weights=rng.integers(1, 6, size=g.ne).astype(np.int32),
+               out_degrees=g.out_degrees)
+    sg = ShardedGraph.build(gw, 2)
+    eng = PullEngine(sg, colfilter.make_program(), layout="flat")
+    want = eng.run(eng.init_state(), 2, fused=False)
+
+    state, report = eng.timed_phases(eng.init_state(), iters=2)
+    np.testing.assert_allclose(np.asarray(state), np.asarray(want),
+                               rtol=1e-6, atol=1e-8)
+    for t in report:
+        assert set(t) == {"exchange", "gather", "reduce", "apply"}
+
+
 @pytest.mark.parametrize("use_mesh", [False, True])
 def test_push_phases_reach_fixed_point(graph, use_mesh):
     from lux_tpu.apps import sssp
