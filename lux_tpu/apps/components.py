@@ -33,16 +33,22 @@ def make_program() -> PushProgram:
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
-                 starts=None, exchange: str = "auto") -> PushEngine:
+                 starts=None, exchange: str = "auto",
+                 enable_sparse: bool = True,
+                 owner_tile_e: int | None = None) -> PushEngine:
     """pair_threshold enables pair-lane delivery on dense iterations
     (best after graph.pair_relabel, passing its ``starts`` through;
     labels are vertex ids, so map results back through the relabel
-    permutation)."""
+    permutation).  enable_sparse=False drops the src-sorted frontier
+    view — the big-scale fit lever (it re-doubles edge memory,
+    ShardedGraph.memory_report(push_sparse=True)); every iteration
+    then runs dense."""
     if sg is None:
         sg = ShardedGraph.build(g, num_parts, starts=starts,
                                 pair_threshold=pair_threshold)
     return PushEngine(sg, make_program(), mesh=mesh,
-                      pair_threshold=pair_threshold, exchange=exchange)
+                      pair_threshold=pair_threshold, exchange=exchange,
+                      enable_sparse=enable_sparse, owner_tile_e=owner_tile_e)
 
 
 def run(g: Graph, num_parts: int = 1, mesh=None, max_iters=None,

@@ -63,10 +63,9 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                                 pair_threshold=pair_threshold)
     if tile_e is None:
         tile_e = 128 if pair_threshold is not None else 512
-    kw = {} if owner_tile_e is None else dict(owner_tile_e=owner_tile_e)
     return PullEngine(sg, make_program(dtype), mesh=mesh,
                       pair_threshold=pair_threshold, tile_e=tile_e,
-                      exchange=exchange, **kw)
+                      exchange=exchange, owner_tile_e=owner_tile_e)
 
 
 

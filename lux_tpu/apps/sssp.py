@@ -79,12 +79,18 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                  delta: float | str | None = None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
-                 starts=None, exchange: str = "auto") -> PushEngine:
+                 starts=None, exchange: str = "auto",
+                 enable_sparse: bool = True,
+                 owner_tile_e: int | None = None) -> PushEngine:
     """delta: bucket width for delta-stepping priority ordering
     (weighted runs); "auto" picks a heuristic; None disables (plain
     Bellman-Ford frontier relaxation).  pair_threshold enables pair-
     lane delivery on dense iterations (best after graph.pair_relabel,
-    whose ``starts`` should be passed through here)."""
+    whose ``starts`` should be passed through here).
+    enable_sparse=False drops the src-sorted frontier view — the
+    big-scale fit lever (it re-doubles edge memory,
+    ShardedGraph.memory_report(push_sparse=True)); every iteration
+    then runs dense."""
     if weighted and g.weights is None:
         raise ValueError("weighted SSSP needs a weighted graph")
     if delta == "auto":
@@ -94,7 +100,8 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                                 pair_threshold=pair_threshold)
     return PushEngine(sg, make_program(start_vertex, weighted), mesh=mesh,
                       delta=delta, pair_threshold=pair_threshold,
-                      exchange=exchange)
+                      exchange=exchange, enable_sparse=enable_sparse,
+                      owner_tile_e=owner_tile_e)
 
 
 def run(g: Graph, start_vertex: int = 0, num_parts: int = 1, mesh=None,

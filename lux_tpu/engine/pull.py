@@ -156,7 +156,7 @@ class PullEngine:
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
-                 owner_tile_e: int = 256):
+                 owner_tile_e: int | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -203,7 +203,7 @@ class PullEngine:
         dev = jnp.asarray if mesh is None else np.asarray
         if exchange == "owner":
             from lux_tpu.ops.owner import OwnerLayout
-            self.owner = OwnerLayout.build(sg, E=owner_tile_e)
+            self.owner = OwnerLayout.build(sg, E=owner_tile_e or 256)
             self.tiles = None
             arrays = dict(
                 **common_graph_arrays(sg, dev),

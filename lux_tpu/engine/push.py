@@ -94,7 +94,7 @@ class PushEngine:
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
-                 owner_tile_e: int = 256):
+                 owner_tile_e: int | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -153,7 +153,7 @@ class PushEngine:
             # below is unchanged (queue exchange is already O(queue))
             from lux_tpu.engine.pull import common_graph_arrays
             from lux_tpu.ops.owner import OwnerLayout
-            self.owner = OwnerLayout.build(dense_sg, E=owner_tile_e)
+            self.owner = OwnerLayout.build(dense_sg, E=owner_tile_e or 256)
             self.tiles = None
             arrays = dict(
                 **common_graph_arrays(dense_sg, dev),

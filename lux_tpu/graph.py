@@ -602,18 +602,51 @@ class ShardedGraph:
             out[v0:v1] = x[p, :v1 - v0]
         return out
 
-    def memory_report(self) -> dict:
-        """HBM bytes for the default TILED engine layout per part —
-        the analogue of the reference's startup memory advisor
-        (reference pagerank.cc:60-85).  (The flat oracle layout ships
-        int32 dst_local instead of int8 rel, +3 B/edge.)"""
-        # src_slot int32 + rel_dst int8 (+ f32 weights)
-        edge_bytes = self.epad * (4 + 1 + (4 if self.weighted else 0))
+    def memory_report(self, *, exchange: str = "gather",
+                      owner_slots_per_part: int | None = None,
+                      push_sparse: bool = False) -> dict:
+        """HBM bytes for the engine edge layouts per part — the
+        analogue of the reference's startup memory advisor (reference
+        pagerank.cc:60-85).  (The flat oracle layout ships int32
+        dst_local instead of int8 rel, +3 B/edge.)
+
+        exchange='owner' prices the owner-side layout instead of the
+        tiled one: per-slot int32 src_local + int8 rel_dst (+ f32
+        weight).  owner_slots_per_part defaults to epad — a LOWER
+        bound; the real count includes per-(src-part, dst-tile) chunk
+        padding and lives in OwnerLayout.stats after the build
+        (measured 1.15-1.5x, PERF_NOTES).
+
+        push_sparse adds the push engine's src-sorted frontier view
+        (graph.src_sorted): ss_dst int32 over epad AGAIN (+ f32
+        weights again) plus the compressed source index — the arrays
+        that roughly DOUBLE edge memory and must be priced before any
+        big-scale push run (round-4 VERDICT).  The source-index pad S
+        uses the cached src-sort when available, else the min(nv-ish,
+        epad) upper bound."""
+        w = 4 if self.weighted else 0
+        if exchange == "owner":
+            slots = (self.epad if owner_slots_per_part is None
+                     else int(owner_slots_per_part))
+            edge_bytes = slots * (4 + 1 + w)
+        else:
+            # src_slot int32 + rel_dst int8 (+ f32 weights)
+            edge_bytes = self.epad * (4 + 1 + w)
+        sparse_bytes = 0
+        if push_sparse:
+            if self._src_sorted_cache is not None:
+                S = self.src_unique_max()
+            else:
+                S = min(self.vpad, self.epad)
+            # src_ids + src_off int32 + ss_dst int32 (+ f32 ss_weight)
+            sparse_bytes = 4 * (2 * S + 1) + self.epad * (4 + w)
         # state f32 + deg int32 (vmask derives from a scalar on device)
         vert_bytes = self.vpad * (4 + 4)
+        per_part = edge_bytes + sparse_bytes + vert_bytes
         return {
             "num_parts": self.num_parts,
             "edge_bytes_per_part": edge_bytes,
+            "push_sparse_bytes_per_part": sparse_bytes,
             "vertex_bytes_per_part": vert_bytes,
-            "total_bytes": self.num_parts * (edge_bytes + vert_bytes),
+            "total_bytes": self.num_parts * per_part,
         }

@@ -1,22 +1,30 @@
-"""VERDICT r1 #8: prove the >=0.5B-edge build path on one chip.
+"""VERDICT r1 #8 / r4 #1-2: prove the >=0.5B-edge path on one chip,
+for BOTH engine families.
 
 Generates RMAT{scale} with the native C++ generator, builds a
-multi-part ShardedGraph within host RAM, runs a few timed pagerank
-iterations on the real TPU, and prints one JSON line per stage plus
-the final GTEPS (driver methodology: loop-dependent fused run, host
-fetch fence).
+multi-part ShardedGraph within host RAM, runs the app on the real TPU,
+and prints one JSON line per stage plus the final GTEPS (driver
+methodology: pull apps time a loop-dependent fused run, push apps time
+whole while_loop converges; host-fetch fence either way).
 
-Usage:
+Usage (key=value args, any order):
   PYTHONPATH=/root/repo:/root/.axon_site \
-      python scripts/bench_bigscale.py [scale=25] [np=4] [pair=0] [ni=3] \
-                                       [tile_e=0] [exchange=gather] \
-                                       [owner_tile_e=256]
+      python scripts/bench_bigscale.py [scale=25] [np=4] [pair=0] \
+          [ni=3] [tile_e=0] [exchange=gather] [owner_e=0] \
+          [app=pagerank|cc|sssp|sssp-w] [sparse=1] [repeats=1]
 
 pair > 0 additionally runs graph.pair_relabel + pair-lane delivery
 (slower host prep; measures the fast path at scale).  tile_e=0 uses
 the engine default (512; 128 for the pair residual); bigger values
 halve the [P, C, 128] partials temporary but grow per-tile chunk
 padding — measured NET WORSE at RMAT26 (PERF_NOTES).
+
+Push apps: cc symmetrizes (and caches) the graph and converges
+max-propagation; sssp converges hop frontiers from vertex 0; sssp-w
+attaches uniform 1..5 int weights (the bench convention) and converges
+weighted frontiers.  sparse=0 drops the src-sorted frontier view
+(halves edge memory; every iteration dense) — the big-scale fit lever
+priced by ShardedGraph.memory_report(push_sparse=...).
 """
 
 from __future__ import annotations
@@ -35,24 +43,40 @@ def log(stage, t0, **kw):
     return time.time()
 
 
+DEFAULTS = dict(scale=25, np=4, pair=0, ni=3, tile_e=0,
+                exchange="gather", owner_e=0, app="pagerank",
+                sparse=1, repeats=1)
+
+
+def parse_args(argv):
+    cfg = dict(DEFAULTS)
+    pos = 0
+    for a in argv:
+        if "=" in a:
+            k, v = a.split("=", 1)
+            if k not in cfg:
+                raise SystemExit(f"unknown arg {k!r} (known: "
+                                 f"{', '.join(cfg)})")
+        else:   # legacy positional order
+            if pos >= len(DEFAULTS):
+                raise SystemExit(f"too many positional args at {a!r}")
+            k, v = list(DEFAULTS)[pos], a
+            pos += 1
+        cfg[k] = v if k in ("exchange", "app") else int(v)
+    return cfg
+
+
 def main():
-    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 25
-    np_parts = int(sys.argv[2]) if len(sys.argv) > 2 else 4
-    pair = int(sys.argv[3]) if len(sys.argv) > 3 else 0
-    ni = int(sys.argv[4]) if len(sys.argv) > 4 else 3
-    tile_e = int(sys.argv[5]) if len(sys.argv) > 5 else 0
-    exchange = sys.argv[6] if len(sys.argv) > 6 else "gather"
-    owner_e = int(sys.argv[7]) if len(sys.argv) > 7 else 0
+    cfg = parse_args(sys.argv[1:])
+    scale, np_parts, pair = cfg["scale"], cfg["np"], cfg["pair"]
+    app, exchange = cfg["app"], cfg["exchange"]
 
     import os
 
     import numpy as np
 
-    from lux_tpu.apps import pagerank
-    from lux_tpu.convert import rmat_graph
     from lux_tpu.format import write_lux
     from lux_tpu.graph import Graph, pair_relabel
-    from lux_tpu.timing import timed_fused_run
 
     t = time.time()
     cache = f"/tmp/rmat{scale}_ef16_s0.lux"
@@ -60,10 +84,33 @@ def main():
         g = Graph.from_file(cache, use_native=True)
         t = log("load_cached", t, nv=g.nv, ne=g.ne)
     else:
+        from lux_tpu.convert import rmat_graph
         g = rmat_graph(scale=scale, edge_factor=16, seed=0)
         t = log("generate", t, nv=g.nv, ne=g.ne)
         write_lux(cache, g.row_ptrs, g.col_idx, degrees=g.out_degrees)
         t = log("cache_write", t)
+
+    if app == "cc":
+        # CC needs the symmetrized edge set (bench.py convention);
+        # cache it — the 2x-edge from_edges sort is minutes at scale 25
+        sym = f"/tmp/rmat{scale}_ef16_s0_sym.lux"
+        if os.path.exists(sym):
+            g = Graph.from_file(sym, use_native=True)
+            t = log("load_sym_cached", t, ne=g.ne)
+        else:
+            from lux_tpu.apps.components import symmetrize
+            s, d = symmetrize(*g.edge_arrays())
+            g = Graph.from_edges(s, d, g.nv)
+            # temp + rename: a crash mid-write must never leave a
+            # truncated cache that a later run would load as the graph
+            write_lux(sym + ".tmp", g.row_ptrs, g.col_idx,
+                      degrees=g.out_degrees)
+            os.replace(sym + ".tmp", sym)
+            t = log("symmetrize", t, ne=g.ne)
+    elif app == "sssp-w":
+        rng = np.random.default_rng(1)
+        g.weights = rng.integers(1, 6, size=g.ne).astype(np.int32)
+        t = log("weights", t)
 
     starts = None
     if pair:
@@ -75,9 +122,15 @@ def main():
         # written LAST and gates the load, so a crash mid-write never
         # serves a partial cache.  ("" = the round-4 algorithm.)
         RELAB_VER = ""
-        rcache = (f"/tmp/rmat{scale}_ef16_s0_relab_np{np_parts}"
+        sym_tag = "_sym" if app == "cc" else ""
+        rcache = (f"/tmp/rmat{scale}_ef16_s0{sym_tag}_relab_np{np_parts}"
                   f"_p{pair}{RELAB_VER}")
         if os.path.exists(rcache + ".starts.npy"):
+            if g.weights is not None:
+                # weights are attached PRE-relabel in this script only
+                # for sssp-w; the unweighted cache cannot serve them
+                raise SystemExit("pair cache + weighted: rebuild the "
+                                 "cache with weights in the .lux file")
             g = Graph.from_file(rcache + ".lux", use_native=True)
             starts = np.load(rcache + ".starts.npy")
             t = log("load_relabel_cache", t)
@@ -86,18 +139,40 @@ def main():
                                             pair_threshold=pair,
                                             verbose=True)
             t = log("pair_relabel", t)
-            write_lux(rcache + ".lux", g.row_ptrs, g.col_idx,
-                      degrees=g.out_degrees)
-            np.save(rcache + ".starts.npy", starts)
-            t = log("relabel_cache_write", t)
+            if g.weights is None:
+                write_lux(rcache + ".lux", g.row_ptrs, g.col_idx,
+                          degrees=g.out_degrees)
+                np.save(rcache + ".starts.npy", starts)
+                t = log("relabel_cache_write", t)
 
-    eng = pagerank.build_engine(g, num_parts=np_parts,
-                                pair_threshold=pair or None,
-                                starts=starts,
-                                tile_e=tile_e or None,
-                                exchange=exchange,
-                                owner_tile_e=owner_e or None)
-    rep = eng.sg.memory_report()
+    kw = dict(num_parts=np_parts, pair_threshold=pair or None,
+              starts=starts, exchange=exchange)
+    if cfg["owner_e"]:
+        kw["owner_tile_e"] = cfg["owner_e"]
+    if app == "pagerank":
+        from lux_tpu.apps import pagerank
+        if cfg["tile_e"]:
+            kw["tile_e"] = cfg["tile_e"]
+        eng = pagerank.build_engine(g, **kw)
+    elif app == "cc":
+        from lux_tpu.apps import components
+        eng = components.build_engine(g, enable_sparse=bool(cfg["sparse"]),
+                                      **kw)
+    elif app in ("sssp", "sssp-w"):
+        from lux_tpu.apps import sssp as sssp_app
+        eng = sssp_app.build_engine(g, start_vertex=0,
+                                    weighted=app == "sssp-w",
+                                    enable_sparse=bool(cfg["sparse"]),
+                                    **kw)
+    else:
+        raise SystemExit(f"unknown app {app!r}")
+
+    rep = eng.sg.memory_report(
+        exchange=eng.exchange,   # the RESOLVED value ('auto' -> real)
+        owner_slots_per_part=(
+            eng.owner.stats["slots"] // len(eng.sg.part_ids())
+            if eng.owner is not None else None),
+        push_sparse=app != "pagerank" and bool(cfg["sparse"]))
     t = log("build_engine", t,
             vpad=eng.sg.vpad, epad=eng.sg.epad,
             device_gb=round(rep["total_bytes"] / 1e9, 2),
@@ -108,18 +183,30 @@ def main():
             owner_stats=(eng.owner.stats if eng.owner is not None
                          else None))
 
-    state, [elapsed] = timed_fused_run(eng, ni)
-    out = eng.unpad(state)
-    assert np.isfinite(out).all(), "non-finite result"
-    gteps = g.ne * ni / elapsed / 1e9
-    log("run", t, iters=ni, elapsed=round(elapsed, 2),
+    if app == "pagerank":
+        from lux_tpu.timing import timed_fused_run
+        ni = cfg["ni"]
+        state, elapsed = timed_fused_run(eng, ni, repeats=cfg["repeats"])
+        out = eng.unpad(state)
+        assert np.isfinite(out).all(), "non-finite result"
+        iters = ni
+    else:
+        from lux_tpu.timing import timed_converge
+        # timed_converge returns labels already unpadded to [nv]
+        out, iters, elapsed = timed_converge(eng, repeats=cfg["repeats"])
+        if app == "cc":
+            assert out.min() >= 0, "CC label underflow"
+    best = min(elapsed)
+    gteps = g.ne * iters / best / 1e9
+    log("run", t, iters=int(iters), elapsed=[round(e, 2) for e in elapsed],
         gteps=round(gteps, 4))
     print(json.dumps({
-        "metric": f"pagerank_rmat{scale}_np{np_parts}_gteps_per_chip",
+        "metric": f"{app}_rmat{scale}_np{np_parts}_gteps_per_chip",
         "value": round(gteps, 4), "unit": "GTEPS",
         "vs_baseline": round(gteps, 4), "np": np_parts,
-        "scale": scale, "pair_threshold": pair or None,
-        "exchange": exchange}))
+        "scale": scale, "ne": g.ne, "pair_threshold": pair or None,
+        "exchange": exchange, "sparse": bool(cfg["sparse"]),
+        "iters": int(iters)}))
 
 
 if __name__ == "__main__":

@@ -87,6 +87,17 @@ def test_memory_report():
     sg = ShardedGraph.build(g, 4)
     rep = sg.memory_report()
     assert rep["total_bytes"] > 0 and rep["num_parts"] == 4
+    assert rep["push_sparse_bytes_per_part"] == 0
+
+    # the push fit plan: sparse view prices the second edge array
+    push = sg.memory_report(push_sparse=True)
+    assert push["push_sparse_bytes_per_part"] >= sg.epad * 4
+    assert push["total_bytes"] > rep["total_bytes"]
+
+    # owner pricing uses the real (padded) slot count when given
+    own = sg.memory_report(exchange="owner",
+                           owner_slots_per_part=2 * sg.epad)
+    assert own["edge_bytes_per_part"] == 2 * sg.epad * 5
 
 
 def test_src_sorted_compressed_index_oracle():

@@ -43,6 +43,21 @@ class TestSSSP:
         assert np.array_equal(sssp.unreachable(dist),
                               want >= int(sssp.HOP_INF))
 
+    def test_dense_only_app_passthrough(self):
+        """The big-scale fit lever: apps expose enable_sparse=False /
+        owner_tile_e (sssp/components.build_engine), dropping the
+        src-sorted view; results must still match the oracle."""
+        src, dst = uniform_random_edges(250, 1800, seed=13)
+        g = Graph.from_edges(src, dst, 250)
+        eng = sssp.build_engine(g, start_vertex=3, num_parts=2,
+                                enable_sparse=False, exchange="owner",
+                                owner_tile_e=128)
+        assert eng.owner is not None and "src_ids" not in eng.arrays
+        dist, _ = eng.run()
+        want = sssp.reference_sssp(g, start_vertex=3)
+        reach = ~sssp.unreachable(dist)
+        np.testing.assert_array_equal(dist[reach], want[reach])
+
     def test_weighted_matches_oracle(self):
         src, dst, w = uniform_random_edges(120, 900, seed=21,
                                            weighted=True)
