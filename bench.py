@@ -56,7 +56,8 @@ PAIR_THRESHOLD = 16   # default; override with -pair
 # PERF_NOTES round-over-round tables.
 DEFAULT_SHAPE = {"pagerank": (21, 16), "cc": (20, 16),
                  "sssp": (21, 16), "sssp-delta": (21, 16),
-                 "colfilter": (16, 128), "pagerank-mp": (23, 16)}
+                 "colfilter": (16, 128), "pagerank-mp": (23, 16),
+                 "sssp-mp": (23, 16)}
 
 
 def build_graph(scale, ef, verbose, weighted=False):
@@ -137,10 +138,11 @@ def run_config(config, args):
                                          pair_threshold=pair_t or 16)
         eng = pagerank.build_engine(g2, num_parts=np_parts,
                                     pair_threshold=pair_t,
+                                    pair_min_fill=args.min_fill,
                                     starts=starts,
                                     exchange="owner" if mp else "auto")
         extra.update(relabel=True, pair_threshold=pair_t, np=np_parts,
-                     exchange=eng.exchange)
+                     exchange=eng.exchange, min_fill=args.min_fill)
         _print_coverage(args, eng)
         samples = bench_fused(eng, g.ne, args.ni, args.verbose,
                               args.repeats)
@@ -177,18 +179,32 @@ def run_config(config, args):
             g2, _perm, starts = pair_relabel(g, args.np, pair_threshold=pair_t or 16)
             eng = components.build_engine(g2, num_parts=args.np,
                                           pair_threshold=pair_t,
+                                          pair_min_fill=args.min_fill,
                                           starts=starts)
-            extra.update(relabel=True, pair_threshold=pair_t)
+            extra.update(relabel=True, pair_threshold=pair_t,
+                         min_fill=args.min_fill)
         else:
-            g2, perm, starts = pair_relabel(g, args.np, pair_threshold=pair_t or 16)
+            # sssp-mp: the PUSH engine's mesh-relevant path — np=4
+            # owner-side dense iterations + sparse queues, regression-
+            # guarded like pagerank-mp (round-4 VERDICT #7).  The
+            # scale-23 int32 label table (34 MB) sits under the auto
+            # threshold, so the exchange is pinned explicitly.
+            mp = config == "sssp-mp"
+            np_parts = max(args.np, 4) if mp else args.np
+            g2, perm, starts = pair_relabel(g, np_parts,
+                                            pair_threshold=pair_t or 16)
             rank = np.empty(g.nv, np.int64)
             rank[perm] = np.arange(g.nv)
             eng = sssp.build_engine(
-                g2, start_vertex=int(rank[0]), num_parts=args.np,
+                g2, start_vertex=int(rank[0]), num_parts=np_parts,
                 weighted=weighted,
                 delta="auto" if config == "sssp-delta" else None,
-                pair_threshold=pair_t, starts=starts)
+                pair_threshold=pair_t, pair_min_fill=args.min_fill,
+                starts=starts,
+                exchange="owner" if mp else "auto")
             extra.update(relabel=True, pair_threshold=pair_t,
+                         min_fill=args.min_fill, np=np_parts,
+                         exchange=eng.exchange,
                          delta="auto" if weighted else None)
         _print_coverage(args, eng)
         samples = bench_converge(eng, g.ne, args.verbose, args.repeats)
@@ -228,6 +244,13 @@ def main() -> int:
     ap.add_argument("-np", type=int, default=1, help="partitions")
     ap.add_argument("-pair", type=int, default=PAIR_THRESHOLD,
                     help="pair-lane threshold (0 disables)")
+    ap.add_argument("-min-fill", type=int, default=16,
+                    dest="min_fill", metavar="F",
+                    help="pair rows under F live lanes ride the "
+                         "residual instead (ops/pairs.py min_fill; "
+                         "measured +32%% on the scalar configs at the "
+                         "150/9 ns row/edge break-even, PERF_NOTES "
+                         "round 5; 0 disables)")
     ap.add_argument("-repeats", type=int, default=3,
                     help="timed repeats per config; the JSON line "
                          "reports the median (tunnel variance exceeds "
@@ -236,10 +259,12 @@ def main() -> int:
     args = ap.parse_args()
     if args.repeats < 1:
         ap.error("-repeats must be >= 1")
+    if args.min_fill is not None and args.min_fill <= 0:
+        args.min_fill = None
 
     configs = ([args.config] if args.config and not args.all
                else ["cc", "sssp", "sssp-delta", "colfilter",
-                     "pagerank-mp", "pagerank"])
+                     "sssp-mp", "pagerank-mp", "pagerank"])
     for config in configs:
         name, samples, extra = run_config(config, args)
         emit(name, samples, extra)

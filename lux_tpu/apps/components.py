@@ -33,6 +33,7 @@ def make_program() -> PushProgram:
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
+                 pair_min_fill: int | None = None,
                  starts=None, exchange: str = "auto",
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None) -> PushEngine:
@@ -47,7 +48,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
         sg = ShardedGraph.build(g, num_parts, starts=starts,
                                 pair_threshold=pair_threshold)
     return PushEngine(sg, make_program(), mesh=mesh,
-                      pair_threshold=pair_threshold, exchange=exchange,
+                      pair_threshold=pair_threshold,
+                      pair_min_fill=pair_min_fill, exchange=exchange,
                       enable_sparse=enable_sparse, owner_tile_e=owner_tile_e)
 
 

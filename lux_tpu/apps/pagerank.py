@@ -49,6 +49,7 @@ def make_program(dtype=jnp.float32) -> PullProgram:
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  dtype=jnp.float32, sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
+                 pair_min_fill: int | None = None,
                  starts=None, tile_e: int | None = None,
                  exchange: str = "auto",
                  owner_tile_e: int | None = None) -> PullEngine:
@@ -64,7 +65,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
     if tile_e is None:
         tile_e = 128 if pair_threshold is not None else 512
     return PullEngine(sg, make_program(dtype), mesh=mesh,
-                      pair_threshold=pair_threshold, tile_e=tile_e,
+                      pair_threshold=pair_threshold,
+                      pair_min_fill=pair_min_fill, tile_e=tile_e,
                       exchange=exchange, owner_tile_e=owner_tile_e)
 
 

@@ -79,6 +79,7 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                  delta: float | str | None = None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
+                 pair_min_fill: int | None = None,
                  starts=None, exchange: str = "auto",
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None) -> PushEngine:
@@ -100,6 +101,7 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                                 pair_threshold=pair_threshold)
     return PushEngine(sg, make_program(start_vertex, weighted), mesh=mesh,
                       delta=delta, pair_threshold=pair_threshold,
+                      pair_min_fill=pair_min_fill,
                       exchange=exchange, enable_sparse=enable_sparse,
                       owner_tile_e=owner_tile_e)
 

@@ -61,6 +61,18 @@ def _common(ap: argparse.ArgumentParser):
                          "96 MB state table; the default).  "
                          "colfilter's dot path has its own dst-free "
                          "machinery and ignores this")
+    ap.add_argument("-min-fill", type=int, default=None,
+                    dest="min_fill", metavar="F",
+                    help="with -pair: drop pair rows that would "
+                         "deliver < F live lanes (their edges ride "
+                         "the residual path); break-even ~15 at the "
+                         "measured 150 ns/row vs ~10 ns/edge rates "
+                         "(PERF_NOTES round 5)")
+    ap.add_argument("-sparse", type=int, default=1, metavar="0|1",
+                    help="sssp/cc: keep the src-sorted sparse-frontier "
+                         "view (1, default).  0 halves edge memory at "
+                         "big scale; every iteration runs dense "
+                         "(memory_report(push_sparse=...) prices it)")
     ap.add_argument("-phases", type=int, default=0, metavar="N",
                     help="after the timed run, run N instrumented "
                          "iterations and print the per-iteration "
@@ -171,6 +183,7 @@ def cmd_pagerank(argv):
     sg = _build_sg(args, g_run, num_parts, starts)
     eng = pagerank.build_engine(g_run, num_parts, mesh, sg=sg,
                                 pair_threshold=args.pair,
+                                pair_min_fill=args.min_fill,
                                 exchange=args.exchange)
     if args.tol is not None:
         from lux_tpu.timing import timed_run_until
@@ -233,12 +246,16 @@ def _push_app(argv, prog_name):
                                 num_parts=num_parts, mesh=mesh,
                                 weighted=weighted, delta=delta, sg=sg,
                                 pair_threshold=args.pair,
-                                exchange=args.exchange)
+                        pair_min_fill=args.min_fill,
+                                exchange=args.exchange,
+                                enable_sparse=bool(args.sparse))
     else:
         eng = components.build_engine(g_run, num_parts=num_parts,
                                       mesh=mesh, sg=sg,
                                       pair_threshold=args.pair,
-                                      exchange=args.exchange)
+                                      pair_min_fill=args.min_fill,
+                                      exchange=args.exchange,
+                                      enable_sparse=bool(args.sparse))
     labels, iters, [elapsed] = timed_converge(
         eng, verbose=args.verbose, trace_dir=args.profile)
     print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")

@@ -153,6 +153,7 @@ class PullEngine:
                  tile_e: int = 512, use_mxu: bool = False,
                  reduce_method: str = "auto",
                  pair_threshold: int | None = None,
+                 pair_min_fill: int | None = None,
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
@@ -174,7 +175,7 @@ class PullEngine:
         self.pairs = None
         if pair_threshold is not None:
             sg = self._setup_pairs(sg, pair_threshold, mesh, layout,
-                                   program)
+                                   program, pair_min_fill)
         from lux_tpu.ops.pairs import resolve_pair_stream
         self.pair_stream = resolve_pair_stream(pair_stream, self.pairs)
         # auto: stream once the [rows, C, E] f32 message temporary
@@ -245,7 +246,7 @@ class PullEngine:
     # -- pair-lane fast path (ops/pairs.py) ----------------------------
 
     def _setup_pairs(self, sg: ShardedGraph, threshold: int, mesh,
-                     layout, program):
+                     layout, program, min_fill=None):
         """Split dense (src-tile, dst-tile) pair edges out of the
         regular gather path (see ops/pairs.py): gather cost is per ROW
         fetched, so pair rows fetch a 128-wide source state row once
@@ -262,7 +263,8 @@ class PullEngine:
                              "edge_value depends only on the source "
                              "state, or on <src, dst> via "
                              "edge_value_from_dot")
-        sp, residual = plan_sharded_pairs(sg, threshold)
+        sp, residual = plan_sharded_pairs(sg, threshold,
+                                          min_fill=min_fill)
         self.pairs = sp                      # None if nothing dense
         return residual
 

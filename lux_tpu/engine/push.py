@@ -91,6 +91,7 @@ class PushEngine:
                  delta: float | None = None,
                  reduce_method: str = "auto",
                  pair_threshold: int | None = None,
+                 pair_min_fill: int | None = None,
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
@@ -134,7 +135,8 @@ class PushEngine:
             if layout != "tiled":
                 raise ValueError(
                     "pair_threshold requires the tiled layout")
-            self.pairs, dense_sg = plan_sharded_pairs(sg, pair_threshold)
+            self.pairs, dense_sg = plan_sharded_pairs(
+                sg, pair_threshold, min_fill=pair_min_fill)
         from lux_tpu.ops.pairs import resolve_pair_stream
         from lux_tpu.ops.tiled import STREAM_MSG_BYTES
         self.pair_stream = resolve_pair_stream(pair_stream, self.pairs)

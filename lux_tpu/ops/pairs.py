@@ -132,8 +132,22 @@ class PairAnalysis:
 
 def analyze_pairs(src_slot: np.ndarray, dst_local: np.ndarray,
                   vpad: int, threshold: int = 8,
-                  max_occ: int = 128) -> PairAnalysis:
-    """See build_pair_plan; this is its sorting/selection half."""
+                  max_occ: int = 128,
+                  min_fill: int | None = None) -> PairAnalysis:
+    """See build_pair_plan; this is its sorting/selection half.
+
+    min_fill (occupancy-aware row packing, round-5 north-star work):
+    drop pair rows that would deliver fewer than ``min_fill`` live
+    lanes, sending their edges to the residual path instead.  Row
+    fill is MONOTONE DECREASING in occurrence depth within a pair
+    (row o carries one edge per source lane with multiplicity > o),
+    so the underfilled rows are exactly each pair's occurrence TAIL —
+    the drop is a per-pair adaptive occurrence cap, computed from one
+    (pidx, occ) histogram.  The break-even fill is the measured
+    per-row delivery cost over the residual per-edge rate
+    (~150 / ~10 ns, PERF_NOTES scale-25 decomposition) ~ 15 lanes;
+    R-MAT tails spread multiplicity so hard that mean fill at RMAT25
+    is 18.6 (inflation 6.88x) with a long sub-break-even tail."""
     assert vpad % W == 0
     ne = len(dst_local)
     n_tiles = vpad // W
@@ -186,8 +200,44 @@ def analyze_pairs(src_slot: np.ndarray, dst_local: np.ndarray,
     remap = np.full(len(sizes), -1, np.int64)
     remap[sel_ids] = np.arange(len(sel_ids))
     pidx = remap[pid_cov]                         # [n_cov]
+
+    if min_fill is not None and min_fill > 1 and len(cov):
+        # fill of row (pair, o) = #edges at occurrence o in the pair;
+        # monotone decreasing in o, so the per-pair cap is the count
+        # of leading occurrence levels with fill >= min_fill.  One
+        # fused sort of (pidx << 32 | occ) groups the histogram; the
+        # pack is safe (dense pidx < n_cov < 2^31, occ < max_occ).
+        key = (pidx.astype(np.int64) << np.int64(32)) | occ
+        from lux_tpu import native as _nat
+        _nat.sort_kv(key, ())
+        newg = np.ones(len(key), bool)
+        newg[1:] = key[1:] != key[:-1]
+        gidx = np.nonzero(newg)[0]
+        fill = np.diff(np.concatenate((gidx, [len(key)])))
+        gp = (key[gidx] >> np.int64(32)).astype(np.int64)
+        go = (key[gidx] & np.int64(0xFFFFFFFF)).astype(np.int64)
+        # leading run of occ levels with fill >= min_fill per pair:
+        # occ levels are contiguous from 0 (groups sorted by occ), so
+        # the cap is the first level that is absent or underfilled
+        ok = fill >= min_fill
+        run = np.zeros(len(sel_ids), np.int64)
+        # count o where (pair, o) ok AND all o' < o ok: prefix-and via
+        # cummax of the first failure position
+        firstbad = np.full(len(sel_ids), np.iinfo(np.int64).max)
+        np.minimum.at(firstbad, gp[~ok], go[~ok])
+        np.maximum.at(run, gp[ok],
+                      np.minimum(go[ok] + 1, firstbad[gp[ok]]))
+        cap = run                                  # rows kept per pair
+        keep2 = occ < cap[pidx]
+        if not keep2.all():
+            residual[cov[~keep2]] = True
+            cov = cov[keep2]
+            pidx = pidx[keep2]
+            occ = occ[keep2]   # no holes: kept occ stay < cap
+
     nrows_pair = np.zeros(len(sel_ids), np.int64)
-    np.maximum.at(nrows_pair, pidx, occ + 1)
+    if len(cov):
+        np.maximum.at(nrows_pair, pidx, occ + 1)
 
     # order pairs by dst tile (for the per-tile combine), then src tile
     pair_dt = (pp[starts[:-1]][sel_pair] % n_tiles)
@@ -211,7 +261,8 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
                     levels_growth: float = 1.35,
                     weights: np.ndarray | None = None,
                     slot_depths: np.ndarray | None = None,
-                    analysis: PairAnalysis | None = None):
+                    analysis: PairAnalysis | None = None,
+                    min_fill: int | None = None):
     """src_slot: int [ne] global padded state slots (state2d row =
     slot // 128); dst_local: int [ne] part-local dst in [0, vpad);
     vpad must be a multiple of 128.  weights (optional, [ne]) are laid
@@ -226,10 +277,12 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
     plan_sharded_pairs).
 
     analysis: a precomputed analyze_pairs result for these arrays
-    (must match threshold/max_occ) — skips the sorting half."""
+    (must match threshold/max_occ/min_fill) — skips the sorting
+    half.  min_fill: see analyze_pairs."""
     if analysis is None:
         analysis = analyze_pairs(src_slot, dst_local, vpad,
-                                 threshold=threshold, max_occ=max_occ)
+                                 threshold=threshold, max_occ=max_occ,
+                                 min_fill=min_fill)
     a = analysis
     ne, n_tiles = a.ne, a.n_tiles
     src_slot = np.asarray(src_slot, np.int64)
@@ -463,7 +516,7 @@ def cost_balanced_starts(g, num_parts: int, threshold: int,
     return weighted_balanced_bounds(cost_ptrs, num_parts, align=W)
 
 
-def plan_sharded_pairs(sg, threshold: int):
+def plan_sharded_pairs(sg, threshold: int, min_fill: int | None = None):
     """Build per-part pair plans for a ShardedGraph and the RESIDUAL
     ShardedGraph (uncovered edges, re-padded) the regular gather path
     should run on.  Returns (StackedPairPlan | None, residual_sg);
@@ -493,7 +546,7 @@ def plan_sharded_pairs(sg, threshold: int):
         return build_pair_plan(
             sg.src_slot[r, :nep], sg.dst_local[r, :nep], sg.vpad,
             threshold=threshold, weights=wp, slot_depths=slot_depths,
-            analysis=analysis)
+            analysis=analysis, min_fill=min_fill)
 
     if P > 1 or local:
         # Pass 1: per-part analyses (the expensive sorting half, done
@@ -508,7 +561,7 @@ def plan_sharded_pairs(sg, threshold: int):
             nep = int(sg.ne_part[rows[r]])
             analyses.append(analyze_pairs(
                 sg.src_slot[r, :nep], sg.dst_local[r, :nep], sg.vpad,
-                threshold=threshold))
+                threshold=threshold, min_fill=min_fill))
         prof_max = (np.maximum.reduce(
             [a.depth_sorted for a in analyses]) if analyses
             else np.zeros(sg.vpad // W, np.int64))

@@ -1,0 +1,84 @@
+"""Host-side pair-row occupancy analysis (north-star work, round 5).
+
+For a graph (R-MAT by scale, or a cached relabeled .lux), builds the
+pair analysis per part and prints the row-fill distribution plus the
+min_fill economics curve: for each candidate F, how many rows survive,
+what coverage remains, and the MODELED per-iteration delivery cost
+    rows * PAIR_ROW_NS + residual_edges * residual_ns
+so the best F is visible without a TPU run (the measured 150 ns/row
+and ~9-10 ns/edge rates, PERF_NOTES).  No device work — pure numpy.
+
+Usage:
+  PYTHONPATH=/root/repo python scripts/pair_fill_hist.py \
+      [scale=21] [np=1] [pair=16] [residual_ns=9.92]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    cfg = dict(scale=21, np=1, pair=16, residual_ns=9.92)
+    for a in sys.argv[1:]:
+        k, v = a.split("=", 1)
+        cfg[k] = float(v) if k == "residual_ns" else int(v)
+
+    from lux_tpu.convert import rmat_graph
+    from lux_tpu.graph import ShardedGraph, pair_relabel
+    from lux_tpu.ops.pairs import W, analyze_pairs
+    from lux_tpu.scalemodel import PAIR_ROW_NS
+
+    t0 = time.time()
+    g = rmat_graph(scale=cfg["scale"], edge_factor=16, seed=0)
+    g2, _perm, starts = pair_relabel(g, cfg["np"],
+                                     pair_threshold=cfg["pair"])
+    sg = ShardedGraph.build(g2, cfg["np"], starts=starts)
+    print(f"# built in {time.time() - t0:.0f}s", file=sys.stderr)
+
+    ne_total = g.ne
+    # per-(pair, occ-level) fill histogram across all parts: level
+    # fill == number of edges at that occurrence level (see
+    # analyze_pairs min_fill docstring)
+    fill_counts = np.zeros(W + 1, np.int64)   # fill value -> #rows
+    for r in range(len(sg.part_ids())):
+        nep = int(sg.ne_part[r])
+        a = analyze_pairs(sg.src_slot[r, :nep], sg.dst_local[r, :nep],
+                          sg.vpad, threshold=cfg["pair"])
+        key = (a.pidx.astype(np.int64) << np.int64(32)) | a.occ
+        key.sort()
+        newg = np.ones(len(key), bool)
+        newg[1:] = key[1:] != key[:-1]
+        gidx = np.nonzero(newg)[0]
+        fill = np.diff(np.concatenate((gidx, [len(key)])))
+        fill_counts += np.bincount(np.minimum(fill, W),
+                                   minlength=W + 1)
+
+    rows_total = int(fill_counts.sum())
+    edges_by_fill = fill_counts * np.arange(W + 1)
+    cov_total = int(edges_by_fill.sum())
+    print(json.dumps(dict(
+        ne=ne_total, covered=cov_total, rows=rows_total,
+        coverage=round(cov_total / ne_total, 4),
+        mean_fill=round(cov_total / max(rows_total, 1), 2))))
+
+    # economics: keep rows with fill >= F (the min_fill drop is the
+    # per-pair occurrence tail, and fill is monotone in depth, so
+    # thresholding the histogram models it exactly)
+    print("| F | rows kept | coverage | modeled s/iter |")
+    print("|---|---|---|---|")
+    for F in (1, 4, 8, 12, 16, 20, 24, 32, 48, 64):
+        keep = fill_counts[F:].sum()
+        cov = int(edges_by_fill[F:].sum())
+        resid = ne_total - cov
+        cost = (keep * PAIR_ROW_NS + resid * cfg["residual_ns"]) * 1e-9
+        print(f"| {F} | {int(keep)} | {cov / ne_total:.3f} "
+              f"| {cost:.3f} |")
+
+
+if __name__ == "__main__":
+    main()

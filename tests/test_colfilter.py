@@ -110,3 +110,32 @@ def test_pair_dot_cli(tmp_path, capsys):
                    "-check"])
     out = capsys.readouterr().out
     assert rc == 0 and "PASS" in out
+
+
+def test_netflix_like_generator():
+    """The NetFlix-shape synthesizer (scripts/bench_netflix.py's
+    input): bipartite endpoints, deduplicated (user, item) pairs,
+    both directions, integer ratings 1..5, heavier skew on items."""
+    from lux_tpu.convert import netflix_like_edges
+    src, dst, w, nv = netflix_like_edges(n_users=300, n_items=40,
+                                         n_ratings=3000, seed=7)
+    assert nv == 340 and len(src) == len(dst) == len(w)
+    assert len(src) % 2 == 0
+    half = len(src) // 2
+    # first half user->item, second the exact reverse
+    assert (src[:half] < 300).all() and (dst[:half] >= 300).all()
+    np.testing.assert_array_equal(src[half:], dst[:half])
+    np.testing.assert_array_equal(dst[half:], src[:half])
+    np.testing.assert_array_equal(w[half:], w[:half])
+    assert w.min() >= 1 and w.max() <= 5
+    # dedup: no repeated (user, item) pair
+    key = src[:half].astype(np.int64) * nv + dst[:half]
+    assert len(np.unique(key)) == half
+    # skew: the most-rated item outdraws the median item by a lot
+    item_deg = np.bincount(dst[:half] - 300, minlength=40)
+    assert item_deg.max() > 4 * np.median(item_deg)
+    # the engine + oracle run on it
+    g = Graph.from_edges(src, dst, nv, weights=w)
+    got = colfilter.run(g, 2, num_parts=2)
+    want = colfilter.reference_colfilter(g, 2)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-7)

@@ -591,3 +591,70 @@ def test_pair_plan_occurrence_cap_path():
     # 8 of the 40 duplicates kept, 32 residual; dense pair fully kept
     assert int(plan.residual.sum()) == 32
     assert plan.stats["covered"] == 8 + 16
+
+
+def test_min_fill_drops_skinny_rows():
+    """Occupancy-aware packing: rows below min_fill live lanes move
+    their edges to the residual (they cost ~150 ns/row but deliver
+    under break-even); pair + residual still partition the edges."""
+    rng = np.random.default_rng(11)
+    vpad = 2 * W
+    # pair A: 40 distinct sources in tile 0 -> dst tile 0, 1 edge each
+    # (one fat, fully-fillable row) ... PLUS one source with 6 edges
+    # (occurrences 1..5 ride 5 skinny rows without min_fill)
+    srcA = np.concatenate([np.arange(40), np.full(5, 3)])
+    dstA = np.concatenate([rng.integers(0, W, 40),
+                           55 + np.arange(5)])
+    # pair B: 16 edges all from ONE source (16 rows x 1 lane each —
+    # pure waste; min_fill must drop the whole pair)
+    srcB = np.full(16, W + 7)
+    dstB = np.arange(16)
+    src = np.concatenate([srcA, srcB])
+    dst = np.concatenate([dstA, dstB])
+    state = rng.random(4 * W)
+    want = full_oracle(src, dst, state, vpad)
+
+    base = build_pair_plan(src, dst, vpad, threshold=8)
+    packed = build_pair_plan(src, dst, vpad, threshold=8, min_fill=8)
+    # the fat row survives; the 5 occurrence-tail rows and all 16
+    # one-lane rows are gone
+    assert packed.stats["R"] < base.stats["R"]
+    assert packed.stats["R"] == 1
+    # occ level 0 carries one edge per live source = 40 (source 3's
+    # occ-0 edge is among them); its occ 1..5 tail is dropped
+    assert packed.stats["covered"] == 40
+    # partition still exact
+    got = pair_reduce_numpy(packed, state)
+    res = packed.residual
+    got += full_oracle(src[res], dst[res], state, vpad)
+    np.testing.assert_allclose(got, want)
+
+
+def test_min_fill_monotone_fill_cap():
+    """Random graphs: every surviving row must have >= min_fill live
+    lanes, and the engine result must stay oracle-exact."""
+    from lux_tpu.apps import pagerank
+    from lux_tpu.convert import uniform_random_edges
+    from lux_tpu.graph import Graph
+
+    src, dst = uniform_random_edges(512, 9000, seed=3)
+    g = Graph.from_edges(src, dst, 512)
+    for mf in (4, 16):
+        plan = build_pair_plan(*_edges_of(g), 512, threshold=4,
+                               min_fill=mf)
+        fills = (plan.rel_dst != -1).sum(axis=1)
+        live = fills[fills > 0]
+        assert (live >= mf).all() or plan.stats["R"] == 0
+        eng = pagerank.build_engine(g, num_parts=2, pair_threshold=4,
+                                    pair_min_fill=mf)
+        want = pagerank.reference_pagerank(g, 3)
+        got = eng.unpad(eng.run(eng.init_state(), 3))
+        np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-9)
+
+
+def _edges_of(g):
+    """(src_slot, dst_local) of a 1-part build of g (vpad 512)."""
+    from lux_tpu.graph import ShardedGraph
+    sg = ShardedGraph.build(g, 1)
+    nep = int(sg.ne_part[0])
+    return sg.src_slot[0, :nep], sg.dst_local[0, :nep]
