@@ -106,6 +106,17 @@ def common_graph_arrays(sg: ShardedGraph, dev):
                     np.int32)[:, None]))
 
 
+def _owner_edge_arrays(owner, dev):
+    """The owner layout's per-slot arrays: packed (uint32 src<<7|rel
+    + uint16 live-lane counts) or classic (int32 src + int8 rel) —
+    see ops/owner.OwnerLayout's packed encoding note."""
+    if owner.packed:
+        return dict(own_sr=dev(owner.src_rel),
+                    own_nv=dev(owner.n_valid))
+    return dict(own_src=dev(owner.src_local),
+                own_rel=dev(owner.rel_dst))
+
+
 def build_graph_arrays(sg: ShardedGraph, layout: str, needs_dst: bool,
                        tile_w: int, tile_e: int, device: bool = True):
     """Per-part graph arrays (all leading dim num_parts) for either
@@ -208,8 +219,7 @@ class PullEngine:
             self.tiles = None
             arrays = dict(
                 **common_graph_arrays(sg, dev),
-                own_src=dev(self.owner.src_local),
-                own_rel=dev(self.owner.rel_dst),
+                **_owner_edge_arrays(self.owner, dev),
                 own_cs=dev(self.owner.chunk_start),
                 own_lc=dev(self.owner.last_chunk))
             if self.owner.weight is not None:

@@ -153,14 +153,14 @@ class PushEngine:
             # source-part small-shard gathers + reduce_scatter replace
             # the label all_gather + big-table gather; the sparse path
             # below is unchanged (queue exchange is already O(queue))
-            from lux_tpu.engine.pull import common_graph_arrays
+            from lux_tpu.engine.pull import (_owner_edge_arrays,
+                                             common_graph_arrays)
             from lux_tpu.ops.owner import OwnerLayout
             self.owner = OwnerLayout.build(dense_sg, E=owner_tile_e or 256)
             self.tiles = None
             arrays = dict(
                 **common_graph_arrays(dense_sg, dev),
-                own_src=dev(self.owner.src_local),
-                own_rel=dev(self.owner.rel_dst),
+                **_owner_edge_arrays(self.owner, dev),
                 own_cs=dev(self.owner.chunk_start),
                 own_lc=dev(self.owner.last_chunk))
             if self.owner.weight is not None:

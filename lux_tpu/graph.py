@@ -604,6 +604,7 @@ class ShardedGraph:
 
     def memory_report(self, *, exchange: str = "gather",
                       owner_slots_per_part: int | None = None,
+                      owner_packed: bool | None = None,
                       push_sparse: bool = False) -> dict:
         """HBM bytes for the engine edge layouts per part — the
         analogue of the reference's startup memory advisor (reference
@@ -611,8 +612,10 @@ class ShardedGraph:
         dst_local instead of int8 rel, +3 B/edge.)
 
         exchange='owner' prices the owner-side layout instead of the
-        tiled one: per-slot int32 src_local + int8 rel_dst (+ f32
-        weight).  owner_slots_per_part defaults to epad — a LOWER
+        tiled one: one packed uint32 per slot (the default whenever
+        vpad <= 2^25, ops/owner.OwnerLayout) or int32 src + int8 rel
+        (+ f32 weight either way); owner_packed=None infers from the
+        vpad bound.  owner_slots_per_part defaults to epad — a LOWER
         bound; the real count includes per-(src-part, dst-tile) chunk
         padding and lives in OwnerLayout.stats after the build
         (measured 1.15-1.5x, PERF_NOTES).
@@ -628,7 +631,10 @@ class ShardedGraph:
         if exchange == "owner":
             slots = (self.epad if owner_slots_per_part is None
                      else int(owner_slots_per_part))
-            edge_bytes = slots * (4 + 1 + w)
+            if owner_packed is None:
+                from lux_tpu.ops.owner import OwnerLayout
+                owner_packed = self.vpad <= OwnerLayout.PACK_VPAD_MAX
+            edge_bytes = slots * ((4 if owner_packed else 5) + w)
         else:
             # src_slot int32 + rel_dst int8 (+ f32 weights)
             edge_bytes = self.epad * (4 + 1 + w)

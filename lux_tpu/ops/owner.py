@@ -61,15 +61,34 @@ class OwnerLayout:
     G: int                      # global dst tiles = num_parts * n_tiles
     n_chunks: int               # padded per-src-part chunk count C
     needs_scan: bool
-    src_local: np.ndarray       # int32 [R, C, E] into own shard; pad->0
-    rel_dst: np.ndarray         # int8 [R, C, E] in [0, W); -1 = pad
+    src_local: np.ndarray | None  # int32 [R, C, E] into own shard;
+    #                               pad->0.  None in packed mode
+    rel_dst: np.ndarray | None  # int8 [R, C, E] in [0, W); -1 = pad.
+    #                             None in packed mode
     weight: np.ndarray | None   # float32 [R, C, E]
     chunk_start: np.ndarray     # bool [R, C] True at each tile's 1st chunk
     last_chunk: np.ndarray      # int32 [R, G]; -1 for edge-less tiles
     stats: dict
+    # PACKED slot encoding (billion-edge fit, round 5): ONE uint32
+    # carries src_local << 7 | rel (W=128 => rel is exactly 7 bits;
+    # usable whenever vpad <= 2^25), and pad lanes are recovered from
+    # a per-chunk live-lane count instead of rel == -1 — chunks fill
+    # contiguously, so a count replaces the whole int8 rel array
+    # (2.66 GB at RMAT27; the difference between fitting one chip and
+    # OOMing it by 1.3 GB, PERF_NOTES round 5)
+    src_rel: np.ndarray | None = None   # uint32 [R, C, E]; pad->0
+    n_valid: np.ndarray | None = None   # uint16 [R, C] live lanes
+
+    @property
+    def packed(self) -> bool:
+        return self.src_rel is not None
+
+    # vpad bound for the 25-bit src_local field of the packed encoding
+    PACK_VPAD_MAX = 1 << 25
 
     @classmethod
-    def build(cls, sg, E: int = 256) -> "OwnerLayout":
+    def build(cls, sg, E: int = 256,
+              packed: bool | None = None) -> "OwnerLayout":
         """Re-lay a ShardedGraph's edges src-part-major (host, once).
 
         Chunks bind to one global dst tile each, so per-(src-part,
@@ -91,6 +110,13 @@ class OwnerLayout:
         from lux_tpu.ops.tiled import warn_sub128_tile
         warn_sub128_tile(E)
         P, vpad, W = sg.num_parts, sg.vpad, 128
+        if packed is None:
+            # auto: pack whenever the 25-bit src_local field fits
+            packed = vpad <= cls.PACK_VPAD_MAX
+        elif packed and vpad > cls.PACK_VPAD_MAX:
+            raise ValueError(
+                f"packed owner layout needs vpad <= {cls.PACK_VPAD_MAX}"
+                f" (25-bit src_local), got vpad={vpad}")
         n_tiles = max(1, _ceil_div(vpad, W))
         G = P * n_tiles
         local = sg.local_parts is not None
@@ -165,8 +191,14 @@ class OwnerLayout:
                                              "max"))
         C = _ceil_div(C, 8) * 8          # Pallas block granularity
 
-        src_local = np.zeros((R, C, E), dtype=np.int32)
-        rel_dst = np.full((R, C, E), -1, dtype=np.int8)
+        if packed:
+            src_local = rel_dst = None
+            src_rel = np.zeros((R, C, E), dtype=np.uint32)
+            n_valid = np.zeros((R, C), dtype=np.uint16)
+        else:
+            src_rel = n_valid = None
+            src_local = np.zeros((R, C, E), dtype=np.int32)
+            rel_dst = np.full((R, C, E), -1, dtype=np.int8)
         weight = (np.zeros((R, C, E), dtype=np.float32)
                   if sg.weighted else None)
         chunk_start = np.ones((R, C), dtype=bool)   # pad chunks isolated
@@ -190,8 +222,15 @@ class OwnerLayout:
             idx = start[:, None] + lanes[None, :]          # [nc, E]
             valid = idx < tile_hi[ci][:, None]
             idx = np.where(valid, idx, lo)
-            src_local[s, :nc] = np.where(valid, srcl[idx], 0)
-            rel_dst[s, :nc] = np.where(valid, rel[idx], -1)
+            if packed:
+                sr = (srcl[idx].astype(np.uint32) << np.uint32(7)
+                      | rel[idx].astype(np.uint32))
+                src_rel[s, :nc] = np.where(valid, sr, 0)
+                n_valid[s, :nc] = np.minimum(
+                    tile_hi[ci] - start, E).astype(np.uint16)
+            else:
+                src_local[s, :nc] = np.where(valid, srcl[idx], 0)
+                rel_dst[s, :nc] = np.where(valid, rel[idx], -1)
             if weight is not None:
                 weight[s, :nc] = np.where(valid, wgt[idx], 0)
             chunk_start[s, :nc] = cj == 0
@@ -205,12 +244,13 @@ class OwnerLayout:
                      inflation=round(P * C * E / max(1, sg.ne), 3),
                      chunk_inflation=round(
                          (P // max(1, R)) * used * E / max(1, sg.ne),
-                         3))
+                         3),
+                     packed=packed)
         return cls(W=W, E=E, n_tiles=n_tiles, G=G, n_chunks=C,
                    needs_scan=needs_scan, src_local=src_local,
                    rel_dst=rel_dst, weight=weight,
                    chunk_start=chunk_start, last_chunk=last_chunk,
-                   stats=stats)
+                   stats=stats, src_rel=src_rel, n_valid=n_valid)
 
     def streams(self) -> bool:
         """Stream gather+partials in lax.map blocks once one src
@@ -330,7 +370,7 @@ def _local_src_edges(sg, n_tiles: int, G: int):
 # dim local src rows); own_w only on weighted graphs, own_ep/own_et
 # only when the layout streams (the fused-combine extraction plan)
 OWNER_SCAN_KEYS = ("own_src", "own_rel", "own_cs", "own_lc", "own_w",
-                   "own_ep", "own_et")
+                   "own_ep", "own_et", "own_sr", "own_nv")
 
 
 def owner_contribs(lay: OwnerLayout, state_rows, g: dict,
@@ -361,10 +401,12 @@ def owner_contribs(lay: OwnerLayout, state_rows, g: dict,
     def step(acc, x):
         st_s, d = x
         tiles = owner_part_tiles(
-            lay, st_s, d["own_src"], d["own_rel"], d.get("own_w"),
+            lay, st_s, d.get("own_sr", d.get("own_src")),
+            d.get("own_rel"), d.get("own_w"),
             d["own_cs"], d["own_lc"], kind, msg_fn, reduce_method,
             use_mxu=use_mxu, extr_pos=d.get("own_ep"),
-            extr_tile=d.get("own_et"), varying_axis=varying_axis)
+            extr_tile=d.get("own_et"), varying_axis=varying_axis,
+            nvalid=d.get("own_nv"))
         contrib = tiles.reshape((num_parts, ntw) + tiles.shape[2:])
         return comb(acc, contrib), None
 
@@ -403,7 +445,7 @@ def owner_exchange(acc, kind: str, axis=None, ndev: int = 1):
 def owner_part_tiles(lay: OwnerLayout, state_s, src, rel, weight, cs,
                      lc, kind: str, msg_fn, reduce_method: str,
                      use_mxu: bool = False, extr_pos=None,
-                     extr_tile=None, varying_axis=None):
+                     extr_tile=None, varying_axis=None, nvalid=None):
     """One source part's contribution: gather from its OWN shard
     ``state_s [vpad, ...]``, message, chunk-reduce, and combine into
     per-global-tile results ``[G, W, ...]`` (identity where the part
@@ -424,12 +466,15 @@ def owner_part_tiles(lay: OwnerLayout, state_s, src, rel, weight, cs,
             state_s, src, rel, weight, lay, kind, msg_fn,
             reduce_method, cs, extr_pos, extr_tile, lc,
             use_mxu=use_mxu,
-            varying_axis=varying_axis)                 # [G, W, ...]
+            varying_axis=varying_axis, nvalid=nvalid)  # [G, W, ...]
     if lay.streams():
         partials = streamed_chunk_partials(
             state_s, src, rel, weight, lay, kind, msg_fn, reduce_method,
-            use_mxu=use_mxu)
+            use_mxu=use_mxu, nvalid=nvalid)
     else:
+        if nvalid is not None:
+            from lux_tpu.ops.tiled import unpack_src_rel
+            src, rel = unpack_src_rel(src, nvalid)
         vals = jnp.take(state_s, src, axis=0)
         msgs = msg_fn(vals, weight)
         if reduce_method.startswith("pallas") and msgs.ndim == 2:

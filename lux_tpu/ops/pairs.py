@@ -88,6 +88,27 @@ def occurrence_index(pair: np.ndarray, slot: np.ndarray) -> np.ndarray:
     return occ
 
 
+def fill_histogram(pidx: np.ndarray, occ: np.ndarray):
+    """Per-(pair, occurrence-level) fill counts, sorted by (pair,
+    occ): returns (gp, go, fill) — pair id, occ level and the number
+    of edges at that level (= the live-lane count of that pair row).
+    The pack is safe: dense pidx < n_cov < 2^31, occ < max_occ.
+    Shared by the min_fill cap (analyze_pairs) and the economics
+    model (scripts/pair_fill_hist.py), so the modeled drop is exactly
+    the planner's."""
+    from lux_tpu import native
+
+    key = (np.asarray(pidx, np.int64) << np.int64(32)) | occ
+    native.sort_kv(key, ())
+    newg = np.ones(len(key), bool)
+    newg[1:] = key[1:] != key[:-1]
+    gidx = np.nonzero(newg)[0]
+    fill = np.diff(np.concatenate((gidx, [len(key)])))
+    gp = (key[gidx] >> np.int64(32)).astype(np.int64)
+    go = (key[gidx] & np.int64(0xFFFFFFFF)).astype(np.int64)
+    return gp, go, fill
+
+
 def quantize_depths(depth_sorted: np.ndarray,
                     levels_growth: float = 1.35) -> np.ndarray:
     """Round a descending per-slot row-count profile up to the fixed
@@ -204,18 +225,8 @@ def analyze_pairs(src_slot: np.ndarray, dst_local: np.ndarray,
     if min_fill is not None and min_fill > 1 and len(cov):
         # fill of row (pair, o) = #edges at occurrence o in the pair;
         # monotone decreasing in o, so the per-pair cap is the count
-        # of leading occurrence levels with fill >= min_fill.  One
-        # fused sort of (pidx << 32 | occ) groups the histogram; the
-        # pack is safe (dense pidx < n_cov < 2^31, occ < max_occ).
-        key = (pidx.astype(np.int64) << np.int64(32)) | occ
-        from lux_tpu import native as _nat
-        _nat.sort_kv(key, ())
-        newg = np.ones(len(key), bool)
-        newg[1:] = key[1:] != key[:-1]
-        gidx = np.nonzero(newg)[0]
-        fill = np.diff(np.concatenate((gidx, [len(key)])))
-        gp = (key[gidx] >> np.int64(32)).astype(np.int64)
-        go = (key[gidx] & np.int64(0xFFFFFFFF)).astype(np.int64)
+        # of leading occurrence levels with fill >= min_fill
+        gp, go, fill = fill_histogram(pidx, occ)
         # leading run of occ levels with fill >= min_fill per pair:
         # occ levels are contiguous from 0 (groups sorted by occ), so
         # the cap is the first level that is absent or underfilled

@@ -302,13 +302,33 @@ STREAM_BLOCK_CHUNKS = 1024
 STREAM_MSG_BYTES = 1 << 30
 
 
+def unpack_src_rel(packed, n_valid):
+    """Decode the PACKED owner slot encoding (ops/owner.OwnerLayout:
+    uint32 src_local << 7 | rel, live-lane counts per chunk) back to
+    (src int32, rel int8 with -1 pads) — done INSIDE each streamed
+    block so the decoded arrays only ever exist one block at a time
+    (the entire point: the packed form saves the int8 rel array's
+    2.66 GB at RMAT27, PERF_NOTES round 5)."""
+    src = jax.lax.shift_right_logical(
+        packed, jnp.uint32(7)).astype(jnp.int32)
+    rel = (packed & jnp.uint32(0x7F)).astype(jnp.int8)
+    lane = jax.lax.broadcasted_iota(jnp.int32, packed.shape,
+                                    packed.ndim - 1)
+    live = lane < n_valid[..., None].astype(jnp.int32)
+    return jnp.where(live, src, 0), jnp.where(live, rel, jnp.int8(-1))
+
+
 def _block_partials(flat_state, src_b, rel_b, w_b, msg_fn, kind: str,
                     E: int, W: int, reduce_method: str,
-                    use_mxu: bool):
+                    use_mxu: bool, nv_b=None):
     """One chunk block's gather + message + per-chunk partials
     [B, E, ...] -> [B, W, ...] (shared by the streamed partial and
     FUSED streamed combine paths — keep the Pallas VMEM sizing and
-    the barrier rationale in ONE place)."""
+    the barrier rationale in ONE place).  nv_b set => src_b is the
+    packed owner encoding (see unpack_src_rel) and rel_b must be
+    None."""
+    if nv_b is not None:
+        src_b, rel_b = unpack_src_rel(src_b, nv_b)
     vals = jnp.take(flat_state, src_b, axis=0)
     msgs = msg_fn(vals, w_b)
     if reduce_method.startswith("pallas") and msgs.ndim == 2:
@@ -331,7 +351,8 @@ def _block_partials(flat_state, src_b, rel_b, w_b, msg_fn, kind: str,
 def streamed_chunk_partials(flat_state, src_slot, rel_dst, weight,
                             layout: TiledLayout, kind: str, msg_fn,
                             reduce_method: str, use_mxu: bool = False,
-                            block_chunks: int = STREAM_BLOCK_CHUNKS):
+                            block_chunks: int = STREAM_BLOCK_CHUNKS,
+                            nvalid=None):
     """Gather + message + per-chunk partials for ONE part, streamed in
     lax.map blocks over the chunk axis -> [C, W, ...] partials.
 
@@ -344,26 +365,35 @@ def streamed_chunk_partials(flat_state, src_slot, rel_dst, weight,
     B = max(8, min(block_chunks, C))
     nB, rem = divmod(C, B)
 
-    def partial_block(src_b, rel_b, w_b):
+    def partial_block(src_b, rel_b, w_b, nv_b=None):
         return _block_partials(flat_state, src_b, rel_b, w_b, msg_fn,
-                               kind, E, W, reduce_method, use_mxu)
+                               kind, E, W, reduce_method, use_mxu,
+                               nv_b=nv_b)
 
+    packed = nvalid is not None
+    second = nvalid if packed else rel_dst   # rides the block split
     parts = []
     if nB:
         def seg(x):
             return x[:nB * B].reshape((nB, B) + x.shape[1:])
 
-        xs = (seg(src_slot), seg(rel_dst)) + \
+        xs = (seg(src_slot), seg(second)) + \
             (() if weight is None else (seg(weight),))
-        blocks = jax.lax.map(
-            lambda x: partial_block(x[0], x[1],
-                                    x[2] if len(x) > 2 else None),
-            xs)                           # [nB, B, W, ...]
+
+        def one(x):
+            w_b = x[2] if len(x) > 2 else None
+            if packed:
+                return partial_block(x[0], None, w_b, nv_b=x[1])
+            return partial_block(x[0], x[1], w_b)
+
+        blocks = jax.lax.map(one, xs)     # [nB, B, W, ...]
         parts.append(blocks.reshape((nB * B,) + blocks.shape[2:]))
     if rem:
+        tail2 = second[nB * B:]
         parts.append(partial_block(
-            src_slot[nB * B:], rel_dst[nB * B:],
-            None if weight is None else weight[nB * B:]))
+            src_slot[nB * B:], None if packed else tail2,
+            None if weight is None else weight[nB * B:],
+            nv_b=tail2 if packed else None))
     return jnp.concatenate(parts, axis=0)
 
 
@@ -441,7 +471,7 @@ def streamed_chunk_combined(flat_state, src_slot, rel_dst, weight,
                             extr_pos, extr_tile, last_chunk,
                             use_mxu: bool = False,
                             block_chunks: int | None = None,
-                            varying_axis=None):
+                            varying_axis=None, nvalid=None):
     """Fused streamed gather + message + per-chunk partials +
     BLOCKED segmented combine + last-chunk extraction for ONE part:
     returns per-tile results [n_tiles, W, ...] WITHOUT ever
@@ -473,27 +503,34 @@ def streamed_chunk_combined(flat_state, src_slot, rel_dst, weight,
             [x, jnp.full((Cp - C,) + x.shape[1:], fill, x.dtype)],
             axis=0)
 
+    packed = nvalid is not None
     src_slot = pad_c(src_slot, 0)
-    rel_dst = pad_c(rel_dst, -1)
+    second = pad_c(nvalid, 0) if packed else pad_c(rel_dst, -1)
     if weight is not None:
         weight = pad_c(weight, 0)
     chunk_start = pad_c(chunk_start, True)
 
-    def partial_block(src_b, rel_b, w_b):
+    def partial_block(src_b, rel_b, w_b, nv_b=None):
         return _block_partials(flat_state, src_b, rel_b, w_b, msg_fn,
-                               kind, E, W, reduce_method, use_mxu)
+                               kind, E, W, reduce_method, use_mxu,
+                               nv_b=nv_b)
 
     msg_aval = jax.eval_shape(
-        lambda: msg_fn(jnp.take(flat_state, src_slot[:1], axis=0),
+        lambda: msg_fn(jnp.take(flat_state,
+                                src_slot[:1].astype(jnp.int32),
+                                axis=0),
                        None if weight is None else weight[:1]))
     ident = identity_for(kind, msg_aval.dtype)
     trail = msg_aval.shape[2:]
 
     def step(carry, x):
         run, acc = carry
-        src_b, rel_b, f_b, ep, et = x[:5]
+        src_b, sec_b, f_b, ep, et = x[:5]
         w_b = x[5] if len(x) > 5 else None
-        partials = partial_block(src_b, rel_b, w_b)   # [B, W, ...]
+        if packed:
+            partials = partial_block(src_b, None, w_b, nv_b=sec_b)
+        else:
+            partials = partial_block(src_b, sec_b, w_b)   # [B, W, ...]
         fb = f_b.reshape(f_b.shape + (1,) * (partials.ndim - 1))
         inner = _segscan(partials, fb, kind)
         absorb = jnp.cumsum(f_b.astype(jnp.int32)) == 0
@@ -508,7 +545,7 @@ def streamed_chunk_combined(flat_state, src_slot, rel_dst, weight,
     def seg(x):
         return x.reshape((nB, B) + x.shape[1:])
 
-    xs = (seg(src_slot), seg(rel_dst), seg(chunk_start), extr_pos,
+    xs = (seg(src_slot), seg(second), seg(chunk_start), extr_pos,
           extr_tile)
     if weight is not None:
         xs = xs + (seg(weight),)

@@ -120,8 +120,8 @@ def main():
         # whenever pair_relabel's PARTITIONING changes, or a stale
         # cache silently benchmarks the old cuts; the .starts.npy is
         # written LAST and gates the load, so a crash mid-write never
-        # serves a partial cache.  ("" = the round-4 algorithm.)
-        RELAB_VER = ""
+        # serves a partial cache.
+        RELAB_VER = "v5p"   # v5p: cache gained .perm.npy (round 5)
         sym_tag = "_sym" if app == "cc" else ""
         rcache = (f"/tmp/rmat{scale}_ef16_s0{sym_tag}_relab_np{np_parts}"
                   f"_p{pair}{RELAB_VER}")
@@ -157,6 +157,7 @@ def main():
         start_vertex = 0
 
     kw = dict(num_parts=np_parts, pair_threshold=pair or None,
+              pair_min_fill=cfg["min_fill"] or None,
               starts=starts, exchange=exchange)
     if cfg["owner_e"]:
         kw["owner_tile_e"] = cfg["owner_e"]
@@ -164,8 +165,7 @@ def main():
         from lux_tpu.apps import pagerank
         if cfg["tile_e"]:
             kw["tile_e"] = cfg["tile_e"]
-        eng = pagerank.build_engine(
-            g, pair_min_fill=cfg["min_fill"] or None, **kw)
+        eng = pagerank.build_engine(g, **kw)
     elif app == "cc":
         from lux_tpu.apps import components
         eng = components.build_engine(g, enable_sparse=bool(cfg["sparse"]),
@@ -184,6 +184,8 @@ def main():
         owner_slots_per_part=(
             eng.owner.stats["slots"] // len(eng.sg.part_ids())
             if eng.owner is not None else None),
+        owner_packed=(eng.owner.packed if eng.owner is not None
+                      else None),
         push_sparse=app != "pagerank" and bool(cfg["sparse"]))
     t = log("build_engine", t,
             vpad=eng.sg.vpad, epad=eng.sg.epad,

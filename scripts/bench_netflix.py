@@ -103,8 +103,8 @@ def main():
     state, elapsed = timed_fused_run(eng, cfg["ni"],
                                      repeats=cfg["repeats"])
     assert np.isfinite(eng.unpad(state)).all()
-    best = min(elapsed)
-    gteps = g.ne * cfg["ni"] / best / 1e9
+    from statistics import median
+    gteps = g.ne * cfg["ni"] / median(elapsed) / 1e9
     log("run", t, iters=cfg["ni"],
         elapsed=[round(e, 2) for e in elapsed], gteps=round(gteps, 4))
     print(json.dumps({

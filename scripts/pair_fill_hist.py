@@ -30,7 +30,7 @@ def main():
 
     from lux_tpu.convert import rmat_graph
     from lux_tpu.graph import ShardedGraph, pair_relabel
-    from lux_tpu.ops.pairs import W, analyze_pairs
+    from lux_tpu.ops.pairs import W, analyze_pairs, fill_histogram
     from lux_tpu.scalemodel import PAIR_ROW_NS
 
     t0 = time.time()
@@ -49,12 +49,7 @@ def main():
         nep = int(sg.ne_part[r])
         a = analyze_pairs(sg.src_slot[r, :nep], sg.dst_local[r, :nep],
                           sg.vpad, threshold=cfg["pair"])
-        key = (a.pidx.astype(np.int64) << np.int64(32)) | a.occ
-        key.sort()
-        newg = np.ones(len(key), bool)
-        newg[1:] = key[1:] != key[:-1]
-        gidx = np.nonzero(newg)[0]
-        fill = np.diff(np.concatenate((gidx, [len(key)])))
+        _gp, _go, fill = fill_histogram(a.pidx, a.occ)
         fill_counts += np.bincount(np.minimum(fill, W),
                                    minlength=W + 1)
 

@@ -115,7 +115,9 @@ def main():
     #    replaces the state all_gather — across 2 real processes.
     eng7 = PullEngine(sg, pagerank.make_program(), mesh=mesh,
                       exchange="owner")
-    assert eng7.owner.src_local.shape[0] == len(list(local))
+    own_arr = (eng7.owner.src_rel if eng7.owner.packed
+               else eng7.owner.src_local)
+    assert own_arr.shape[0] == len(list(local))
     s7 = eng7.run(eng7.init_state(), 5)
     np.testing.assert_allclose(eng7.unpad(s7), want_pr, rtol=2e-5)
 

@@ -271,7 +271,7 @@ def test_owner_layout_covers_every_edge(graph):
     from lux_tpu.ops.owner import OwnerLayout
 
     sg = ShardedGraph.build(graph, 4)
-    lay = OwnerLayout.build(sg, E=64)
+    lay = OwnerLayout.build(sg, E=64, packed=False)
     got = []
     for s in range(sg.num_parts):
         for c in range(lay.n_chunks):
@@ -343,8 +343,9 @@ def test_owner_local_parts_build_matches_full(graph):
     lay_l = OwnerLayout.build(loc, E=64)
     assert (lay_f.n_chunks, lay_f.needs_scan, lay_f.G) == \
         (lay_l.n_chunks, lay_l.needs_scan, lay_l.G)
-    np.testing.assert_array_equal(lay_f.src_local, lay_l.src_local)
-    np.testing.assert_array_equal(lay_f.rel_dst, lay_l.rel_dst)
+    assert lay_f.packed and lay_l.packed      # small vpad: auto-packed
+    np.testing.assert_array_equal(lay_f.src_rel, lay_l.src_rel)
+    np.testing.assert_array_equal(lay_f.n_valid, lay_l.n_valid)
     np.testing.assert_array_equal(lay_f.chunk_start, lay_l.chunk_start)
     np.testing.assert_array_equal(lay_f.last_chunk, lay_l.last_chunk)
 
@@ -464,3 +465,52 @@ def test_owner_fused_mesh(graph, ref5, monkeypatch):
     assert "own_ep" in eng.arrays
     out = eng.unpad(eng.run(eng.init_state(), 5))
     np.testing.assert_allclose(out, ref5, rtol=1e-5, atol=1e-8)
+
+
+def test_packed_layout_decodes_to_classic(graph):
+    """The packed uint32 encoding + live-lane counts must decode to
+    exactly the classic (src_local, rel_dst) arrays."""
+    import jax.numpy as jnp
+
+    from lux_tpu.ops.owner import OwnerLayout
+    from lux_tpu.ops.tiled import unpack_src_rel
+
+    sg = ShardedGraph.build(graph, 4)
+    classic = OwnerLayout.build(sg, E=64, packed=False)
+    packed = OwnerLayout.build(sg, E=64, packed=True)
+    assert packed.src_local is None and packed.rel_dst is None
+    for r in range(4):
+        src, rel = unpack_src_rel(jnp.asarray(packed.src_rel[r]),
+                                  jnp.asarray(packed.n_valid[r]))
+        np.testing.assert_array_equal(np.asarray(src),
+                                      classic.src_local[r])
+        np.testing.assert_array_equal(np.asarray(rel),
+                                      classic.rel_dst[r])
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_packed_owner_engine_matches_unpacked(graph, ref5, use_mesh):
+    """Pull engine results must be identical under the packed and
+    classic owner encodings, single device and on the mesh."""
+    mesh = make_mesh(8) if use_mesh else None
+    P = 8 if use_mesh else 4
+    from lux_tpu.ops import owner as owner_mod
+
+    sg = ShardedGraph.build(graph, P)
+    eng_p = PullEngine(sg, pagerank.make_program(), mesh=mesh,
+                       exchange="owner")
+    assert eng_p.owner.packed
+    got = eng_p.unpad(eng_p.run(eng_p.init_state(), 5))
+    np.testing.assert_allclose(got, ref5, rtol=2e-5, atol=1e-9)
+
+    import unittest.mock as mock
+    real_build = owner_mod.OwnerLayout.build.__func__
+    with mock.patch.object(
+            owner_mod.OwnerLayout, "build",
+            classmethod(lambda cls, sg_, E=256, packed=None:
+                        real_build(cls, sg_, E=E, packed=False))):
+        eng_c = PullEngine(sg, pagerank.make_program(), mesh=mesh,
+                           exchange="owner")
+    assert not eng_c.owner.packed
+    want = eng_c.unpad(eng_c.run(eng_c.init_state(), 5))
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
