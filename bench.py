@@ -244,13 +244,13 @@ def main() -> int:
     ap.add_argument("-np", type=int, default=1, help="partitions")
     ap.add_argument("-pair", type=int, default=PAIR_THRESHOLD,
                     help="pair-lane threshold (0 disables)")
-    ap.add_argument("-min-fill", type=int, default=16,
+    ap.add_argument("-min-fill", type=int, default=24,
                     dest="min_fill", metavar="F",
                     help="pair rows under F live lanes ride the "
                          "residual instead (ops/pairs.py min_fill; "
-                         "measured +32%% on the scalar configs at the "
-                         "150/9 ns row/edge break-even, PERF_NOTES "
-                         "round 5; 0 disables)")
+                         "measured +33%% on the headline — the "
+                         "RMAT21 sweep put the optimum at 24, "
+                         "PERF_NOTES round 5; 0 disables)")
     ap.add_argument("-repeats", type=int, default=3,
                     help="timed repeats per config; the JSON line "
                          "reports the median (tunnel variance exceeds "
