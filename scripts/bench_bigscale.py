@@ -45,7 +45,7 @@ def log(stage, t0, **kw):
 
 DEFAULTS = dict(scale=25, np=4, pair=0, ni=3, tile_e=0,
                 exchange="gather", owner_e=0, app="pagerank",
-                sparse=1, repeats=1, min_fill=0)
+                sparse=1, repeats=1, min_fill=0, seg=0)
 
 
 def parse_args(argv):
@@ -204,6 +204,36 @@ def main():
         out = eng.unpad(state)
         assert np.isfinite(out).all(), "non-finite result"
         iters = ni
+    elif cfg["seg"]:
+        # SEGMENTED converge: cap each while_loop execution at seg
+        # iterations with host round-trips between segments — bounds
+        # single-execution duration under the TPU-worker crash
+        # envelope (PERF_NOTES round 5: a ~2x-longer all-dense CC
+        # converge died where the same-shape sssp converge ran).
+        # Timing includes the segment round-trips (honest; recorded).
+        from lux_tpu.timing import fence, fetch
+        label, active = eng.init_state()
+        _l, _a, _it = eng.converge(label, active, 1)   # compile
+        fence(_l)
+        label, active = eng.init_state()
+        fence((label, active))
+        t0 = time.perf_counter()
+        iters = 0
+        while True:
+            label, active, it = eng.converge(label, active,
+                                             cfg["seg"])
+            it = int(fetch(it))
+            iters += it
+            if it < cfg["seg"]:
+                break
+        elapsed = [time.perf_counter() - t0]
+        out = eng.unpad(label)
+        if app == "cc":
+            assert out.min() >= 0, "CC label underflow"
+        else:
+            from lux_tpu.apps import sssp as _s
+            reached = int((~_s.unreachable(out)).sum())
+            assert reached > g.nv // 100, "vacuous sssp run"
     else:
         from lux_tpu.timing import timed_converge
         # timed_converge returns labels already unpadded to [nv]
@@ -227,6 +257,7 @@ def main():
         "scale": scale, "ne": g.ne, "pair_threshold": pair or None,
         "exchange": exchange, "sparse": bool(cfg["sparse"]),
         "start": (start_vertex if app in ("sssp", "sssp-w") else None),
+        "seg": cfg["seg"] or None,
         "iters": int(iters)}))
 
 
