@@ -94,10 +94,16 @@ def test_memory_report():
     assert push["push_sparse_bytes_per_part"] >= sg.epad * 4
     assert push["total_bytes"] > rep["total_bytes"]
 
-    # owner pricing uses the real (padded) slot count when given
+    # owner pricing uses the real (padded) slot count when given;
+    # packed (one uint32/slot) is inferred for small vpad, classic
+    # (int32 + int8) on request
     own = sg.memory_report(exchange="owner",
                            owner_slots_per_part=2 * sg.epad)
-    assert own["edge_bytes_per_part"] == 2 * sg.epad * 5
+    assert own["edge_bytes_per_part"] == 2 * sg.epad * 4
+    classic = sg.memory_report(exchange="owner",
+                               owner_slots_per_part=2 * sg.epad,
+                               owner_packed=False)
+    assert classic["edge_bytes_per_part"] == 2 * sg.epad * 5
 
 
 def test_src_sorted_compressed_index_oracle():
