@@ -414,13 +414,9 @@ class PullEngine:
         C = lay.n_chunks
         Kdim = old_p.shape[-1]
 
-        src_vals = jnp.take(flat_state, g["src_slot"], axis=0)
-        src_vals = jax.lax.optimization_barrier(src_vals)  # [C, E, K]
         n_tiles = lay.n_tiles
         old_pad = jnp.pad(old_p, ((0, n_tiles * W - sg.vpad), (0, 0)))
         tiles = old_pad.reshape(n_tiles, W, Kdim)
-        tile_vals = jnp.take(tiles, jnp.minimum(g["chunk_tile"],
-                                                n_tiles - 1), axis=0)
         rel = g["rel_dst"]
         wgt = g.get("weight")
 
@@ -434,7 +430,16 @@ class PullEngine:
         lanes = jnp.arange(W, dtype=rel.dtype)
 
         def block(args):
-            s, t, r, w = args
+            # BOTH gathers happen per block: materializing the [C, E,
+            # K] source values / [C, W, K] tile rows whole-graph is
+            # ~15 GB at the NetFlix shape (measured OOM, round 5) —
+            # the block bound must cover the gather outputs, not just
+            # the [B, E, W] dot intermediate
+            slot_b, ct_b, r, w = args
+            s = jnp.take(flat_state, slot_b, axis=0)       # [B, E, K]
+            s = jax.lax.optimization_barrier(s)
+            t = jnp.take(tiles, jnp.minimum(ct_b, n_tiles - 1),
+                         axis=0)                           # [B, W, K]
             D = jnp.einsum("bek,bwk->bew", s, t,
                            preferred_element_type=s.dtype)
             mask = r[..., None] == lanes                   # [B, E, W]
@@ -443,8 +448,8 @@ class PullEngine:
             return jnp.einsum("bew,bek->bwk", mask.astype(s.dtype),
                               msgs)                        # [B, W, K]
 
-        args = (pad_c(src_vals).reshape(nB, B, E, Kdim),
-                pad_c(tile_vals).reshape(nB, B, W, Kdim),
+        args = (pad_c(g["src_slot"]).reshape(nB, B, E),
+                pad_c(g["chunk_tile"]).reshape(nB, B),
                 pad_c(rel).reshape(nB, B, E),
                 pad_c(wgt).reshape(nB, B, E))
         partials = jax.lax.map(block, args).reshape(Cp, W, Kdim)[:C]
