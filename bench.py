@@ -265,10 +265,21 @@ def main() -> int:
     configs = ([args.config] if args.config and not args.all
                else ["cc", "sssp", "sssp-delta", "colfilter",
                      "sssp-mp", "pagerank-mp", "pagerank"])
+    failures = 0
     for config in configs:
-        name, samples, extra = run_config(config, args)
+        try:
+            name, samples, extra = run_config(config, args)
+        except Exception as e:   # noqa: BLE001 — one config's crash
+            # (e.g. a TPU-worker restart, PERF_NOTES round-5 duration
+            # wall) must not take down the remaining configs or the
+            # tail-line headline metric the driver records
+            failures += 1
+            print(json.dumps({"metric": f"{config}_FAILED",
+                              "error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+            continue
         emit(name, samples, extra)
-    return 0
+    return 1 if failures == len(configs) else 0
 
 
 if __name__ == "__main__":
