@@ -19,6 +19,18 @@ gains, so every config runs the TIMED REGION ``-repeats`` times
 (default 3; build/compile excluded) and reports the MEDIAN, with the
 per-repeat samples recorded in the JSON line.
 
+Resilience (round 6, lux_tpu/resilience.py): each config runs under
+the supervisor — transient failures (worker death, tunnel drops)
+retry with backoff up to ``-retries`` times, deterministic ones (OOM,
+HTTP 413) fail the config immediately; and samples more than
+``-outlier``x off their batch median (BENCH_r05's pagerank-mp
+collapse: [0.1116, 0.0107, 0.1118]) are DISCARDED and re-run once
+rather than silently medianed.  Every metric line records the audit
+trail: "attempts" (total timed runs incl. outlier reruns),
+"discarded" (the thrown-away samples), and "run_attempts" when the
+whole config was retried.  scripts/check_bench.py validates the
+schema.
+
 Configs (-config runs one):
   pagerank        PageRank, pull model, fixed iterations   (BASELINE #1/#4)
   pagerank-mp     PageRank, np=4 multi-part OWNER exchange + pair
@@ -85,7 +97,9 @@ def _print_coverage(args, eng):
 def bench_fused(eng, ne, ni, verbose, repeats):
     """GTEPS samples over ``repeats`` timed fused runs (ONE warmup/
     compile up front inside timed_fused_run; each repeat re-times only
-    the fused loop)."""
+    the fused loop).  Returns (samples, rerun) where ``rerun()`` times
+    one more run (jit cache is warm) — the outlier discard-and-rerun
+    rule's second chance."""
     import numpy as np
 
     from lux_tpu.timing import timed_fused_run
@@ -98,11 +112,17 @@ def bench_fused(eng, ne, ni, verbose, repeats):
               f" total): {times}", file=sys.stderr)
     # the benched result must be sane, or the GTEPS line is meaningless
     assert np.isfinite(eng.unpad(state)).all(), "non-finite bench result"
-    return [ne * ni / e for e in elapsed]
+
+    def rerun():
+        _state, [e] = timed_fused_run(eng, ni, repeats=1)
+        return ne * ni / e
+
+    return [ne * ni / e for e in elapsed], rerun
 
 
 def bench_converge(eng, ne, verbose, repeats):
-    """GTEPS samples over ``repeats`` timed whole-run converges."""
+    """GTEPS samples over ``repeats`` timed whole-run converges;
+    returns (samples, rerun) like bench_fused."""
     from lux_tpu.timing import timed_converge
 
     labels, iters, elapsed = timed_converge(eng, repeats=repeats)
@@ -110,11 +130,17 @@ def bench_converge(eng, ne, verbose, repeats):
         times = " ".join(f"{e:.2f}s" for e in elapsed)
         print(f"# converged in {iters} iterations; {repeats} timed "
               f"runs: {times}", file=sys.stderr)
-    return [ne * iters / e for e in elapsed]
+
+    def rerun():
+        _l, it, [e] = timed_converge(eng, repeats=1)
+        return ne * it / e
+
+    return [ne * iters / e for e in elapsed], rerun
 
 
 def run_config(config, args):
-    """Returns (name, gteps samples list, extra json fields)."""
+    """Returns (name, gteps samples list, extra json fields,
+    rerun() -> one more gteps sample)."""
     pair_t = args.pair if args.pair > 0 else None
     import numpy as np
 
@@ -144,8 +170,8 @@ def run_config(config, args):
         extra.update(relabel=True, pair_threshold=pair_t, np=np_parts,
                      exchange=eng.exchange, min_fill=args.min_fill)
         _print_coverage(args, eng)
-        samples = bench_fused(eng, g.ne, args.ni, args.verbose,
-                              args.repeats)
+        samples, rerun = bench_fused(eng, g.ne, args.ni, args.verbose,
+                                     args.repeats)
         name = f"pagerank{'_mp' if mp else ''}_rmat{scale}"
     elif config == "colfilter":
         from lux_tpu.apps import colfilter
@@ -161,8 +187,8 @@ def run_config(config, args):
             eng = colfilter.build_engine(g, num_parts=args.np)
             extra.update(relabel=False, pair_threshold=None)
         _print_coverage(args, eng)
-        samples = bench_fused(eng, g.ne, args.ni, args.verbose,
-                              args.repeats)
+        samples, rerun = bench_fused(eng, g.ne, args.ni, args.verbose,
+                                     args.repeats)
         name = f"colfilter_rmat{scale}"
     else:
         from lux_tpu.apps import components, sssp
@@ -207,12 +233,18 @@ def run_config(config, args):
                          exchange=eng.exchange,
                          delta="auto" if weighted else None)
         _print_coverage(args, eng)
-        samples = bench_converge(eng, g.ne, args.verbose, args.repeats)
+        samples, rerun = bench_converge(eng, g.ne, args.verbose,
+                                        args.repeats)
         name = f"{config.replace('-', '_')}_rmat{scale}"
-    return name, [s / 1e9 for s in samples], extra
+    return (name, [s / 1e9 for s in samples], extra,
+            lambda: rerun() / 1e9)
 
 
-def emit(name, samples, extra):
+def emit(name, samples, extra, attempts=None, discarded=()):
+    """One JSON metric line.  attempts = total timed runs (originals
+    + outlier reruns); discarded = samples thrown out by the >3x rule
+    — recorded, never silently medianed (scripts/check_bench.py
+    validates the schema)."""
     gteps = median(samples)
     result = {
         "metric": f"{name}_gteps_per_chip",
@@ -220,6 +252,8 @@ def emit(name, samples, extra):
         "unit": "GTEPS",
         "vs_baseline": round(gteps / 1.0, 4),
         "samples": [round(s, 4) for s in samples],
+        "attempts": len(samples) if attempts is None else attempts,
+        "discarded": [round(d, 4) for d in discarded],
         **extra,
     }
     print(json.dumps(result), flush=True)
@@ -255,6 +289,19 @@ def main() -> int:
                     help="timed repeats per config; the JSON line "
                          "reports the median (tunnel variance exceeds "
                          "round-over-round gains, PERF_NOTES)")
+    ap.add_argument("-retries", type=int, default=2,
+                    help="per-config retries for RETRYABLE failures "
+                         "(transient worker/tunnel death, classified "
+                         "by lux_tpu.resilience); deterministic "
+                         "failures (OOM, HTTP 413) never retry")
+    ap.add_argument("-backoff", type=float, default=5.0,
+                    help="initial retry backoff seconds (doubles per "
+                         "retry)")
+    ap.add_argument("-outlier", type=float, default=3.0,
+                    help="discard-and-rerun factor: samples more than "
+                         "F x off the batch median are discarded, "
+                         "re-run once, and recorded in 'discarded' "
+                         "(VERDICT r5 #7; 0 disables)")
     ap.add_argument("-verbose", action="store_true")
     args = ap.parse_args()
     if args.repeats < 1:
@@ -262,23 +309,51 @@ def main() -> int:
     if args.min_fill is not None and args.min_fill <= 0:
         args.min_fill = None
 
+    from lux_tpu import resilience
+
     configs = ([args.config] if args.config and not args.all
                else ["cc", "sssp", "sssp-delta", "colfilter",
                      "sssp-mp", "pagerank-mp", "pagerank"])
     failures = 0
     for config in configs:
+        report = resilience.RunReport()
+        policy = resilience.RetryPolicy(retries=max(0, args.retries),
+                                        backoff_s=args.backoff)
         try:
-            name, samples, extra = run_config(config, args)
+            # supervised: a transient worker crash retries the whole
+            # config (fresh graph+engine — exactly what a dead worker
+            # needs) with backoff; fatal classes surface immediately
+            (name, samples, extra, rerun), report = resilience.supervise(
+                lambda k: run_config(config, args), policy, report)
+            try:
+                samples, discarded, attempts = resilience.screen_outliers(
+                    samples, rerun, factor=args.outlier)
+            except Exception as e:  # noqa: BLE001 — rerun crashed
+                # a crash during an outlier RERUN must not void the
+                # already-measured batch: screen without the rerun
+                # (the discard still drops the collapse) and record
+                # what happened
+                samples, discarded, attempts = resilience.screen_outliers(
+                    samples, None, factor=args.outlier)
+                extra = dict(
+                    extra,
+                    rerun_error=f"{type(e).__name__}: {e}"[:200],
+                    rerun_error_class=resilience.classify(e))
         except Exception as e:   # noqa: BLE001 — one config's crash
             # (e.g. a TPU-worker restart, PERF_NOTES round-5 duration
             # wall) must not take down the remaining configs or the
             # tail-line headline metric the driver records
             failures += 1
             print(json.dumps({"metric": f"{config}_FAILED",
-                              "error": f"{type(e).__name__}: {e}"[:300]}),
+                              "error": f"{type(e).__name__}: {e}"[:300],
+                              "attempts": report.attempts,
+                              "failure_class": resilience.classify(e)}),
                   flush=True)
             continue
-        emit(name, samples, extra)
+        if report.attempts > 1:
+            extra = dict(extra, run_attempts=report.attempts)
+        emit(name, samples, extra, attempts=attempts,
+             discarded=discarded)
     return 1 if failures == len(configs) else 0
 
 

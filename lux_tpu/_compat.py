@@ -1,0 +1,76 @@
+"""Compatibility shims for the pinned environment's jax.
+
+jax 0.4.37 (this container) predates two things the engines rely on
+(both are plain aliases/identities upstream; newer jax has them and
+each registration below is skipped):
+
+- the upstream batching rule for ``lax.optimization_barrier`` (added
+  in 0.4.38).  The engines place the barrier inside vmapped per-part
+  steps (engine/pull.py, engine/push.py, ops/{tiled,pairs,owner}.py),
+  so without the rule every vmapped engine trace dies with
+  ``NotImplementedError: Batching rule for 'optimization_barrier' not
+  implemented`` — the bulk of the seed test failures.  The rule is
+  the identity (the barrier is semantically a no-op), exactly what
+  upstream registered.
+- the top-level ``jax.shard_map`` export (graduated from
+  ``jax.experimental.shard_map`` later).  The mesh engines and
+  device_check call it by the stable name with the renamed
+  ``check_vma=`` kwarg; the alias translates it to 0.4.37's
+  ``check_rep=``.
+- ``jax.lax.pcast``: the varying-manual-axes (VMA) cast newer
+  shard_map tracing requires for constant scan carries
+  (ops/owner.py, ops/tiled.py).  0.4.37's shard_map has no VMA
+  analysis, so the value-level identity is the correct shim.
+"""
+
+from __future__ import annotations
+
+
+def register() -> None:
+    try:
+        import jax
+        from jax._src.lax import lax as _lax
+        from jax.interpreters import batching
+    except Exception:           # noqa: BLE001 — no/odd jax: nothing to fix
+        return
+    prim = getattr(_lax, "optimization_barrier_p", None)
+    if prim is not None and prim not in batching.primitive_batchers:
+        def _batcher(batched_args, batch_dims, **params):
+            return prim.bind(*batched_args, **params), batch_dims
+
+        batching.primitive_batchers[prim] = _batcher
+
+    if "shard_map" not in jax.__dict__:
+        try:
+            import inspect
+
+            from jax.experimental.shard_map import shard_map
+        except Exception:       # noqa: BLE001 — neither name: leave it
+            shard_map = None    # (the pcast shim below still applies)
+        if shard_map is None:
+            pass
+        elif "check_vma" in inspect.signature(shard_map).parameters:
+            jax.shard_map = shard_map
+        else:
+            import functools
+
+            @functools.wraps(shard_map)
+            def _shard_map(f, /, *args, **kwargs):
+                if "check_vma" in kwargs:
+                    kwargs["check_rep"] = kwargs.pop("check_vma")
+                # old check_rep has no replication rule for while_loop
+                # (the engines' converge loops); it is a safety
+                # analysis only — off matches what newer jax accepts
+                kwargs.setdefault("check_rep", False)
+                return shard_map(f, *args, **kwargs)
+
+            jax.shard_map = _shard_map
+
+    if not hasattr(jax.lax, "pcast"):
+        def _pcast(x, axes=None, *, to=None, **_kw):
+            return x
+
+        jax.lax.pcast = _pcast
+
+
+register()

@@ -74,24 +74,73 @@ def load(path: str):
     return leaves, meta
 
 
+def _check_leaves(path, expect, leaves):
+    """Shape/dtype-match loaded ``leaves`` against ``expect`` (live,
+    donated, or eval_shape-abstract arrays — all carry shape/dtype).
+    A count-only check would let a checkpoint from a DIFFERENT
+    graph/scale resume silently: XLA's clamping gathers would then
+    produce wrong results instead of an error."""
+    for i, (d, l) in enumerate(zip(expect, leaves)):
+        if (tuple(d.shape) != tuple(l.shape)
+                or np.dtype(d.dtype) != np.dtype(l.dtype)):
+            raise ValueError(
+                f"{path} leaf {i} is {l.dtype}{tuple(l.shape)}, "
+                f"engine expects {np.dtype(d.dtype)}"
+                f"{tuple(d.shape)} — checkpoint from a different "
+                f"graph/scale?")
+
+
 def run_checkpointed(eng, state, num_iters: int, path: str,
-                     segment: int = 50, start_iter: int = 0):
+                     segment=50, start_iter: int = 0,
+                     resume: bool = False, on_segment=None):
     """Run a pull engine ``num_iters`` iterations, checkpointing every
-    ``segment`` iterations.  Resume by loading the checkpoint and
-    passing its iteration counter as ``start_iter``."""
+    segment (``segment``: int size or segmented.DurationBudget).
+
+    resume=True loads the checkpoint at ``path`` (if present), places
+    its state on the engine's devices (eng.place) and continues from
+    its iteration counter — the passed ``state`` supplies the pytree
+    structure.  ``on_segment(state, done)`` runs BEFORE each save and
+    may raise (the save is skipped, so the checkpoint stays at the
+    last good segment) or return a replacement state (which is what
+    gets checkpointed — the fault-injection harness relies on the
+    guard raising before a corrupted state can reach the save)."""
+    import jax
+
     from lux_tpu.segmented import run_segments
 
-    return run_segments(
-        eng, state, num_iters, segment, start_iter=start_iter,
-        on_segment=lambda s, done:
-            save(path, (s,), {"iter": done, "kind": "pull"}))
+    if resume and os.path.exists(path):
+        leaves, meta = load(path)
+        treedef = jax.tree.structure(state)
+        if meta.get("kind") != "pull" or treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"{path} is not a matching pull-engine checkpoint "
+                f"(kind={meta.get('kind')!r}, {len(leaves)} arrays)")
+        _check_leaves(path, jax.tree.leaves(state), leaves)
+        state = eng.place(jax.tree.unflatten(treedef, leaves))
+        start_iter = int(meta["iter"])
+
+    def seg_hook(s, done):
+        out = None
+        if on_segment is not None:
+            res = on_segment(s, done)
+            if res is not None:
+                s = out = res
+        save(path, (s,), {"iter": done, "kind": "pull"})
+        return out
+
+    return run_segments(eng, state, num_iters, segment,
+                        start_iter=start_iter, on_segment=seg_hook)
 
 
-def converge_checkpointed(eng, path: str, segment: int = 50,
+def converge_checkpointed(eng, path: str, segment=50,
                           resume: bool = False,
-                          max_iters: int | None = None):
-    """Run a push engine to convergence in ``segment``-iteration
-    slices, checkpointing after each slice.  Returns
+                          max_iters: int | None = None,
+                          on_segment=None):
+    """Run a push engine to convergence in segment slices
+    (``segment``: int size or segmented.DurationBudget),
+    checkpointing after each slice.  ``on_segment(label, active,
+    total, cnt)`` runs BEFORE each save, with the same raise/replace
+    contract as run_checkpointed.  Returns
     (labels, active, total_iters)."""
     from lux_tpu.segmented import converge_segments
 
@@ -101,12 +150,29 @@ def converge_checkpointed(eng, path: str, segment: int = 50,
             raise ValueError(
                 f"{path} is not a push-engine checkpoint "
                 f"(kind={meta.get('kind')!r}, {len(leaves)} arrays)")
+        try:                            # abstract: no device work
+            import jax
+            expect = jax.tree.leaves(jax.eval_shape(eng.init_state))
+        except Exception:               # noqa: BLE001 — untraceable
+            expect = None
+        if expect is not None and len(expect) == len(leaves):
+            _check_leaves(path, expect, leaves)
         label, active = eng.place(*leaves)
         done = int(meta["iter"])
     else:
         label, active = eng.init_state()
         done = 0
+
+    def seg_hook(lbl, act, total, cnt):
+        out = None
+        if on_segment is not None:
+            res = on_segment(lbl, act, total, cnt)
+            if res is not None:
+                lbl, act = res
+                out = res
+        save(path, (lbl, act), {"iter": total, "kind": "push"})
+        return out
+
     return converge_segments(
         eng, label, active, segment, max_iters, start_iter=done,
-        on_segment=lambda lbl, act, total, cnt:
-            save(path, (lbl, act), {"iter": total, "kind": "push"}))
+        on_segment=seg_hook)

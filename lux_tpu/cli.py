@@ -14,6 +14,12 @@ Flags (reference names kept):
                 default: the -mesh size, i.e. one partition per device)
   -mesh N       shard over an N-device mesh (default: 1 device)
   -weighted     treat the graph/run as weighted (colfilter implies it)
+  -retries N    supervised run: classify + retry transient failures,
+                auto-resuming from the last segment checkpoint
+  -seg-budget S duration-budgeted segments (each XLA execution < S s —
+                the ~55 s tunnel wall, PERF_NOTES round 5)
+  -resume CKPT  checkpoint path to save to / resume from
+                (all three: lux_tpu/resilience.py)
 
 Timing methodology matches the reference: wall clock around the
 iteration loop only, printed as ``ELAPSED TIME = ... s`` plus GTEPS
@@ -73,6 +79,26 @@ def _common(ap: argparse.ArgumentParser):
                          "view (1, default).  0 halves edge memory at "
                          "big scale; every iteration runs dense "
                          "(memory_report(push_sparse=...) prices it)")
+    ap.add_argument("-retries", type=int, default=0, metavar="N",
+                    help="supervise the run (lux_tpu.resilience): "
+                         "classify failures, retry transient ones up "
+                         "to N times with exponential backoff, and "
+                         "auto-resume from the last segment "
+                         "checkpoint instead of restarting")
+    ap.add_argument("-seg-budget", type=float, default=0.0,
+                    dest="seg_budget", metavar="S",
+                    help="run in duration-budgeted segments: size "
+                         "each XLA execution to stay under S seconds "
+                         "(the ~55 s tunnel duration wall, PERF_NOTES "
+                         "round 5); implies the supervised path")
+    ap.add_argument("-resume", default=None, metavar="CKPT",
+                    help="checkpoint file: save after every segment "
+                         "and resume from it if it exists; implies "
+                         "the supervised path (without -resume, "
+                         "-retries/-seg-budget checkpoint to a "
+                         "temporary file for in-run crash recovery "
+                         "only).  Supervised timing includes segment "
+                         "checkpoint saves")
     ap.add_argument("-phases", type=int, default=0, metavar="N",
                     help="after the timed run, run N instrumented "
                          "iterations and print the per-iteration "
@@ -128,6 +154,70 @@ def _warn_exchange_ignored(args):
     if args.exchange not in ("gather", "auto"):
         print(f"note: -exchange {args.exchange} does not apply to "
               f"colfilter's dot path; ignored")
+
+
+def _supervisor_opts(args, app):
+    """None, or (checkpoint path, supervised-run kwargs) when any of
+    -retries / -seg-budget / -resume asks for the resilience
+    supervisor (lux_tpu/resilience.py)."""
+    if not (args.retries > 0 or args.seg_budget > 0 or args.resume):
+        return None
+    import os
+    import tempfile
+
+    from lux_tpu import resilience
+
+    if getattr(args, "profile", None):
+        print("note: -profile is ignored on the supervised path "
+              "(segments are separate XLA executions)")
+    if getattr(args, "verbose", False):
+        print("note: -verbose is ignored on the supervised path")
+    # pid-qualified: concurrent runs must not clobber (or worse,
+    # cross-resume) each other's in-run recovery checkpoints
+    path = args.resume or os.path.join(
+        tempfile.gettempdir(),
+        f"lux_{app}_supervised.{os.getpid()}.ckpt.npz")
+    kw = dict(policy=resilience.RetryPolicy(retries=max(0, args.retries)),
+              seg_budget=args.seg_budget or None,
+              resume=args.resume is not None)
+    return path, kw
+
+
+def _run_supervised(eng, sup, args, ni=None):
+    """One supervised execution (pull fixed-``ni``, or push converge
+    when ni is None), printing the supervisor report and reclaiming
+    the implicit (non -resume) recovery checkpoint on BOTH success
+    and failure — its pid-qualified name means nothing else ever
+    would.  Returns (result, total_iters, elapsed, billed, mark):
+    ``billed`` excludes iterations a previous invocation's -resume
+    checkpoint already did (in-run retries bill in full — redone
+    segments and backoff are this run's cost, resilience.RunReport
+    .initial_resume)."""
+    import os
+
+    from lux_tpu import resilience
+
+    path, kw = sup
+    t0 = time.perf_counter()
+    try:
+        if ni is not None:
+            result, report = resilience.supervised_run(eng, ni, path,
+                                                       **kw)
+            total = ni
+        else:
+            label, _active, total, report = \
+                resilience.supervised_converge(eng, path, **kw)
+            result = eng.unpad(label)
+        elapsed = time.perf_counter() - t0
+    finally:
+        if not args.resume and os.path.exists(path):
+            os.unlink(path)
+    print(f"# supervisor: attempts={report.attempts} "
+          f"segments={report.segments} "
+          f"resumed_from={report.resumed_from}")
+    billed = total - (report.initial_resume or 0)
+    return (result, total, elapsed, billed,
+            " (supervised; incl. checkpoint saves)")
 
 
 def _relabel_for_pairs(args, g, num_parts):
@@ -186,6 +276,10 @@ def cmd_pagerank(argv):
                                 pair_min_fill=args.min_fill,
                                 exchange=args.exchange)
     if args.tol is not None:
+        if args.retries > 0 or args.seg_budget > 0 or args.resume:
+            print("note: -tol runs one monolithic convergence "
+                  "program; -retries/-seg-budget/-resume apply to "
+                  "fixed -ni runs only and are ignored here")
         from lux_tpu.timing import timed_run_until
         state, iters, res, elapsed = timed_run_until(
             eng, args.tol, args.max_iters, trace_dir=args.profile)
@@ -193,10 +287,19 @@ def cmd_pagerank(argv):
               f"residual {res:.3e})")
         print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
     else:
-        state, [elapsed] = timed_fused_run(eng, args.ni,
-                                           trace_dir=args.profile)
+        sup = _supervisor_opts(args, "pagerank")
+        if sup is not None:
+            state, _total, elapsed, ni, mark = _run_supervised(
+                eng, sup, args, ni=args.ni)
+        else:
+            state, [elapsed] = timed_fused_run(eng, args.ni,
+                                               trace_dir=args.profile)
+            ni, mark = args.ni, ""
         print(f"ELAPSED TIME = {elapsed:.7f} s")
-        print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
+        if ni > 0:
+            print(f"GTEPS = {g.ne * ni / elapsed / 1e9:.4f}{mark}")
+        else:
+            print("GTEPS = n/a (run already complete in checkpoint)")
 
     if args.phases:
         _state, rep = eng.timed_phases(eng.init_state(), args.phases)
@@ -256,10 +359,19 @@ def _push_app(argv, prog_name):
                                       pair_min_fill=args.min_fill,
                                       exchange=args.exchange,
                                       enable_sparse=bool(args.sparse))
-    labels, iters, [elapsed] = timed_converge(
-        eng, verbose=args.verbose, trace_dir=args.profile)
+    sup = _supervisor_opts(args, prog_name)
+    if sup is not None:
+        labels, iters, elapsed, it_exec, mark = _run_supervised(
+            eng, sup, args)
+    else:
+        labels, iters, [elapsed] = timed_converge(
+            eng, verbose=args.verbose, trace_dir=args.profile)
+        it_exec, mark = iters, ""
     print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
-    print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
+    if it_exec > 0:
+        print(f"GTEPS = {g.ne * it_exec / elapsed / 1e9:.4f}{mark}")
+    else:
+        print("GTEPS = n/a (run already complete in checkpoint)")
 
     if args.phases:
         lab0, act0 = eng.init_state()
@@ -305,10 +417,19 @@ def cmd_colfilter(argv):
     sg = _build_sg(args, g_run, num_parts, starts)
     eng = colfilter.build_engine(g_run, num_parts, mesh, sg=sg,
                                  pair_threshold=args.pair)
-    state, [elapsed] = timed_fused_run(eng, args.ni,
-                                       trace_dir=args.profile)
+    sup = _supervisor_opts(args, "colfilter")
+    if sup is not None:
+        state, _total, elapsed, ni, mark = _run_supervised(
+            eng, sup, args, ni=args.ni)
+    else:
+        state, [elapsed] = timed_fused_run(eng, args.ni,
+                                           trace_dir=args.profile)
+        ni, mark = args.ni, ""
     print(f"ELAPSED TIME = {elapsed:.7f} s")
-    print(f"GTEPS = {g.ne * args.ni / elapsed / 1e9:.4f}")
+    if ni > 0:
+        print(f"GTEPS = {g.ne * ni / elapsed / 1e9:.4f}{mark}")
+    else:
+        print("GTEPS = n/a (run already complete in checkpoint)")
     out = eng.unpad(state)
     # out is in the run graph's (possibly relabeled) vertex order;
     # rmse is computed over edges, so the relabeled graph is the

@@ -20,11 +20,18 @@ import numpy as np
 from lux_tpu import segmented
 
 
-class DivergenceError(RuntimeError):
+class GuardError(RuntimeError):
+    """Base of the runtime guards' failures.  resilience.classify keys
+    off the subclasses: DivergenceError (NaN escape — possibly a
+    transient corruption whose last checkpoint is clean) is retryable
+    from a checkpoint; StallError (deterministic livelock) is fatal."""
+
+
+class DivergenceError(GuardError):
     pass
 
 
-class StallError(RuntimeError):
+class StallError(GuardError):
     pass
 
 

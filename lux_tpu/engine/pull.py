@@ -301,6 +301,19 @@ class PullEngine:
                                     self.sg.num_parts)[0]
         return jnp.asarray(state)
 
+    def place(self, state):
+        """Put a host state pytree on the engine's devices with the
+        parts sharding (mirrors init_state's placement; used by
+        checkpoint/resilience resume)."""
+        leaves, treedef = jax.tree.flatten(state)
+        if self.mesh is not None:
+            leaves = shard_over_parts(
+                self.mesh, [np.asarray(x) for x in leaves],
+                self.sg.num_parts)
+        else:
+            leaves = [jnp.asarray(x) for x in leaves]
+        return jax.tree.unflatten(treedef, leaves)
+
     # -- one part's work ----------------------------------------------
 
     def _apply_epilogue(self, old_p, red, g):
@@ -620,9 +633,18 @@ class PullEngine:
 
         return lambda state, n: run(state, n, *self.graph_args)
 
-    def run(self, state, num_iters: int, fused: bool = True):
+    def run(self, state, num_iters: int, fused: bool = True,
+            seg_budget: float | None = None):
         """num_iters iterations; fused=True compiles the whole loop into
-        one XLA program (no host round-trips)."""
+        one XLA program (no host round-trips).  seg_budget (seconds)
+        instead runs duration-budgeted fused segments
+        (segmented.DurationBudget) so each XLA execution stays under
+        the tunnel's ~55 s crash envelope (PERF_NOTES round 5) — the
+        systematic form of the old hand-picked small-``ni`` routing."""
+        if seg_budget is not None:
+            from lux_tpu.segmented import DurationBudget, run_segments
+            return run_segments(self, state, num_iters,
+                                DurationBudget(seg_budget))
         if fused:
             return self._run_fused(state, num_iters)
         for _ in range(num_iters):

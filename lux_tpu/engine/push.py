@@ -644,15 +644,32 @@ class PushEngine:
         cap = np.iinfo(np.int32).max if max_iters is None else max_iters
         return self._converge_fn(label, active, cap)
 
-    def run(self, max_iters: int | None = None, verbose: bool = False):
+    def run(self, max_iters: int | None = None, verbose: bool = False,
+            seg_budget: float | None = None):
         """init -> converge -> host label array [nv]; returns
         (labels, num_iters).  verbose=True uses the stepwise path and
-        prints per-iteration frontier sizes."""
+        prints per-iteration frontier sizes.  seg_budget (seconds)
+        converges in duration-budgeted while_loop slices
+        (segmented.DurationBudget) so each XLA execution stays under
+        the tunnel's ~55 s crash envelope (PERF_NOTES round 5) — the
+        systematic form of the old hand-routed ``seg=2`` converges."""
         label, active = self.init_state()
         if verbose and self.delta is not None:
             print("note: -verbose uses the stepwise path, which runs "
                   "plain frontier relaxation; the timed converge path "
                   "runs delta-stepping")
+        if seg_budget is not None and verbose:
+            print("note: -verbose runs the stepwise path; seg_budget "
+                  "is ignored (budgeted segments need the fused "
+                  "converge program)")
+        if seg_budget is not None and not verbose:
+            from lux_tpu.segmented import DurationBudget, \
+                converge_segments
+            label, active, it = converge_segments(
+                self, label, active,
+                DurationBudget(seg_budget, per_size_compile=False),
+                max_iters)
+            return self.unpad(label), it
         if verbose:
             it = 0
             cnt = int(jnp.sum(active)) if self.mesh is None else int(

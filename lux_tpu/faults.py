@@ -1,0 +1,112 @@
+"""Deterministic fault injection for the resilience layer.
+
+The tunnel's real failure modes — transient TPU worker death (a
+pagerank-mp sample collapsed 10x in BENCH_r05 and one whole config
+crashed during round 5), slow segments, and state corruption — do not
+reproduce on demand, so the recovery paths that handle them would
+otherwise ship untested.  This module injects synthetic versions of
+those failures at SEGMENT BOUNDARIES on a deterministic schedule
+(explicit, or derived from a seed), so the whole
+classify/retry/resume path (lux_tpu/resilience.py) is exercised by
+the CPU test suite.
+
+Faults key on a global boundary COUNTER, not on iteration numbers:
+after a crash-and-resume the counter has advanced past the fired
+fault, so a schedule never re-fires and every supervised run
+terminates.  The counter also persists across the supervisor's
+retries, which is what makes a seeded schedule reproducible
+end-to-end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+CRASH = "crash"     # raise InjectedWorkerCrash (retryable)
+DELAY = "delay"     # sleep delay_s (exercises slow-segment paths)
+NAN = "nan"         # NaN-corrupt the first floating state leaf
+
+
+class InjectedWorkerCrash(RuntimeError):
+    """Synthetic analogue of the tunnel's transient worker death;
+    resilience.classify treats it as retryable."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A deterministic boundary-counter -> action schedule.
+
+    ``fire(state)`` is called by the supervisor at every segment
+    boundary.  It returns None (no state change), or a HOST-side
+    corrupted copy of the state pytree (the caller re-places it on
+    device); a scheduled CRASH raises InjectedWorkerCrash before the
+    segment's checkpoint save; a scheduled DELAY sleeps.  ``fired``
+    records what actually happened, for assertions.
+    """
+
+    schedule: dict
+    delay_s: float = 0.0
+    nan_count: int = 1
+    boundaries: int = dataclasses.field(default=0, init=False)
+    fired: list = dataclasses.field(default_factory=list, init=False)
+
+    @classmethod
+    def seeded(cls, seed: int, n: int = 16, p_crash: float = 0.25,
+               p_delay: float = 0.0, p_nan: float = 0.0,
+               delay_s: float = 0.0, nan_count: int = 1) -> "FaultPlan":
+        """Derive a schedule over the first ``n`` boundaries from a
+        seed — same seed, same faults, every run."""
+        rng = np.random.default_rng(seed)
+        schedule = {}
+        for i in range(n):
+            r = float(rng.random())
+            if r < p_crash:
+                schedule[i] = CRASH
+            elif r < p_crash + p_delay:
+                schedule[i] = DELAY
+            elif r < p_crash + p_delay + p_nan:
+                schedule[i] = NAN
+        return cls(schedule=schedule, delay_s=delay_s,
+                   nan_count=nan_count)
+
+    def fire(self, state):
+        i = self.boundaries
+        self.boundaries += 1
+        action = self.schedule.get(i)
+        if action is None:
+            return None
+        self.fired.append((i, action))
+        if action == CRASH:
+            raise InjectedWorkerCrash(
+                f"injected worker crash at segment boundary {i}")
+        if action == DELAY:
+            time.sleep(self.delay_s)
+            return None
+        if action == NAN:
+            return nan_corrupt(state, self.nan_count)
+        raise ValueError(f"unknown fault action {action!r}")
+
+
+def nan_corrupt(state, count: int = 1):
+    """Host copy of ``state`` with NaN poked into the first ``count``
+    cells of its first floating leaf (what a corrupted segment output
+    looks like to debug.check_finite)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(state)
+    out, done = [], False
+    for leaf in leaves:
+        arr = np.array(leaf)              # host copy, always writable
+        if (not done and arr.size
+                and np.issubdtype(arr.dtype, np.floating)):
+            arr.reshape(-1)[:count] = np.nan
+            done = True
+        out.append(arr)
+    if not done:
+        raise ValueError(
+            "no floating leaf to NaN-corrupt (integer-labeled "
+            "programs need a CRASH/DELAY fault instead)")
+    return jax.tree.unflatten(treedef, out)

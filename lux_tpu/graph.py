@@ -643,7 +643,13 @@ class ShardedGraph:
             if self._src_sorted_cache is not None:
                 S = self.src_unique_max()
             else:
-                S = min(self.vpad, self.epad)
+                # a part's unique sources are bounded by min(nv, ne):
+                # sources come from ANY part (nv ~ num_parts * vpad),
+                # not just this one's vpad — the old min(vpad, epad)
+                # under-priced exactly the multi-part big-scale fits
+                # this advisor gates (~200 MB/part at RMAT25 np=4,
+                # round-5 ADVICE #1)
+                S = min(self.num_parts * self.vpad, self.epad)
             # src_ids + src_off int32 + ss_dst int32 (+ f32 ss_weight)
             sparse_bytes = 4 * (2 * S + 1) + self.epad * (4 + w)
         # state f32 + deg int32 (vmask derives from a scalar on device)

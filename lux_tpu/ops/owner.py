@@ -111,12 +111,22 @@ class OwnerLayout:
         warn_sub128_tile(E)
         P, vpad, W = sg.num_parts, sg.vpad, 128
         if packed is None:
-            # auto: pack whenever the 25-bit src_local field fits
-            packed = vpad <= cls.PACK_VPAD_MAX
+            # auto: pack whenever the 25-bit src_local field fits AND
+            # the uint16 live-lane count can hold a full chunk
+            packed = (vpad <= cls.PACK_VPAD_MAX
+                      and E <= np.iinfo(np.uint16).max)
         elif packed and vpad > cls.PACK_VPAD_MAX:
             raise ValueError(
                 f"packed owner layout needs vpad <= {cls.PACK_VPAD_MAX}"
                 f" (25-bit src_local), got vpad={vpad}")
+        elif packed and E > np.iinfo(np.uint16).max:
+            # n_valid is uint16 [R, C]; a bigger chunk would silently
+            # wrap the live-lane count and corrupt the pad recovery
+            # (round-5 ADVICE #2 — the analogue of the vpad check)
+            raise ValueError(
+                f"packed owner layout needs E <= "
+                f"{np.iinfo(np.uint16).max} (uint16 live-lane "
+                f"counts), got E={E}; pass packed=False")
         n_tiles = max(1, _ceil_div(vpad, W))
         G = P * n_tiles
         local = sg.local_parts is not None

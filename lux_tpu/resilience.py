@@ -1,0 +1,333 @@
+"""Resilient run supervision: crash classification, retry/resume,
+duration-budgeted segmentation, and bench sample screening.
+
+The reference inherits fault tolerance from the Legion/Realm runtime
+it sits on (SURVEY §1); lux_tpu's substrate is JAX over the axon
+tunnel, whose measured failure modes (PERF_NOTES round 5) are:
+transient TPU worker death (one bench config crashed outright and a
+pagerank-mp sample collapsed 10x in BENCH_r05), the ~55 s
+single-execution duration wall, and HTTP 413 rejects for
+constant-heavy programs.  This module is the recovery story:
+
+- ``classify`` sorts failures into RETRYABLE (tunnel/worker death,
+  injected crashes, NaN escapes caught by debug.check_finite — the
+  last checkpoint predates the corruption, so resuming can help) and
+  FATAL (HTTP 413 / OOM compile rejects, StallError livelocks,
+  programming errors — deterministic, retrying reruns the same bug).
+  A deterministic divergence still surfaces: it recurs until the
+  retry budget is exhausted and the last error propagates.
+- ``supervise`` retries retryable failures with exponential backoff.
+- ``supervised_run`` / ``supervised_converge`` compose the retry loop
+  with checkpoint.py's segmented paths: every segment checkpoints
+  atomically, retries AUTO-RESUME from the last checkpoint instead of
+  restarting, and optional fault injection (lux_tpu/faults.py) plus
+  the debug.py finite guard run at each boundary.
+- a ``seg_budget`` sizes segments with ``segmented.DurationBudget``
+  so each XLA execution stays under the duration wall.
+- ``screen_outliers`` is bench.py's discard-and-rerun rule for
+  tunnel-variance collapses (samples >3x off the median).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import time
+from statistics import median
+from typing import Callable
+
+import numpy as np
+
+RETRYABLE = "retryable"
+FATAL = "fatal"
+
+# Deterministic failures — retrying replays the same program into the
+# same rejection.  Checked before the transient MESSAGE patterns: an
+# XlaRuntimeError carrying an HTTP 413 or an OOM must not match the
+# worker/tunnel signatures below.  \b413\b so a port / byte count /
+# request id containing "413..." cannot condemn a transient error.
+_FATAL_RE = re.compile(
+    r"\b413\b|too\s+large|resource.?exhausted|out of memory|"
+    r"failed to allocate|program shape", re.I)
+
+# Transient tunnel/worker signatures: connection loss, worker death,
+# deadline blowouts — the things a fresh attempt can outlive.
+_RETRYABLE_RE = re.compile(
+    r"unavailable|connection|socket|deadline|timed?[\s_-]?out|"
+    r"worker|terminated|cancell?ed|aborted|heartbeat|broken pipe|"
+    r"reset by peer|transport|tunnel", re.I)
+
+_RETRYABLE_TYPES = (ConnectionError, TimeoutError, BrokenPipeError,
+                    EOFError)
+
+# Deterministic filesystem failures (a bad -resume path, a read-only
+# checkpoint dir): OSError subclasses a retry cannot fix.
+_FATAL_OSERRORS = (FileNotFoundError, NotADirectoryError,
+                   IsADirectoryError, PermissionError, FileExistsError)
+
+
+def classify(exc: BaseException) -> str:
+    """RETRYABLE or FATAL for one failure (see module docstring for
+    the taxonomy)."""
+    from lux_tpu import debug, faults
+
+    if isinstance(exc, faults.InjectedWorkerCrash):
+        return RETRYABLE
+    if isinstance(exc, debug.StallError):
+        return FATAL
+    if isinstance(exc, debug.DivergenceError):
+        return RETRYABLE        # possible transient corruption;
+        #                         deterministic NaN exhausts retries
+    if isinstance(exc, _RETRYABLE_TYPES):
+        return RETRYABLE        # typed transport errors outrank any
+        #                         message scan ("...writing request
+        #                         payload too large buffer" etc.)
+    msg = f"{type(exc).__name__}: {exc}"
+    if _FATAL_RE.search(msg):
+        return FATAL
+    if isinstance(exc, _FATAL_OSERRORS):
+        return FATAL
+    if isinstance(exc, OSError):
+        return RETRYABLE        # tunnel I/O
+    if _RETRYABLE_RE.search(msg):
+        return RETRYABLE
+    return FATAL
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Exponential backoff for retryable failures.  ``sleep`` is
+    injectable so tests (and dry runs) never actually wait."""
+
+    retries: int = 3
+    backoff_s: float = 1.0
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 60.0
+    sleep: Callable[[float], None] = time.sleep
+
+    def delay_s(self, failure_index: int) -> float:
+        return min(self.backoff_s * self.backoff_factor ** failure_index,
+                   self.max_backoff_s)
+
+
+@dataclasses.dataclass
+class RunReport:
+    """What the supervisor did: for logs and bench JSON lines."""
+
+    attempts: int = 0
+    failures: list = dataclasses.field(default_factory=list)
+    #           ^ (exception type name, message[:200], classification)
+    resumed_from: list = dataclasses.field(default_factory=list)
+    #           ^ checkpoint iteration counter at each resume
+    initial_resume: int | None = None
+    #           ^ iteration a PRE-EXISTING checkpoint supplied to the
+    #             first attempt (explicit resume=True only) — in-run
+    #             retry resumes redo work this run already did and
+    #             are deliberately NOT counted here
+    total_iters: int = 0
+    segments: int = 0
+
+    def as_dict(self) -> dict:
+        return dict(attempts=self.attempts, segments=self.segments,
+                    resumed_from=list(self.resumed_from),
+                    initial_resume=self.initial_resume,
+                    failures=[list(f) for f in self.failures],
+                    total_iters=self.total_iters)
+
+
+def supervise(attempt: Callable, policy: RetryPolicy | None = None,
+              report: RunReport | None = None):
+    """Run ``attempt(k)`` (k = 0-based attempt index) under classified
+    retries: retryable failures back off and retry, fatal ones (and
+    retry-budget exhaustion) re-raise.  Returns (result, report)."""
+    policy = policy or RetryPolicy()
+    report = report or RunReport()
+    for k in range(max(0, policy.retries) + 1):
+        report.attempts += 1
+        try:
+            return attempt(k), report
+        except Exception as e:      # noqa: BLE001 — classified below
+            kind = classify(e)
+            report.failures.append(
+                (type(e).__name__, str(e)[:200], kind))
+            if kind == FATAL or k >= policy.retries:
+                raise
+            policy.sleep(policy.delay_s(k))
+    raise AssertionError("unreachable")
+
+
+def _make_segment(segment, seg_budget, per_size_compile=True):
+    if seg_budget:
+        from lux_tpu.segmented import DurationBudget
+        return DurationBudget(float(seg_budget),
+                              per_size_compile=per_size_compile)
+    return segment
+
+
+def _record_resume(path, report):
+    from lux_tpu import checkpoint
+
+    if os.path.exists(path):
+        try:
+            _leaves, meta = checkpoint.load(path)
+            report.resumed_from.append(int(meta.get("iter", 0)))
+        except Exception:           # noqa: BLE001 — a torn/alien file
+            pass                    # just means a fresh start
+
+
+def supervised_run(eng, num_iters: int, path: str, *,
+                   policy: RetryPolicy | None = None,
+                   segment=50, seg_budget: float | None = None,
+                   resume: bool = False, faults=None,
+                   guard: bool = True, report: RunReport | None = None):
+    """Supervised pull-engine fixed-iteration run: segmented +
+    checkpointed to ``path``, with classified retries resuming from
+    the last atomic checkpoint.  Returns (state, report).
+
+    resume=False starts fresh (a stale file at ``path`` is removed so
+    a crash before the first save cannot resurrect it); retries within
+    the run always resume.  ``faults`` (faults.FaultPlan) and the
+    finite ``guard`` run at each segment boundary BEFORE the save, so
+    injected/real corruption never reaches a checkpoint."""
+    from lux_tpu import checkpoint, debug
+
+    report = report or RunReport()
+    if not resume and os.path.exists(path):
+        os.unlink(path)
+
+    def hook(s, done):
+        report.segments += 1
+        out = None
+        if faults is not None:
+            res = faults.fire(s)
+            if res is not None:
+                s = out = eng.place(res)
+        if guard:
+            debug.check_finite(
+                s, f"supervised pull run @ iteration {done}")
+        return out
+
+    # eng.run DONATES its state buffers, so a consumed state cannot
+    # feed a second attempt — but a resuming attempt whose checkpoint
+    # exists only reads the pytree STRUCTURE (checkpoint.py), so a
+    # spent state (or an abstract eval_shape stub on a fresh-process
+    # resume) serves as structure donor and the attempt skips
+    # re-placing a fresh multi-hundred-MB state on device.
+    state0 = None
+
+    def attempt(k):
+        nonlocal state0
+        do_resume = resume or k > 0
+        if do_resume:
+            _record_resume(path, report)
+            if k == 0 and report.resumed_from:
+                report.initial_resume = report.resumed_from[0]
+        will_load = do_resume and os.path.exists(path)
+        if will_load and state0 is None:
+            import jax
+            try:                    # structure-only: no placement
+                state0 = jax.eval_shape(eng.init_state)
+            except Exception:       # noqa: BLE001 — untraceable init
+                state0 = eng.init_state()
+        elif not will_load:
+            state0 = eng.init_state()
+        return checkpoint.run_checkpointed(
+            eng, state0, num_iters, path,
+            segment=_make_segment(segment, seg_budget),
+            resume=do_resume, on_segment=hook)
+
+    state, report = supervise(attempt, policy, report)
+    report.total_iters = num_iters
+    return state, report
+
+
+def supervised_converge(eng, path: str, *,
+                        policy: RetryPolicy | None = None,
+                        segment=50, seg_budget: float | None = None,
+                        resume: bool = False,
+                        max_iters: int | None = None, faults=None,
+                        guard: bool = True,
+                        report: RunReport | None = None):
+    """Supervised push-engine convergence: segmented + checkpointed to
+    ``path``, with classified retries resuming from the last atomic
+    checkpoint.  Returns (label, active, total_iters, report).
+
+    The boundary guard runs check_finite(allow_inf=True) — +inf is the
+    legitimate unreached sentinel; NaN raises DivergenceError, which
+    classifies retryable (the checkpoint predates the corruption)."""
+    from lux_tpu import checkpoint, debug
+
+    report = report or RunReport()
+    if not resume and os.path.exists(path):
+        os.unlink(path)
+
+    def hook(lbl, act, total, cnt):
+        report.segments += 1
+        out = None
+        if faults is not None:
+            res = faults.fire((lbl, act))
+            if res is not None:
+                lbl, act = eng.place(*[np.asarray(x) for x in res])
+                out = (lbl, act)
+        if guard:
+            debug.check_finite(
+                lbl, f"supervised converge @ iteration {total}",
+                allow_inf=True)
+        return out
+
+    def attempt(k):
+        do_resume = resume or k > 0
+        if do_resume:
+            _record_resume(path, report)
+            if k == 0 and report.resumed_from:
+                report.initial_resume = report.resumed_from[0]
+        return checkpoint.converge_checkpointed(
+            eng, path,
+            segment=_make_segment(segment, seg_budget,
+                                  per_size_compile=False),
+            resume=do_resume, max_iters=max_iters, on_segment=hook)
+
+    (label, active, total), report = supervise(attempt, policy, report)
+    report.total_iters = total
+    return label, active, total, report
+
+
+def screen_outliers(samples, rerun: Callable[[], float] | None,
+                    factor: float = 3.0):
+    """bench.py's discard-and-rerun rule (round-5 VERDICT #7): a
+    sample more than ``factor``x off the median of its batch is a
+    tunnel collapse (BENCH_r05 pagerank-mp: [0.1116, 0.0107, 0.1118]),
+    not a measurement — it is discarded and re-run ONCE, and the
+    discards are reported so the JSON line cannot silently median
+    over a collapse.
+
+    Returns (kept_samples, discarded, attempts) where ``attempts``
+    counts every timed run (original batch + reruns).  factor<=0
+    disables screening.
+    """
+    samples = list(samples)
+    attempts = len(samples)
+    if len(samples) < 2 or not factor or factor <= 0:
+        return samples, [], attempts
+    m = median(samples)
+
+    def is_outlier(s):
+        return s < m / factor or s > m * factor
+
+    kept = [s for s in samples if not is_outlier(s)]
+    discarded = [s for s in samples if is_outlier(s)]
+    if not kept:        # mutual disagreement: nothing to trust more
+        return samples, [], attempts
+    for _ in list(discarded):
+        if rerun is None:
+            break
+        s = rerun()
+        attempts += 1
+        if is_outlier(s):
+            discarded.append(s)     # the rerun ALSO collapsed: record
+            #                         it, never median it (reruns get
+            #                         one chance — no retry loops)
+        else:
+            kept.append(s)
+    return kept, discarded, attempts

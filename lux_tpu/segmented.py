@@ -1,56 +1,161 @@
 """Shared segmented-execution drivers.
 
-Checkpointing (checkpoint.py) and runtime guards (debug.py) both run
-engines in host-visible segments; this module is the single copy of
-that slicing logic so per-segment behaviors (save, finite checks,
-stall detection) compose instead of forking.
+Checkpointing (checkpoint.py), runtime guards (debug.py) and the run
+supervisor (resilience.py) all run engines in host-visible segments;
+this module is the single copy of that slicing logic so per-segment
+behaviors (save, finite checks, stall detection, fault injection,
+duration budgeting) compose instead of forking.
+
+Two extensions beyond plain fixed-size slicing:
+
+- ``on_segment`` hooks may RETURN a replacement state to continue
+  with (the fault-injection harness corrupts state this way;
+  lux_tpu/faults.py) or raise to abort; returning None keeps the
+  current state.
+- ``segment`` may be an int OR a ``DurationBudget``: each execution
+  is then timed (fenced through ``lux_tpu.timing``) and the next
+  slice is sized so a single XLA execution stays under the budget —
+  the systematic replacement for the ad-hoc ``seg=2`` / small-``ni``
+  routing big-scale runs used against the ~55 s tunnel duration wall
+  (PERF_NOTES round 5).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 import numpy as np
 
 
-def run_segments(eng, state, num_iters: int, segment: int,
+class DurationBudget:
+    """Adaptive segment sizing against a per-XLA-execution duration
+    budget (default 45 s — safely under the measured ~55 s
+    worker-crash envelope, PERF_NOTES round 5).
+
+    Policy, shaped by how the remote tunnel bills time:
+
+    - the first ``warmup`` slices run ``probe_n`` iterations each:
+      the FIRST execution of a program includes its (remote) compile,
+      so only the last warmup slice's measured rate is trusted;
+    - the slice size then LOCKS at ``headroom * budget_s / per_iter``
+      clamped to [1, max_segment] — sticky, because pull engines
+      compile one fused program per distinct slice length and a
+      drifting size would recompile every segment;
+    - an execution that overruns the budget halves the lock.  With
+      ``per_size_compile=True`` (pull engines: one fused program per
+      distinct slice length) the first execution at any new size is
+      exempt, since it may carry that size's compile; push converge
+      is ONE program with the cap as an argument AND reports actual
+      relax steps (which vary every segment), so its callers pass
+      False — otherwise every overrun would look like a fresh size
+      and stay permanently exempt.
+    """
+
+    def __init__(self, budget_s: float = 45.0, probe_n: int = 1,
+                 warmup: int = 2, max_segment: int = 4096,
+                 headroom: float = 0.8, per_size_compile: bool = True):
+        if not budget_s > 0:
+            raise ValueError(f"budget_s must be > 0, got {budget_s}")
+        self.budget_s = float(budget_s)
+        self.probe_n = max(1, int(probe_n))
+        self.warmup = max(1, int(warmup))
+        self.max_segment = max(1, int(max_segment))
+        self.headroom = float(headroom)
+        self.per_size_compile = bool(per_size_compile)
+        self.locked: int | None = None
+        self.per_iter: float | None = None
+        self._measured = 0
+        self._seen: set[int] = set()
+
+    def next_n(self, remaining: int) -> int:
+        n = self.locked if self.locked is not None else self.probe_n
+        return max(1, min(n, remaining, self.max_segment))
+
+    def observe(self, n: int, seconds: float) -> None:
+        """Record one fenced execution of ``n`` iterations."""
+        first_at_size = self.per_size_compile and n not in self._seen
+        self._seen.add(n)
+        self._measured += 1
+        if self.locked is None:
+            if self._measured < self.warmup:
+                return                      # compile-contaminated
+            self.per_iter = max(seconds / max(n, 1), 1e-9)
+            self.locked = max(1, min(
+                self.max_segment,
+                int(self.headroom * self.budget_s / self.per_iter)))
+        elif (seconds > self.budget_s and not first_at_size
+              and self.locked > 1):
+            self.locked = max(1, self.locked // 2)
+
+
+def _next_n(segment, remaining: int) -> int:
+    if isinstance(segment, DurationBudget):
+        return segment.next_n(remaining)
+    return min(segment, remaining)
+
+
+def run_segments(eng, state, num_iters: int, segment,
                  on_segment: Callable | None = None,
                  start_iter: int = 0):
-    """Run a pull engine in ``segment``-iteration slices.
-    ``on_segment(state, done_iters)`` runs after each slice."""
+    """Run a pull engine in slices (``segment``: int size or
+    DurationBudget).  ``on_segment(state, done_iters)`` runs after
+    each slice and may return a replacement state."""
+    budget = segment if isinstance(segment, DurationBudget) else None
     done = start_iter
     while done < num_iters:
-        n = min(segment, num_iters - done)
-        state = eng.run(state, n)
+        n = _next_n(segment, num_iters - done)
+        if budget is not None:
+            from lux_tpu.timing import fence
+            t0 = time.perf_counter()
+            state = eng.run(state, n)
+            fence(state)           # O(1)-byte fence, not a download
+            budget.observe(n, time.perf_counter() - t0)
+        else:
+            state = eng.run(state, n)
         done += n
         if on_segment is not None:
-            on_segment(state, done)
+            res = on_segment(state, done)
+            if res is not None:
+                state = res
     return state
 
 
-def converge_segments(eng, label, active, segment: int,
+def converge_segments(eng, label, active, segment,
                       max_iters: int | None = None,
                       on_segment: Callable | None = None,
                       start_iter: int = 0):
-    """Run a push engine to convergence in slices.
+    """Run a push engine to convergence in slices (``segment``: int
+    size or DurationBudget).
 
     ``on_segment(label, active, total_iters, active_count)`` runs after
-    each slice (may raise to abort).  Convergence is detected from the
-    active mask, never from iteration counts (delta-stepping counts
-    relax steps only).  Returns (label, active, total_iters).
+    each slice (may raise to abort, or return a replacement
+    ``(label, active)``).  Convergence is detected from the active
+    mask, never from iteration counts (delta-stepping counts relax
+    steps only).  Returns (label, active, total_iters).
     """
     import jax
     import jax.numpy as jnp
 
+    budget = segment if isinstance(segment, DurationBudget) else None
     total = start_iter
     cap = np.iinfo(np.int32).max if max_iters is None else max_iters
     while total < cap:
-        n = min(segment, cap - total)
+        n = _next_n(segment, cap - total)
+        t0 = time.perf_counter()
         label, active, it = eng.converge(label, active, n)
-        total += int(np.asarray(jax.device_get(it)))
+        # the scalar fetch depends on the whole while_loop: it is the
+        # completion fence (tunnel-safe, O(1) bytes)
+        it = int(np.asarray(jax.device_get(it)))
+        if budget is not None and it > 0:
+            budget.observe(it, time.perf_counter() - t0)
+        total += it
         cnt = int(np.asarray(jax.device_get(jnp.sum(active))))
         if on_segment is not None:
-            on_segment(label, active, total, cnt)
+            res = on_segment(label, active, total, cnt)
+            if res is not None:
+                label, active = res
+                cnt = int(np.asarray(jax.device_get(jnp.sum(active))))
         if cnt == 0:
             break
     return label, active, total

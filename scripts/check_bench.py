@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Validate bench metric lines against the resilience-era schema.
+
+The driver's BENCH_*.json artifacts wrap bench.py's stdout; each
+metric line there is one JSON object.  Round 6 added an audit trail
+(lux_tpu/resilience.py): ``attempts`` (total timed runs, outlier
+reruns included), ``discarded`` (samples thrown out by the >3x
+discard-and-rerun rule), and ``run_attempts`` when a whole config was
+retried after a transient crash.  A headline number whose line lacks
+that metadata can silently median over a tunnel collapse — exactly
+the BENCH_r05 pagerank-mp incident ([0.1116, 0.0107, 0.1118]) this
+schema exists to make impossible — so missing metadata FAILS the
+check.
+
+Usage:
+    python scripts/check_bench.py [-legacy-ok] FILE...
+
+FILE is a driver artifact (JSON object with a ``tail`` transcript), a
+raw JSONL of metric lines, or a single JSON metric object.
+``-legacy-ok`` downgrades pre-round-6 metadata gaps (missing
+samples/attempts/discarded) to warnings so the historical BENCH_r01-05
+artifacts still audit cleanly; structural errors (bad median,
+inconsistent counts, malformed lines) always fail.
+
+Checked per metric line:
+- required keys: metric, value, unit, vs_baseline
+- samples: non-empty list of finite numbers, value == median(samples)
+  (to rounding)
+- attempts: int, == len(samples) + len(discarded) — every discarded
+  sample was either re-run (adding a kept sample) or counted
+- discarded: list of finite numbers, each >FACTORx off the kept median
+  is not re-checked here (the factor is a bench flag), but discarded
+  samples must not also appear in samples
+- run_attempts (optional): int >= 2
+- *_FAILED lines: error message plus attempts and failure_class
+  ("retryable" | "fatal")
+
+Exit status: 0 clean, 1 any error (loud, listed on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from statistics import median
+
+LEGACY_KEYS = ("samples", "attempts", "discarded")
+
+
+def iter_metric_lines(path: str):
+    """Yield (lineno_label, dict) metric objects from ``path``."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:      # driver artifact
+        src = doc["tail"].splitlines()
+        label = "tail line"
+    elif isinstance(doc, dict) and "metric" in doc:  # one bare object
+        yield "object", doc
+        return
+    else:                                            # raw JSONL
+        src = text.splitlines()
+        label = "line"
+    for i, line in enumerate(src, 1):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            yield f"{label} {i}", {"_unparseable": line[:120]}
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            yield f"{label} {i}", obj
+
+
+def _is_num(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool) \
+        and x == x and abs(x) != float("inf")
+
+
+def check_line(obj: dict, *, legacy_ok: bool):
+    """Returns (errors, warnings) string lists for one metric line."""
+    errs, warns = [], []
+    if "_unparseable" in obj:
+        return [f"unparseable JSON: {obj['_unparseable']}"], []
+    name = obj.get("metric", "?")
+
+    if name.endswith("_FAILED"):
+        if not obj.get("error"):
+            errs.append(f"{name}: failure line without an 'error'")
+        missing = [k for k in ("attempts", "failure_class")
+                   if k not in obj]
+        if missing:
+            (warns if legacy_ok else errs).append(
+                f"{name}: failure line missing {missing}")
+        elif obj["failure_class"] not in ("retryable", "fatal"):
+            errs.append(f"{name}: failure_class="
+                        f"{obj['failure_class']!r} not retryable|fatal")
+        return errs, warns
+
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        if k not in obj:
+            errs.append(f"{name}: missing required key {k!r}")
+    if "value" in obj and not _is_num(obj["value"]):
+        errs.append(f"{name}: non-finite value {obj['value']!r}")
+
+    missing = [k for k in LEGACY_KEYS if k not in obj]
+    if missing:
+        msg = (f"{name}: missing resilience metadata {missing} "
+               f"(pre-round-6 schema)")
+        (warns if legacy_ok else errs).append(msg)
+
+    samples = obj.get("samples")
+    if samples is not None:
+        if (not isinstance(samples, list) or not samples
+                or not all(_is_num(s) for s in samples)):
+            errs.append(f"{name}: samples must be a non-empty list "
+                        f"of finite numbers, got {samples!r}")
+            samples = None
+    if samples and _is_num(obj.get("value")):
+        m = median(samples)
+        # value = round(median(raw), 4) while samples are rounded
+        # individually: the two medians agree to ~1e-4
+        if abs(obj["value"] - m) > 2e-4:
+            errs.append(f"{name}: value {obj['value']} is not the "
+                        f"median of samples ({m:.4f}) — collapsed "
+                        f"sample silently medianed?")
+
+    discarded = obj.get("discarded")
+    if discarded is not None:
+        if (not isinstance(discarded, list)
+                or not all(_is_num(d) for d in discarded)):
+            errs.append(f"{name}: discarded must be a list of finite "
+                        f"numbers, got {discarded!r}")
+            discarded = None
+    if samples and discarded:
+        # a kept sample equal to a discarded one is a contradiction
+        # (discards are >FACTORx off the median the keeps define) —
+        # it means a discarded collapse was ALSO medianed
+        overlap = sorted(set(samples) & set(discarded))
+        if overlap:
+            errs.append(f"{name}: {overlap} appear in both samples "
+                        f"and discarded — discarded sample medianed")
+
+    attempts = obj.get("attempts")
+    if attempts is not None:
+        if not isinstance(attempts, int) or attempts < 1:
+            errs.append(f"{name}: attempts must be a positive int, "
+                        f"got {attempts!r}")
+        elif samples is not None and discarded is not None:
+            want = len(samples) + len(discarded)
+            if attempts != want:
+                errs.append(
+                    f"{name}: attempts={attempts} inconsistent with "
+                    f"{len(samples)} samples + {len(discarded)} "
+                    f"discarded (= {want})")
+
+    ra = obj.get("run_attempts")
+    if ra is not None and (not isinstance(ra, int) or ra < 2):
+        errs.append(f"{name}: run_attempts={ra!r} (recorded only "
+                    f"when >= 2)")
+    return errs, warns
+
+
+def check_file(path: str, *, legacy_ok: bool):
+    errs, warns, n = [], [], 0
+    try:
+        lines = list(iter_metric_lines(path))
+    except (OSError, UnicodeDecodeError) as e:
+        return [f"{path}: unreadable ({e})"], [], 0
+    if not lines:
+        return [f"{path}: no metric lines found"], [], 0
+    for where, obj in lines:
+        n += 1
+        e, w = check_line(obj, legacy_ok=legacy_ok)
+        errs += [f"{path} ({where}): {m}" for m in e]
+        warns += [f"{path} ({where}): {m}" for m in w]
+    return errs, warns, n
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate bench metric lines against the "
+                    "round-6 resilience schema")
+    ap.add_argument("files", nargs="+", metavar="FILE")
+    ap.add_argument("-legacy-ok", action="store_true",
+                    dest="legacy_ok",
+                    help="downgrade pre-round-6 metadata gaps "
+                         "(missing samples/attempts/discarded) to "
+                         "warnings — for auditing historical "
+                         "BENCH_r01-05 artifacts")
+    args = ap.parse_args(argv)
+
+    total_errs, total = [], 0
+    for path in args.files:
+        errs, warns, n = check_file(path, legacy_ok=args.legacy_ok)
+        total += n
+        total_errs += errs
+        for w in warns:
+            print(f"WARNING: {w}", file=sys.stderr)
+    for e in total_errs:
+        print(f"ERROR: {e}", file=sys.stderr)
+    if total_errs:
+        print(f"check_bench: {len(total_errs)} error(s) over {total} "
+              f"metric line(s) — the bench schema audit FAILED",
+              file=sys.stderr)
+        return 1
+    print(f"check_bench: {total} metric line(s) OK "
+          f"({len(args.files)} file(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""scripts/check_bench.py: bench metric-line schema audit.
+
+Fast CPU checks: the historical BENCH_r01-05 artifacts audit clean
+under -legacy-ok (and fail loudly without it — they predate the
+round-6 attempts/discarded metadata), and synthetic good/bad
+new-schema lines pass/fail as designed.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "check_bench.py"
+ARTIFACTS = sorted(REPO.glob("BENCH_r0*.json"))
+
+GOOD_LINE = {
+    "metric": "pagerank_mp_rmat23_gteps_per_chip",
+    "value": 0.1118, "unit": "GTEPS", "vs_baseline": 0.1118,
+    "samples": [0.1116, 0.1118, 0.112],
+    "attempts": 4, "discarded": [0.0107], "np": 4,
+}
+
+
+def run_check(*argv):
+    return subprocess.run(
+        [sys.executable, str(SCRIPT), *map(str, argv)],
+        capture_output=True, text=True)
+
+
+def test_current_artifacts_audit_clean_as_legacy():
+    assert ARTIFACTS, "no BENCH_r*.json artifacts in the repo root"
+    r = run_check("-legacy-ok", *ARTIFACTS)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+
+
+def test_current_artifacts_fail_strict_schema():
+    """Pre-round-6 lines lack attempts/discarded; the default (strict)
+    mode must fail LOUDLY, naming the missing metadata."""
+    r = run_check(*ARTIFACTS)
+    assert r.returncode == 1
+    assert "missing resilience metadata" in r.stderr
+    assert "FAILED" in r.stderr
+
+
+def test_good_new_schema_line_passes(tmp_path):
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(GOOD_LINE) + "\n")
+    r = run_check(p)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda d: d.pop("attempts"), "missing resilience metadata"),
+    (lambda d: d.update(attempts=9), "inconsistent"),
+    (lambda d: d.update(value=0.0107), "not the median"),
+    (lambda d: d.update(samples=[]), "non-empty list"),
+    (lambda d: d.pop("value"), "missing required key"),
+    (lambda d: d.update(run_attempts=1), "run_attempts"),
+    (lambda d: d.update(samples=[0.1116, 0.1118, 0.0107],
+                        value=0.1116, attempts=4),
+     "both samples and discarded"),
+])
+def test_bad_lines_fail(tmp_path, mutate, needle):
+    d = dict(GOOD_LINE)
+    mutate(d)
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert needle in r.stderr
+
+
+def test_failed_config_line_schema(tmp_path):
+    good = {"metric": "sssp_FAILED", "error": "RuntimeError: worker",
+            "attempts": 3, "failure_class": "retryable"}
+    bad = {"metric": "sssp_FAILED", "error": "RuntimeError: worker"}
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(good) + "\n")
+    assert run_check(p).returncode == 0
+    p.write_text(json.dumps(bad) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1 and "failure line missing" in r.stderr
+    # legacy mode tolerates it (historical crash lines)
+    assert run_check("-legacy-ok", p).returncode == 0
+
+
+def test_unparseable_and_empty_inputs(tmp_path):
+    p = tmp_path / "junk.jsonl"
+    p.write_text('{"metric": broken\n')
+    r = run_check(p)
+    assert r.returncode == 1 and "unparseable" in r.stderr
+    p.write_text("nothing here\n")
+    r = run_check(p)
+    assert r.returncode == 1 and "no metric lines" in r.stderr

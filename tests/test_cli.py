@@ -39,6 +39,34 @@ def test_pagerank_cli(lux_file, capsys):
     assert "[PASS]" in out
 
 
+def test_pagerank_cli_supervised_resume(lux_file, tmp_path, capsys):
+    """-retries/-seg-budget/-resume run the supervised path
+    (lux_tpu/resilience.py) and a second invocation resumes from the
+    checkpoint instead of recomputing."""
+    ck = str(tmp_path / "pr.ckpt.npz")
+    rc = cli.main(["pagerank", "-file", lux_file, "-ni", "6", "-np", "2",
+                   "-retries", "1", "-seg-budget", "30",
+                   "-resume", ck, "-check"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "[PASS]" in out
+    assert "# supervisor: attempts=1" in out
+    import os
+    assert os.path.exists(ck)
+    rc = cli.main(["pagerank", "-file", lux_file, "-ni", "6", "-np", "2",
+                   "-resume", ck, "-check"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "[PASS]" in out
+    assert "resumed_from=[6]" in out
+
+
+def test_sssp_cli_supervised(lux_file, capsys):
+    rc = cli.main(["sssp", "-file", lux_file, "-start", "1",
+                   "-retries", "1", "-seg-budget", "30", "-check"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "[PASS]" in out
+    assert "# supervisor:" in out
+
+
 def test_sssp_cli(lux_file, capsys):
     rc = cli.main(["sssp", "-file", lux_file, "-start", "1", "-check"])
     out = capsys.readouterr().out
