@@ -19,6 +19,18 @@ gains, so every config runs the TIMED REGION ``-repeats`` times
 (default 3; build/compile excluded) and reports the MEDIAN, with the
 per-repeat samples recorded in the JSON line.
 
+Telemetry (round 7, lux_tpu/telemetry.py): every config runs inside a
+telemetry scope, and each metric line carries a ``telemetry`` field:
+``runs`` (per-timed-run seconds + iteration counts, straight from the
+``timed_run`` events — the per-sample decomposition that makes tunnel
+variance auditable) and ``counters`` (the device-side per-iteration
+counter digest when ``-iter-stats`` is on; null otherwise — counters
+run a separate compiled variant of the loop, so they are opt-in for
+the headline numbers).  ``-events FILE`` additionally appends the raw
+event JSONL (rendered by scripts/events_summary.py);
+scripts/check_bench.py validates the telemetry field against samples
+and attempts.
+
 Resilience (round 6, lux_tpu/resilience.py): each config runs under
 the supervisor — transient failures (worker death, tunnel drops)
 retry with backoff up to ``-retries`` times, deterministic ones (OOM,
@@ -236,15 +248,19 @@ def run_config(config, args):
         samples, rerun = bench_converge(eng, g.ne, args.verbose,
                                         args.repeats)
         name = f"{config.replace('-', '_')}_rmat{scale}"
+    # ne as it RAN (post-symmetrize for cc): lets check_bench re-derive
+    # each sample from the telemetry runs' (iters, seconds)
+    extra["ne"] = int(g.ne)
     return (name, [s / 1e9 for s in samples], extra,
             lambda: rerun() / 1e9)
 
 
-def emit(name, samples, extra, attempts=None, discarded=()):
+def emit(name, samples, extra, attempts=None, discarded=(),
+         telemetry=None):
     """One JSON metric line.  attempts = total timed runs (originals
     + outlier reruns); discarded = samples thrown out by the >3x rule
-    — recorded, never silently medianed (scripts/check_bench.py
-    validates the schema)."""
+    — recorded, never silently medianed; telemetry = per-run seconds
+    + counter digest (scripts/check_bench.py validates the schema)."""
     gteps = median(samples)
     result = {
         "metric": f"{name}_gteps_per_chip",
@@ -254,9 +270,23 @@ def emit(name, samples, extra, attempts=None, discarded=()):
         "samples": [round(s, 4) for s in samples],
         "attempts": len(samples) if attempts is None else attempts,
         "discarded": [round(d, 4) for d in discarded],
+        **({"telemetry": telemetry} if telemetry is not None else {}),
         **extra,
     }
     print(json.dumps(result), flush=True)
+
+
+def config_telemetry(events, start_idx, iter_stats):
+    """The metric line's ``telemetry`` field for one config: the
+    ``timed_run`` events emitted since ``start_idx`` (one per timed
+    repeat, outlier reruns included) plus the counter digest."""
+    runs = [{"repeat": ev["repeat"], "iters": ev["iters"],
+             "seconds": ev["seconds"]}
+            for ev in events.events[start_idx:]
+            if ev["kind"] == "timed_run"]
+    return {"runs": runs,
+            "counters": (iter_stats.summary()
+                         if iter_stats is not None else None)}
 
 
 def main() -> int:
@@ -302,6 +332,20 @@ def main() -> int:
                          "F x off the batch median are discarded, "
                          "re-run once, and recorded in 'discarded' "
                          "(VERDICT r5 #7; 0 disables)")
+    ap.add_argument("-events", default=None, metavar="FILE",
+                    help="append the run's structured telemetry "
+                         "events as JSONL to FILE "
+                         "(scripts/events_summary.py renders it); "
+                         "the per-config 'telemetry' JSON field is "
+                         "recorded regardless")
+    ap.add_argument("-iter-stats", action="store_true",
+                    dest="iter_stats",
+                    help="record device-side per-iteration counters "
+                         "and put their digest in each line's "
+                         "telemetry.counters — runs the engines' "
+                         "counter-recording loop variant, so keep it "
+                         "OFF for headline numbers (overhead is "
+                         "within tunnel noise, PERF_NOTES round 7)")
     ap.add_argument("-verbose", action="store_true")
     args = ap.parse_args()
     if args.repeats < 1:
@@ -309,51 +353,69 @@ def main() -> int:
     if args.min_fill is not None and args.min_fill <= 0:
         args.min_fill = None
 
-    from lux_tpu import resilience
+    from lux_tpu import resilience, telemetry
 
     configs = ([args.config] if args.config and not args.all
                else ["cc", "sssp", "sssp-delta", "colfilter",
                      "sssp-mp", "pagerank-mp", "pagerank"])
     failures = 0
+    # one event log for the whole bench run (in-memory always — the
+    # timed_run events are the per-config telemetry field; -events
+    # additionally streams them to disk as JSONL)
+    events = telemetry.EventLog(args.events)
     for config in configs:
         report = resilience.RunReport()
         policy = resilience.RetryPolicy(retries=max(0, args.retries),
                                         backoff_s=args.backoff)
-        try:
-            # supervised: a transient worker crash retries the whole
-            # config (fresh graph+engine — exactly what a dead worker
-            # needs) with backoff; fatal classes surface immediately
-            (name, samples, extra, rerun), report = resilience.supervise(
-                lambda k: run_config(config, args), policy, report)
+        st = telemetry.IterStats() if args.iter_stats else None
+        events.emit("config_start", config=config,
+                    schema=telemetry.SCHEMA)
+        idx0 = len(events.events)
+        with telemetry.use(events=events, iter_stats=st):
             try:
-                samples, discarded, attempts = resilience.screen_outliers(
-                    samples, rerun, factor=args.outlier)
-            except Exception as e:  # noqa: BLE001 — rerun crashed
-                # a crash during an outlier RERUN must not void the
-                # already-measured batch: screen without the rerun
-                # (the discard still drops the collapse) and record
-                # what happened
-                samples, discarded, attempts = resilience.screen_outliers(
-                    samples, None, factor=args.outlier)
-                extra = dict(
-                    extra,
-                    rerun_error=f"{type(e).__name__}: {e}"[:200],
-                    rerun_error_class=resilience.classify(e))
-        except Exception as e:   # noqa: BLE001 — one config's crash
-            # (e.g. a TPU-worker restart, PERF_NOTES round-5 duration
-            # wall) must not take down the remaining configs or the
-            # tail-line headline metric the driver records
-            failures += 1
-            print(json.dumps({"metric": f"{config}_FAILED",
-                              "error": f"{type(e).__name__}: {e}"[:300],
-                              "attempts": report.attempts,
-                              "failure_class": resilience.classify(e)}),
-                  flush=True)
-            continue
+                # supervised: a transient worker crash retries the
+                # whole config (fresh graph+engine — exactly what a
+                # dead worker needs) with backoff; fatal classes
+                # surface immediately
+                (name, samples, extra, rerun), report = \
+                    resilience.supervise(
+                        lambda k: run_config(config, args), policy,
+                        report)
+                try:
+                    samples, discarded, attempts = \
+                        resilience.screen_outliers(
+                            samples, rerun, factor=args.outlier)
+                except Exception as e:  # noqa: BLE001 — rerun crashed
+                    # a crash during an outlier RERUN must not void
+                    # the already-measured batch: screen without the
+                    # rerun (the discard still drops the collapse)
+                    # and record what happened
+                    samples, discarded, attempts = \
+                        resilience.screen_outliers(
+                            samples, None, factor=args.outlier)
+                    extra = dict(
+                        extra,
+                        rerun_error=f"{type(e).__name__}: {e}"[:200],
+                        rerun_error_class=resilience.classify(e))
+            except Exception as e:  # noqa: BLE001 — one config's crash
+                # (e.g. a TPU-worker restart, PERF_NOTES round-5
+                # duration wall) must not take down the remaining
+                # configs or the tail-line headline metric the driver
+                # records
+                failures += 1
+                print(json.dumps(
+                    {"metric": f"{config}_FAILED",
+                     "error": f"{type(e).__name__}: {e}"[:300],
+                     "attempts": report.attempts,
+                     "failure_class": resilience.classify(e)}),
+                    flush=True)
+                continue
         if report.attempts > 1:
             extra = dict(extra, run_attempts=report.attempts)
         emit(name, samples, extra, attempts=attempts,
-             discarded=discarded)
+             discarded=discarded,
+             telemetry=config_telemetry(events, idx0, st))
+    events.close()
     return 1 if failures == len(configs) else 0
 
 

@@ -51,7 +51,7 @@ def make_program(k: int = K, lam: float = LAMBDA,
     return PullProgram(reduce="sum", edge_value=edge_value, apply=apply,
                        init=init, needs_dst=True,
                        edge_value_from_dot=edge_value_from_dot,
-                       state_bytes=4 * k)
+                       state_bytes=4 * k, name="colfilter")
 
 
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,

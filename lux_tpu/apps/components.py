@@ -27,7 +27,8 @@ def make_program() -> PushProgram:
         return sg.to_padded(labels), sg.to_padded(active)
 
     return PushProgram(reduce="max", relax=relax,
-                       identity=np.int32(-1), init=init)
+                       identity=np.int32(-1), init=init,
+                       name="components")
 
 
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,

@@ -43,7 +43,8 @@ def make_program(dtype=jnp.float32) -> PullProgram:
 
     return PullProgram(reduce="sum", edge_value=edge_value, apply=apply,
                        init=init, needs_dst=False,
-                       state_bytes=np.dtype(dtype).itemsize)
+                       state_bytes=np.dtype(dtype).itemsize,
+                       name="pagerank")
 
 
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,

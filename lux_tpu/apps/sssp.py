@@ -53,7 +53,7 @@ def make_program(start_vertex: int, weighted: bool = False) -> PushProgram:
         return sg.to_padded(dist), sg.to_padded(active)
 
     return PushProgram(reduce="min", relax=relax, identity=identity,
-                       init=init)
+                       init=init, name="sssp")
 
 
 def default_delta(g: Graph) -> float:

@@ -90,6 +90,24 @@ def _check_leaves(path, expect, leaves):
                 f"graph/scale?")
 
 
+def _timed_save(path, state, meta):
+    """save() wrapped in a profiler annotation + telemetry event (the
+    full-state fetch a checkpoint costs is worth seeing by name in
+    traces and event logs)."""
+    import time
+
+    from lux_tpu import telemetry
+    from lux_tpu.profiling import annotation
+
+    t0 = time.perf_counter()
+    with annotation("lux_checkpoint_save"):
+        save(path, state, meta)
+    telemetry.current().emit(
+        "checkpoint_save", iter=int(meta.get("iter", 0)),
+        engine=meta.get("kind"), path=path,
+        seconds=round(time.perf_counter() - t0, 6))
+
+
 def run_checkpointed(eng, state, num_iters: int, path: str,
                      segment=50, start_iter: int = 0,
                      resume: bool = False, on_segment=None):
@@ -108,6 +126,8 @@ def run_checkpointed(eng, state, num_iters: int, path: str,
 
     from lux_tpu.segmented import run_segments
 
+    from lux_tpu import telemetry
+
     if resume and os.path.exists(path):
         leaves, meta = load(path)
         treedef = jax.tree.structure(state)
@@ -118,6 +138,8 @@ def run_checkpointed(eng, state, num_iters: int, path: str,
         _check_leaves(path, jax.tree.leaves(state), leaves)
         state = eng.place(jax.tree.unflatten(treedef, leaves))
         start_iter = int(meta["iter"])
+        telemetry.current().emit("checkpoint_resume", engine="pull",
+                                 iter=start_iter, path=path)
 
     def seg_hook(s, done):
         out = None
@@ -125,7 +147,7 @@ def run_checkpointed(eng, state, num_iters: int, path: str,
             res = on_segment(s, done)
             if res is not None:
                 s = out = res
-        save(path, (s,), {"iter": done, "kind": "pull"})
+        _timed_save(path, (s,), {"iter": done, "kind": "pull"})
         return out
 
     return run_segments(eng, state, num_iters, segment,
@@ -142,6 +164,7 @@ def converge_checkpointed(eng, path: str, segment=50,
     total, cnt)`` runs BEFORE each save, with the same raise/replace
     contract as run_checkpointed.  Returns
     (labels, active, total_iters)."""
+    from lux_tpu import telemetry
     from lux_tpu.segmented import converge_segments
 
     if resume and os.path.exists(path):
@@ -159,6 +182,8 @@ def converge_checkpointed(eng, path: str, segment=50,
             _check_leaves(path, expect, leaves)
         label, active = eng.place(*leaves)
         done = int(meta["iter"])
+        telemetry.current().emit("checkpoint_resume", engine="push",
+                                 iter=done, path=path)
     else:
         label, active = eng.init_state()
         done = 0
@@ -170,7 +195,7 @@ def converge_checkpointed(eng, path: str, segment=50,
             if res is not None:
                 lbl, act = res
                 out = res
-        save(path, (lbl, act), {"iter": total, "kind": "push"})
+        _timed_save(path, (lbl, act), {"iter": total, "kind": "push"})
         return out
 
     return converge_segments(

@@ -20,6 +20,13 @@ Flags (reference names kept):
                 the ~55 s tunnel wall, PERF_NOTES round 5)
   -resume CKPT  checkpoint path to save to / resume from
                 (all three: lux_tpu/resilience.py)
+  -events FILE  append structured JSONL telemetry events (header with
+                graph shape + HBM estimate, per-run/segment timings,
+                retries, checkpoints; lux_tpu/telemetry.py)
+  -iter-stats   device-side per-iteration counters accumulated INSIDE
+                the fused loop (push: frontier/edges, pull: residual/
+                changed), replayed after the run — works on the fused
+                AND the supervised/segmented paths
 
 Timing methodology matches the reference: wall clock around the
 iteration loop only, printed as ``ELAPSED TIME = ... s`` plus GTEPS
@@ -29,6 +36,7 @@ iteration loop only, printed as ``ELAPSED TIME = ... s`` plus GTEPS
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 import time
 
@@ -99,6 +107,24 @@ def _common(ap: argparse.ArgumentParser):
                          "temporary file for in-run crash recovery "
                          "only).  Supervised timing includes segment "
                          "checkpoint saves")
+    ap.add_argument("-events", default=None, metavar="FILE",
+                    help="append structured telemetry events to FILE "
+                         "as JSONL (one object per line; schema in "
+                         "lux_tpu/telemetry.py, rendered by "
+                         "scripts/events_summary.py): graph header "
+                         "with the HBM estimate, timed-run/segment "
+                         "seconds, classified retries, checkpoint "
+                         "saves/resumes")
+    ap.add_argument("-iter-stats", action="store_true",
+                    dest="iter_stats",
+                    help="record device-side per-iteration counters "
+                         "inside the fused loop (push: frontier size "
+                         "+ edges relaxed; pull: residual + changed "
+                         "vertices) and replay them after the run — "
+                         "unlike the old stepwise -verbose this "
+                         "neither changes the timed path's shape nor "
+                         "adds host syncs, and it composes with "
+                         "-retries/-seg-budget segment runs")
     ap.add_argument("-phases", type=int, default=0, metavar="N",
                     help="after the timed run, run N instrumented "
                          "iterations and print the per-iteration "
@@ -136,16 +162,65 @@ def _mesh_and_parts(args):
     return mesh, num_parts
 
 
-def _print_phases(report):
+def _print_phases(report, tel=None):
     """Per-iteration phase table — the analogue of the reference's
     -verbose per-iteration loadTime/compTime/updateTime prints
-    (reference sssp_gpu.cu:513-518)."""
+    (reference sssp_gpu.cu:513-518).  With a telemetry handle the
+    table also lands in the event log as one ``phases`` event, which
+    scripts/events_summary.py renders back into the reference-style
+    table."""
     META = ("frontier", "bucket", "advances")   # counters, not times
     for i, t in enumerate(report):
         extra = "".join(f" {k}={t[k]:g}" for k in META if k in t)
         split = "  ".join(f"{k}={v * 1e3:7.2f}ms" for k, v in t.items()
                           if k not in META)
         print(f"iter {i}:{extra}  {split}")
+    if tel is not None:
+        tel.emit("phases", iters=len(report),
+                 report=[{k: (v if k in META else round(v, 6))
+                          for k, v in t.items()} for t in report])
+
+
+@contextlib.contextmanager
+def _telemetry(args, app):
+    """Scope the run's telemetry sinks (lux_tpu/telemetry.py) from
+    -events / -iter-stats.  Without either flag this is the null
+    handle and every emit stays a no-op; engines keep building their
+    counter-free programs."""
+    from lux_tpu import telemetry
+
+    if not (args.events or args.iter_stats):
+        yield telemetry.current()
+        return
+    ev = telemetry.EventLog(args.events) if args.events else None
+    st = telemetry.IterStats() if args.iter_stats else None
+    try:
+        with telemetry.use(events=ev, iter_stats=st) as tel:
+            tel.emit("run_start", schema=telemetry.SCHEMA, app=app,
+                     file=args.file, mesh=args.mesh,
+                     np=args.np or None)
+            yield tel
+    finally:
+        if ev is not None:
+            ev.close()
+
+
+def _finish_run(tel, elapsed, iters):
+    """Close out one timed run: emit the ``run_done`` event
+    (scripts/events_summary.py checks segment seconds against it) and
+    replay the device-side per-iteration counters when -iter-stats
+    recorded them — the exact series the old stepwise -verbose path
+    printed, now read from the fused run's buffers."""
+    tel.emit("run_done", seconds=round(elapsed, 6), iters=iters)
+    st = tel.iter_stats
+    if st is None or st.kind is None:
+        return
+    print("# iter-stats (device-side counters, fused run):")
+    for line in st.replay_lines():
+        print(line)
+    # the digest's "kind" (push|pull) would shadow the event kind
+    tel.emit("iter_stats", **{("engine" if k == "kind" else k): v
+                              for k, v in st.summary().items()})
 
 
 def _warn_exchange_ignored(args):
@@ -171,7 +246,9 @@ def _supervisor_opts(args, app):
         print("note: -profile is ignored on the supervised path "
               "(segments are separate XLA executions)")
     if getattr(args, "verbose", False):
-        print("note: -verbose is ignored on the supervised path")
+        print("note: -verbose is ignored on the supervised path; "
+              "-iter-stats records per-iteration counters across "
+              "segments instead")
     # pid-qualified: concurrent runs must not clobber (or worse,
     # cross-resume) each other's in-run recovery checkpoints
     path = args.resume or os.path.join(
@@ -242,6 +319,9 @@ def _build_sg(args, g, num_parts, starts=None):
 
     sg = ShardedGraph.build(g, num_parts, starts=starts,
                             pair_threshold=getattr(args, "pair", None))
+    from lux_tpu import telemetry
+    telemetry.current().emit("header", schema=telemetry.SCHEMA,
+                             **sg.telemetry_header())
     if args.verbose:
         rep = sg.memory_report()
         print(f"memory: {rep['total_bytes'] / 1e6:.1f} MB total over "
@@ -267,54 +347,60 @@ def cmd_pagerank(argv):
 
     from lux_tpu.apps import pagerank
 
-    g = _load(args, weighted=False)
-    mesh, num_parts = _mesh_and_parts(args)
-    g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
-    sg = _build_sg(args, g_run, num_parts, starts)
-    eng = pagerank.build_engine(g_run, num_parts, mesh, sg=sg,
-                                pair_threshold=args.pair,
-                                pair_min_fill=args.min_fill,
-                                exchange=args.exchange)
-    if args.tol is not None:
-        if args.retries > 0 or args.seg_budget > 0 or args.resume:
-            print("note: -tol runs one monolithic convergence "
-                  "program; -retries/-seg-budget/-resume apply to "
-                  "fixed -ni runs only and are ignored here")
-        from lux_tpu.timing import timed_run_until
-        state, iters, res, elapsed = timed_run_until(
-            eng, args.tol, args.max_iters, trace_dir=args.profile)
-        print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations, "
-              f"residual {res:.3e})")
-        print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
-    else:
-        sup = _supervisor_opts(args, "pagerank")
-        if sup is not None:
-            state, _total, elapsed, ni, mark = _run_supervised(
-                eng, sup, args, ni=args.ni)
+    with _telemetry(args, "pagerank") as tel:
+        g = _load(args, weighted=False)
+        mesh, num_parts = _mesh_and_parts(args)
+        g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
+        sg = _build_sg(args, g_run, num_parts, starts)
+        eng = pagerank.build_engine(g_run, num_parts, mesh, sg=sg,
+                                    pair_threshold=args.pair,
+                                    pair_min_fill=args.min_fill,
+                                    exchange=args.exchange)
+        if args.tol is not None:
+            if args.retries > 0 or args.seg_budget > 0 or args.resume:
+                print("note: -tol runs one monolithic convergence "
+                      "program; -retries/-seg-budget/-resume apply to "
+                      "fixed -ni runs only and are ignored here")
+            from lux_tpu.timing import timed_run_until
+            state, iters, res, elapsed = timed_run_until(
+                eng, args.tol, args.max_iters, trace_dir=args.profile)
+            print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations, "
+                  f"residual {res:.3e})")
+            print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
+            _finish_run(tel, elapsed, iters)
         else:
-            state, [elapsed] = timed_fused_run(eng, args.ni,
-                                               trace_dir=args.profile)
-            ni, mark = args.ni, ""
-        print(f"ELAPSED TIME = {elapsed:.7f} s")
-        if ni > 0:
-            print(f"GTEPS = {g.ne * ni / elapsed / 1e9:.4f}{mark}")
-        else:
-            print("GTEPS = n/a (run already complete in checkpoint)")
+            sup = _supervisor_opts(args, "pagerank")
+            if sup is not None:
+                state, total, elapsed, ni, mark = _run_supervised(
+                    eng, sup, args, ni=args.ni)
+            else:
+                state, [elapsed] = timed_fused_run(
+                    eng, args.ni, trace_dir=args.profile)
+                total = ni = args.ni
+                mark = ""
+            print(f"ELAPSED TIME = {elapsed:.7f} s")
+            if ni > 0:
+                print(f"GTEPS = {g.ne * ni / elapsed / 1e9:.4f}{mark}")
+            else:
+                print("GTEPS = n/a (run already complete in checkpoint)")
+            _finish_run(tel, elapsed, total)
 
-    if args.phases:
-        _state, rep = eng.timed_phases(eng.init_state(), args.phases)
-        _print_phases(rep)
-    if args.check:
-        # On-device sharded audit over the resident edge arrays (the
-        # reference's per-part GPU check tasks, sssp_gpu.cu:800-843);
-        # runs at any scale, no host edge-list rebuild.  NOTE: audits
-        # the FULL sg built above, not eng.sg (pair-lane engines keep
-        # only the residual edges there).  The residual is
-        # permutation-invariant, so no -pair un-relabel is needed.
-        from lux_tpu.device_check import check_pagerank_device
-        res = check_pagerank_device(sg, state, tol=1e-3, mesh=eng.mesh)
-        print(res)
-        return 0 if res.ok else 1
+        if args.phases:
+            _state, rep = eng.timed_phases(eng.init_state(), args.phases)
+            _print_phases(rep, tel)
+        if args.check:
+            # On-device sharded audit over the resident edge arrays
+            # (the reference's per-part GPU check tasks,
+            # sssp_gpu.cu:800-843); runs at any scale, no host
+            # edge-list rebuild.  NOTE: audits the FULL sg built
+            # above, not eng.sg (pair-lane engines keep only the
+            # residual edges there).  The residual is
+            # permutation-invariant, so no -pair un-relabel is needed.
+            from lux_tpu.device_check import check_pagerank_device
+            res = check_pagerank_device(sg, state, tol=1e-3,
+                                        mesh=eng.mesh)
+            print(res)
+            return 0 if res.ok else 1
     return 0
 
 
@@ -332,65 +418,67 @@ def _push_app(argv, prog_name):
     from lux_tpu.apps import components, sssp
 
     weighted = prog_name == "sssp" and args.weighted
-    g = _load(args, weighted=weighted)
-    mesh, num_parts = _mesh_and_parts(args)
-    g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
-    sg = _build_sg(args, g_run, num_parts, starts)
-    start = args.start if prog_name == "sssp" else None
-    if perm is not None and start is not None:
-        rank = np.empty(g.nv, np.int64)
-        rank[perm] = np.arange(g.nv)
-        start = int(rank[start])
-    if prog_name == "sssp":
-        delta = args.delta
-        if delta is not None and delta != "auto":
-            delta = float(delta)
-        eng = sssp.build_engine(g_run, start_vertex=start,
-                                num_parts=num_parts, mesh=mesh,
-                                weighted=weighted, delta=delta, sg=sg,
-                                pair_threshold=args.pair,
-                        pair_min_fill=args.min_fill,
-                                exchange=args.exchange,
-                                enable_sparse=bool(args.sparse))
-    else:
-        eng = components.build_engine(g_run, num_parts=num_parts,
-                                      mesh=mesh, sg=sg,
-                                      pair_threshold=args.pair,
-                                      pair_min_fill=args.min_fill,
-                                      exchange=args.exchange,
-                                      enable_sparse=bool(args.sparse))
-    sup = _supervisor_opts(args, prog_name)
-    if sup is not None:
-        labels, iters, elapsed, it_exec, mark = _run_supervised(
-            eng, sup, args)
-    else:
-        labels, iters, [elapsed] = timed_converge(
-            eng, verbose=args.verbose, trace_dir=args.profile)
-        it_exec, mark = iters, ""
-    print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
-    if it_exec > 0:
-        print(f"GTEPS = {g.ne * it_exec / elapsed / 1e9:.4f}{mark}")
-    else:
-        print("GTEPS = n/a (run already complete in checkpoint)")
-
-    if args.phases:
-        lab0, act0 = eng.init_state()
-        _l, _a, rep = eng.timed_phases(lab0, act0, args.phases)
-        _print_phases(rep)
-    if args.check:
-        # On-device per-part audits (reference sssp_gpu.cu:800-843,
-        # components_gpu.cu:788); labels are in g_run order, which is
-        # exactly sg's order — the fixed-point properties are
-        # permutation-invariant, so no -pair un-relabel is needed.
-        from lux_tpu import device_check
+    with _telemetry(args, prog_name) as tel:
+        g = _load(args, weighted=weighted)
+        mesh, num_parts = _mesh_and_parts(args)
+        g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
+        sg = _build_sg(args, g_run, num_parts, starts)
+        start = args.start if prog_name == "sssp" else None
+        if perm is not None and start is not None:
+            rank = np.empty(g.nv, np.int64)
+            rank[perm] = np.arange(g.nv)
+            start = int(rank[start])
         if prog_name == "sssp":
-            res = device_check.check_sssp_device(
-                sg, labels, weighted=weighted, mesh=eng.mesh)
+            delta = args.delta
+            if delta is not None and delta != "auto":
+                delta = float(delta)
+            eng = sssp.build_engine(g_run, start_vertex=start,
+                                    num_parts=num_parts, mesh=mesh,
+                                    weighted=weighted, delta=delta,
+                                    sg=sg, pair_threshold=args.pair,
+                                    pair_min_fill=args.min_fill,
+                                    exchange=args.exchange,
+                                    enable_sparse=bool(args.sparse))
         else:
-            res = device_check.check_components_device(
-                sg, labels, mesh=eng.mesh)
-        print(res)
-        return 0 if res.ok else 1
+            eng = components.build_engine(g_run, num_parts=num_parts,
+                                          mesh=mesh, sg=sg,
+                                          pair_threshold=args.pair,
+                                          pair_min_fill=args.min_fill,
+                                          exchange=args.exchange,
+                                          enable_sparse=bool(args.sparse))
+        sup = _supervisor_opts(args, prog_name)
+        if sup is not None:
+            labels, iters, elapsed, it_exec, mark = _run_supervised(
+                eng, sup, args)
+        else:
+            labels, iters, [elapsed] = timed_converge(
+                eng, verbose=args.verbose, trace_dir=args.profile)
+            it_exec, mark = iters, ""
+        print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations)")
+        if it_exec > 0:
+            print(f"GTEPS = {g.ne * it_exec / elapsed / 1e9:.4f}{mark}")
+        else:
+            print("GTEPS = n/a (run already complete in checkpoint)")
+        _finish_run(tel, elapsed, iters)
+
+        if args.phases:
+            lab0, act0 = eng.init_state()
+            _l, _a, rep = eng.timed_phases(lab0, act0, args.phases)
+            _print_phases(rep, tel)
+        if args.check:
+            # On-device per-part audits (reference sssp_gpu.cu:800-843,
+            # components_gpu.cu:788); labels are in g_run order, which
+            # is exactly sg's order — the fixed-point properties are
+            # permutation-invariant, so no -pair un-relabel is needed.
+            from lux_tpu import device_check
+            if prog_name == "sssp":
+                res = device_check.check_sssp_device(
+                    sg, labels, weighted=weighted, mesh=eng.mesh)
+            else:
+                res = device_check.check_components_device(
+                    sg, labels, mesh=eng.mesh)
+            print(res)
+            return 0 if res.ok else 1
     return 0
 
 
@@ -411,38 +499,41 @@ def cmd_colfilter(argv):
     from lux_tpu.apps import colfilter
 
     _warn_exchange_ignored(args)
-    g = _load(args, weighted=True)
-    mesh, num_parts = _mesh_and_parts(args)
-    g_run, _perm, starts = _relabel_for_pairs(args, g, num_parts)
-    sg = _build_sg(args, g_run, num_parts, starts)
-    eng = colfilter.build_engine(g_run, num_parts, mesh, sg=sg,
-                                 pair_threshold=args.pair)
-    sup = _supervisor_opts(args, "colfilter")
-    if sup is not None:
-        state, _total, elapsed, ni, mark = _run_supervised(
-            eng, sup, args, ni=args.ni)
-    else:
-        state, [elapsed] = timed_fused_run(eng, args.ni,
-                                           trace_dir=args.profile)
-        ni, mark = args.ni, ""
-    print(f"ELAPSED TIME = {elapsed:.7f} s")
-    if ni > 0:
-        print(f"GTEPS = {g.ne * ni / elapsed / 1e9:.4f}{mark}")
-    else:
-        print("GTEPS = n/a (run already complete in checkpoint)")
-    out = eng.unpad(state)
-    # out is in the run graph's (possibly relabeled) vertex order;
-    # rmse is computed over edges, so the relabeled graph is the
-    # matching — and equivalent — choice
-    print(f"RMSE = {colfilter.rmse(g_run, out):.6f}")
-    if args.phases:
-        _state, rep = eng.timed_phases(eng.init_state(), args.phases)
-        _print_phases(rep)
-    if args.check:
-        from lux_tpu.device_check import check_colfilter_device
-        res = check_colfilter_device(sg, out, mesh=eng.mesh)
-        print(res)
-        return 0 if res.ok else 1
+    with _telemetry(args, "colfilter") as tel:
+        g = _load(args, weighted=True)
+        mesh, num_parts = _mesh_and_parts(args)
+        g_run, _perm, starts = _relabel_for_pairs(args, g, num_parts)
+        sg = _build_sg(args, g_run, num_parts, starts)
+        eng = colfilter.build_engine(g_run, num_parts, mesh, sg=sg,
+                                     pair_threshold=args.pair)
+        sup = _supervisor_opts(args, "colfilter")
+        if sup is not None:
+            state, total, elapsed, ni, mark = _run_supervised(
+                eng, sup, args, ni=args.ni)
+        else:
+            state, [elapsed] = timed_fused_run(eng, args.ni,
+                                               trace_dir=args.profile)
+            total = ni = args.ni
+            mark = ""
+        print(f"ELAPSED TIME = {elapsed:.7f} s")
+        if ni > 0:
+            print(f"GTEPS = {g.ne * ni / elapsed / 1e9:.4f}{mark}")
+        else:
+            print("GTEPS = n/a (run already complete in checkpoint)")
+        _finish_run(tel, elapsed, total)
+        out = eng.unpad(state)
+        # out is in the run graph's (possibly relabeled) vertex order;
+        # rmse is computed over edges, so the relabeled graph is the
+        # matching — and equivalent — choice
+        print(f"RMSE = {colfilter.rmse(g_run, out):.6f}")
+        if args.phases:
+            _state, rep = eng.timed_phases(eng.init_state(), args.phases)
+            _print_phases(rep, tel)
+        if args.check:
+            from lux_tpu.device_check import check_colfilter_device
+            res = check_colfilter_device(sg, out, mesh=eng.mesh)
+            print(res)
+            return 0 if res.ok else 1
     return 0
 
 

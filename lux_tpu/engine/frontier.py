@@ -40,18 +40,19 @@ def compact_mask(mask, labels, capacity: int):
     position < count.  If count > capacity the queue is truncated —
     callers must branch to the dense path in that case.
     """
-    vpad = mask.shape[0]
-    ranks = jnp.cumsum(mask.astype(jnp.int32))          # 1-based
-    count = ranks[-1]
-    # i-th set bit = first position whose running count reaches i+1;
-    # vectorized binary search over the monotone ranks array.
-    want = jnp.arange(capacity, dtype=jnp.int32) + 1
-    ids = jnp.searchsorted(ranks, want, side="left",
-                           method="scan_unrolled").astype(jnp.int32)
-    valid = want <= count
-    ids = jnp.where(valid, ids, vpad)
-    vals = jnp.take(labels, jnp.minimum(ids, vpad - 1), axis=0)
-    return ids, vals, count
+    with jax.named_scope("lux_sparse_compact"):
+        vpad = mask.shape[0]
+        ranks = jnp.cumsum(mask.astype(jnp.int32))      # 1-based
+        count = ranks[-1]
+        # i-th set bit = first position whose running count reaches
+        # i+1; vectorized binary search over the monotone ranks array.
+        want = jnp.arange(capacity, dtype=jnp.int32) + 1
+        ids = jnp.searchsorted(ranks, want, side="left",
+                               method="scan_unrolled").astype(jnp.int32)
+        valid = want <= count
+        ids = jnp.where(valid, ids, vpad)
+        vals = jnp.take(labels, jnp.minimum(ids, vpad - 1), axis=0)
+        return ids, vals, count
 
 
 def expand_frontier(ids, vals, src_ids, src_off, nv: int,
@@ -73,36 +74,39 @@ def expand_frontier(ids, vals, src_ids, src_off, nv: int,
     frontier out-edges here (may exceed EB — callers must then use the
     dense path; entries past ``total`` are masked by in_range).
     """
-    Q = ids.shape[0]
-    S = src_ids.shape[0]
-    # binary-search each queue id in the compressed source index
-    pos = jnp.searchsorted(src_ids, ids, side="left",
-                           method="scan_unrolled")
-    posc = jnp.minimum(pos, S - 1).astype(jnp.int32)
-    present = (jnp.take(src_ids, posc, axis=0) == ids) & (ids < nv)
-    begin = jnp.where(present, jnp.take(src_off, posc, axis=0), 0)
-    end = jnp.where(present, jnp.take(src_off, posc + 1, axis=0), 0)
-    deg = (end - begin).astype(jnp.int32)
-    off = jnp.cumsum(deg)                       # END offsets per item
-    total = off[-1]
-    start = off - deg                           # begin offset per item
-    # Owner of each edge slot via the CSR-expand trick: drop each
-    # item's 1-based queue index at its first slot, then a running max
-    # spreads it across the item's extent.  (Items with deg > 0 have
-    # distinct starts, so the scatter-max never collides.)
-    marks = jnp.zeros((edge_budget + 1,), jnp.int32)
-    qidx = jnp.arange(Q, dtype=jnp.int32) + 1
-    marks = marks.at[jnp.minimum(start, edge_budget)].max(
-        jnp.where(deg > 0, qidx, 0))
-    owner = jax.lax.cummax(marks[:edge_budget]) - 1      # [EB]
-    owner = jnp.maximum(owner, 0)
-    slot = jnp.arange(edge_budget, dtype=off.dtype)
-    in_range = slot < jnp.minimum(total, edge_budget)
-    within = slot - jnp.take(start, owner, axis=0)
-    edge_idx = (jnp.take(begin, owner, axis=0) + within).astype(jnp.int32)
-    edge_idx = jnp.where(in_range, edge_idx, 0)
-    src_val = jnp.take(vals, owner, axis=0)
-    return edge_idx, src_val, in_range, total, off
+    with jax.named_scope("lux_sparse_expand"):
+        Q = ids.shape[0]
+        S = src_ids.shape[0]
+        # binary-search each queue id in the compressed source index
+        pos = jnp.searchsorted(src_ids, ids, side="left",
+                               method="scan_unrolled")
+        posc = jnp.minimum(pos, S - 1).astype(jnp.int32)
+        present = (jnp.take(src_ids, posc, axis=0) == ids) & (ids < nv)
+        begin = jnp.where(present, jnp.take(src_off, posc, axis=0), 0)
+        end = jnp.where(present, jnp.take(src_off, posc + 1, axis=0), 0)
+        deg = (end - begin).astype(jnp.int32)
+        off = jnp.cumsum(deg)                   # END offsets per item
+        total = off[-1]
+        start = off - deg                       # begin offset per item
+        # Owner of each edge slot via the CSR-expand trick: drop each
+        # item's 1-based queue index at its first slot, then a running
+        # max spreads it across the item's extent.  (Items with
+        # deg > 0 have distinct starts, so the scatter-max never
+        # collides.)
+        marks = jnp.zeros((edge_budget + 1,), jnp.int32)
+        qidx = jnp.arange(Q, dtype=jnp.int32) + 1
+        marks = marks.at[jnp.minimum(start, edge_budget)].max(
+            jnp.where(deg > 0, qidx, 0))
+        owner = jax.lax.cummax(marks[:edge_budget]) - 1      # [EB]
+        owner = jnp.maximum(owner, 0)
+        slot = jnp.arange(edge_budget, dtype=off.dtype)
+        in_range = slot < jnp.minimum(total, edge_budget)
+        within = slot - jnp.take(start, owner, axis=0)
+        edge_idx = (jnp.take(begin, owner, axis=0)
+                    + within).astype(jnp.int32)
+        edge_idx = jnp.where(in_range, edge_idx, 0)
+        src_val = jnp.take(vals, owner, axis=0)
+        return edge_idx, src_val, in_range, total, off
 
 
 def scatter_reduce(labels, dst_local, cand, kind: str):
@@ -112,10 +116,11 @@ def scatter_reduce(labels, dst_local, cand, kind: str):
     reduction identity so they are no-ops.  Unsorted scatter — only used
     on the bounded sparse edge budget, never on full edge arrays.
     """
-    vpad = labels.shape[0]
-    safe = jnp.minimum(dst_local, vpad - 1)
-    if kind == "min":
-        return labels.at[safe].min(cand, mode="drop")
-    if kind == "max":
-        return labels.at[safe].max(cand, mode="drop")
+    with jax.named_scope("lux_sparse_scatter"):
+        vpad = labels.shape[0]
+        safe = jnp.minimum(dst_local, vpad - 1)
+        if kind == "min":
+            return labels.at[safe].min(cand, mode="drop")
+        if kind == "max":
+            return labels.at[safe].max(cand, mode="drop")
     raise ValueError(f"unsupported sparse reduce {kind!r}")

@@ -22,8 +22,9 @@ from lux_tpu.parallel.mesh import PARTS_AXIS
 
 
 def cksum(x):
-    """Tiny fence scalar: depends on the phase output, costs nothing
-    (the same first-8-elements convention as lux_tpu.timing.fence)."""
+    """Tiny fence value ([3] float32): depends on the phase output,
+    costs nothing (the same first-8-elements convention as
+    lux_tpu.timing.fence, wide-int-safe — see timing._cksum)."""
     from lux_tpu.timing import _cksum
     return _cksum(x)
 
@@ -60,8 +61,10 @@ class PhaseTimer:
         self.last_fence = None
 
     def __call__(self, name, fn, *args):
-        t0 = time.perf_counter()
-        out, c = fn(*args)
-        self.last_fence = self._fetch(c)
-        self.t[name] = time.perf_counter() - t0
+        from lux_tpu.profiling import annotation
+        with annotation(f"lux_phase_{name}"):
+            t0 = time.perf_counter()
+            out, c = fn(*args)
+            self.last_fence = self._fetch(c)
+            self.t[name] = time.perf_counter() - t0
         return out

@@ -70,6 +70,10 @@ class PullProgram:
                 f32.  Feeds resolve_exchange's state-table size
                 estimate (the big-table gather cliff is in BYTES);
                 None -> assume 4 (scalar f32).
+    name        optional app label; engines scope their traced step
+                in ``jax.named_scope(f"lux_{name}")`` so profiler
+                captures (profiling.trace) attribute device ops to
+                the app instead of anonymous XLA fusions.
     """
     reduce: str
     edge_value: Callable
@@ -78,3 +82,4 @@ class PullProgram:
     needs_dst: bool = False
     edge_value_from_dot: Callable | None = None
     state_bytes: int | None = None
+    name: str | None = None

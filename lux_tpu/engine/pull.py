@@ -168,7 +168,8 @@ class PullEngine:
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
-                 owner_tile_e: int | None = None):
+                 owner_tile_e: int | None = None,
+                 stats_cap: int | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -211,6 +212,8 @@ class PullEngine:
         self.program = program
         self.mesh = mesh
         self.use_mxu = use_mxu
+        from lux_tpu.telemetry import DEFAULT_STATS_CAP
+        self.stats_cap = int(stats_cap or DEFAULT_STATS_CAP)
         self.reduce_method = resolve_reduce_method(reduce_method)
         dev = jnp.asarray if mesh is None else np.asarray
         if exchange == "owner":
@@ -398,11 +401,16 @@ class PullEngine:
     def _part_step(self, flat_state, old_p, g):
         """g: dict of this part's graph arrays."""
         if self._streams:
-            red = self._part_red_streamed(flat_state, g)
+            with jax.named_scope("lux_gather_reduce"):
+                red = self._part_red_streamed(flat_state, g)
+            with jax.named_scope("lux_apply"):
+                return self._apply_epilogue(old_p, red, g)
+        with jax.named_scope("lux_gather"):
+            msgs = self._part_msgs(flat_state, old_p, g)
+        with jax.named_scope("lux_reduce"):
+            red = self._part_reduce(flat_state, msgs, g)
+        with jax.named_scope("lux_apply"):
             return self._apply_epilogue(old_p, red, g)
-        msgs = self._part_msgs(flat_state, old_p, g)
-        red = self._part_reduce(flat_state, msgs, g)
-        return self._apply_epilogue(old_p, red, g)
 
     def _part_step_dot(self, flat_state, old_p, g):
         red = self._part_dot_red(flat_state, old_p, g)
@@ -541,8 +549,9 @@ class PullEngine:
         """One owner-exchange iteration for the locally-held rows
         (single device: all parts; under shard_map: this device's)."""
         sg = self.sg
-        acc = self._owner_contribs(state, g)
-        red = self._owner_exchange(acc)[:, :sg.vpad]
+        with jax.named_scope("lux_gen_exchange"):
+            acc = self._owner_contribs(state, g)
+            red = self._owner_exchange(acc)[:, :sg.vpad]
         flat = None
         if self.pairs is not None:
             # pair rows are fetched from the FULL table (row-granular
@@ -584,6 +593,9 @@ class PullEngine:
                     return self._owner_step(state,
                                             dict(zip(keys, gargs)))
 
+            if self.program.name:
+                core = jax.named_scope(
+                    f"lux_{self.program.name}")(core)
             self._step_core = core
             jitted = jax.jit(core, donate_argnums=0)
             return lambda state: jitted(state, *self.graph_args)
@@ -601,9 +613,13 @@ class PullEngine:
             def core(state, *gargs):
                 g = dict(zip(keys, gargs))
                 # The per-iteration vertex-state exchange over ICI.
-                full = jax.lax.all_gather(state, PARTS_AXIS, tiled=True)
+                with jax.named_scope("lux_exchange"):
+                    full = jax.lax.all_gather(state, PARTS_AXIS,
+                                              tiled=True)
                 return self._parts_step(state, full, g)
 
+        if self.program.name:
+            core = jax.named_scope(f"lux_{self.program.name}")(core)
         self._step_core = core
         jitted = jax.jit(core, donate_argnums=0)
         return lambda state: jitted(state, *self.graph_args)
@@ -651,6 +667,50 @@ class PullEngine:
             state = self.step(state)
         return state
 
+    def _iter_counters(self, new, old):
+        """Per-iteration device-side counters shared by the stats
+        loops: (max-abs state change — the residual run_until
+        converges on, count of vertices whose state changed).
+        Computed on the sharded global arrays like _run_until's
+        residual; O(state), tiny next to the O(edges) gather."""
+        d = jnp.abs(new.astype(jnp.float32) - old.astype(jnp.float32))
+        res = jnp.max(d)
+        if d.ndim > 2:                        # K-vector payloads
+            d = d.reshape(d.shape[0], d.shape[1], -1).max(axis=-1)
+        changed = jnp.sum((d > 0).astype(jnp.uint32))
+        return res, changed
+
+    @functools.cached_property
+    def _run_stats_fused(self):
+        core = self._step_core
+        cap = self.stats_cap
+
+        @functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def run(state, num_iters, *gargs):
+            def body(i, c):
+                s, res, chg = c
+                new = core(s, *gargs)
+                r, cnt = self._iter_counters(new, s)
+                return (new, res.at[i].set(r, mode="drop"),
+                        chg.at[i].set(cnt, mode="drop"))
+
+            return jax.lax.fori_loop(
+                0, num_iters, body,
+                (state, jnp.zeros((cap,), jnp.float32),
+                 jnp.zeros((cap,), jnp.uint32)))
+
+        return lambda state, n: run(state, n, *self.graph_args)
+
+    def run_stats(self, state, num_iters: int):
+        """``run(fused=True)`` + device-side iteration counters
+        accumulated inside the fori_loop: returns (state, residual
+        float32 [stats_cap], changed uint32 [stats_cap]) where
+        residual[i] is iteration i's max-abs state change and
+        changed[i] its changed-vertex count (see lux_tpu/telemetry.py;
+        writes past stats_cap drop).  Fetch the buffers once per
+        run/segment — a few KB, independent of graph size."""
+        return self._run_stats_fused(state, num_iters)
+
     @functools.cached_property
     def _run_until(self):
         core = self._step_core
@@ -673,6 +733,44 @@ class PullEngine:
             return s, it, res
 
         return run
+
+    @functools.cached_property
+    def _run_until_stats(self):
+        core = self._step_core
+        cap = self.stats_cap
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def run(state, tol, max_iters, *gargs):
+            def cond(c):
+                it, s, res, rb, cb = c
+                return (res > tol) & (it < max_iters)
+
+            def body(c):
+                it, s, _res, rb, cb = c
+                new = core(s, *gargs)
+                r, cnt = self._iter_counters(new, s)
+                return (it + 1, new, r,
+                        rb.at[it].set(r, mode="drop"),
+                        cb.at[it].set(cnt, mode="drop"))
+
+            it, s, res, rb, cb = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), state, jnp.float32(jnp.inf),
+                 jnp.zeros((cap,), jnp.float32),
+                 jnp.zeros((cap,), jnp.uint32)))
+            return s, it, res, rb, cb
+
+        return run
+
+    def run_until_stats(self, state, tol: float,
+                        max_iters: int = np.iinfo(np.int32).max):
+        """``run_until`` + the per-iteration residual/changed counters
+        of ``run_stats`` — closing the 'pull residuals are invisible
+        inside run_until' observability hole.  Returns (state, it,
+        residual, residual_buf, changed_buf)."""
+        return self._run_until_stats(state, jnp.float32(tol),
+                                     jnp.int32(max_iters),
+                                     *self.graph_args)
 
     def run_until(self, state, tol: float,
                   max_iters: int = np.iinfo(np.int32).max):

@@ -70,11 +70,15 @@ class PushProgram:
     identity  scalar no-op candidate (+inf for min, -inf/0 for max).
     init      (sharded_graph) -> (label0 [num_parts, vpad],
               active0 bool [num_parts, vpad]) numpy.
+    name      optional app label; engines scope their traced step in
+              ``jax.named_scope(f"lux_{name}")`` so profiler captures
+              (profiling.trace) attribute device ops to the app.
     """
     reduce: str
     relax: Callable
     identity: Any
     init: Callable
+    name: str | None = None
 
     def better(self, cand, old):
         return cand < old if self.reduce == "min" else cand > old
@@ -95,7 +99,8 @@ class PushEngine:
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
-                 owner_tile_e: int | None = None):
+                 owner_tile_e: int | None = None,
+                 stats_cap: int | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -122,6 +127,8 @@ class PushEngine:
         self.program = program
         self.mesh = mesh
         self.delta = delta
+        from lux_tpu.telemetry import DEFAULT_STATS_CAP
+        self.stats_cap = int(stats_cap or DEFAULT_STATS_CAP)
         self.sparse_threshold = sparse_threshold
         self.reduce_method = resolve_reduce_method(reduce_method)
         # Pair-lane delivery for the DENSE iterations (ops/pairs.py):
@@ -323,13 +330,17 @@ class PushEngine:
         return self.stream_chunks and self.tiles is not None
 
     def _dense_parts(self, label, active, full_label, full_active, g):
-        flat_l = self._dense_flat(full_label, full_active)
+        with jax.named_scope("lux_exchange"):
+            flat_l = self._dense_flat(full_label, full_active)
         stream = self._streams
 
         def one(old, g):
-            cand = None if stream else self._dense_cand(flat_l, g)
-            red = self._dense_red(flat_l, cand, g)
-            return self._dense_update(old, red, g)
+            with jax.named_scope("lux_relax"):
+                cand = None if stream else self._dense_cand(flat_l, g)
+            with jax.named_scope("lux_reduce"):
+                red = self._dense_red(flat_l, cand, g)
+            with jax.named_scope("lux_update"):
+                return self._dense_update(old, red, g)
 
         g = {k: g[k] for k in self._DENSE_KEYS if k in g}
         return jax.vmap(one)(label, g)
@@ -367,15 +378,16 @@ class PushEngine:
             msg, jax.ShapeDtypeStruct((1, 1), label.dtype),
             (jax.ShapeDtypeStruct((1, 1), jnp.float32)
              if "own_w" in g else None)).dtype
-        acc = owner_contribs(
-            self.owner, masked, g,
-            prog.reduce, msg, msg_dtype, sg.num_parts,
-            self.reduce_method,
-            varying_axis=PARTS_AXIS if on_mesh else None)
-        red = owner_exchange(
-            acc, prog.reduce,
-            axis=PARTS_AXIS if on_mesh else None,
-            ndev=1 if not on_mesh else self.mesh.devices.size)
+        with jax.named_scope("lux_gen_exchange"):
+            acc = owner_contribs(
+                self.owner, masked, g,
+                prog.reduce, msg, msg_dtype, sg.num_parts,
+                self.reduce_method,
+                varying_axis=PARTS_AXIS if on_mesh else None)
+            red = owner_exchange(
+                acc, prog.reduce,
+                axis=PARTS_AXIS if on_mesh else None,
+                ndev=1 if not on_mesh else self.mesh.devices.size)
         red = red[:, :sg.vpad]
         if self.pairs is not None:
             # pair rows fetch from the FULL masked table (row-granular
@@ -486,12 +498,22 @@ class PushEngine:
 
     # -- compiled whole-run / single-step ------------------------------
 
-    def _build(self, converge: bool):
+    def _build(self, converge: bool, stats: bool = False):
+        """stats=True (converge only) additionally accumulates
+        device-side per-iteration counters INSIDE the while_loop into
+        fixed [stats_cap] buffers: frontier size (int32) and frontier
+        out-edges relaxed (uint32) per iteration — see
+        lux_tpu/telemetry.py for the exact semantics.  Out-degrees
+        come from the FULL graph (self.sg, pair rows included), passed
+        as one extra sharded argument so the counter-free program
+        never carries them."""
+        assert not stats or converge
         keys = sorted(self.arrays)
         graph_args = tuple(self.arrays[k] for k in keys)
         on_mesh = self.mesh is not None
         sg, prog = self.sg, self.program
         use_sparse, sparse_limit = self._sparse_mode()
+        cap_n = self.stats_cap
 
         def global_sum(x):
             s = jnp.sum(x)
@@ -522,19 +544,38 @@ class PushEngine:
         def body(label, active, count, g):
             if not use_sparse:
                 return dense_body(label, active, g)
+
             # Reference heuristic: frontier > nv/16 -> dense/pull mode
             # (sssp_gpu.cu:414), and the queue must fit (_sparse_mode).
+            def sparse_branch():
+                with jax.named_scope("lux_sparse"):
+                    return self._sparse_parts(label, active, g,
+                                              gather_fn, pmin_fn)
+
+            def dense_branch():
+                with jax.named_scope("lux_dense"):
+                    return dense_body(label, active, g)
+
             q_fits = count <= jnp.int32(sparse_limit)
-            return jax.lax.cond(
-                q_fits,
-                lambda: self._sparse_parts(label, active, g, gather_fn,
-                                           pmin_fn),
-                lambda: dense_body(label, active, g))
+            return jax.lax.cond(q_fits, sparse_branch, dense_branch)
 
         use_delta = converge and self.delta is not None
 
         def inner(label, active, max_iters, *gargs):
+            if stats:
+                deg_full, gargs = gargs[0], gargs[1:]
             g = dict(zip(keys, gargs))
+
+            def esum(act):
+                # out-edges of the frontier ``act`` — the relax work
+                # of the iteration.  uint32: a full 2^31+-edge
+                # frontier must not wrap int32.
+                e = jnp.sum(jnp.where(act, deg_full, 0)
+                            .astype(jnp.uint32))
+                if on_mesh:
+                    e = jax.lax.psum(e, PARTS_AXIS)
+                return e
+
             if not converge:
                 cnt0 = global_sum(active)
                 new_label, new_active = body(label, active, cnt0, g)
@@ -562,19 +603,30 @@ class PushEngine:
                 # active, raising B eventually makes the frontier
                 # non-empty.
                 def cond(c):
-                    it, lbl, act, B, cnt = c
+                    it, lbl, act, B, cnt = c[:5]
                     return (cnt > 0) & (it < max_iters)
 
                 def wbody(c):
-                    it, lbl, act, B, cnt = c
+                    it, lbl, act, B, cnt = c[:5]
+                    buf = c[5:]
                     front = act & (lbl < B)
                     nf = global_sum(front)
 
-                    def relax(it, lbl, act, B):
+                    def relax(it, lbl, act, B, *buf):
+                        if stats:
+                            # counters record the bucket front ENTERING
+                            # this relax — the series timed_phases'
+                            # delta schedule reports; advances relax
+                            # nothing and write no entry
+                            fsz, fed = buf
+                            buf = (fsz.at[it].set(nf, mode="drop"),
+                                   fed.at[it].set(esum(front),
+                                                  mode="drop"))
                         nl, na = body(lbl, front, nf, g)
-                        return it + 1, nl, (act & ~front) | na, B
+                        return (it + 1, nl, (act & ~front) | na, B,
+                                *buf)
 
-                    def advance(it, lbl, act, B):
+                    def advance(it, lbl, act, B, *buf):
                         # Strict progress: with float labels a delta
                         # below one ulp at the current magnitude makes
                         # active_min + delta round back to active_min
@@ -588,46 +640,87 @@ class PushEngine:
                             nb = jnp.maximum(
                                 nb, jnp.nextafter(
                                     am, jnp.asarray(jnp.inf, am.dtype)))
-                        return it, lbl, act, nb
+                        return it, lbl, act, nb, *buf
 
-                    it, lbl, act, B = jax.lax.cond(
-                        nf > 0, relax, advance, it, lbl, act, B)
-                    return it, lbl, act, B, global_sum(act)
+                    out = jax.lax.cond(
+                        nf > 0, relax, advance, it, lbl, act, B, *buf)
+                    it, lbl, act, B = out[:4]
+                    return (it, lbl, act, B, global_sum(act), *out[4:])
 
                 B0 = active_min(label, active) + delta
-                it, lbl, act, _B, _ = jax.lax.while_loop(
-                    cond, wbody,
-                    (jnp.int32(0), label, active, B0,
-                     global_sum(active)))
+                init = (jnp.int32(0), label, active, B0,
+                        global_sum(active))
+                if stats:
+                    init = init + (jnp.zeros((cap_n,), jnp.int32),
+                                   jnp.zeros((cap_n,), jnp.uint32))
+                out = jax.lax.while_loop(cond, wbody, init)
+                it, lbl, act = out[0], out[1], out[2]
+                if stats:
+                    return lbl, act, it, out[5], out[6]
                 return lbl, act, it
 
             def cond(c):
-                it, lbl, act, cnt = c
+                it, lbl, act, cnt = c[:4]
                 return (cnt > 0) & (it < max_iters)
 
             def wbody(c):
-                it, lbl, act, cnt = c
+                it, lbl, act, cnt = c[:4]
+                if stats:
+                    fsz, fed = c[4:]
+                    # edges relaxed by THIS iteration: out-edges of
+                    # the frontier entering it
+                    fed = fed.at[it].set(esum(act), mode="drop")
                 nl, na = body(lbl, act, cnt, g)
-                return it + 1, nl, na, global_sum(na)
+                ncnt = global_sum(na)
+                if stats:
+                    # frontier AFTER the iteration — exactly the
+                    # series the stepwise -verbose path printed
+                    fsz = fsz.at[it].set(ncnt, mode="drop")
+                    return it + 1, nl, na, ncnt, fsz, fed
+                return it + 1, nl, na, ncnt
 
             it0 = jnp.int32(0)
             cnt0 = global_sum(active)
-            it, lbl, act, _ = jax.lax.while_loop(
-                cond, wbody, (it0, label, active, cnt0))
+            init = (it0, label, active, cnt0)
+            if stats:
+                init = init + (jnp.zeros((cap_n,), jnp.int32),
+                               jnp.zeros((cap_n,), jnp.uint32))
+            out = jax.lax.while_loop(cond, wbody, init)
+            it, lbl, act = out[0], out[1], out[2]
+            if stats:
+                return lbl, act, it, out[4], out[5]
             return lbl, act, it
 
+        if prog.name:
+            inner = jax.named_scope(f"lux_{prog.name}")(inner)
         if on_mesh:
             P = PartitionSpec
+            out_specs = (P(PARTS_AXIS), P(PARTS_AXIS), P())
+            if stats:
+                # counters are psum-replicated scalars written into
+                # replicated buffers
+                out_specs = out_specs + (P(), P())
             inner = jax.shard_map(
                 inner, mesh=self.mesh,
                 in_specs=(P(PARTS_AXIS), P(PARTS_AXIS), P()) +
-                         (P(PARTS_AXIS),) * len(keys),
-                out_specs=(P(PARTS_AXIS), P(PARTS_AXIS), P()))
+                         (P(PARTS_AXIS),) * (len(keys) + int(stats)),
+                out_specs=out_specs)
 
         jitted = jax.jit(inner, donate_argnums=(0, 1))
 
+        extra = ()
+        if stats:
+            deg_full = np.asarray(self.sg.deg_padded)
+            if self.mesh is not None:
+                deg_full = shard_over_parts(self.mesh, [deg_full],
+                                            self.sg.num_parts)[0]
+            else:
+                deg_full = jnp.asarray(deg_full)
+            extra = (deg_full,)
+
         def call(label, active, max_iters=np.iinfo(np.int32).max):
-            return jitted(label, active, jnp.int32(max_iters), *graph_args)
+            return jitted(label, active, jnp.int32(max_iters), *extra,
+                          *graph_args)
 
         return call
 
@@ -644,45 +737,70 @@ class PushEngine:
         cap = np.iinfo(np.int32).max if max_iters is None else max_iters
         return self._converge_fn(label, active, cap)
 
+    @functools.cached_property
+    def _converge_stats_fn(self):
+        return self._build(converge=True, stats=True)
+
+    def converge_stats(self, label, active,
+                       max_iters: int | None = None):
+        """``converge`` + device-side iteration counters accumulated
+        INSIDE the fused while_loop (compiled lazily on first use —
+        the counter-free program is untouched).  Returns (label,
+        active, iters, frontier int32 [stats_cap], edges uint32
+        [stats_cap]): classic engines record the post-iteration
+        frontier size (the stepwise -verbose series) and the entering
+        frontier's out-edge count; delta engines record each relax
+        step's bucket-front size and out-edges (see
+        lux_tpu/telemetry.py).  Writes past ``stats_cap`` drop;
+        entries past ``iters`` are zero.  Fetch the buffers once per
+        run/segment (a few KB) — never inside a timed region's hot
+        loop."""
+        cap = np.iinfo(np.int32).max if max_iters is None else max_iters
+        return self._converge_stats_fn(label, active, cap)
+
     def run(self, max_iters: int | None = None, verbose: bool = False,
             seg_budget: float | None = None):
         """init -> converge -> host label array [nv]; returns
-        (labels, num_iters).  verbose=True uses the stepwise path and
-        prints per-iteration frontier sizes.  seg_budget (seconds)
-        converges in duration-budgeted while_loop slices
-        (segmented.DurationBudget) so each XLA execution stays under
-        the tunnel's ~55 s crash envelope (PERF_NOTES round 5) — the
-        systematic form of the old hand-routed ``seg=2`` converges."""
+        (labels, num_iters).  verbose=True REPLAYS per-iteration
+        frontier sizes from the fused run's device-side counters
+        (``converge_stats``) — the old stepwise slow path is gone, and
+        delta engines replay their ACTUAL bucket schedule's relax
+        steps.  seg_budget (seconds) converges in duration-budgeted
+        while_loop slices (segmented.DurationBudget) so each XLA
+        execution stays under the tunnel's ~55 s crash envelope
+        (PERF_NOTES round 5) — counters then accumulate across
+        segments, so seg_budget and verbose compose."""
+        import contextlib
+
+        from lux_tpu import telemetry
         label, active = self.init_state()
-        if verbose and self.delta is not None:
-            print("note: -verbose uses the stepwise path, which runs "
-                  "plain frontier relaxation; the timed converge path "
-                  "runs delta-stepping")
-        if seg_budget is not None and verbose:
-            print("note: -verbose runs the stepwise path; seg_budget "
-                  "is ignored (budgeted segments need the fused "
-                  "converge program)")
-        if seg_budget is not None and not verbose:
-            from lux_tpu.segmented import DurationBudget, \
-                converge_segments
-            label, active, it = converge_segments(
-                self, label, active,
-                DurationBudget(seg_budget, per_size_compile=False),
-                max_iters)
-            return self.unpad(label), it
+        tel = telemetry.current()
+        st = tel.iter_stats
+        ctx = contextlib.nullcontext()
+        if verbose and st is None:
+            st = telemetry.IterStats()
+            ctx = telemetry.use(events=tel.events, iter_stats=st)
+        with ctx:
+            if seg_budget is not None:
+                from lux_tpu.segmented import DurationBudget, \
+                    converge_segments
+                label, active, it = converge_segments(
+                    self, label, active,
+                    DurationBudget(seg_budget, per_size_compile=False),
+                    max_iters)
+            elif st is not None:
+                st.begin_run()
+                label, active, itd, fsz, fed = self.converge_stats(
+                    label, active, max_iters)
+                it = int(jax.device_get(itd))
+                st.extend_push(fsz, fed, it)
+            else:
+                label, active, itd = self.converge(label, active,
+                                                   max_iters)
+                it = int(jax.device_get(itd))
         if verbose:
-            it = 0
-            cnt = int(jnp.sum(active)) if self.mesh is None else int(
-                jax.device_get(jnp.sum(active)))
-            cap = np.iinfo(np.int32).max if max_iters is None else max_iters
-            while cnt > 0 and it < cap:
-                label, active, c = self.step(label, active)
-                cnt = int(jax.device_get(c))
-                it += 1
-                print(f"iter {it}: frontier={cnt}")
-        else:
-            label, active, it = self.converge(label, active, max_iters)
-            it = int(jax.device_get(it))
+            for line in st.replay_lines():
+                print(line)
         return self.unpad(label), it
 
     def unpad(self, state) -> np.ndarray:

@@ -662,3 +662,18 @@ class ShardedGraph:
             "vertex_bytes_per_part": vert_bytes,
             "total_bytes": self.num_parts * per_part,
         }
+
+    def telemetry_header(self, **memory_kwargs) -> dict:
+        """Graph shape + the startup memory advisor's per-part HBM
+        estimate, as one JSON-serializable dict — the payload of the
+        event log's ``header`` event (lux_tpu/telemetry.py), so every
+        events JSONL is self-describing.  ``memory_kwargs`` forward to
+        ``memory_report`` (exchange=, push_sparse=, ...)."""
+        return {
+            "nv": int(self.nv), "ne": int(self.ne),
+            "weighted": bool(self.weighted),
+            "num_parts": int(self.num_parts),
+            "vpad": int(self.vpad), "epad": int(self.epad),
+            "memory": {k: int(v) for k, v in
+                       self.memory_report(**memory_kwargs).items()},
+        }

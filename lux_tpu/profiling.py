@@ -6,9 +6,19 @@ pagerank.cc:108-118).  The TPU-native equivalents:
 
 - ``trace(dir)``: captures an XLA/TPU profiler trace viewable in
   TensorBoard / Perfetto (the analogue of Legion's prof logs).
-- ``phase_timer()``: host-side phase timing with completion fences
+- ``PhaseTimer``: host-side phase timing with completion fences
   (load / build / compile / iterate), printed like the reference's
-  loadTime/compTime/updateTime breakdown.
+  loadTime/compTime/updateTime breakdown; ``report()`` returns the
+  phases list so callers (event logs, tables) consume it directly
+  instead of re-parsing stdout.
+- ``annotation``/``step_annotation``: host-side
+  ``jax.profiler.TraceAnnotation`` wrappers the run paths (timing.py,
+  segmented.py, checkpoint.py, engine/phased.py) put around their
+  iterate / segment / checkpoint regions, so a captured trace shows
+  named regions instead of anonymous XLA ops; the engines' traced
+  code additionally carries ``jax.named_scope`` labels (lux_exchange /
+  lux_gather / lux_reduce / lux_apply, push: lux_relax / lux_update /
+  lux_sparse) that name the device-side ops themselves.
 """
 
 from __future__ import annotations
@@ -28,6 +38,30 @@ def trace(log_dir: str | None):
     with jax.profiler.trace(log_dir):
         yield
     print(f"profiler trace written to {log_dir}")
+
+
+def annotation(name: str):
+    """Host-side named region for profiler traces
+    (jax.profiler.TraceAnnotation); a no-op nullcontext when the
+    profiler is unavailable.  Costs nothing outside an active trace
+    capture."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:       # noqa: BLE001 — profiling must never break
+        return contextlib.nullcontext()
+
+
+def step_annotation(name: str, step: int):
+    """Per-step named region (jax.profiler.StepTraceAnnotation) —
+    segments/repeats show up as numbered steps in the trace viewer."""
+    try:
+        import jax
+
+        return jax.profiler.StepTraceAnnotation(name, step_num=step)
+    except Exception:       # noqa: BLE001
+        return contextlib.nullcontext()
 
 
 class _Phase:
@@ -60,18 +94,23 @@ class PhaseTimer:
     @contextlib.contextmanager
     def phase(self, name: str, fence=None):
         h = _Phase()
-        t0 = time.perf_counter()
-        yield h
-        f = fence() if callable(fence) else fence
-        for val in (f, h.fence):
-            if val is not None:
-                from lux_tpu.timing import fetch
-                fetch(val)
-        self.phases.append((name, time.perf_counter() - t0))
+        with annotation(f"lux_phase_{name}"):
+            t0 = time.perf_counter()
+            yield h
+            f = fence() if callable(fence) else fence
+            for val in (f, h.fence):
+                if val is not None:
+                    from lux_tpu.timing import fetch
+                    fetch(val)
+            self.phases.append((name, time.perf_counter() - t0))
 
-    def report(self, file=None):
+    def report(self, file=None) -> list[tuple[str, float]]:
+        """Print the phase table and RETURN the (name, seconds) phases
+        list, so callers (CLI tables, event logs) consume the data
+        directly instead of re-parsing stdout."""
         total = sum(t for _, t in self.phases)
         for name, t in self.phases:
             print(f"  {name:<12s} {t:8.3f} s "
                   f"({100 * t / max(total, 1e-12):5.1f}%)", file=file)
         print(f"  {'total':<12s} {total:8.3f} s", file=file)
+        return list(self.phases)

@@ -127,13 +127,18 @@ class RunReport:
     #             are deliberately NOT counted here
     total_iters: int = 0
     segments: int = 0
+    counters: dict | None = None
+    #           ^ device-side iteration-counter digest
+    #             (telemetry.IterStats.summary()) when the run was
+    #             supervised under an active iter-stats handle
 
     def as_dict(self) -> dict:
         return dict(attempts=self.attempts, segments=self.segments,
                     resumed_from=list(self.resumed_from),
                     initial_resume=self.initial_resume,
                     failures=[list(f) for f in self.failures],
-                    total_iters=self.total_iters)
+                    total_iters=self.total_iters,
+                    counters=self.counters)
 
 
 def supervise(attempt: Callable, policy: RetryPolicy | None = None,
@@ -141,6 +146,8 @@ def supervise(attempt: Callable, policy: RetryPolicy | None = None,
     """Run ``attempt(k)`` (k = 0-based attempt index) under classified
     retries: retryable failures back off and retry, fatal ones (and
     retry-budget exhaustion) re-raise.  Returns (result, report)."""
+    from lux_tpu import telemetry
+
     policy = policy or RetryPolicy()
     report = report or RunReport()
     for k in range(max(0, policy.retries) + 1):
@@ -151,7 +158,14 @@ def supervise(attempt: Callable, policy: RetryPolicy | None = None,
             kind = classify(e)
             report.failures.append(
                 (type(e).__name__, str(e)[:200], kind))
-            if kind == FATAL or k >= policy.retries:
+            fatal = kind == FATAL or k >= policy.retries
+            telemetry.current().emit(
+                "failure" if fatal else "retry", attempt=k,
+                error=type(e).__name__, message=str(e)[:200],
+                classification=kind,
+                **({} if fatal
+                   else {"backoff_s": round(policy.delay_s(k), 3)}))
+            if fatal:
                 raise
             policy.sleep(policy.delay_s(k))
     raise AssertionError("unreachable")
@@ -239,7 +253,19 @@ def supervised_run(eng, num_iters: int, path: str, *,
 
     state, report = supervise(attempt, policy, report)
     report.total_iters = num_iters
+    _attach_counters(report)
     return state, report
+
+
+def _attach_counters(report):
+    """Fold the active iter-stats digest (device-side per-iteration
+    counters accumulated by the segmented drivers) into the report, so
+    RunReport.as_dict() carries the counter summary."""
+    from lux_tpu import telemetry
+
+    st = telemetry.current().iter_stats
+    if st is not None:
+        report.counters = st.summary()
 
 
 def supervised_converge(eng, path: str, *,
@@ -290,6 +316,7 @@ def supervised_converge(eng, path: str, *,
 
     (label, active, total), report = supervise(attempt, policy, report)
     report.total_iters = total
+    _attach_counters(report)
     return label, active, total, report
 
 
@@ -306,6 +333,9 @@ def screen_outliers(samples, rerun: Callable[[], float] | None,
     counts every timed run (original batch + reruns).  factor<=0
     disables screening.
     """
+    from lux_tpu import telemetry
+
+    tel = telemetry.current()
     samples = list(samples)
     attempts = len(samples)
     if len(samples) < 2 or not factor or factor <= 0:
@@ -319,9 +349,11 @@ def screen_outliers(samples, rerun: Callable[[], float] | None,
     discarded = [s for s in samples if is_outlier(s)]
     if not kept:        # mutual disagreement: nothing to trust more
         return samples, [], attempts
-    for _ in list(discarded):
+    for d in list(discarded):
+        tel.emit("outlier_discard", sample=round(d, 6),
+                 median=round(m, 6), factor=factor)
         if rerun is None:
-            break
+            continue
         s = rerun()
         attempts += 1
         if is_outlier(s):
@@ -330,4 +362,6 @@ def screen_outliers(samples, rerun: Callable[[], float] | None,
             #                         one chance — no retry loops)
         else:
             kept.append(s)
+        tel.emit("outlier_rerun", sample=round(s, 6),
+                 kept=not is_outlier(s))
     return kept, discarded, attempts

@@ -18,6 +18,12 @@ Two extensions beyond plain fixed-size slicing:
   the systematic replacement for the ad-hoc ``seg=2`` / small-``ni``
   routing big-scale runs used against the ~55 s tunnel duration wall
   (PERF_NOTES round 5).
+
+Both drivers are telemetry emitters (lux_tpu/telemetry.py): with an
+active handle, every slice emits a ``segment`` event (sizes, fenced
+seconds) and budget lock/halve decisions emit ``budget_*`` events;
+with iter-stats active the slices run the engines' counter-recording
+programs and fetch the per-iteration buffers once per boundary.
 """
 
 from __future__ import annotations
@@ -74,6 +80,8 @@ class DurationBudget:
 
     def observe(self, n: int, seconds: float) -> None:
         """Record one fenced execution of ``n`` iterations."""
+        from lux_tpu import telemetry
+
         first_at_size = self.per_size_compile and n not in self._seen
         self._seen.add(n)
         self._measured += 1
@@ -84,9 +92,16 @@ class DurationBudget:
             self.locked = max(1, min(
                 self.max_segment,
                 int(self.headroom * self.budget_s / self.per_iter)))
+            telemetry.current().emit(
+                "budget_lock", n=self.locked,
+                per_iter_s=round(self.per_iter, 6),
+                budget_s=self.budget_s)
         elif (seconds > self.budget_s and not first_at_size
               and self.locked > 1):
             self.locked = max(1, self.locked // 2)
+            telemetry.current().emit(
+                "budget_halve", n=self.locked,
+                seconds=round(seconds, 3), budget_s=self.budget_s)
 
 
 def _next_n(segment, remaining: int) -> int:
@@ -100,24 +115,52 @@ def run_segments(eng, state, num_iters: int, segment,
                  start_iter: int = 0):
     """Run a pull engine in slices (``segment``: int size or
     DurationBudget).  ``on_segment(state, done_iters)`` runs after
-    each slice and may return a replacement state."""
+    each slice and may return a replacement state.
+
+    With telemetry active (lux_tpu/telemetry.py): each slice emits a
+    ``segment`` event with its fenced seconds, and with iter-stats the
+    slice runs ``eng.run_stats`` — the device-side per-iteration
+    counters are fetched once per segment boundary (a few KB) and
+    accumulated across segments."""
+    from lux_tpu import telemetry
+    from lux_tpu.profiling import step_annotation
+
+    tel = telemetry.current()
+    st = tel.iter_stats
+    if st is not None and start_iter == 0:
+        st.begin_run()          # a resume keeps accumulating instead
     budget = segment if isinstance(segment, DurationBudget) else None
+    timed = budget is not None or tel.events is not None
     done = start_iter
+    seg_idx = 0
     while done < num_iters:
         n = _next_n(segment, num_iters - done)
+        t0 = time.perf_counter()
+        with step_annotation("lux_segment", seg_idx):
+            if st is not None:
+                state, res_b, chg_b = eng.run_stats(state, n)
+            else:
+                state = eng.run(state, n)
+            if timed or st is not None:
+                from lux_tpu.timing import fence
+                fence(state)   # O(1)-byte fence, not a download
+        dt = time.perf_counter() - t0
         if budget is not None:
-            from lux_tpu.timing import fence
-            t0 = time.perf_counter()
-            state = eng.run(state, n)
-            fence(state)           # O(1)-byte fence, not a download
-            budget.observe(n, time.perf_counter() - t0)
-        else:
-            state = eng.run(state, n)
+            budget.observe(n, dt)
         done += n
+        if timed:
+            tel.emit("segment", engine="pull", n=n, done=done,
+                     seconds=round(dt, 6))
+        seg_idx += 1
         if on_segment is not None:
             res = on_segment(state, done)
             if res is not None:
                 state = res
+        # counters land only after the segment hook (checkpoint save)
+        # survives: a crash in the save window makes the retry re-run
+        # this slice, so appending earlier would double-count it
+        if st is not None:
+            st.extend_pull(res_b, chg_b, n)
     return state
 
 
@@ -133,29 +176,56 @@ def converge_segments(eng, label, active, segment,
     ``(label, active)``).  Convergence is detected from the active
     mask, never from iteration counts (delta-stepping counts relax
     steps only).  Returns (label, active, total_iters).
+
+    With telemetry active: each slice emits a ``segment`` event, and
+    with iter-stats the slice runs ``eng.converge_stats`` — frontier/
+    edge counters fetched once per boundary and accumulated across
+    segments (a resumed run keeps accumulating).
     """
     import jax
     import jax.numpy as jnp
 
+    from lux_tpu import telemetry
+    from lux_tpu.profiling import step_annotation
+
+    tel = telemetry.current()
+    st = tel.iter_stats
+    if st is not None and start_iter == 0:
+        st.begin_run()
     budget = segment if isinstance(segment, DurationBudget) else None
     total = start_iter
+    seg_idx = 0
     cap = np.iinfo(np.int32).max if max_iters is None else max_iters
     while total < cap:
         n = _next_n(segment, cap - total)
         t0 = time.perf_counter()
-        label, active, it = eng.converge(label, active, n)
-        # the scalar fetch depends on the whole while_loop: it is the
-        # completion fence (tunnel-safe, O(1) bytes)
-        it = int(np.asarray(jax.device_get(it)))
+        with step_annotation("lux_segment", seg_idx):
+            if st is not None:
+                label, active, it, fsz, fed = eng.converge_stats(
+                    label, active, n)
+            else:
+                label, active, it = eng.converge(label, active, n)
+            # the scalar fetch depends on the whole while_loop: it is
+            # the completion fence (tunnel-safe, O(1) bytes)
+            it = int(np.asarray(jax.device_get(it)))
+        dt = time.perf_counter() - t0
         if budget is not None and it > 0:
-            budget.observe(it, time.perf_counter() - t0)
+            budget.observe(it, dt)
         total += it
         cnt = int(np.asarray(jax.device_get(jnp.sum(active))))
+        tel.emit("segment", engine="push", iters=it, total=total,
+                 active=cnt, seconds=round(dt, 6))
+        seg_idx += 1
         if on_segment is not None:
             res = on_segment(label, active, total, cnt)
             if res is not None:
                 label, active = res
                 cnt = int(np.asarray(jax.device_get(jnp.sum(active))))
+        # counters land only after the segment hook (checkpoint save)
+        # survives: a crash in the save window makes the retry re-run
+        # this slice, so appending earlier would double-count it
+        if st is not None:
+            st.extend_push(fsz, fed, it)
         if cnt == 0:
             break
     return label, active, total

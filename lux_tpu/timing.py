@@ -22,9 +22,27 @@ def fetch(x) -> np.ndarray:
 
 
 def _cksum(*leaves):
+    """Tiny completion-fence checksum (first 8 elements per leaf).
+
+    float32 carries a 24-bit mantissa: casting INTEGER leaves wider
+    than 24 bits (e.g. the packed uint32 pair rows, src<<7|rel)
+    through it collapses values differing only above bit 24 into the
+    same checksum.  Wide integer leaves therefore sum exactly in
+    int32 (wraparound keeps determinism) and ride two separate
+    sub-24-bit float channels, each exactly representable — the
+    result is a [3] vector, one float channel + the int sum's
+    low-12/high-20 bit channels."""
     import jax.numpy as jnp
-    return sum(jnp.sum(leaf.reshape(-1)[:8].astype(jnp.float32))
-               for leaf in leaves)
+    f = jnp.float32(0)
+    i = jnp.int32(0)
+    for leaf in leaves:
+        x = leaf.reshape(-1)[:8]
+        if jnp.issubdtype(x.dtype, jnp.integer) and x.dtype.itemsize > 3:
+            i = i + jnp.sum(x.astype(jnp.int32))
+        else:
+            f = f + jnp.sum(x.astype(jnp.float32))
+    return jnp.stack([f, (i & 0xFFF).astype(jnp.float32),
+                      ((i >> 12) & 0xFFFFF).astype(jnp.float32)])
 
 
 _cksum_jit = None
@@ -61,44 +79,90 @@ def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None,
     When trace_dir is set, a profiler trace captures ONLY the timed
     runs (warmup and compilation are excluded).
 
+    With telemetry iter-stats active (telemetry.use(iter_stats=...)),
+    every run is the counter-recording variant (eng.run_stats — same
+    program warmed and timed) and the LAST timed repeat's counters are
+    fetched AFTER its elapsed time is recorded, so the download is
+    never billed.  Per-repeat seconds are emitted as ``timed_run``
+    events.
+
     Returns (final_state, [elapsed_seconds per repeat]).
     """
-    state = eng.init_state()
-    state = eng.run(state, num_iters)
+    from lux_tpu import telemetry
+    from lux_tpu.profiling import step_annotation
+
+    tel = telemetry.current()
+    st = tel.iter_stats
+
+    def one(state):
+        if st is not None:
+            return eng.run_stats(state, num_iters)
+        return eng.run(state, num_iters), None, None
+
+    state, res_b, chg_b = one(eng.init_state())
     fence(state)
     elapsed = []
     with _trace_ctx(trace_dir):
-        for _ in range(repeats):
+        for i in range(repeats):
             state = eng.init_state()
             fence(state)       # H2D upload is async: keep it untimed
-            t0 = time.perf_counter()
-            state = eng.run(state, num_iters)
-            fence(state)       # O(1)-byte fence, not a state download
-            elapsed.append(time.perf_counter() - t0)
+            with step_annotation("lux_timed_run", i):
+                t0 = time.perf_counter()
+                state, res_b, chg_b = one(state)
+                fence(state)   # O(1)-byte fence, not a state download
+                elapsed.append(time.perf_counter() - t0)
+            tel.emit("timed_run", repeat=i, iters=num_iters,
+                     seconds=round(elapsed[-1], 6))
+    if st is not None:
+        st.begin_run()         # counters describe the LAST timed run
+        st.extend_pull(res_b, chg_b, num_iters)
     return state, elapsed
 
 
 def timed_converge(eng, max_iters=None, verbose: bool = False,
                    trace_dir: str | None = None, repeats: int = 1):
-    """Warm up a push engine's converge program ONCE (printing
-    per-iteration frontier sizes during the warmup pass when verbose),
-    then time ``repeats`` fresh whole-run converges; a trace_dir
-    captures only the timed runs.
+    """Warm up a push engine's converge program ONCE (replaying
+    per-iteration frontier sizes from the warmup's device counters
+    when verbose), then time ``repeats`` fresh whole-run converges; a
+    trace_dir captures only the timed runs.  With telemetry iter-stats
+    active the timed program is eng.converge_stats and the last timed
+    repeat's counters are fetched after its elapsed time is recorded.
     Returns (labels, iters, [elapsed_seconds per repeat])."""
-    if verbose:
-        eng.run(max_iters=max_iters, verbose=True)   # stepwise, printed
+    from lux_tpu import telemetry
+    from lux_tpu.profiling import step_annotation
+
+    tel = telemetry.current()
+    st = tel.iter_stats
+
+    def one(label, active):
+        if st is not None:
+            return eng.converge_stats(label, active, max_iters)
+        l, a, it = eng.converge(label, active, max_iters)
+        return l, a, it, None, None
+
+    if verbose and st is None:
+        # one extra run purely to replay counters; with an active
+        # iter-stats handle the caller replays the TIMED run's
+        # counters instead (printing here would double the series)
+        eng.run(max_iters=max_iters, verbose=True)
     label, active = eng.init_state()
-    l2, a2, _ = eng.converge(label, active, max_iters)  # compile
+    l2, a2, _it, _f, _e = one(label, active)        # compile
     fence(l2)
     elapsed = []
     with _trace_ctx(trace_dir):
-        for _ in range(repeats):
+        for i in range(repeats):
             label, active = eng.init_state()
             fence((label, active))   # keep the async upload untimed
-            t0 = time.perf_counter()
-            label, active, iters = eng.converge(label, active, max_iters)
-            iters = int(fetch(iters))
-            elapsed.append(time.perf_counter() - t0)
+            with step_annotation("lux_timed_converge", i):
+                t0 = time.perf_counter()
+                label, active, it_d, fsz, fed = one(label, active)
+                iters = int(fetch(it_d))
+                elapsed.append(time.perf_counter() - t0)
+            tel.emit("timed_run", repeat=i, iters=iters,
+                     seconds=round(elapsed[-1], 6))
+    if st is not None:
+        st.begin_run()
+        st.extend_push(fsz, fed, iters)
     return eng.unpad(label), iters, elapsed
 
 
@@ -107,15 +171,33 @@ def timed_run_until(eng, tol: float, max_iters: int,
     """Warm a pull engine's convergence program with a one-iteration
     call of the SAME executable (tol/max_iters are traced args, so no
     recompile), then time a fresh run-to-convergence; a trace_dir
-    captures only the timed run.  Returns (state, iters, residual,
-    elapsed)."""
-    s0, _it, _res = eng.run_until(eng.init_state(), tol, max_iters=1)
+    captures only the timed run.  With telemetry iter-stats active the
+    program is eng.run_until_stats (per-iteration residuals fetched
+    after the elapsed time is recorded).  Returns (state, iters,
+    residual, elapsed)."""
+    from lux_tpu import telemetry
+
+    tel = telemetry.current()
+    st = tel.iter_stats
+
+    def one(state, cap):
+        if st is not None:
+            return eng.run_until_stats(state, tol, max_iters=cap)
+        s, it, res = eng.run_until(state, tol, max_iters=cap)
+        return s, it, res, None, None
+
+    s0, _it, _res, _rb, _cb = one(eng.init_state(), 1)
     fence(s0)
     state0 = eng.init_state()
     fence(state0)              # keep the async upload untimed
     with _trace_ctx(trace_dir):
         t0 = time.perf_counter()
-        state, it, res = eng.run_until(state0, tol, max_iters)
+        state, it, res, rb, cb = one(state0, max_iters)
         iters = int(fetch(it))
         elapsed = time.perf_counter() - t0
+    tel.emit("timed_run", repeat=0, iters=iters,
+             seconds=round(elapsed, 6))
+    if st is not None:
+        st.begin_run()
+        st.extend_pull(rb, cb, iters)
     return state, iters, float(fetch(res)), elapsed

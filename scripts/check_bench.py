@@ -34,6 +34,20 @@ Checked per metric line:
 - run_attempts (optional): int >= 2
 - *_FAILED lines: error message plus attempts and failure_class
   ("retryable" | "fatal")
+- telemetry (round 7, lux_tpu/telemetry.py): ``runs`` — one
+  {repeat, iters, seconds} per timed run, straight from the
+  ``timed_run`` events — and ``counters`` (the device-side
+  per-iteration digest, or null when -iter-stats was off).  Checked:
+  len(runs) == attempts (every sample and every discard has its
+  seconds on record), and with ``ne`` present each run's
+  ne*iters/seconds re-derives a recorded sample — the per-run
+  decomposition summing back to the published number, so a collapsed
+  run can't hide behind its median.  Both loosen to >= / skip when
+  the line carries run_attempts (whole config retried) or
+  rerun_error (an outlier rerun crashed after its timed_run event
+  landed) — those runs legitimately have no recorded sample.  Missing
+  telemetry fails strict mode like the round-6 keys (the round-1..6
+  artifacts predate it: -legacy-ok).
 
 Exit status: 0 clean, 1 any error (loud, listed on stderr).
 """
@@ -164,7 +178,120 @@ def check_line(obj: dict, *, legacy_ok: bool):
     if ra is not None and (not isinstance(ra, int) or ra < 2):
         errs.append(f"{name}: run_attempts={ra!r} (recorded only "
                     f"when >= 2)")
+
+    if "telemetry" not in obj:
+        (warns if legacy_ok else errs).append(
+            f"{name}: missing telemetry field (pre-round-7 schema)")
+    else:
+        errs += check_telemetry(name, obj)
     return errs, warns
+
+
+def check_telemetry(name: str, obj: dict) -> list[str]:
+    """Round-7 telemetry field: schema, runs-vs-attempts count, and
+    each run's seconds re-deriving a recorded sample."""
+    errs = []
+    tel = obj["telemetry"]
+    if not isinstance(tel, dict) or "runs" not in tel \
+            or "counters" not in tel:
+        return [f"{name}: telemetry must be a dict with 'runs' and "
+                f"'counters', got {tel!r}"]
+
+    runs = tel["runs"]
+    if not isinstance(runs, list) or not runs or not all(
+            isinstance(r, dict)
+            and isinstance(r.get("repeat"), int) and r["repeat"] >= 0
+            and isinstance(r.get("iters"), int) and r["iters"] >= 0
+            and _is_num(r.get("seconds")) and r["seconds"] > 0
+            for r in runs):
+        return [f"{name}: telemetry.runs must be a non-empty list of "
+                f"{{repeat>=0, iters>=0, seconds>0}}, got {runs!r}"]
+
+    attempts = obj.get("attempts")
+    # a retried config (run_attempts) or a crashed outlier rerun
+    # (rerun_error) legitimately leaves timed_run events whose sample
+    # never made it into the line — only require >= then
+    loose = "run_attempts" in obj or "rerun_error" in obj
+    if isinstance(attempts, int):
+        if (len(runs) < attempts) or (not loose
+                                      and len(runs) != attempts):
+            errs.append(
+                f"{name}: telemetry.runs has {len(runs)} timed runs "
+                f"but attempts={attempts}"
+                + ("" if loose else " (and the config was never "
+                                    "retried)"))
+
+    # per-run decomposition: ne*iters/seconds must land on a recorded
+    # sample (kept or discarded) — the telemetry-era analogue of
+    # 'per-segment seconds sum to the elapsed'
+    ne = obj.get("ne")
+    recorded = [s for s in (obj.get("samples") or []) if _is_num(s)] \
+        + [d for d in (obj.get("discarded") or []) if _is_num(d)]
+    if _is_num(ne) and recorded and not loose:
+        for r in runs:
+            if r["iters"] <= 0:
+                continue
+            implied = ne * r["iters"] / r["seconds"] / 1e9
+            if min(abs(implied - s) for s in recorded) > 2e-4:
+                errs.append(
+                    f"{name}: run (repeat {r['repeat']}) implies "
+                    f"{implied:.4f} GTEPS — matches no recorded "
+                    f"sample; seconds and samples disagree")
+
+    cnt = tel["counters"]
+    if cnt is not None:
+        if (not isinstance(cnt, dict)
+                or cnt.get("kind") not in ("push", "pull")
+                or not isinstance(cnt.get("iters"), int)
+                or cnt["iters"] < 0
+                or not isinstance(cnt.get("truncated"), bool)):
+            errs.append(f"{name}: telemetry.counters malformed: "
+                        f"{cnt!r}")
+        else:
+            numeric = [k for k in ("frontier_last", "frontier_max",
+                                   "frontier_sum", "edges_sum",
+                                   "residual_first", "residual_last",
+                                   "changed_last", "changed_sum")
+                       if k in cnt and not _is_num(cnt[k])]
+            if numeric:
+                errs.append(f"{name}: telemetry.counters non-finite "
+                            f"fields {numeric}")
+    return errs
+
+
+def iter_event_lines(path: str):
+    """Telemetry event objects ({"t": ..., "kind": ...} JSONL, the
+    -events FILE format) — so an event log handed to this checker
+    audits as events instead of failing as 'no metric lines'."""
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "kind" in obj \
+                    and "metric" not in obj:
+                yield f"line {i}", obj
+
+
+def check_event_lines(path: str, events):
+    """Minimal schema for a telemetry event log: string kind, numeric
+    timestamp, numeric seconds where present."""
+    errs = []
+    for where, ev in events:
+        if not isinstance(ev.get("kind"), str):
+            errs.append(f"{path} ({where}): event kind must be a "
+                        f"string, got {ev.get('kind')!r}")
+        if not _is_num(ev.get("t")):
+            errs.append(f"{path} ({where}): event without a numeric "
+                        f"'t' timestamp")
+        if "seconds" in ev and not _is_num(ev["seconds"]):
+            errs.append(f"{path} ({where}): non-finite seconds "
+                        f"{ev['seconds']!r}")
+    return errs
 
 
 def check_file(path: str, *, legacy_ok: bool):
@@ -174,6 +301,9 @@ def check_file(path: str, *, legacy_ok: bool):
     except (OSError, UnicodeDecodeError) as e:
         return [f"{path}: unreadable ({e})"], [], 0
     if not lines:
+        events = list(iter_event_lines(path))
+        if events:
+            return check_event_lines(path, events), [], len(events)
         return [f"{path}: no metric lines found"], [], 0
     for where, obj in lines:
         n += 1

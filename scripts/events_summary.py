@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Render a telemetry event-log JSONL into the reference-style table.
+
+The reference's -verbose run prints a per-iteration
+loadTime/compTime/updateTime breakdown and little else (reference
+sssp_gpu.cu:513-518, pagerank.cc:108-118).  ``-events FILE`` runs
+(lux_tpu/cli.py, bench.py) leave a structured JSONL instead
+(lux_tpu/telemetry.py); this script renders one back into that
+human shape — and audits it while doing so:
+
+- unparseable lines or events without a ``kind`` FAIL the render, as
+  do timed events (timed_run/segment/run_done) missing their
+  ``seconds``
+- per run: segment seconds must not sum PAST the ``run_done``
+  elapsed (20% + 50 ms slack) — overshoot means segments overlap or
+  double-count, i.e. the fenced slice timings are lying.  Summing
+  UNDER the elapsed is expected: the elapsed legitimately includes
+  checkpoint saves and host driver time between slices.
+
+Usage:
+    python scripts/events_summary.py FILE [FILE...]
+
+Exit status: 0 clean, 1 any error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+KNOWN = {"run_start", "config_start", "header", "timed_run",
+         "segment", "run_done", "iter_stats", "phases",
+         "checkpoint_save", "checkpoint_resume", "retry", "failure",
+         "budget_lock", "budget_halve", "outlier_discard",
+         "outlier_rerun"}
+
+
+def load_events(path: str):
+    """Parse one JSONL file.  Returns (events, errors)."""
+    events, errs = [], []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError as e:
+                errs.append(f"line {i}: unparseable JSON ({e})")
+                continue
+            if not isinstance(ev, dict) or "kind" not in ev:
+                errs.append(f"line {i}: event without a 'kind'")
+                continue
+            events.append(ev)
+    if not events and not errs:
+        errs.append("no events found")
+    return events, errs
+
+
+def split_runs(events):
+    """Group the flat stream into runs at run_start/config_start
+    boundaries (one CLI invocation / bench config each); a log
+    without boundary events is one anonymous run."""
+    runs, cur = [], []
+    for ev in events:
+        if ev["kind"] in ("run_start", "config_start") and cur:
+            runs.append(cur)
+            cur = []
+        cur.append(ev)
+    if cur:
+        runs.append(cur)
+    return runs
+
+
+def _fmt_s(x: float) -> str:
+    return f"{x:9.3f} s"
+
+
+def render_run(run, out=sys.stdout) -> list[str]:
+    """Print one run's table; returns audit errors."""
+    errs = []
+    by = {}
+    for ev in run:
+        by.setdefault(ev["kind"], []).append(ev)
+
+    def seconds_of(kind):
+        """[seconds] of every ``kind`` event; missing/non-numeric
+        seconds become audit errors instead of a crash."""
+        vals = []
+        for ev in by.get(kind, []):
+            s = ev.get("seconds")
+            if isinstance(s, (int, float)) and not isinstance(s, bool):
+                vals.append(s)
+            else:
+                errs.append(f"{kind} event without numeric "
+                            f"'seconds': {ev!r}"[:160])
+        return vals
+
+    head = (by.get("run_start") or by.get("config_start") or [{}])[0]
+    title = head.get("app") or head.get("config") or "run"
+    print(f"== {title} ==", file=out)
+    for h in by.get("header", []):
+        mem = h.get("memory", {})
+        per_part = mem.get("edge_bytes_per_part", 0) \
+            + mem.get("vertex_bytes_per_part", 0)
+        print(f"  graph: nv={h.get('nv')} ne={h.get('ne')} "
+              f"parts={h.get('num_parts')} "
+              f"(~{per_part / 1e6:.1f} MB/part HBM, "
+              f"{mem.get('total_bytes', 0) / 1e6:.1f} MB total)",
+              file=out)
+
+    # the reference's per-iteration loadTime/compTime/updateTime
+    # table, from the CLI's -phases instrumented iterations
+    META = ("frontier", "bucket", "advances")   # counters, not times
+    for ph in by.get("phases", []):
+        print("  per-iteration phases (reference loadTime/compTime/"
+              "updateTime analogue):", file=out)
+        for i, t in enumerate(ph.get("report", [])):
+            cells = "  ".join(
+                (f"{k}={v:g}" if k in META
+                 else f"{k}={v * 1e3:8.2f}ms") for k, v in t.items()
+                if isinstance(v, (int, float)))
+            print(f"    iter {i}: {cells}", file=out)
+
+    for st in by.get("iter_stats", []):
+        eng = st.get("engine")
+        # a zero-iteration digest carries only kind/iters/truncated
+        if eng == "push" and "frontier_max" in st:
+            print(f"  counters (push): {st.get('iters')} iters, "
+                  f"frontier max {st.get('frontier_max')} "
+                  f"sum {st.get('frontier_sum')}, "
+                  f"edges relaxed {st.get('edges_sum')}", file=out)
+        elif eng == "pull" and "residual_first" in st:
+            print(f"  counters (pull): {st.get('iters')} iters, "
+                  f"residual {st['residual_first']:.3e} -> "
+                  f"{st['residual_last']:.3e}, "
+                  f"changed_last {st.get('changed_last')}", file=out)
+        else:
+            print(f"  counters ({eng}): {st.get('iters')} iters",
+                  file=out)
+        if st.get("truncated"):
+            print("    WARNING: counter buffers truncated", file=out)
+
+    timed = by.get("timed_run", [])
+    if timed:
+        secs = seconds_of("timed_run")
+        print(f"  timed runs: {len(timed)}  "
+              f"[{' '.join(f'{s:.3f}s' for s in secs)}]", file=out)
+
+    segs = by.get("segment", [])
+    seg_s = sum(seconds_of("segment"))
+    if segs:
+        print(f"  segments: {len(segs)}  compTime {_fmt_s(seg_s)}",
+              file=out)
+    saves = by.get("checkpoint_save", [])
+    if saves:
+        print(f"  checkpoint saves: {len(saves)}  updateTime "
+              f"{_fmt_s(sum(s.get('seconds', 0) for s in saves))}",
+              file=out)
+    for r in by.get("checkpoint_resume", []):
+        print(f"  resumed from iter {r.get('iter')} "
+              f"({r.get('path')})", file=out)
+    for r in by.get("retry", []):
+        print(f"  retry: attempt {r.get('attempt')} "
+              f"{r.get('error')} [{r.get('classification')}] "
+              f"backoff {r.get('backoff_s')}s", file=out)
+    for r in by.get("failure", []):
+        print(f"  FAILURE: {r.get('error')} "
+              f"[{r.get('classification')}]", file=out)
+    for d in by.get("outlier_discard", []):
+        print(f"  outlier discarded: {d.get('sample')} "
+              f"(median {d.get('median')})", file=out)
+
+    done = by.get("run_done", [])
+    if done:
+        total = sum(seconds_of("run_done"))
+        print(f"  ELAPSED TIME = {total:.6f} s", file=out)
+        # segments are slices OF the elapsed: summing past it means
+        # they overlap or double-count (under-sum is fine — elapsed
+        # also bills checkpoint saves and host driver time)
+        if segs and seg_s > total * 1.2 + 0.05:
+            errs.append(
+                f"{title}: segment seconds sum to {seg_s:.3f}s > "
+                f"run_done elapsed {total:.3f}s — segments overlap "
+                f"or double-count")
+
+    unknown = sorted(set(by) - KNOWN)
+    if unknown:
+        print(f"  (other events: "
+              f"{', '.join(f'{k} x{len(by[k])}' for k in unknown)})",
+              file=out)
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a lux_tpu telemetry event JSONL "
+                    "(-events FILE) into the reference-style table")
+    ap.add_argument("files", nargs="+", metavar="FILE")
+    args = ap.parse_args(argv)
+
+    all_errs = []
+    for path in args.files:
+        try:
+            events, errs = load_events(path)
+        except OSError as e:
+            all_errs.append(f"{path}: unreadable ({e})")
+            continue
+        all_errs += [f"{path}: {e}" for e in errs]
+        for run in split_runs(events):
+            all_errs += [f"{path}: {e}" for e in render_run(run)]
+    for e in all_errs:
+        print(f"ERROR: {e}", file=sys.stderr)
+    if all_errs:
+        print(f"events_summary: {len(all_errs)} error(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

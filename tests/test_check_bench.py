@@ -22,6 +22,21 @@ GOOD_LINE = {
     "value": 0.1118, "unit": "GTEPS", "vs_baseline": 0.1118,
     "samples": [0.1116, 0.1118, 0.112],
     "attempts": 4, "discarded": [0.0107], "np": 4,
+    "ne": 10**9,
+    # round 7: per-run seconds (one per attempt, reruns included)
+    # re-deriving each recorded sample, plus the counter digest
+    "telemetry": {
+        "runs": [
+            {"repeat": 0, "iters": 10, "seconds": 89.605735},
+            {"repeat": 1, "iters": 10, "seconds": 89.445438},
+            {"repeat": 2, "iters": 10, "seconds": 89.285714},
+            {"repeat": 0, "iters": 10, "seconds": 934.579439},
+        ],
+        "counters": {"kind": "pull", "iters": 10, "truncated": False,
+                     "residual_first": 3.5e-4,
+                     "residual_last": 9.7e-8,
+                     "changed_last": 12, "changed_sum": 480},
+    },
 }
 
 
@@ -64,10 +79,22 @@ def test_good_new_schema_line_passes(tmp_path):
     (lambda d: d.update(samples=[0.1116, 0.1118, 0.0107],
                         value=0.1116, attempts=4),
      "both samples and discarded"),
+    # round-7 telemetry field
+    (lambda d: d.pop("telemetry"), "missing telemetry"),
+    (lambda d: d["telemetry"].update(runs=d["telemetry"]["runs"][:2]),
+     "timed runs"),
+    (lambda d: d["telemetry"]["runs"][0].update(seconds=50.0),
+     "matches no recorded sample"),
+    (lambda d: d["telemetry"].update(counters={"kind": "sideways"}),
+     "counters malformed"),
+    (lambda d: d["telemetry"].update(runs=[{"repeat": 0, "iters": 10,
+                                            "seconds": 0.0}] * 4),
+     "telemetry.runs"),
+    (lambda d: d.update(telemetry={"runs": []}), "telemetry must be"),
 ])
 def test_bad_lines_fail(tmp_path, mutate, needle):
-    d = dict(GOOD_LINE)
-    mutate(d)
+    d = json.loads(json.dumps(GOOD_LINE))   # deep copy: mutators
+    mutate(d)                               # touch nested dicts
     p = tmp_path / "bench.jsonl"
     p.write_text(json.dumps(d) + "\n")
     r = run_check(p)
@@ -87,6 +114,39 @@ def test_failed_config_line_schema(tmp_path):
     assert r.returncode == 1 and "failure line missing" in r.stderr
     # legacy mode tolerates it (historical crash lines)
     assert run_check("-legacy-ok", p).returncode == 0
+
+
+def test_crashed_rerun_line_accepted(tmp_path):
+    """An outlier rerun that crashed after its timed_run event landed
+    leaves runs > attempts with no matching sample; the recorded
+    rerun_error legitimizes both (bench.py's crash-tolerant path)."""
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["samples"] = [0.1116, 0.1118, 0.112]
+    d["value"] = 0.1118
+    d["discarded"] = []
+    d["attempts"] = 3
+    d["rerun_error"] = "RuntimeError: tunnel died"
+    d["rerun_error_class"] = "retryable"
+    # 4th run's sample never recorded — the crashed rerun
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 0, r.stderr
+
+
+def test_events_jsonl_accepted(tmp_path):
+    """An -events telemetry log (kind/t objects, no metric lines)
+    audits as events instead of failing (round-7 acceptance: both
+    checkers accept the -events JSONL)."""
+    p = tmp_path / "events.jsonl"
+    p.write_text(
+        '{"t": 1.0, "kind": "run_start", "app": "sssp"}\n'
+        '{"t": 1.2, "kind": "timed_run", "repeat": 0, "iters": 5, '
+        '"seconds": 0.02}\n')
+    assert run_check(p).returncode == 0
+    p.write_text('{"t": 1.0, "kind": "segment", "seconds": "fast"}\n')
+    r = run_check(p)
+    assert r.returncode == 1 and "non-finite seconds" in r.stderr
 
 
 def test_unparseable_and_empty_inputs(tmp_path):

@@ -65,6 +65,10 @@ def test_phase_timer(capsys):
         pass
     with pt.phase("b", fence=np.zeros(3)):
         pass
-    pt.report()
+    phases = pt.report()
     out = capsys.readouterr().out
     assert "a" in out and "total" in out
+    # round 7: report() RETURNS the phases list so callers consume
+    # the data instead of re-parsing stdout
+    assert [name for name, _t in phases] == ["a", "b"]
+    assert all(t >= 0 for _n, t in phases)

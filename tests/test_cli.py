@@ -106,6 +106,65 @@ def test_pair_flag_cli(lux_file, capsys):
         assert "[PASS]" in out, f"{app}: {out}"
 
 
+def _iter_lines(out):
+    return [ln for ln in out.splitlines() if ln.startswith("iter ")]
+
+
+def test_iter_stats_matches_verbose_replay(lux_file, capsys):
+    """-iter-stats on the fused timed path reports the same
+    per-iteration frontier series as -verbose (both replay the
+    device-side counters; test_telemetry ties that series to the
+    stepwise NumPy oracle)."""
+    rc = cli.main(["sssp", "-file", lux_file, "-start", "1",
+                   "-iter-stats"])
+    stats_out = capsys.readouterr().out
+    assert rc == 0
+    assert "# iter-stats" in stats_out
+    rc = cli.main(["sssp", "-file", lux_file, "-start", "1",
+                   "-verbose"])
+    verbose_out = capsys.readouterr().out
+    assert rc == 0
+    assert _iter_lines(stats_out) == _iter_lines(verbose_out)
+    assert _iter_lines(stats_out), "no per-iteration lines printed"
+
+
+def test_events_flag_writes_jsonl(lux_file, tmp_path, capsys):
+    import json
+
+    ev = tmp_path / "events.jsonl"
+    rc = cli.main(["pagerank", "-file", lux_file, "-ni", "3",
+                   "-np", "2", "-events", str(ev), "-iter-stats"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "iter 1: residual=" in out
+    events = [json.loads(s) for s in ev.read_text().splitlines()]
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "run_start" and "header" in kinds
+    assert "run_done" in kinds and "iter_stats" in kinds
+    hdr = events[kinds.index("header")]
+    assert hdr["nv"] == 120 and hdr["memory"]["total_bytes"] > 0
+
+
+def test_iter_stats_supervised_segments(lux_file, tmp_path, capsys):
+    """Counters accumulate across supervised segment boundaries: the
+    supervised run's series equals the plain fused run's."""
+    import json
+
+    ev = tmp_path / "events.jsonl"
+    rc = cli.main(["sssp", "-file", lux_file, "-start", "1",
+                   "-iter-stats"])
+    plain = _iter_lines(capsys.readouterr().out)
+    assert rc == 0
+    rc = cli.main(["sssp", "-file", lux_file, "-start", "1",
+                   "-iter-stats", "-retries", "1", "-seg-budget", "30",
+                   "-events", str(ev)])
+    sup_out = capsys.readouterr().out
+    assert rc == 0
+    assert _iter_lines(sup_out) == plain
+    kinds = [json.loads(s)["kind"] for s in ev.read_text().splitlines()]
+    assert "segment" in kinds and "checkpoint_save" in kinds
+
+
 def test_convert_cli(tmp_path, capsys):
     txt = tmp_path / "e.txt"
     txt.write_text("0 1\n1 2\n2 0\n")

@@ -1,0 +1,146 @@
+"""scripts/events_summary.py: render + audit of -events JSONL logs."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "events_summary.py"
+
+
+def run_summary(*argv):
+    return subprocess.run(
+        [sys.executable, str(SCRIPT), *map(str, argv)],
+        capture_output=True, text=True)
+
+
+def write_log(path, events):
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+
+
+GOOD = [
+    {"t": 1.0, "kind": "run_start", "schema": 1, "app": "pagerank"},
+    {"t": 1.1, "kind": "header", "schema": 1, "nv": 120, "ne": 900,
+     "num_parts": 2,
+     "memory": {"edge_bytes_per_part": 2560,
+                "vertex_bytes_per_part": 512,
+                "total_bytes": 6144}},
+    {"t": 1.2, "kind": "segment", "engine": "pull", "n": 2, "done": 2,
+     "seconds": 0.12},
+    {"t": 1.3, "kind": "checkpoint_save", "iter": 2, "engine": "pull",
+     "path": "/tmp/x.npz", "seconds": 0.01},
+    {"t": 1.4, "kind": "segment", "engine": "pull", "n": 2, "done": 4,
+     "seconds": 0.10},
+    {"t": 1.5, "kind": "run_done", "seconds": 0.30, "iters": 4},
+    {"t": 1.6, "kind": "iter_stats", "engine": "pull", "iters": 4,
+     "truncated": False, "residual_first": 3.5e-4,
+     "residual_last": 9.7e-8, "changed_last": 120},
+    {"t": 1.7, "kind": "phases", "iters": 1,
+     "report": [{"exchange": 0.002, "gather": 0.003, "reduce": 0.004,
+                 "apply": 0.001}]},
+]
+
+
+def test_good_log_renders(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, GOOD)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "== pagerank ==" in out
+    assert "nv=120" in out and "ne=900" in out
+    assert "segments: 2" in out
+    assert "checkpoint saves: 1" in out
+    assert "loadTime/compTime/updateTime" in out
+    assert "counters (pull)" in out
+    assert "ELAPSED TIME = 0.3" in out
+
+
+def test_cli_produced_log_accepted(tmp_path):
+    """End-to-end: a real -events run (the acceptance criterion's
+    'a JSONL that events_summary.py accepts')."""
+    import numpy as np
+
+    from lux_tpu import cli
+    from lux_tpu import format as luxfmt
+    from lux_tpu.convert import uniform_random_edges
+    from lux_tpu.graph import Graph
+
+    src, dst = uniform_random_edges(100, 700, seed=4)
+    g = Graph.from_edges(src, dst, 100)
+    lux = tmp_path / "g.lux"
+    luxfmt.write_lux(str(lux), g.row_ptrs, g.col_idx,
+                     degrees=g.out_degrees)
+    ev = tmp_path / "events.jsonl"
+    rc = cli.main(["sssp", "-file", str(lux), "-start", "0",
+                   "-iter-stats", "-events", str(ev)])
+    assert rc == 0 and ev.exists()
+    kinds = [json.loads(s)["kind"] for s in
+             ev.read_text().splitlines()]
+    assert {"run_start", "header", "timed_run", "run_done",
+            "iter_stats"} <= set(kinds)
+    r = run_summary(ev)
+    assert r.returncode == 0, r.stderr
+    assert "== sssp ==" in r.stdout
+    assert "counters (push)" in r.stdout
+
+
+def test_unparseable_line_fails(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    p.write_text('{"kind": "header"}\nnot json at all {\n')
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "unparseable" in r.stderr
+
+
+def test_missing_kind_fails(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    p.write_text('{"t": 1.0, "no_kind": true}\n')
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "without a 'kind'" in r.stderr
+
+
+def test_segment_overcount_fails(tmp_path):
+    """Segment seconds summing PAST the run's elapsed means the
+    fenced slice timings overlap or double-count — the audit must
+    fail (under-sum is legitimate: elapsed also bills checkpoint
+    saves and host driver time)."""
+    bad = [
+        {"t": 1.0, "kind": "run_start", "app": "pagerank"},
+        {"t": 1.1, "kind": "segment", "engine": "pull", "n": 2,
+         "done": 2, "seconds": 5.0},
+        {"t": 1.2, "kind": "run_done", "seconds": 0.5, "iters": 2},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, bad)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "overlap" in r.stderr
+
+
+def test_timed_event_missing_seconds_fails(tmp_path):
+    bad = [
+        {"t": 1.0, "kind": "run_start", "app": "sssp"},
+        {"t": 1.1, "kind": "timed_run", "repeat": 0, "iters": 5},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, bad)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "without numeric 'seconds'" in r.stderr
+
+
+def test_multi_run_log_splits(tmp_path):
+    events = GOOD + [
+        {"t": 2.0, "kind": "config_start", "config": "sssp"},
+        {"t": 2.1, "kind": "timed_run", "repeat": 0, "iters": 5,
+         "seconds": 0.02},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "== pagerank ==" in r.stdout and "== sssp ==" in r.stdout
+    assert "timed runs: 1" in r.stdout

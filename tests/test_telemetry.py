@@ -1,0 +1,284 @@
+"""In-loop telemetry (lux_tpu/telemetry.py): device-side iteration
+counters against stepwise/NumPy oracles, the structured event log, and
+the cross-layer wiring (segmented drivers, supervisor, timing helpers).
+
+The counter contract under test is the acceptance bar of the round-7
+ISSUE: the fused run's per-iteration frontier sizes / residuals must
+equal what the old stepwise -verbose path printed — computed here by
+actually stepping the engines one compiled iteration at a time.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from lux_tpu import telemetry
+from lux_tpu.apps import components, pagerank, sssp
+from lux_tpu.convert import uniform_random_edges
+from lux_tpu.graph import Graph
+from lux_tpu.parallel.mesh import make_mesh
+
+
+def small_graph(nv=180, ne=1400, seed=7, weighted=False):
+    if weighted:
+        src, dst, w = uniform_random_edges(nv, ne, seed=seed,
+                                           weighted=True)
+        return Graph.from_edges(src, dst, nv, weights=w)
+    src, dst = uniform_random_edges(nv, ne, seed=seed)
+    return Graph.from_edges(src, dst, nv)
+
+
+def stepwise_push_series(eng):
+    """The old stepwise -verbose path: frontier size after each
+    compiled step, plus each iteration's entering-frontier out-edges
+    from the full graph's degrees (the NumPy side of the oracle)."""
+    deg = np.asarray(eng.sg.deg_padded)
+    label, active = eng.init_state()
+    fronts, edges = [], []
+    cnt = int(jax.device_get(np.sum(np.asarray(active))))
+    while cnt > 0:
+        act_np = np.asarray(jax.device_get(active))
+        edges.append(int(deg[act_np].sum()))
+        label, active, c = eng.step(label, active)
+        cnt = int(jax.device_get(c))
+        fronts.append(cnt)
+    return fronts, edges
+
+
+@pytest.mark.parametrize("np_parts,mesh_n", [(1, 0), (8, 8)])
+def test_push_classic_counters_match_stepwise(np_parts, mesh_n):
+    g = small_graph()
+    mesh = make_mesh(mesh_n) if mesh_n else None
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=np_parts,
+                            mesh=mesh)
+    fronts, edges = stepwise_push_series(eng)
+
+    label, active = eng.init_state()
+    l2, a2, it, fsz, fed = eng.converge_stats(label, active)
+    it = int(jax.device_get(it))
+    assert it == len(fronts)
+    assert np.asarray(fsz)[:it].tolist() == fronts
+    assert np.asarray(fed)[:it].tolist() == edges
+    # past-the-run entries stay zero, and the labels are the oracle's
+    assert not np.asarray(fsz)[it:].any()
+    dist = eng.unpad(l2)
+    want = sssp.reference_sssp(g, start_vertex=1)
+    reach = ~sssp.unreachable(dist)
+    np.testing.assert_array_equal(dist[reach], want[reach])
+
+
+@pytest.mark.parametrize("np_parts,mesh_n", [(1, 0), (8, 8)])
+def test_components_counters_match_stepwise(np_parts, mesh_n):
+    s, d = small_graph(seed=9).edge_arrays()
+    g = Graph.from_edges(*components.symmetrize(s, d), 180)
+    mesh = make_mesh(mesh_n) if mesh_n else None
+    eng = components.build_engine(g, num_parts=np_parts, mesh=mesh)
+    fronts, edges = stepwise_push_series(eng)
+    label, active = eng.init_state()
+    _l, _a, it, fsz, fed = eng.converge_stats(label, active)
+    it = int(jax.device_get(it))
+    assert np.asarray(fsz)[:it].tolist() == fronts
+    assert np.asarray(fed)[:it].tolist() == edges
+
+
+def test_push_delta_counters_match_timed_phases():
+    """Delta engines record each relax step's bucket-front size — the
+    exact schedule the instrumented stepwise path
+    (timed_phases/_timed_phases_delta) replays."""
+    g = small_graph(weighted=True)
+    eng = sssp.build_engine(g, start_vertex=0, num_parts=1,
+                            weighted=True, delta="auto")
+    label, active = eng.init_state()
+    _l, _a, it, fsz, _fed = eng.converge_stats(label, active)
+    it = int(jax.device_get(it))
+    lab0, act0 = eng.init_state()
+    _l2, _a2, report = eng.timed_phases(lab0, act0, iters=it)
+    assert [t["frontier"] for t in report] == \
+        np.asarray(fsz)[:it].tolist()
+
+
+@pytest.mark.parametrize("np_parts,mesh_n", [(1, 0), (8, 8)])
+def test_pull_counters_match_stepwise(np_parts, mesh_n):
+    g = small_graph(seed=11)
+    mesh = make_mesh(mesh_n) if mesh_n else None
+    eng = pagerank.build_engine(g, num_parts=np_parts, mesh=mesh)
+    prev = np.asarray(jax.device_get(eng.init_state())).copy()
+    res_oracle, chg_oracle = [], []
+    s = eng.init_state()
+    for _ in range(5):
+        s = eng.step(s)
+        cur = np.asarray(jax.device_get(s)).copy()
+        d = np.abs(cur.astype(np.float32) - prev.astype(np.float32))
+        res_oracle.append(float(d.max()))
+        chg_oracle.append(int((d > 0).sum()))
+        prev = cur
+
+    s2, rb, cb = eng.run_stats(eng.init_state(), 5)
+    np.testing.assert_allclose(np.asarray(rb)[:5], res_oracle,
+                               rtol=1e-6)
+    assert np.asarray(cb)[:5].tolist() == chg_oracle
+    np.testing.assert_array_equal(np.asarray(jax.device_get(s2)), prev)
+
+
+def test_pull_run_until_stats_matches_run_until():
+    g = small_graph(seed=13)
+    eng = pagerank.build_engine(g, num_parts=2)
+    s1, it1, res1 = eng.run_until(eng.init_state(), 1e-6,
+                                  max_iters=50)
+    s2, it2, res2, rb, cb = eng.run_until_stats(
+        eng.init_state(), 1e-6, max_iters=50)
+    it1, it2 = int(jax.device_get(it1)), int(jax.device_get(it2))
+    assert it1 == it2
+    assert float(jax.device_get(res1)) == float(jax.device_get(res2))
+    # the residual series ends exactly at the convergence residual,
+    # and every earlier entry is above the tolerance
+    rbn = np.asarray(rb)[:it2]
+    assert rbn[-1] == pytest.approx(float(jax.device_get(res2)))
+    assert (rbn[:-1] > 1e-6).all()
+    np.testing.assert_array_equal(np.asarray(jax.device_get(s1)),
+                                  np.asarray(jax.device_get(s2)))
+
+
+def test_push_verbose_replays_counters(capsys):
+    g = small_graph()
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=1)
+    fronts, _ = stepwise_push_series(eng)
+    eng2 = sssp.build_engine(g, start_vertex=1, num_parts=1)
+    _labels, it = eng2.run(verbose=True)
+    out = capsys.readouterr().out
+    want = [f"iter {i}: frontier={f}" for i, f in enumerate(fronts, 1)]
+    got = [ln for ln in out.splitlines() if ln.startswith("iter ")]
+    assert [ln.split(" edges")[0] for ln in got] == want
+
+
+def test_stats_cap_truncation():
+    g = small_graph()
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=1)
+    eng.stats_cap = 2     # read lazily when converge_stats compiles
+    label, active = eng.init_state()
+    _l, _a, it, fsz, fed = eng.converge_stats(label, active)
+    it = int(jax.device_get(it))
+    assert it > 2 and fsz.shape == (2,)
+    st = telemetry.IterStats()
+    st.extend_push(fsz, fed, it)
+    assert st.truncated and len(st.frontier) == 2
+    assert "truncated" in list(st.replay_lines())[-1]
+
+
+def test_segmented_accumulation_matches_unsegmented():
+    """Slice boundaries must be invisible in the counter series (the
+    supervised/budgeted paths run through converge_segments)."""
+    from lux_tpu.segmented import converge_segments, run_segments
+
+    g = small_graph()
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=1)
+    label, active = eng.init_state()
+    _l, _a, it, fsz, _fed = eng.converge_stats(label, active)
+    it = int(jax.device_get(it))
+
+    st = telemetry.IterStats()
+    ev = telemetry.EventLog()
+    with telemetry.use(events=ev, iter_stats=st):
+        label, active = eng.init_state()
+        _l2, _a2, total = converge_segments(eng, label, active,
+                                            segment=2)
+    assert total == it
+    assert st.frontier == np.asarray(fsz)[:it].tolist()
+    segs = [e for e in ev.events if e["kind"] == "segment"]
+    assert sum(e["iters"] for e in segs) == it
+    assert all(e["engine"] == "push" for e in segs)
+
+    peng = pagerank.build_engine(g, num_parts=1)
+    _s, rb, cb = peng.run_stats(peng.init_state(), 6)
+    st2 = telemetry.IterStats()
+    with telemetry.use(iter_stats=st2):
+        run_segments(peng, peng.init_state(), 6, segment=4)
+    np.testing.assert_allclose(st2.residual, np.asarray(rb)[:6],
+                               rtol=1e-6)
+    assert st2.changed == np.asarray(cb)[:6].tolist()
+
+
+def test_timed_helpers_emit_and_record(tmp_path):
+    from lux_tpu.timing import timed_converge, timed_fused_run
+
+    g = small_graph()
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=1)
+    st = telemetry.IterStats()
+    ev = telemetry.EventLog(str(tmp_path / "ev.jsonl"))
+    with telemetry.use(events=ev, iter_stats=st):
+        _labels, it, elapsed = timed_converge(eng, repeats=2)
+    assert len(elapsed) == 2 and len(st.frontier) == it
+    runs = [e for e in ev.events if e["kind"] == "timed_run"]
+    assert [r["repeat"] for r in runs] == [0, 1]
+    assert [r["seconds"] for r in runs] == \
+        [round(e, 6) for e in elapsed]
+    # the JSONL on disk is the same stream
+    lines = [json.loads(s) for s in
+             (tmp_path / "ev.jsonl").read_text().splitlines()]
+    assert [ln["kind"] for ln in lines] == \
+        [e["kind"] for e in ev.events]
+
+    peng = pagerank.build_engine(g, num_parts=1)
+    st2 = telemetry.IterStats()
+    with telemetry.use(iter_stats=st2):
+        timed_fused_run(peng, 4, repeats=1)
+    assert st2.kind == "pull" and len(st2.residual) == 4
+
+
+def test_supervised_run_report_carries_counters(tmp_path):
+    from lux_tpu import resilience
+
+    g = small_graph()
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=1)
+    st = telemetry.IterStats()
+    ev = telemetry.EventLog()
+    with telemetry.use(events=ev, iter_stats=st):
+        _label, _active, total, report = resilience.supervised_converge(
+            eng, str(tmp_path / "ck.npz"), segment=2)
+    assert report.counters is not None
+    assert report.counters["kind"] == "push"
+    assert report.counters["iters"] == total == len(st.frontier)
+    assert report.as_dict()["counters"] == report.counters
+    kinds = ev.counts()
+    assert kinds.get("segment") and kinds.get("checkpoint_save")
+
+
+def test_counters_exact_through_crash_resume(tmp_path):
+    """Counters append only after the segment hook (checkpoint save)
+    survives: a crash in the save window re-runs the slice on resume,
+    and the accumulated series must NOT double-count it."""
+    from lux_tpu import faults, resilience
+
+    g = small_graph()
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=1)
+    label, active = eng.init_state()
+    _l, _a, it, fsz, _fed = eng.converge_stats(label, active)
+    it = int(jax.device_get(it))
+    ref = np.asarray(fsz)[:it].tolist()
+
+    eng2 = sssp.build_engine(g, start_vertex=1, num_parts=1)
+    plan = faults.FaultPlan.seeded(seed=3, n=8, p_crash=0.5)
+    st = telemetry.IterStats()
+    with telemetry.use(iter_stats=st):
+        _lbl, _act, total, report = resilience.supervised_converge(
+            eng2, str(tmp_path / "ck.npz"), segment=2, faults=plan,
+            policy=resilience.RetryPolicy(retries=8, backoff_s=0.0))
+    assert report.attempts > 1, "no injected crash fired"
+    assert total == it
+    assert st.frontier == ref
+
+
+def test_event_log_and_null_handle():
+    ev = telemetry.EventLog()
+    ev.emit("header", nv=4)
+    ev.emit("segment", engine="pull", seconds=0.5)
+    assert ev.counts() == {"header": 1, "segment": 1}
+    # the null handle swallows emits and reports no sinks
+    assert telemetry.current().emit("anything") is None
+    assert telemetry.current().iter_stats is None
+    # nested scopes restore the previous handle
+    with telemetry.use(events=ev) as tel:
+        assert telemetry.current() is tel
+    assert telemetry.current().events is None

@@ -1,0 +1,64 @@
+"""timing.fence / _cksum: the O(1)-byte completion fence.
+
+Round-7 regression (ISSUE 2 satellite): the checksum used to cast
+every leaf through float32, whose 24-bit mantissa collapses integer
+values differing only above bit 24 — exactly the packed uint32 pair
+rows (src << 7 | rel).  Wide integer leaves must now sum exactly.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.timing import _cksum, fence, fetch
+
+
+def ck(*leaves):
+    return np.asarray(_cksum(*leaves))
+
+
+def test_wide_uint32_values_distinguished():
+    """Two packed-pair-row buffers differing only above float32
+    precision must produce different checksums (the old float32 path
+    mapped both to the same number)."""
+    a = jnp.full((8,), 1 << 25, jnp.uint32)
+    b = a.at[0].set((1 << 25) + 1)
+    old_a = float(jnp.sum(a[:8].astype(jnp.float32)))
+    old_b = float(jnp.sum(b[:8].astype(jnp.float32)))
+    assert old_a == old_b          # the bug this test pins down
+    assert not np.array_equal(ck(a), ck(b))
+
+
+def test_wide_int_sum_is_exact_and_deterministic():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 1 << 32, size=8, dtype=np.uint32))
+    assert np.array_equal(ck(x), ck(x))
+    # flipping any single low bit moves the checksum
+    for i in range(8):
+        y = x.at[i].set(x[i] ^ 1)
+        assert not np.array_equal(ck(x), ck(y)), f"lane {i}"
+
+
+def test_narrow_and_float_leaves_ride_the_float_channel():
+    # int16 fits float32 exactly: stays on the float channel
+    small = jnp.arange(8, dtype=jnp.int16)
+    f = jnp.linspace(0.0, 1.0, 8, dtype=jnp.float32)
+    c = ck(small, f)
+    assert c.shape == (3,)
+    assert c[1] == 0 and c[2] == 0       # int channels untouched
+    # mixed wide + float: each rides its own channel
+    wide = jnp.full((8,), (1 << 30) + 7, jnp.uint32)
+    c2 = ck(f, wide)
+    assert c2[0] == float(jnp.sum(f))
+    assert (c2[1], c2[2]) != (0.0, 0.0)
+
+
+def test_fence_handles_packed_pytrees():
+    """fence() on a state pytree containing wide uint32 leaves (the
+    packed owner layout) completes without error and leaves the state
+    intact."""
+    state = {"rows": jnp.full((4, 8), (1 << 26) + 3, jnp.uint32),
+             "vals": jnp.ones((4, 8), jnp.float32)}
+    fence(state)
+    np.testing.assert_array_equal(fetch(state["rows"]),
+                                  np.full((4, 8), (1 << 26) + 3,
+                                          np.uint64).astype(np.uint32))
