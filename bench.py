@@ -193,8 +193,10 @@ def run_config(config, args):
                                              pair_threshold=pair_t)
             eng = colfilter.build_engine(g2, num_parts=args.np,
                                          pair_threshold=pair_t,
+                                         pair_min_fill=args.min_fill_dot,
                                          starts=starts)
-            extra.update(relabel=True, pair_threshold=pair_t)
+            extra.update(relabel=True, pair_threshold=pair_t,
+                         min_fill=args.min_fill_dot)
         else:
             eng = colfilter.build_engine(g, num_parts=args.np)
             extra.update(relabel=False, pair_threshold=None)
@@ -308,13 +310,16 @@ def main() -> int:
     ap.add_argument("-np", type=int, default=1, help="partitions")
     ap.add_argument("-pair", type=int, default=PAIR_THRESHOLD,
                     help="pair-lane threshold (0 disables)")
-    ap.add_argument("-min-fill", type=int, default=24,
+    ap.add_argument("-min-fill", type=int, default=-1,
                     dest="min_fill", metavar="F",
                     help="pair rows under F live lanes ride the "
                          "residual instead (ops/pairs.py min_fill; "
                          "measured +33%% on the headline — the "
                          "RMAT21 sweep put the optimum at 24, "
-                         "PERF_NOTES round 5; 0 disables)")
+                         "PERF_NOTES round 5; 0 disables; default -1 "
+                         "= per-config: 24 for scalar programs, the "
+                         "K-AWARE break-even for colfilter's SDDMM "
+                         "rows, scalemodel.break_even_fill)")
     ap.add_argument("-repeats", type=int, default=3,
                     help="timed repeats per config; the JSON line "
                          "reports the median (tunnel variance exceeds "
@@ -350,8 +355,16 @@ def main() -> int:
     args = ap.parse_args()
     if args.repeats < 1:
         ap.error("-repeats must be >= 1")
-    if args.min_fill is not None and args.min_fill <= 0:
-        args.min_fill = None
+    if args.min_fill < -1:
+        ap.error("-min-fill must be >= -1 "
+                 "(-1 = per-config default, 0 = off)")
+    if args.min_fill == -1:      # per-config defaults
+        args.min_fill = 24              # scalar rows, round-5 optimum
+        args.min_fill_dot = "auto"      # K-aware SDDMM break-even
+    elif args.min_fill == 0:
+        args.min_fill = args.min_fill_dot = None
+    else:
+        args.min_fill_dot = args.min_fill
 
     from lux_tpu import resilience, telemetry
 

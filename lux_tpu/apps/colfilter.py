@@ -57,11 +57,20 @@ def make_program(k: int = K, lam: float = LAMBDA,
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
+                 pair_min_fill: int | str | None = None,
+                 pair_stream: bool | None = None,
                  starts=None) -> PullEngine:
     """pair_threshold routes dense tile pairs through the blocked-
-    SDDMM pair path (ops/pairs.pair_partial_dot): one reshaped-row
+    SDDMM pair path (ops/pairs.pair_partial_dot, streamed past the
+    memory budget — pair_partial_dot_streamed): one reshaped-row
     fetch per pair row instead of a per-edge [*, K] row gather — best
-    after graph.pair_relabel, whose ``starts`` pass through here."""
+    after graph.pair_relabel, whose ``starts`` pass through here.
+
+    pair_min_fill="auto" applies the K-AWARE occupancy cap: SDDMM
+    rows cost more per row than scalar rows (~260 vs 150 ns at K=20,
+    scalemodel.pair_row_ns), so under-filled rows ride the residual
+    at a higher break-even fill (~22) than the scalar ~16
+    (ops/pairs.resolve_min_fill)."""
     if g.weights is None:
         raise ValueError("collaborative filtering needs a weighted graph")
     if sg is None:
@@ -69,7 +78,9 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                                 pair_threshold=pair_threshold)
     tile_e = 128 if pair_threshold is not None else 512
     return PullEngine(sg, make_program(), mesh=mesh,
-                      pair_threshold=pair_threshold, tile_e=tile_e)
+                      pair_threshold=pair_threshold,
+                      pair_min_fill=pair_min_fill,
+                      pair_stream=pair_stream, tile_e=tile_e)
 
 
 def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):

@@ -47,6 +47,14 @@ from lux_tpu.timing import fetch as _fetch
 from lux_tpu.timing import timed_converge, timed_fused_run
 
 
+def _min_fill_arg(v: str):
+    """-min-fill value: an int, or 'auto' for the K-aware modeled
+    break-even (ops/pairs.resolve_min_fill)."""
+    if v == "auto":
+        return "auto"
+    return int(v)
+
+
 def _common(ap: argparse.ArgumentParser):
     ap.add_argument("-file", required=True, help=".lux graph file")
     ap.add_argument("-np", type=int, default=0,
@@ -75,13 +83,16 @@ def _common(ap: argparse.ArgumentParser):
                          "96 MB state table; the default).  "
                          "colfilter's dot path has its own dst-free "
                          "machinery and ignores this")
-    ap.add_argument("-min-fill", type=int, default=None,
+    ap.add_argument("-min-fill", type=_min_fill_arg, default=None,
                     dest="min_fill", metavar="F",
                     help="with -pair: drop pair rows that would "
                          "deliver < F live lanes (their edges ride "
                          "the residual path); break-even ~15 at the "
                          "measured 150 ns/row vs ~10 ns/edge rates "
-                         "(PERF_NOTES round 5)")
+                         "(PERF_NOTES round 5).  'auto' picks the "
+                         "K-AWARE modeled break-even (~16 scalar, "
+                         "~22 for colfilter's K=20 SDDMM rows — "
+                         "scalemodel.break_even_fill)")
     ap.add_argument("-sparse", type=int, default=1, metavar="0|1",
                     help="sssp/cc: keep the src-sorted sparse-frontier "
                          "view (1, default).  0 halves edge memory at "
@@ -505,7 +516,8 @@ def cmd_colfilter(argv):
         g_run, _perm, starts = _relabel_for_pairs(args, g, num_parts)
         sg = _build_sg(args, g_run, num_parts, starts)
         eng = colfilter.build_engine(g_run, num_parts, mesh, sg=sg,
-                                     pair_threshold=args.pair)
+                                     pair_threshold=args.pair,
+                                     pair_min_fill=args.min_fill)
         sup = _supervisor_opts(args, "colfilter")
         if sup is not None:
             state, total, elapsed, ni, mark = _run_supervised(

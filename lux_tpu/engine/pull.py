@@ -47,6 +47,18 @@ from lux_tpu.parallel.mesh import PARTS_AXIS, shard_over_parts
 DOT_BLOCK_CHUNKS = 128
 
 
+def _dot_kdim(program) -> int:
+    """K of a dot-path program's vector state — feeds the K-aware pair
+    economics (min_fill="auto", ops/pairs.resolve_min_fill) and the
+    SDDMM streaming budget.  Programs using edge_value_from_dot should
+    set state_bytes = 4 * K (colfilter does); unset falls back to
+    scalar economics."""
+    if getattr(program, "edge_value_from_dot", None) is None:
+        return 1
+    sb = getattr(program, "state_bytes", None)
+    return max(1, (sb or 4) // 4)
+
+
 
 def resolve_reduce_method(method: str) -> str:
     """'auto' picks the Pallas kernel on real TPUs and the portable
@@ -164,11 +176,12 @@ class PullEngine:
                  tile_e: int = 512, use_mxu: bool = False,
                  reduce_method: str = "auto",
                  pair_threshold: int | None = None,
-                 pair_min_fill: int | None = None,
+                 pair_min_fill: int | str | None = None,
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
                  owner_tile_e: int | None = None,
+                 owner_minmax_fused: bool = False,
                  stats_cap: int | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
@@ -184,12 +197,24 @@ class PullEngine:
                 "hold no destination state)")
         _check_local_parts(sg, mesh, pair_threshold)
         self.exchange = exchange
+        # psum_scatter-style fused min/max owner exchange (ring
+        # reduce-scatter, ops/owner.py) — opt-in until measured on a
+        # real mesh
+        self.owner_minmax_fused = bool(owner_minmax_fused)
         self.pairs = None
         if pair_threshold is not None:
             sg = self._setup_pairs(sg, pair_threshold, mesh, layout,
                                    program, pair_min_fill)
-        from lux_tpu.ops.pairs import resolve_pair_stream
+        from lux_tpu.ops.pairs import (resolve_pair_dot_stream,
+                                       resolve_pair_stream)
         self.pair_stream = resolve_pair_stream(pair_stream, self.pairs)
+        # the SDDMM (K-dim) pair path streams by the shared 1 GB
+        # budget (ops/tiled.STREAM_MSG_BYTES) instead of always: under
+        # it the monolithic lax.map measured best; past it the stacked
+        # per-row partials are the 67.7 GB NetFlix compile allocation
+        self.pair_dot_stream = resolve_pair_dot_stream(
+            pair_stream, self.pairs, len(sg.part_ids()),
+            _dot_kdim(program))
         # auto: stream once the [rows, C, E] f32 message temporary
         # passes the budget — vmap materializes EVERY materialized
         # part's messages together (sg here is the pair residual when
@@ -277,7 +302,8 @@ class PullEngine:
                              "state, or on <src, dst> via "
                              "edge_value_from_dot")
         sp, residual = plan_sharded_pairs(sg, threshold,
-                                          min_fill=min_fill)
+                                          min_fill=min_fill,
+                                          kdim=_dot_kdim(program))
         self.pairs = sp                      # None if nothing dense
         return residual
 
@@ -478,8 +504,11 @@ class PullEngine:
                              g["last_chunk"], prog.reduce)
         red = red.reshape(n_tiles * W, Kdim)[:sg.vpad]
         if self.pairs is not None:
-            from lux_tpu.ops.pairs import pair_partial_dot
-            pred = pair_partial_dot(
+            from lux_tpu.ops.pairs import (pair_partial_dot,
+                                           pair_partial_dot_streamed)
+            fn = (pair_partial_dot_streamed if self.pair_dot_stream
+                  else pair_partial_dot)
+            pred = fn(
                 self.pairs, flat_state, g["pair_rowbind"],
                 g["pair_rel"], g["pair_weight"], g["pair_row_tile"],
                 g["pair_tile_pos"], g["pair_tile0"][0],
@@ -531,7 +560,8 @@ class PullEngine:
         return owner_exchange(
             acc, self.program.reduce,
             axis=None if self.mesh is None else PARTS_AXIS,
-            ndev=1 if self.mesh is None else self.mesh.devices.size)
+            ndev=1 if self.mesh is None else self.mesh.devices.size,
+            minmax_fused=self.owner_minmax_fused)
 
     def _owner_apply(self, state_rows, red_rows, flat_state, g):
         """Pair contribution + apply epilogue, vmapped over the local

@@ -95,11 +95,12 @@ class PushEngine:
                  delta: float | None = None,
                  reduce_method: str = "auto",
                  pair_threshold: int | None = None,
-                 pair_min_fill: int | None = None,
+                 pair_min_fill: int | str | None = None,
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
                  owner_tile_e: int | None = None,
+                 owner_minmax_fused: bool = False,
                  stats_cap: int | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
@@ -112,6 +113,9 @@ class PushEngine:
         _check_local_parts(sg, mesh, pair_threshold)
         exchange = resolve_exchange(exchange, sg, program)
         self.exchange = exchange
+        # fused (ring reduce-scatter) min/max owner exchange — opt-in,
+        # see ops/owner.owner_exchange
+        self.owner_minmax_fused = bool(owner_minmax_fused)
         if delta is not None:
             if program.reduce != "min":
                 raise ValueError("delta-stepping requires a 'min' program")
@@ -387,7 +391,8 @@ class PushEngine:
             red = owner_exchange(
                 acc, prog.reduce,
                 axis=PARTS_AXIS if on_mesh else None,
-                ndev=1 if not on_mesh else self.mesh.devices.size)
+                ndev=1 if not on_mesh else self.mesh.devices.size,
+                minmax_fused=self.owner_minmax_fused)
         red = red[:, :sg.vpad]
         if self.pairs is not None:
             # pair rows fetch from the FULL masked table (row-granular

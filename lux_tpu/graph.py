@@ -605,7 +605,9 @@ class ShardedGraph:
     def memory_report(self, *, exchange: str = "gather",
                       owner_slots_per_part: int | None = None,
                       owner_packed: bool | None = None,
-                      push_sparse: bool = False) -> dict:
+                      push_sparse: bool = False,
+                      pairs=None, pair_kdim: int = 1,
+                      pair_stream: bool | None = None) -> dict:
         """HBM bytes for the engine edge layouts per part — the
         analogue of the reference's startup memory advisor (reference
         pagerank.cc:60-85).  (The flat oracle layout ships int32
@@ -619,6 +621,18 @@ class ShardedGraph:
         bound; the real count includes per-(src-part, dst-tile) chunk
         padding and lives in OwnerLayout.stats after the build
         (measured 1.15-1.5x, PERF_NOTES).
+
+        pairs (a StackedPairPlan, typically ``engine.pairs`` — pass
+        the RESIDUAL graph's report the same plan the engine holds)
+        prices the pair-lane delivery: the materialized row arrays
+        (rowbind + int8 rel + f32 weights + tile_pos, + row_tile for
+        K-dim/SDDMM plans, ``pair_kdim`` > 1) AND the delivery
+        temporaries — at the STREAMED per-block bound when streaming
+        engages (the default; ops/pairs.resolve_pair_stream /
+        resolve_pair_dot_stream with ``pair_stream`` forwarded), NOT
+        the monolithic [Rp, 128, K] tensor that is only real when
+        streaming is forced off (67.7 GB at the NetFlix shape,
+        PERF_NOTES round 5/8).
 
         push_sparse adds the push engine's src-sorted frontier view
         (graph.src_sorted): ss_dst int32 over epad AGAIN (+ f32
@@ -652,13 +666,44 @@ class ShardedGraph:
                 S = min(self.num_parts * self.vpad, self.epad)
             # src_ids + src_off int32 + ss_dst int32 (+ f32 ss_weight)
             sparse_bytes = 4 * (2 * S + 1) + self.epad * (4 + w)
+        pair_bytes = pair_temp = 0
+        if pairs is not None:
+            from lux_tpu.ops.pairs import (PAIR_DOT_BLOCK_BYTES,
+                                           PAIR_STREAM_BLOCK_BYTES,
+                                           resolve_pair_dot_stream,
+                                           resolve_pair_stream)
+            from lux_tpu.ops.pairs import W as _PW
+            Rp = int(pairs.Rp)
+            wlane = _PW * 4 if pairs.weight is not None else 0
+            # rowbind int32 + rel int8[128] (+ f32 weights) + tile_pos
+            pair_bytes = Rp * (4 + _PW + wlane) + pairs.tile_pos.shape[1] * 4
+            rows = len(self.part_ids())
+            if pair_kdim > 1:
+                pair_bytes += Rp * 4                       # row_tile
+                streamed = resolve_pair_dot_stream(
+                    pair_stream, pairs, rows, pair_kdim)
+                # streamed: one slot-block of tiles/dots/partials;
+                # monolithic: the lax.map-stacked per-row partials
+                # PLUS the delivered tile values (XLA materializes
+                # both — measured 2x the partials tensor alone,
+                # PERF_NOTES round-8 memory_analysis table)
+                pair_temp = (PAIR_DOT_BLOCK_BYTES if streamed
+                             else 2 * Rp * _PW * pair_kdim * 4)
+            else:
+                streamed = resolve_pair_stream(pair_stream, pairs)
+                # monolithic: delivered f32 value rows + row partials
+                pair_temp = (PAIR_STREAM_BLOCK_BYTES if streamed
+                             else 2 * Rp * _PW * 4)
         # state f32 + deg int32 (vmask derives from a scalar on device)
         vert_bytes = self.vpad * (4 + 4)
-        per_part = edge_bytes + sparse_bytes + vert_bytes
+        per_part = edge_bytes + sparse_bytes + pair_bytes \
+            + pair_temp + vert_bytes
         return {
             "num_parts": self.num_parts,
             "edge_bytes_per_part": edge_bytes,
             "push_sparse_bytes_per_part": sparse_bytes,
+            "pair_bytes_per_part": pair_bytes,
+            "pair_temp_bytes_per_part": pair_temp,
             "vertex_bytes_per_part": vert_bytes,
             "total_bytes": self.num_parts * per_part,
         }

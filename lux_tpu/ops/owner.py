@@ -430,13 +430,22 @@ def owner_contribs(lay: OwnerLayout, state_rows, g: dict,
     return acc
 
 
-def owner_exchange(acc, kind: str, axis=None, ndev: int = 1):
+def owner_exchange(acc, kind: str, axis=None, ndev: int = 1,
+                   minmax_fused: bool = False):
     """Route accumulated contributions [P, ntw, ...] to their
     destination parts.  axis=None (single device): identity — every
     dst row is already local.  On a mesh: reduce_scatter over ICI —
     ``psum_scatter`` for sum, ``all_to_all`` + local combine for
     min/max (the TPU-native replacement for the whole-region
-    all_gather, reference pull_model.inl:454-461)."""
+    all_gather, reference pull_model.inl:454-461).
+
+    minmax_fused=True routes min/max through the psum_scatter-style
+    RING reduce-scatter (``ring_reduce_scatter``) instead: the combine
+    happens en route, so the receive working set per step is ONE
+    device's row chunk [P/ndev, ntw] instead of the all_to_all's full
+    [P, ntw] landing buffer + ndev-way local reduction (round-5
+    pointer #5).  Opt-in until measured on a real mesh; oracle-equal
+    to the all_to_all path (tests/test_owner.py)."""
     import jax
     import jax.numpy as jnp
 
@@ -445,11 +454,44 @@ def owner_exchange(acc, kind: str, axis=None, ndev: int = 1):
     if kind == "sum":
         return jax.lax.psum_scatter(acc, axis, scatter_dimension=0,
                                     tiled=True)
+    if minmax_fused:
+        return ring_reduce_scatter(acc, kind, axis, ndev)
     recv = jax.lax.all_to_all(acc, axis, split_axis=0, concat_axis=0,
                               tiled=True)
     rows = acc.shape[0] // ndev
     red = recv.reshape((ndev, rows) + recv.shape[1:])
     return {"min": jnp.min, "max": jnp.max}[kind](red, axis=0)
+
+
+def ring_reduce_scatter(acc, kind: str, axis, ndev: int):
+    """Ring reduce-scatter for any combine kind (shard_map body).
+
+    acc [P, ...] per device; returns [P/ndev, ...] — device d ends
+    with the fully-combined rows of ITS chunk d (the same contract as
+    ``psum_scatter(..., scatter_dimension=0, tiled=True)``).  Chunk c
+    starts at device c+1 and travels the ring c+1 -> c+2 -> ... -> c,
+    each hop folding the visiting device's local contribution, so the
+    partial being combined is always one chunk — ndev-1 ppermute hops
+    of [P/ndev, ...] each, combine fused per hop."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.ops.tiled import combine_op
+
+    comb = combine_op(kind)
+    rows = acc.shape[0] // ndev
+    chunks = acc.reshape((ndev, rows) + acc.shape[1:])
+    idx = jax.lax.axis_index(axis)
+    perm = [(j, (j + 1) % ndev) for j in range(ndev)]
+    # device i launches its contribution to chunk i-1
+    cur = jnp.take(chunks, (idx - 1) % ndev, axis=0)
+    for s in range(ndev - 1):
+        cur = jax.lax.ppermute(cur, axis, perm)
+        # after hop s, device i holds chunk (i - 2 - s) mod ndev and
+        # folds its own contribution; the last fold (s = ndev - 2)
+        # lands chunk i fully combined at device i
+        cur = comb(cur, jnp.take(chunks, (idx - 2 - s) % ndev, axis=0))
+    return cur
 
 
 def owner_part_tiles(lay: OwnerLayout, state_s, src, rel, weight, cs,

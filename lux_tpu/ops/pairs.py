@@ -151,10 +151,27 @@ class PairAnalysis:
     # ShardedGraph.build invariant)
 
 
+def resolve_min_fill(min_fill, kdim: int = 1) -> int | None:
+    """The K-aware half of the min_fill economics: ``"auto"`` resolves
+    to the modeled break-even fill for ``kdim``-wide rows
+    (scalemodel.break_even_fill — row cost grows with K, so K-dim rows
+    must be fuller to beat the residual: ~16 scalar, ~22 at K=20).
+    Integers and None pass through unchanged."""
+    if min_fill == "auto":
+        from lux_tpu.scalemodel import break_even_fill
+        return break_even_fill(kdim)
+    if min_fill is not None and not isinstance(min_fill, (int,
+                                                          np.integer)):
+        raise ValueError(f"min_fill must be an int, None or 'auto', "
+                         f"got {min_fill!r}")
+    return min_fill
+
+
 def analyze_pairs(src_slot: np.ndarray, dst_local: np.ndarray,
                   vpad: int, threshold: int = 8,
                   max_occ: int = 128,
-                  min_fill: int | None = None) -> PairAnalysis:
+                  min_fill: int | str | None = None,
+                  kdim: int = 1) -> PairAnalysis:
     """See build_pair_plan; this is its sorting/selection half.
 
     min_fill (occupancy-aware row packing, round-5 north-star work):
@@ -168,7 +185,13 @@ def analyze_pairs(src_slot: np.ndarray, dst_local: np.ndarray,
     per-row delivery cost over the residual per-edge rate
     (~150 / ~10 ns, PERF_NOTES scale-25 decomposition) ~ 15 lanes;
     R-MAT tails spread multiplicity so hard that mean fill at RMAT25
-    is 18.6 (inflation 6.88x) with a long sub-break-even tail."""
+    is 18.6 (inflation 6.88x) with a long sub-break-even tail.
+
+    min_fill="auto" resolves to the K-aware modeled break-even for
+    ``kdim``-wide rows (resolve_min_fill): SDDMM delivery rows
+    (pair_partial_dot*) cost more per row than scalar rows, so their
+    break-even fill is higher (~22 at K=20 vs ~16 scalar)."""
+    min_fill = resolve_min_fill(min_fill, kdim)
     assert vpad % W == 0
     ne = len(dst_local)
     n_tiles = vpad // W
@@ -273,7 +296,8 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
                     weights: np.ndarray | None = None,
                     slot_depths: np.ndarray | None = None,
                     analysis: PairAnalysis | None = None,
-                    min_fill: int | None = None):
+                    min_fill: int | str | None = None,
+                    kdim: int = 1):
     """src_slot: int [ne] global padded state slots (state2d row =
     slot // 128); dst_local: int [ne] part-local dst in [0, vpad);
     vpad must be a multiple of 128.  weights (optional, [ne]) are laid
@@ -289,11 +313,11 @@ def build_pair_plan(src_slot: np.ndarray, dst_local: np.ndarray,
 
     analysis: a precomputed analyze_pairs result for these arrays
     (must match threshold/max_occ/min_fill) — skips the sorting
-    half.  min_fill: see analyze_pairs."""
+    half.  min_fill/kdim: see analyze_pairs."""
     if analysis is None:
         analysis = analyze_pairs(src_slot, dst_local, vpad,
                                  threshold=threshold, max_occ=max_occ,
-                                 min_fill=min_fill)
+                                 min_fill=min_fill, kdim=kdim)
     a = analysis
     ne, n_tiles = a.ne, a.n_tiles
     src_slot = np.asarray(src_slot, np.int64)
@@ -527,7 +551,9 @@ def cost_balanced_starts(g, num_parts: int, threshold: int,
     return weighted_balanced_bounds(cost_ptrs, num_parts, align=W)
 
 
-def plan_sharded_pairs(sg, threshold: int, min_fill: int | None = None):
+def plan_sharded_pairs(sg, threshold: int,
+                       min_fill: int | str | None = None,
+                       kdim: int = 1):
     """Build per-part pair plans for a ShardedGraph and the RESIDUAL
     ShardedGraph (uncovered edges, re-padded) the regular gather path
     should run on.  Returns (StackedPairPlan | None, residual_sg);
@@ -539,9 +565,14 @@ def plan_sharded_pairs(sg, threshold: int, min_fill: int | None = None):
     plans only its OWN rows, but against a process-group-allreduced
     common depth profile (multihost.allreduce_host — the s_pad-style
     agreement push uses, push.py), so every process compiles the SAME
-    class structure and row shapes."""
+    class structure and row shapes.
+
+    min_fill="auto" + kdim: K-aware break-even resolution (resolved
+    ONCE here so every part — and every process — caps on the same
+    fill; see resolve_min_fill)."""
     import dataclasses as _dc
 
+    min_fill = resolve_min_fill(min_fill, kdim)
     if sg.vpad % W:
         raise ValueError("pair delivery needs vpad % 128 == 0; build "
                          "the ShardedGraph with vpad_align=128")
@@ -692,10 +723,15 @@ def _class_combine(sp: StackedPairPlan, partials, tile_pos, kind: str):
     return jnp.take(slots, tile_pos, axis=0)         # [n_tiles, ...]
 
 
+# scalar streamed-delivery block budget (pair_partial_streamed): the
+# delivered f32 value rows of ONE scan block
+PAIR_STREAM_BLOCK_BYTES = 64 << 20
+
+
 def pair_partial_streamed(sp: StackedPairPlan, flat_state, rowbind, rel,
                           weight, tile_pos, kind: str, msg_fn,
                           reduce_method: str = "xla",
-                          block_bytes: int = 64 << 20):
+                          block_bytes: int = PAIR_STREAM_BLOCK_BYTES):
     """Memory-bounded pair delivery: identical result to
     ``pair_partial`` but the delivered f32 value rows and their
     per-row partials never materialize beyond one scan block.
@@ -866,6 +902,116 @@ def pair_partial_dot(sp: StackedPairPlan, state, rowbind, rel, weight,
     return red.reshape(-1, Kdim)
 
 
+# Streamed SDDMM block budget: live bytes of ONE scan block (delivered
+# S/T tiles + the [B, 128, 128] dot blocks + messages/partials).  The
+# [*, W, W] dot intermediate dominates for K < 128, so blocks land at
+# a few hundred rows — the same order as the monolithic path's
+# measured-best lax.map block (DOT_BLOCK_CHUNKS, engine/pull.py).
+PAIR_DOT_BLOCK_BYTES = 64 << 20
+
+
+def pair_partial_dot_streamed(sp: StackedPairPlan, state, rowbind, rel,
+                              weight, row_tile, tile_pos, part_tile0,
+                              msg_dot_fn,
+                              block_bytes: int = PAIR_DOT_BLOCK_BYTES):
+    """Memory-bounded SDDMM pair delivery: identical result to
+    ``pair_partial_dot`` but neither the delivered [Rp, 128, K] tile
+    values nor the per-row [Rp, 128, K] gradient partials ever
+    materialize beyond one scan block.
+
+    The monolithic path's lax.map STACKS its per-row partials — at the
+    NetFlix shape that is a reproducible f32[6454, 4, 256, 128, 20] =
+    67.7 GB compile allocation (PERF_NOTES round 5), 4.3x the chip.
+    Here each depth class (cnt slots x L contiguous rows) runs as a
+    ``lax.scan`` over blocks of S WHOLE slots (S*L rows, sized to
+    ``block_bytes``); each step fetches the block's src/dst tiles,
+    forms D = S @ T^T, lane-selects the dots, applies ``msg_dot_fn``,
+    reduces through the one-hot gradient matmul AND folds the
+    cross-row (occurrence-depth) sum inside the step — emitting
+    per-SLOT results [S, 128, K], so live memory is one block at any
+    scale.  The scalar analogue (and the original of the slot-block
+    discipline) is ``pair_partial_streamed``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if weight is None:
+        raise ValueError("pair_partial_dot needs per-lane weights")
+    Kdim = state.shape[-1]
+    s3 = state.reshape(-1, W * Kdim)
+    lanes = jnp.arange(W, dtype=rel.dtype)
+
+    def slot_results(rb, rl, wt, rt, S, L):
+        """[S*L] delivery rows -> [S, 128, K] per-slot gradient sums
+        (one block; the body is pair_partial_dot's per-row pipeline
+        plus the in-step depth reduction)."""
+        Sv = jnp.take(s3, rb, axis=0).reshape(-1, W, Kdim)
+        T = jnp.take(s3, part_tile0 + rt, axis=0).reshape(-1, W, Kdim)
+        D = jnp.einsum("rck,rwk->rcw", Sv, T,
+                       preferred_element_type=Sv.dtype)
+        mask = rl[..., None] == lanes                  # [S*L, 128, 128]
+        dot = jnp.sum(jnp.where(mask, D, 0), axis=-1)  # [S*L, 128]
+        msgs = msg_dot_fn(Sv, dot, wt)                 # [S*L, 128, K]
+        # dead lanes (rel == -1) match no output lane -> contribute 0
+        p = jnp.einsum("rcw,rck->rwk", mask.astype(Sv.dtype), msgs)
+        return jnp.sum(p.reshape(S, L, W, Kdim), axis=1)
+
+    # per-row live bytes: S + T + msgs + partials tiles [W, K] each,
+    # plus the [W, W] dot/mask blocks
+    row_bytes = 4 * W * (W + 4 * Kdim)
+    outs = []
+    row0 = 0
+    for (cnt, L) in sp.classes:
+        # whole slots per block, >= 1, sized so one block's rows stay
+        # under block_bytes
+        S = max(1, min(cnt, block_bytes // max(1, L * row_bytes)))
+        nB, rem = divmod(cnt, S)
+
+        def seg(lo, n):
+            sl = slice(row0 + lo * L, row0 + (lo + n) * L)
+            return (rowbind[sl], rel[sl], weight[sl], row_tile[sl])
+
+        cls_out = []
+        if nB:
+            rb, rl, wt, rt = seg(0, nB * S)
+            xs = (rb.reshape(nB, S * L), rl.reshape(nB, S * L, W),
+                  wt.reshape(nB, S * L, W), rt.reshape(nB, S * L))
+
+            def step(_, x, S=S, L=L):
+                return None, slot_results(*x, S, L)
+
+            _, reds = jax.lax.scan(step, None, xs)   # [nB, S, 128, K]
+            cls_out.append(reds.reshape(nB * S, W, Kdim))
+        if rem:
+            cls_out.append(slot_results(*seg(nB * S, rem), rem, L))
+        outs.append(jnp.concatenate(cls_out, axis=0))
+        row0 += cnt * L
+    # trailing identity slot (sum identity = 0) in the message dtype,
+    # exactly like _class_combine's; zero classes degenerate cleanly
+    out_dtype = outs[0].dtype if outs else state.dtype
+    outs.append(jnp.zeros((1, W, Kdim), out_dtype))
+    slots = jnp.concatenate(outs, axis=0)          # [n_slots+1, 128, K]
+    return jnp.take(slots, tile_pos, axis=0).reshape(-1, Kdim)
+
+
+def resolve_pair_dot_stream(pair_stream, sp, rows: int,
+                            kdim: int) -> bool:
+    """Auto-engage rule for the streamed SDDMM delivery, mirroring the
+    engines' chunk-streaming budget (ops/tiled.STREAM_MSG_BYTES, the
+    1 GB rule): stream once the monolithic path's stacked per-row
+    partials — f32 [rows, Rp, 128, kdim], what vmap over parts
+    materializes together and what produced the 67.7 GB NetFlix
+    compile allocation — would pass the budget.  pair_stream
+    True/False forces; None picks by budget (the default K-dim pair
+    path at scale)."""
+    if sp is None:
+        return False
+    if pair_stream is not None:
+        return bool(pair_stream)
+    from lux_tpu.ops.tiled import STREAM_MSG_BYTES
+    return rows * sp.Rp * W * max(1, kdim) * 4 > STREAM_MSG_BYTES
+
+
 def stacked_pair_reduce_numpy(sp: StackedPairPlan, p: int,
                               state_flat: np.ndarray, kind: str = "sum",
                               msg=None) -> np.ndarray:
@@ -902,5 +1048,56 @@ def stacked_pair_reduce_numpy(sp: StackedPairPlan, p: int,
                         if 0 <= w < W:
                             out[t * W + w] = op(
                                 out[t * W + w], vals[rr, col])
+                break
+    return out
+
+
+def stacked_pair_dot_numpy(sp: StackedPairPlan, p: int,
+                           state: np.ndarray, part_tile0: int,
+                           msg_dot_fn) -> np.ndarray:
+    """float64 oracle for one part of the SDDMM pair delivery
+    (pair_partial_dot / pair_partial_dot_streamed): per delivery row,
+    dot[c] = <S[c], T[rel[c]]> over the row's dst tile, msgs =
+    msg_dot_fn(S, dot, w), accumulated into the lane's dst vertex.
+    state: [n_state_rows * 128, K]; returns [n_tiles * 128, K].
+
+    With integer-valued states/weights whose products stay under 2^24
+    this equals the f32 device result EXACTLY (all sums exact) — the
+    equivalence tests' trick for order-independent exact matching."""
+    s2 = np.asarray(state, np.float64)
+    Kdim = s2.shape[-1]
+    out = np.zeros((sp.n_tiles * W, Kdim))
+    row_base, slot_base = {}, {}
+    s = r = 0
+    for c, L in sp.classes:
+        slot_base[L], row_base[L] = s, r
+        s += c
+        r += c * L
+    for t in range(sp.n_tiles):
+        slot = int(sp.tile_pos[p, t])
+        if slot == sp.n_slots:
+            continue
+        for c, L in sp.classes:
+            sb, rb = slot_base[L], row_base[L]
+            if sb <= slot < sb + c:
+                for rr in range(rb + (slot - sb) * L,
+                                rb + (slot - sb + 1) * L):
+                    S = s2[sp.rowbind[p, rr] * W:
+                           (sp.rowbind[p, rr] + 1) * W]       # [128, K]
+                    tile = int(sp.row_tile[p, rr])
+                    T = s2[(part_tile0 + tile) * W:
+                           (part_tile0 + tile + 1) * W]       # [128, K]
+                    lanes = sp.rel_dst[p, rr]
+                    for col in range(W):
+                        w = int(lanes[col])
+                        if not 0 <= w < W:
+                            continue
+                        # numpy 0-d scalars so broadcasting program
+                        # callbacks ((w - dot)[..., None] * src) work
+                        dot = S[col] @ T[w]
+                        msg = msg_dot_fn(
+                            S[col], dot,
+                            np.float64(sp.weight[p, rr, col]))
+                        out[t * W + w] += np.asarray(msg).reshape(Kdim)
                 break
     return out

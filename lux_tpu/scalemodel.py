@@ -46,6 +46,52 @@ GATHER_SMALL_NS = 8.96   # per-edge gather, state table <= ~64 MB
 GATHER_BIG_NS = 14.6     # per-edge gather past the emitter step
 BIG_TABLE_BYTES = 96e6   # auto-exchange threshold (engine/pull.py)
 PAIR_ROW_NS = 150.0      # per delivered 128-lane pair row
+# K-dim (SDDMM) pair rows: a delivery row additionally fetches TWO
+# [128, K] tile blocks (row-granular, cheap) and runs two 128x128xK
+# MXU contractions (D = S @ T^T and the one-hot gradient matmul) plus
+# the [128, 128] lane select.  2 x 2*128*128*K flops at the f32 MXU
+# rate (~half the 24 TFLOP/s bf16 figure) ~= 5.5 ns per K — MODELED
+# from the measured primitive costs, not yet swept on-device
+# (PERF_NOTES round 8); the scalar row's 150 ns stays as the fixed
+# per-row machinery term.
+PAIR_DOT_ROW_K_NS = 5.5
+# K-dim residual edges (the chunked dot path) pay the ~9 ns/row src
+# gather plus per-edge MXU work that also scales with K.
+RESIDUAL_EDGE_NS = 9.92
+RESIDUAL_DOT_K_NS = 0.11
+
+
+def pair_row_ns(kdim: int = 1) -> float:
+    """Modeled cost of ONE delivered pair row: the measured 150 ns for
+    scalar programs; + PAIR_DOT_ROW_K_NS per K for the SDDMM (K-dim)
+    delivery (ops/pairs.pair_partial_dot*)."""
+    if kdim <= 1:
+        return PAIR_ROW_NS
+    return PAIR_ROW_NS + PAIR_DOT_ROW_K_NS * kdim
+
+
+def residual_edge_ns(kdim: int = 1) -> float:
+    """Modeled per-edge cost of the residual (gather) path serving the
+    same program: ~9.92 ns scalar, + per-K MXU work on the dot path."""
+    if kdim <= 1:
+        return RESIDUAL_EDGE_NS
+    return RESIDUAL_EDGE_NS + RESIDUAL_DOT_K_NS * kdim
+
+
+def break_even_fill(kdim: int = 1,
+                    residual_ns: float | None = None) -> int:
+    """min_fill break-even: live lanes a pair row must deliver to beat
+    sending its edges down the residual path — row_cost / residual
+    per-edge cost, rounded up.  Scalar: 150 / 9.92 ~= 16 (the measured
+    RMAT21 optimum basin is F=12..32, PERF_NOTES round 5).  K=20
+    (colfilter): 260 / 12.1 ~= 22 — K-dim rows must be FULLER to pay,
+    because row cost grows with K faster than residual cost."""
+    if residual_ns is None:
+        residual_ns = residual_edge_ns(kdim)
+    import math
+    return max(1, math.ceil(pair_row_ns(kdim) / residual_ns))
+
+
 STATE_NS_PER_VERTEX = 6.0  # apply + epilogues, per padded vertex
                            # (the ~0.2 s/iter residual in the RMAT25
                            # np=4 decomposition)

@@ -11,7 +11,18 @@ Usage (key=value args, any order):
   PYTHONPATH=/root/repo:/root/.axon_site \
       python scripts/bench_bigscale.py [scale=25] [np=4] [pair=0] \
           [ni=3] [tile_e=0] [exchange=gather] [owner_e=0] \
-          [app=pagerank|cc|sssp|sssp-w] [sparse=1] [repeats=1]
+          [app=pagerank|cc|sssp|sssp-w] [sparse=1] [repeats=1] \
+          [preset=rmat27pair]
+
+preset=rmat27pair expands to the scale-27 pair record configuration
+(round-5 pointer #4): pagerank scale=27 np=8 pair=16 min_fill=16
+exchange=owner owner_e=128 ni=1 repeats=3 — pair(16)+owner+min_fill
+on the 2.1B-edge flagship graph.  The geometry stays inside the
+proven RMAT26 pair+owner shapes (min_fill thins the residual toward
+well-packed E=128 chunks; the packed uint32 owner encoding holds the
+arrays), ni=1 keeps each execution under the ~55 s duration wall
+(PERF_NOTES round 5), and the relabel needs ~60-80 GB host peak.
+Explicit key=value args override preset fields.
 
 pair > 0 additionally runs graph.pair_relabel + pair-lane delivery
 (slower host prep; measures the fast path at scale).  tile_e=0 uses
@@ -45,11 +56,18 @@ def log(stage, t0, **kw):
 
 DEFAULTS = dict(scale=25, np=4, pair=0, ni=3, tile_e=0,
                 exchange="gather", owner_e=0, app="pagerank",
-                sparse=1, repeats=1, min_fill=0, seg=0)
+                sparse=1, repeats=1, min_fill=0, seg=0, preset="")
+
+# the scale-27 pair record configuration (round-5 pointer #4); see
+# the module docstring
+PRESETS = dict(rmat27pair=dict(
+    app="pagerank", scale=27, np=8, pair=16, min_fill=16,
+    exchange="owner", owner_e=128, ni=1, repeats=3))
 
 
 def parse_args(argv):
     cfg = dict(DEFAULTS)
+    explicit = {}
     pos = 0
     for a in argv:
         if "=" in a:
@@ -62,7 +80,14 @@ def parse_args(argv):
                 raise SystemExit(f"too many positional args at {a!r}")
             k, v = list(DEFAULTS)[pos], a
             pos += 1
-        cfg[k] = v if k in ("exchange", "app") else int(v)
+        explicit[k] = v if k in ("exchange", "app", "preset") else int(v)
+    preset = explicit.pop("preset", "")
+    if preset:
+        if preset not in PRESETS:
+            raise SystemExit(f"unknown preset {preset!r} (known: "
+                             f"{', '.join(PRESETS)})")
+        cfg.update(PRESETS[preset])
+    cfg.update(explicit)        # explicit args override the preset
     return cfg
 
 
@@ -247,17 +272,32 @@ def main():
                 f"sssp reached only {reached} vertices — vacuous run "
                 f"(isolated start?); GTEPS would be meaningless")
     from statistics import median
-    gteps = g.ne * iters / median(elapsed) / 1e9
+
+    from lux_tpu.resilience import screen_outliers
+    raw = [g.ne * iters / e / 1e9 for e in elapsed]
+    # outlier-screened like bench.py (>3x tunnel collapses discarded,
+    # never medianed; no rerun here — scripts run one batch)
+    samples, discarded, attempts = screen_outliers(raw, None,
+                                                   factor=3.0)
+    gteps = median(samples)
     log("run", t, iters=int(iters), elapsed=[round(e, 2) for e in elapsed],
         gteps=round(gteps, 4))
     print(json.dumps({
         "metric": f"{app}_rmat{scale}_np{np_parts}_gteps_per_chip",
         "value": round(gteps, 4), "unit": "GTEPS",
-        "vs_baseline": round(gteps, 4), "np": np_parts,
+        "vs_baseline": round(gteps, 4),
+        "samples": [round(s, 4) for s in samples],
+        "attempts": attempts,
+        "discarded": [round(d, 4) for d in discarded],
+        "np": np_parts,
         "scale": scale, "ne": g.ne, "pair_threshold": pair or None,
+        "min_fill": cfg["min_fill"] or None,
         "exchange": exchange, "sparse": bool(cfg["sparse"]),
         "start": (start_vertex if app in ("sssp", "sssp-w") else None),
         "seg": cfg["seg"] or None,
+        "telemetry": {"runs": [
+            {"repeat": i, "iters": int(iters), "seconds": e}
+            for i, e in enumerate(elapsed)], "counters": None},
         "iters": int(iters)}))
 
 

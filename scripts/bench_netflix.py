@@ -12,7 +12,16 @@ the factorization must actually LEARN, or the GTEPS line is noise.
 Usage:
   PYTHONPATH=/root/repo:/root/.axon_site \
       python scripts/bench_netflix.py [ratings=100000000] [np=4] \
-          [pair=16] [ni=3] [repeats=3]
+          [pair=16] [ni=3] [repeats=3] [min_fill=-1]
+
+min_fill: -1 (default) = the K-AWARE modeled break-even for K=20
+SDDMM rows (~22; ops/pairs.resolve_min_fill), 0 = off, > 0 explicit.
+The pair-composed run rides the STREAMED SDDMM delivery
+(ops/pairs.pair_partial_dot_streamed) past the 1 GB budget — the
+67.7 GB monolithic compile allocation this shape used to hit is the
+round-5 ledger entry the streamed path exists to remove; the
+build_engine log line records the priced ledger
+(memory_report(pairs=...)).
 """
 
 from __future__ import annotations
@@ -23,7 +32,8 @@ import resource
 import sys
 import time
 
-DEFAULTS = dict(ratings=100_000_000, np=4, pair=16, ni=3, repeats=3)
+DEFAULTS = dict(ratings=100_000_000, np=4, pair=16, ni=3, repeats=3,
+                min_fill=-1)
 
 
 def log(stage, t0, **kw):
@@ -47,6 +57,8 @@ def main():
             pos += 1
         cfg[k] = int(v)
     ratings, np_parts, pair = cfg["ratings"], cfg["np"], cfg["pair"]
+    min_fill = ("auto" if cfg["min_fill"] < 0
+                else cfg["min_fill"] or None)
 
     import numpy as np
 
@@ -80,10 +92,19 @@ def main():
 
     eng = colfilter.build_engine(g, num_parts=np_parts,
                                  pair_threshold=pair or None,
+                                 pair_min_fill=min_fill,
                                  starts=starts)
-    rep = eng.sg.memory_report()
+    # the priced fit ledger: pair arrays + STREAMED delivery blocks
+    # (not the monolithic [Rp, 128, K] tensor), K = colfilter.K
+    rep = eng.sg.memory_report(pairs=eng.pairs, pair_kdim=colfilter.K)
     t = log("build_engine", t, vpad=eng.sg.vpad, epad=eng.sg.epad,
             device_gb=round(rep["total_bytes"] / 1e9, 2),
+            pair_gb=round(np_parts * rep["pair_bytes_per_part"] / 1e9,
+                          2),
+            pair_temp_gb=round(
+                np_parts * rep["pair_temp_bytes_per_part"] / 1e9, 2),
+            pair_dot_stream=eng.pair_dot_stream,
+            min_fill=min_fill,
             pair_cov=(round(eng.pairs.stats["coverage"], 3)
                       if eng.pairs is not None else None),
             pair_inflation=(round(eng.pairs.stats["inflation"], 2)
@@ -104,15 +125,30 @@ def main():
                                      repeats=cfg["repeats"])
     assert np.isfinite(eng.unpad(state)).all()
     from statistics import median
-    gteps = g.ne * cfg["ni"] / median(elapsed) / 1e9
+
+    from lux_tpu.resilience import screen_outliers
+    raw = [g.ne * cfg["ni"] / e / 1e9 for e in elapsed]
+    # outlier-screened like bench.py (>3x tunnel collapses discarded,
+    # never medianed; no rerun here — scripts run one batch)
+    samples, discarded, attempts = screen_outliers(raw, None,
+                                                   factor=3.0)
+    gteps = median(samples)
     log("run", t, iters=cfg["ni"],
         elapsed=[round(e, 2) for e in elapsed], gteps=round(gteps, 4))
     print(json.dumps({
         "metric": f"colfilter_netflix{ratings // 1_000_000}m_np"
                   f"{np_parts}_gteps_per_chip",
         "value": round(gteps, 4), "unit": "GTEPS",
-        "vs_baseline": round(gteps, 4), "np": np_parts, "ne": g.ne,
-        "pair_threshold": pair or None,
+        "vs_baseline": round(gteps, 4),
+        "samples": [round(s, 4) for s in samples],
+        "attempts": attempts,
+        "discarded": [round(d, 4) for d in discarded],
+        "np": np_parts, "ne": g.ne, "iters": cfg["ni"],
+        "pair_threshold": pair or None, "min_fill": min_fill,
+        "pair_stream": (eng.pair_dot_stream if pair else None),
+        "telemetry": {"runs": [
+            {"repeat": i, "iters": cfg["ni"], "seconds": e}
+            for i, e in enumerate(elapsed)], "counters": None},
         "rmse": [round(r, 6) for r in (rmse0, rmse1, rmse2)]}))
 
 

@@ -34,6 +34,13 @@ Checked per metric line:
 - run_attempts (optional): int >= 2
 - *_FAILED lines: error message plus attempts and failure_class
   ("retryable" | "fatal")
+- round-8 script lines: colfilter-netflix (scripts/bench_netflix.py)
+  must carry a strictly-decreasing ``rmse`` trajectory plus the pair
+  configuration; bigscale lines (scripts/bench_bigscale.py, e.g. the
+  RMAT27 pair record) must carry scale/ne/iters/exchange consistent
+  with the metric name — both now emit the same samples/attempts/
+  discarded + telemetry audit schema as bench.py, so the outlier
+  screen is checked on them too
 - telemetry (round 7, lux_tpu/telemetry.py): ``runs`` — one
   {repeat, iters, seconds} per timed run, straight from the
   ``timed_run`` events — and ``counters`` (the device-side
@@ -56,10 +63,21 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from statistics import median
 
 LEGACY_KEYS = ("samples", "attempts", "discarded")
+
+# Round-8 script metric lines (scripts/bench_netflix.py and
+# scripts/bench_bigscale.py emit the same resilience/telemetry schema
+# as bench.py plus script-specific fields, validated below):
+# colfilter-netflix carries the RMSE learning trajectory, bigscale
+# carries the scale/exchange/pair configuration of record.
+NETFLIX_METRIC = re.compile(
+    r"^colfilter_netflix(\d+)m_np(\d+)_gteps_per_chip$")
+BIGSCALE_METRIC = re.compile(
+    r"^(pagerank|cc|sssp|sssp-w)_rmat(\d+)_np(\d+)_gteps_per_chip$")
 
 
 def iter_metric_lines(path: str):
@@ -184,7 +202,82 @@ def check_line(obj: dict, *, legacy_ok: bool):
             f"{name}: missing telemetry field (pre-round-7 schema)")
     else:
         errs += check_telemetry(name, obj)
+
+    if NETFLIX_METRIC.match(name):
+        errs += check_netflix_fields(name, obj)
+    else:
+        m = BIGSCALE_METRIC.match(name)
+        if m:
+            errs += check_bigscale_fields(name, obj, int(m.group(2)))
     return errs, warns
+
+
+def _check_pair_cfg(name: str, obj: dict) -> list[str]:
+    """pair_threshold / min_fill fields shared by the netflix and
+    bigscale lines: positive int or null (min_fill also 'auto', the
+    K-aware break-even)."""
+    errs = []
+    pt = obj.get("pair_threshold")
+    if pt is not None and (not isinstance(pt, int) or pt < 1):
+        errs.append(f"{name}: pair_threshold={pt!r} must be a "
+                    f"positive int or null")
+    mf = obj.get("min_fill")
+    if mf is not None and mf != "auto" and (
+            not isinstance(mf, int) or mf < 1):
+        errs.append(f"{name}: min_fill={mf!r} must be a positive "
+                    f"int, 'auto' or null")
+    return errs
+
+
+def check_netflix_fields(name: str, obj: dict) -> list[str]:
+    """colfilter-netflix lines (scripts/bench_netflix.py): the RMSE
+    trajectory must be recorded and STRICTLY DECREASING — a GTEPS
+    number on a factorization that is not learning is noise (the
+    script asserts this at run time; the audit re-checks the
+    artifact), plus the pair configuration fields."""
+    errs = []
+    missing = [k for k in ("rmse", "ne", "np", "iters",
+                           "pair_threshold") if k not in obj]
+    if missing:
+        errs.append(f"{name}: netflix line missing {missing}")
+    rmse = obj.get("rmse")
+    if rmse is not None:
+        if (not isinstance(rmse, list) or len(rmse) < 2
+                or not all(_is_num(r) for r in rmse)):
+            errs.append(f"{name}: rmse must be a list of >= 2 finite "
+                        f"numbers, got {rmse!r}")
+        elif not all(b < a for a, b in zip(rmse, rmse[1:])):
+            errs.append(f"{name}: rmse {rmse} is not strictly "
+                        f"decreasing — the factorization did not "
+                        f"learn; the GTEPS line is noise")
+    return errs + _check_pair_cfg(name, obj)
+
+
+def check_bigscale_fields(name: str, obj: dict,
+                          name_scale: int) -> list[str]:
+    """bigscale lines (scripts/bench_bigscale.py, e.g. the RMAT27
+    pair record): configuration of record must be present and
+    self-consistent with the metric name."""
+    errs = []
+    missing = [k for k in ("scale", "ne", "iters", "exchange")
+               if k not in obj]
+    if missing:
+        errs.append(f"{name}: bigscale line missing {missing}")
+    scale = obj.get("scale")
+    if isinstance(scale, int) and scale != name_scale:
+        errs.append(f"{name}: scale={scale} contradicts the metric "
+                    f"name's rmat{name_scale}")
+    ex = obj.get("exchange")
+    if ex is not None and ex not in ("gather", "owner", "auto"):
+        errs.append(f"{name}: exchange={ex!r} not "
+                    f"gather|owner|auto")
+    it = obj.get("iters")
+    if it is not None and (not isinstance(it, int) or it < 1):
+        errs.append(f"{name}: iters={it!r} must be a positive int")
+    ne = obj.get("ne")
+    if ne is not None and (not isinstance(ne, int) or ne < 1):
+        errs.append(f"{name}: ne={ne!r} must be a positive int")
+    return errs + _check_pair_cfg(name, obj)
 
 
 def check_telemetry(name: str, obj: dict) -> list[str]:

@@ -1,16 +1,29 @@
-"""Host-side pair-row occupancy analysis (north-star work, round 5).
+"""Host-side pair-row occupancy analysis (north-star work, round 5;
+K-aware SDDMM economics, round 8).
 
-For a graph (R-MAT by scale, or a cached relabeled .lux), builds the
-pair analysis per part and prints the row-fill distribution plus the
-min_fill economics curve: for each candidate F, how many rows survive,
-what coverage remains, and the MODELED per-iteration delivery cost
-    rows * PAIR_ROW_NS + residual_edges * residual_ns
-so the best F is visible without a TPU run (the measured 150 ns/row
-and ~9-10 ns/edge rates, PERF_NOTES).  No device work — pure numpy.
+For a graph (R-MAT by scale, or the synthesized NetFlix rating shape),
+builds the pair analysis per part and prints the row-fill distribution
+plus the min_fill economics curve: for each candidate F, how many rows
+survive, what coverage remains, and the MODELED per-iteration delivery
+cost
+
+    rows * pair_row_ns(kdim) + residual_edges * residual_ns
+
+so the best F is visible without a TPU run.  kdim > 1 prices K-dim
+(SDDMM, ops/pairs.pair_partial_dot*) rows: row cost grows with K
+(scalemodel.pair_row_ns — two [128, K] tile fetches + two 128x128xK
+MXU contractions per row), so the break-even fill is HIGHER than the
+scalar ~16 (~22 at colfilter's K=20).  No device work — pure numpy.
 
 Usage:
   PYTHONPATH=/root/repo python scripts/pair_fill_hist.py \
-      [scale=21] [np=1] [pair=16] [residual_ns=9.92]
+      [shape=rmat|netflix] [scale=21] [ratings=100000000] [np=1] \
+      [pair=16] [kdim=1] [residual_ns=0]
+
+residual_ns=0 uses the modeled K-aware default
+(scalemodel.residual_edge_ns).  shape=netflix builds the bench shape
+(scripts/bench_netflix.py, convert.netflix_like_edges) and defaults
+kdim to colfilter's K=20.
 """
 
 from __future__ import annotations
@@ -23,21 +36,39 @@ import numpy as np
 
 
 def main():
-    cfg = dict(scale=21, np=1, pair=16, residual_ns=9.92)
+    cfg = dict(shape="rmat", scale=21, ratings=100_000_000, np=1,
+               pair=16, kdim=0, residual_ns=0.0)
     for a in sys.argv[1:]:
         k, v = a.split("=", 1)
-        cfg[k] = float(v) if k == "residual_ns" else int(v)
+        if k not in cfg:
+            raise SystemExit(f"unknown arg {k!r} (known: "
+                             f"{', '.join(cfg)})")
+        cfg[k] = (v if k == "shape"
+                  else float(v) if k == "residual_ns" else int(v))
 
-    from lux_tpu.convert import rmat_graph
     from lux_tpu.graph import ShardedGraph, pair_relabel
     from lux_tpu.ops.pairs import W, analyze_pairs, fill_histogram
-    from lux_tpu.scalemodel import PAIR_ROW_NS
+    from lux_tpu.scalemodel import (break_even_fill, pair_row_ns,
+                                    residual_edge_ns)
+
+    kdim = cfg["kdim"] or (20 if cfg["shape"] == "netflix" else 1)
+    residual_ns = cfg["residual_ns"] or residual_edge_ns(kdim)
+    row_ns = pair_row_ns(kdim)
 
     t0 = time.time()
-    g = rmat_graph(scale=cfg["scale"], edge_factor=16, seed=0)
+    if cfg["shape"] == "netflix":
+        from lux_tpu.convert import netflix_like_edges
+        src, dst, w, nv = netflix_like_edges(n_ratings=cfg["ratings"])
+        from lux_tpu.graph import Graph
+        g = Graph.from_edges(src, dst, nv, weights=w)
+        del src, dst, w
+    else:
+        from lux_tpu.convert import rmat_graph
+        g = rmat_graph(scale=cfg["scale"], edge_factor=16, seed=0)
     g2, _perm, starts = pair_relabel(g, cfg["np"],
                                      pair_threshold=cfg["pair"])
-    sg = ShardedGraph.build(g2, cfg["np"], starts=starts)
+    sg = ShardedGraph.build(g2, cfg["np"], starts=starts,
+                            pair_threshold=cfg["pair"])
     print(f"# built in {time.time() - t0:.0f}s", file=sys.stderr)
 
     ne_total = g.ne
@@ -57,6 +88,10 @@ def main():
     edges_by_fill = fill_counts * np.arange(W + 1)
     cov_total = int(edges_by_fill.sum())
     print(json.dumps(dict(
+        shape=cfg["shape"], kdim=kdim,
+        pair_row_ns=round(row_ns, 1),
+        residual_ns=round(residual_ns, 2),
+        break_even=break_even_fill(kdim, residual_ns),
         ne=ne_total, covered=cov_total, rows=rows_total,
         coverage=round(cov_total / ne_total, 4),
         mean_fill=round(cov_total / max(rows_total, 1), 2))))
@@ -66,11 +101,11 @@ def main():
     # thresholding the histogram models it exactly)
     print("| F | rows kept | coverage | modeled s/iter |")
     print("|---|---|---|---|")
-    for F in (1, 4, 8, 12, 16, 20, 24, 32, 48, 64):
+    for F in (1, 4, 8, 12, 16, 20, 22, 24, 32, 48, 64):
         keep = fill_counts[F:].sum()
         cov = int(edges_by_fill[F:].sum())
         resid = ne_total - cov
-        cost = (keep * PAIR_ROW_NS + resid * cfg["residual_ns"]) * 1e-9
+        cost = (keep * row_ns + resid * residual_ns) * 1e-9
         print(f"| {F} | {int(keep)} | {cov / ne_total:.3f} "
               f"| {cost:.3f} |")
 

@@ -157,3 +157,74 @@ def test_unparseable_and_empty_inputs(tmp_path):
     p.write_text("nothing here\n")
     r = run_check(p)
     assert r.returncode == 1 and "no metric lines" in r.stderr
+
+
+# ---- round-8 script lines (netflix / bigscale) ----------------------
+
+NETFLIX_LINE = {
+    "metric": "colfilter_netflix100m_np4_gteps_per_chip",
+    "value": 0.09, "unit": "GTEPS", "vs_baseline": 0.09,
+    "samples": [0.09, 0.0905, 0.0896], "attempts": 3, "discarded": [],
+    "np": 4, "ne": 186_000_000, "iters": 3, "pair_threshold": 16,
+    "min_fill": "auto", "pair_stream": True,
+    "telemetry": {"runs": [
+        {"repeat": 0, "iters": 3, "seconds": 186e6 * 3 / 0.09 / 1e9},
+        {"repeat": 1, "iters": 3, "seconds": 186e6 * 3 / 0.0905 / 1e9},
+        {"repeat": 2, "iters": 3, "seconds": 186e6 * 3 / 0.0896 / 1e9},
+    ], "counters": None},
+    "rmse": [2.926, 2.800, 2.714],
+}
+
+BIGSCALE_LINE = {
+    "metric": "pagerank_rmat27_np8_gteps_per_chip",
+    "value": 0.11, "unit": "GTEPS", "vs_baseline": 0.11,
+    "samples": [0.11], "attempts": 1, "discarded": [],
+    "np": 8, "scale": 27, "ne": 2_147_483_648, "iters": 1,
+    "pair_threshold": 16, "min_fill": 16, "exchange": "owner",
+    "sparse": True, "start": None, "seg": None,
+    "telemetry": {"runs": [
+        {"repeat": 0, "iters": 1, "seconds": 2_147_483_648 / 0.11 / 1e9},
+    ], "counters": None},
+}
+
+
+def _audit_one(tmp_path, obj):
+    p = tmp_path / "line.json"
+    p.write_text(json.dumps(obj))
+    return run_check(p)
+
+
+def test_netflix_and_bigscale_lines_pass_strict(tmp_path):
+    for obj in (NETFLIX_LINE, BIGSCALE_LINE):
+        r = _audit_one(tmp_path, obj)
+        assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda o: o.update(rmse=[2.9, 2.95, 2.8]), "not strictly"),
+    (lambda o: o.update(rmse=[2.9]), ">= 2 finite"),
+    (lambda o: o.pop("rmse"), "missing"),
+    (lambda o: o.update(min_fill="bogus"), "min_fill"),
+    (lambda o: o.update(pair_threshold=0), "pair_threshold"),
+])
+def test_bad_netflix_lines_fail(tmp_path, mutate, needle):
+    obj = json.loads(json.dumps(NETFLIX_LINE))
+    mutate(obj)
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 1, "audit passed a bad netflix line"
+    assert needle in r.stderr, r.stderr
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda o: o.update(scale=26), "contradicts"),
+    (lambda o: o.update(exchange="bogus"), "exchange"),
+    (lambda o: o.update(iters=0), "iters"),
+    (lambda o: o.pop("exchange"), "missing"),
+    (lambda o: o.update(min_fill=0), "min_fill"),
+])
+def test_bad_bigscale_lines_fail(tmp_path, mutate, needle):
+    obj = json.loads(json.dumps(BIGSCALE_LINE))
+    mutate(obj)
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 1, "audit passed a bad bigscale line"
+    assert needle in r.stderr, r.stderr

@@ -11,12 +11,25 @@ jax.make_array_from_process_local_data).
 """
 
 import os
+import re
 import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NPROC = 2
+
+# Capability gate: some XLA CPU builds cannot run multi-process
+# collectives at all ("Multiprocess computations aren't implemented on
+# the CPU backend" at the first cross-process all_gather) — a missing
+# platform capability, not a lux_tpu regression.  When a worker dies
+# with exactly that signature the test SKIPS (tier-1 stays green by
+# construction); any other failure still fails loudly.
+_CPU_MP_UNSUPPORTED = re.compile(
+    r"[Mm]ultiprocess computations aren'?t implemented on the CPU "
+    r"backend")
 
 
 def test_two_process_engines(tmp_path):
@@ -54,5 +67,11 @@ def test_two_process_engines(tmp_path):
             if p.poll() is None:
                 p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 and _CPU_MP_UNSUPPORTED.search(out):
+            pytest.skip("this jaxlib's CPU backend does not implement "
+                        "multi-process computations (capability probe "
+                        "hit the known XLA signature); the test is "
+                        "meaningful only where the platform supports "
+                        "CPU collectives")
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"MP_OK pid={i}" in out, out

@@ -1,0 +1,59 @@
+"""Native-tool bitrot guard (tier-1, CPU-only, fast).
+
+``make -C lux_tpu/native smoke`` builds both native artifacts and runs
+the converter end-to-end on a 3-edge list; the Python side then loads
+the produced .lux through the pthread loader and round-trips
+``native.sort_kv`` — so a broken toolchain, a stale .so, or an ABI
+drift in the ctypes bindings fails HERE instead of minutes into a
+big-graph benchmark (the converter/loader path was previously only
+exercised by scripts/bench_converter.py, which needs multi-GB inputs).
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "lux_tpu", "native")
+
+
+def test_make_smoke_and_bindings():
+    # toolchain probe up front: no make / no C++ compiler is a
+    # machine limitation, not bitrot — skip, don't fail
+    cxx = os.environ.get("CXX", "g++").split()[0]
+    if shutil.which("make") is None or shutil.which(cxx) is None:
+        pytest.skip(f"no make/{cxx} toolchain on this machine")
+    proc = subprocess.run(["make", "-C", NATIVE_DIR, "smoke"],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"native smoke failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    assert "smoke OK" in proc.stdout
+
+    from lux_tpu import native
+    assert native.available()
+
+    # the converter's smoke output loads through the pthread loader
+    # with the exact 3-edge weighted graph (dst-sorted: 2->0, 0->1,
+    # 1->2 with weights 1, 5, 3)
+    from lux_tpu.graph import Graph
+    lux = os.path.join(NATIVE_DIR, "build", "smoke.lux")
+    g = Graph.from_file(lux, use_native=True)
+    assert (g.nv, g.ne) == (3, 3)
+    src, dst = g.edge_arrays()
+    np.testing.assert_array_equal(src, [2, 0, 1])
+    np.testing.assert_array_equal(dst, [0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(g.weights), [1, 5, 3])
+
+    # sort_kv round-trip: key sort carries payloads in lockstep
+    keys = np.array([5, 1, 4, 1, 3], np.int64)
+    pay = np.arange(5, dtype=np.int64)
+    native.sort_kv(keys, (pay,))
+    np.testing.assert_array_equal(keys, [1, 1, 3, 4, 5])
+    assert sorted(pay.tolist()) == list(range(5))
+    np.testing.assert_array_equal(keys, np.sort(
+        np.array([5, 1, 4, 1, 3])))

@@ -514,3 +514,74 @@ def test_packed_owner_engine_matches_unpacked(graph, ref5, use_mesh):
     assert not eng_c.owner.packed
     want = eng_c.unpad(eng_c.run(eng_c.init_state(), 5))
     np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+# ---- fused (ring reduce-scatter) min/max exchange (round 8) ---------
+
+
+def test_ring_reduce_scatter_matches_all_to_all():
+    """owner_exchange(minmax_fused=True) — the psum_scatter-style ring
+    that combines en route — must agree bitwise with the all_to_all +
+    local-combine path AND the elementwise numpy reduce, for min and
+    max, per-device-distinct inputs."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from lux_tpu.ops.owner import owner_exchange
+    from lux_tpu.parallel.mesh import PARTS_AXIS
+
+    mesh = make_mesh(8)
+    ndev, Pn, ntw = 8, 16, 256
+    rng = np.random.default_rng(7)
+    acc = rng.random((ndev, Pn, ntw)).astype(np.float32)
+
+    for kind in ("min", "max"):
+        def body(a, fused, kind=kind):
+            return owner_exchange(a.reshape(Pn, ntw), kind,
+                                  axis=PARTS_AXIS, ndev=ndev,
+                                  minmax_fused=fused)[None]
+
+        run = functools.partial(jax.shard_map, mesh=mesh,
+                                in_specs=P(PARTS_AXIS),
+                                out_specs=P(PARTS_AXIS))
+        want = np.asarray(run(lambda a: body(a, False))(acc))
+        got = np.asarray(run(lambda a: body(a, True))(acc))
+        np.testing.assert_array_equal(got.reshape(Pn, ntw),
+                                      want.reshape(Pn, ntw))
+        op = np.minimum if kind == "min" else np.maximum
+        np.testing.assert_array_equal(want.reshape(Pn, ntw),
+                                      op.reduce(acc, axis=0))
+
+
+def test_owner_mesh_min_fused(graph):
+    """Engine-level oracle: the fused min exchange reproduces the
+    all_to_all engine's result on the 8-device mesh."""
+    mesh = make_mesh(8)
+    base = PullEngine(ShardedGraph.build(graph, 8), _min_program(),
+                      mesh=mesh, exchange="owner")
+    fused = PullEngine(ShardedGraph.build(graph, 8), _min_program(),
+                       mesh=mesh, exchange="owner",
+                       owner_minmax_fused=True)
+    st = base.init_state()
+    want = base.unpad(base.step(st))
+    got = fused.unpad(fused.step(fused.init_state()))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_push_owner_mesh_fused_minmax(graph):
+    """cc/sssp inherit the fused exchange through PushEngine: a dense
+    owner-mode sssp converge on the mesh with minmax_fused must match
+    the reference distances."""
+    from lux_tpu.apps import sssp
+    from lux_tpu.engine.push import PushEngine
+
+    start = _hub_start(graph)
+    want = sssp.reference_sssp(graph, start)
+    mesh = make_mesh(8)
+    eng = PushEngine(ShardedGraph.build(graph, 8),
+                     sssp.make_program(start), mesh=mesh,
+                     enable_sparse=False, exchange="owner",
+                     owner_minmax_fused=True)
+    dist, _iters = eng.run()
+    np.testing.assert_array_equal(dist.astype(np.int64), want)

@@ -1,0 +1,221 @@
+"""Streamed-vs-monolithic SDDMM pair delivery equivalence (round 8).
+
+The streamed path (ops/pairs.pair_partial_dot_streamed) must be
+EXACTLY the monolithic pair_partial_dot — same per-row pipeline, same
+per-slot reduction order — and both must match the float64 NumPy
+oracle (stacked_pair_dot_numpy) EXACTLY when states/weights are
+integer-valued with products under 2^24 (all sums exact, so any
+correct implementation agrees bitwise).  Covered: K in {1, 20}, depth
+classes with ragged fill, multi-block scans + remainder blocks, the
+min_fill-dropped-edges-ride-residual invariant, and engines on 1 part,
+multi-part, and the 8-virtual-device mesh.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lux_tpu.engine.program import PullProgram
+from lux_tpu.engine.pull import PullEngine
+from lux_tpu.graph import Graph, ShardedGraph
+from lux_tpu.ops.pairs import (W, pair_partial_dot,
+                               pair_partial_dot_streamed,
+                               plan_sharded_pairs,
+                               stacked_pair_dot_numpy)
+
+
+def _rating_graph(seed=5, nv=512, ne=8000):
+    """Hub-skewed weighted graph: dense tile pairs with RAGGED fill
+    (zipf sources spread occurrence depth unevenly across slots)."""
+    rng = np.random.default_rng(seed)
+    src = (rng.zipf(1.3, ne) - 1) % nv
+    dst = rng.integers(0, nv, ne)
+    w = rng.integers(1, 6, ne).astype(np.int32)
+    return Graph.from_edges(src.astype(np.uint32),
+                            dst.astype(np.uint32), nv, weights=w)
+
+
+def _int_state(rng, n, k):
+    """Integer-valued f32 state: keeps every dot/message/sum exactly
+    representable, so f32 == float64 oracle bitwise."""
+    return rng.integers(0, 4, (n, k)).astype(np.float32)
+
+
+def _msg_dot(s, dot, wt):
+    # colfilter's gradient shape: (w - <s, d>) * s
+    return (wt - dot)[..., None] * s
+
+
+@pytest.mark.parametrize("kdim", [1, 20])
+def test_streamed_matches_monolithic_and_oracle_exactly(kdim):
+    g = _rating_graph()
+    sg = ShardedGraph.build(g, 2, vpad_align=128)
+    sp, _res = plan_sharded_pairs(sg, threshold=4)
+    assert sp is not None and len(sp.classes) > 1   # ragged depths
+    rng = np.random.default_rng(3)
+    state = _int_state(rng, sg.num_parts * sg.vpad, kdim)
+
+    for p in range(sg.num_parts):
+        t0 = p * (sg.vpad // W)
+        args = (sp, jnp.asarray(state), jnp.asarray(sp.rowbind[p]),
+                jnp.asarray(sp.rel_dst[p]), jnp.asarray(sp.weight[p]),
+                jnp.asarray(sp.row_tile[p]),
+                jnp.asarray(sp.tile_pos[p]), t0, _msg_dot)
+        mono = np.asarray(pair_partial_dot(*args))
+        # tiny blocks force multi-block scans AND remainder blocks
+        strm = np.asarray(pair_partial_dot_streamed(
+            *args, block_bytes=1 << 16))
+        np.testing.assert_array_equal(strm, mono)
+        oracle = stacked_pair_dot_numpy(sp, p, state, t0, _msg_dot)
+        np.testing.assert_array_equal(strm.astype(np.float64), oracle)
+
+
+def _dot_program(k, gamma=1.0, lam=0.0):
+    """colfilter-shaped program with integer-preserving apply
+    (gamma=1, lam=0): one step on integer state stays exact."""
+
+    def edge_value(s, d, w):
+        err = w - jnp.sum(s * d, axis=-1)
+        return err[..., None] * s
+
+    def init(sg):
+        rng = np.random.default_rng(11)
+        return rng.integers(0, 4, (sg.num_parts, sg.vpad, k)).astype(
+            np.float32)
+
+    return PullProgram(
+        reduce="sum", edge_value=edge_value,
+        apply=lambda old, red, ctx: old + gamma * (red - lam * old),
+        init=init, needs_dst=True,
+        edge_value_from_dot=_msg_dot, state_bytes=4 * k)
+
+
+@pytest.mark.parametrize("kdim", [1, 20])
+@pytest.mark.parametrize("num_parts", [1, 3])
+def test_engine_streamed_matches_monolithic(kdim, num_parts):
+    """Whole-engine A/B: pair_stream=True vs False differ ONLY in the
+    SDDMM delivery, so the stepped states must agree bitwise."""
+    g = _rating_graph(seed=9)
+    sg = ShardedGraph.build(g, num_parts, vpad_align=128)
+    mono = PullEngine(sg, _dot_program(kdim), pair_threshold=4,
+                      tile_e=128, pair_stream=False)
+    strm = PullEngine(ShardedGraph.build(g, num_parts, vpad_align=128),
+                      _dot_program(kdim), pair_threshold=4,
+                      tile_e=128, pair_stream=True)
+    assert mono.pairs is not None and mono.pairs.stats["covered"] > 0
+    assert not mono.pair_dot_stream and strm.pair_dot_stream
+    a = np.asarray(mono.step(mono.init_state()))
+    b = np.asarray(strm.step(strm.init_state()))
+    np.testing.assert_array_equal(b, a)
+
+
+def test_engine_mesh_streamed_matches_single_device():
+    """8 virtual devices: the shard_map'd streamed SDDMM path must
+    equal the single-device run and the colfilter oracle."""
+    from lux_tpu.apps import colfilter
+    from lux_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(1)
+    n_users, n_items, ne = 300, 80, 6000
+    u = rng.integers(0, n_users, ne, dtype=np.uint32)
+    i = rng.integers(0, n_items, ne, dtype=np.uint32) + n_users
+    w = rng.integers(1, 6, ne, dtype=np.int32)
+    g = Graph.from_edges(np.concatenate([u, i]), np.concatenate([i, u]),
+                         n_users + n_items,
+                         weights=np.concatenate([w, w]))
+    want = colfilter.reference_colfilter(g, 3)
+
+    mesh = make_mesh(8)
+    sg = ShardedGraph.build(g, 8, pair_threshold=4)
+    eng = PullEngine(sg, colfilter.make_program(), mesh=mesh,
+                     pair_threshold=4, pair_stream=True, tile_e=128)
+    assert eng.pairs is not None and eng.pair_dot_stream
+    got = eng.unpad(eng.run(eng.init_state(), 3))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-7)
+
+    solo = PullEngine(ShardedGraph.build(g, 8, pair_threshold=4),
+                      colfilter.make_program(), pair_threshold=4,
+                      pair_stream=True, tile_e=128)
+    got_solo = solo.unpad(solo.run(solo.init_state(), 3))
+    np.testing.assert_allclose(got, got_solo, rtol=1e-6, atol=1e-9)
+
+
+def test_min_fill_dropped_edges_ride_residual_dot():
+    """K-dim min_fill invariant: edges dropped from under-filled
+    SDDMM rows must be served EXACTLY by the residual dot path — the
+    pair+min_fill engine equals the no-pair engine bitwise on integer
+    state (one gamma=1 step)."""
+    g = _rating_graph(seed=21)
+    K = 20
+    base = PullEngine(ShardedGraph.build(g, 2, vpad_align=128),
+                      _dot_program(K))
+    capped = PullEngine(ShardedGraph.build(g, 2, vpad_align=128),
+                        _dot_program(K), pair_threshold=4,
+                        pair_min_fill=16, tile_e=128)
+    assert capped.pairs is not None
+    # every surviving row delivers >= min_fill live lanes
+    fills = (capped.pairs.rel_dst != -1).sum(axis=2)
+    live = fills[fills > 0]
+    assert live.size and (live >= 16).all()
+    # partition: covered + residual = all edges
+    cov = capped.pairs.stats["covered"]
+    resid = int(capped.sg.ne_part.sum())
+    assert cov + resid == g.ne
+    a = np.asarray(base.step(base.init_state()))
+    b = np.asarray(capped.step(capped.init_state()))
+    np.testing.assert_array_equal(b, a)
+
+
+def test_auto_min_fill_is_k_aware():
+    """min_fill='auto' resolves through the K-aware cost model: K-dim
+    rows must be FULLER to beat their costlier delivery, so the K=20
+    cap exceeds the scalar one and the planner caps exactly at the
+    modeled break-even."""
+    from lux_tpu.ops.pairs import analyze_pairs, resolve_min_fill
+    from lux_tpu.scalemodel import break_even_fill
+
+    assert break_even_fill(20) > break_even_fill(1)
+    assert resolve_min_fill("auto", 20) == break_even_fill(20)
+    assert resolve_min_fill("auto") == break_even_fill(1)
+    assert resolve_min_fill(None) is None
+    assert resolve_min_fill(7, 20) == 7
+    with pytest.raises(ValueError, match="min_fill"):
+        resolve_min_fill("bogus")
+
+    g = _rating_graph(seed=33)
+    sg = ShardedGraph.build(g, 1, vpad_align=128)
+    nep = int(sg.ne_part[0])
+    auto = analyze_pairs(sg.src_slot[0, :nep], sg.dst_local[0, :nep],
+                         sg.vpad, threshold=4, min_fill="auto",
+                         kdim=20)
+    expl = analyze_pairs(sg.src_slot[0, :nep], sg.dst_local[0, :nep],
+                         sg.vpad, threshold=4,
+                         min_fill=break_even_fill(20))
+    np.testing.assert_array_equal(auto.residual, expl.residual)
+    np.testing.assert_array_equal(auto.cov, expl.cov)
+
+
+def test_memory_report_prices_streamed_blocks():
+    """memory_report(pairs=...) must price the STREAMED per-block
+    temporary when streaming engages, not the monolithic [Rp, 128, K]
+    tensor — and the monolithic figure when it is forced off."""
+    from lux_tpu.ops.pairs import (PAIR_DOT_BLOCK_BYTES,
+                                   PAIR_STREAM_BLOCK_BYTES)
+
+    g = _rating_graph(seed=41)
+    sg = ShardedGraph.build(g, 2, vpad_align=128)
+    sp, res = plan_sharded_pairs(sg, threshold=4)
+    assert sp is not None
+    K = 20
+    rep_s = res.memory_report(pairs=sp, pair_kdim=K, pair_stream=True)
+    assert rep_s["pair_temp_bytes_per_part"] == PAIR_DOT_BLOCK_BYTES
+    rep_m = res.memory_report(pairs=sp, pair_kdim=K, pair_stream=False)
+    # partials + delivered tile values: XLA materializes both
+    # (measured ~2x the partials tensor, PERF_NOTES round 8)
+    assert rep_m["pair_temp_bytes_per_part"] == 2 * sp.Rp * W * K * 4
+    # scalar plans price the scalar streamed block (the default path)
+    rep_sc = res.memory_report(pairs=sp)
+    assert rep_sc["pair_temp_bytes_per_part"] == PAIR_STREAM_BLOCK_BYTES
+    # pair arrays themselves are priced either way
+    assert rep_s["pair_bytes_per_part"] > 0
+    assert rep_s["total_bytes"] > res.memory_report()["total_bytes"]
