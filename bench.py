@@ -31,6 +31,14 @@ event JSONL (rendered by scripts/events_summary.py);
 scripts/check_bench.py validates the telemetry field against samples
 and attempts.
 
+Guarded execution (round 9, lux_tpu/health.py): ``-health`` runs
+every config's timed loops under the device-side watchdog (NaN/Inf,
+divergence/oscillation, frontier stalls — a separate compiled loop
+variant, like the counter variants) and records the digest in each
+line's ``telemetry.health`` (null when off); a tripped watchdog
+fails the config with a _FAILED line.  scripts/check_bench.py
+type-checks the digest.
+
 Resilience (round 6, lux_tpu/resilience.py): each config runs under
 the supervisor — transient failures (worker death, tunnel drops)
 retry with backoff up to ``-retries`` times, deterministic ones (OOM,
@@ -178,7 +186,8 @@ def run_config(config, args):
                                     pair_threshold=pair_t,
                                     pair_min_fill=args.min_fill,
                                     starts=starts,
-                                    exchange="owner" if mp else "auto")
+                                    exchange="owner" if mp else "auto",
+                                    health=args.health)
         extra.update(relabel=True, pair_threshold=pair_t, np=np_parts,
                      exchange=eng.exchange, min_fill=args.min_fill)
         _print_coverage(args, eng)
@@ -194,11 +203,13 @@ def run_config(config, args):
             eng = colfilter.build_engine(g2, num_parts=args.np,
                                          pair_threshold=pair_t,
                                          pair_min_fill=args.min_fill_dot,
-                                         starts=starts)
+                                         starts=starts,
+                                         health=args.health)
             extra.update(relabel=True, pair_threshold=pair_t,
                          min_fill=args.min_fill_dot)
         else:
-            eng = colfilter.build_engine(g, num_parts=args.np)
+            eng = colfilter.build_engine(g, num_parts=args.np,
+                                         health=args.health)
             extra.update(relabel=False, pair_threshold=None)
         _print_coverage(args, eng)
         samples, rerun = bench_fused(eng, g.ne, args.ni, args.verbose,
@@ -220,7 +231,8 @@ def run_config(config, args):
             eng = components.build_engine(g2, num_parts=args.np,
                                           pair_threshold=pair_t,
                                           pair_min_fill=args.min_fill,
-                                          starts=starts)
+                                          starts=starts,
+                                          health=args.health)
             extra.update(relabel=True, pair_threshold=pair_t,
                          min_fill=args.min_fill)
         else:
@@ -241,7 +253,8 @@ def run_config(config, args):
                 delta="auto" if config == "sssp-delta" else None,
                 pair_threshold=pair_t, pair_min_fill=args.min_fill,
                 starts=starts,
-                exchange="owner" if mp else "auto")
+                exchange="owner" if mp else "auto",
+                health=args.health)
             extra.update(relabel=True, pair_threshold=pair_t,
                          min_fill=args.min_fill, np=np_parts,
                          exchange=eng.exchange,
@@ -281,14 +294,25 @@ def emit(name, samples, extra, attempts=None, discarded=(),
 def config_telemetry(events, start_idx, iter_stats):
     """The metric line's ``telemetry`` field for one config: the
     ``timed_run`` events emitted since ``start_idx`` (one per timed
-    repeat, outlier reruns included) plus the counter digest."""
+    repeat, outlier reruns included), the counter digest, and — with
+    -health — the watchdog digest from the run's ``health`` event
+    (null when the watchdog was off; a TRIPPED watchdog raises and
+    the config emits a _FAILED line instead, so a digest here always
+    reports a clean bill: tripped=false plus what was checked).
+    scripts/check_bench.py type-checks all three."""
     runs = [{"repeat": ev["repeat"], "iters": ev["iters"],
              "seconds": ev["seconds"]}
             for ev in events.events[start_idx:]
             if ev["kind"] == "timed_run"]
+    health = None
+    for ev in events.events[start_idx:]:
+        if ev["kind"] == "health":
+            health = {k: v for k, v in ev.items()
+                      if k not in ("t", "kind", "where")}
     return {"runs": runs,
             "counters": (iter_stats.summary()
-                         if iter_stats is not None else None)}
+                         if iter_stats is not None else None),
+            "health": health}
 
 
 def main() -> int:
@@ -351,6 +375,14 @@ def main() -> int:
                          "counter-recording loop variant, so keep it "
                          "OFF for headline numbers (overhead is "
                          "within tunnel noise, PERF_NOTES round 7)")
+    ap.add_argument("-health", action="store_true",
+                    help="run every config under the device-side "
+                         "health watchdog (lux_tpu/health.py) and "
+                         "record its digest in telemetry.health — a "
+                         "separate compiled loop variant (measured "
+                         "within tunnel noise of watchdog-off, "
+                         "PERF_NOTES round 9), so keep it OFF for "
+                         "headline numbers")
     ap.add_argument("-verbose", action="store_true")
     args = ap.parse_args()
     if args.repeats < 1:

@@ -36,3 +36,10 @@ from lux_tpu import _compat  # noqa: F401  (jax version shims)
 from lux_tpu.format import LuxFileHeader, read_lux, write_lux, peek_lux
 from lux_tpu.graph import Graph, ShardedGraph
 from lux_tpu.partition import edge_balanced_bounds
+
+# round-9 guarded-execution typed errors, re-exported for callers
+# that catch rather than build (see ARCHITECTURE.md "Data integrity
+# & guarded execution")
+from lux_tpu.checkpoint import CorruptCheckpointError
+from lux_tpu.format import GraphFormatError
+from lux_tpu.health import HealthError

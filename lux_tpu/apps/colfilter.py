@@ -59,7 +59,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  pair_threshold: int | None = None,
                  pair_min_fill: int | str | None = None,
                  pair_stream: bool | None = None,
-                 starts=None) -> PullEngine:
+                 starts=None, health: bool = False) -> PullEngine:
     """pair_threshold routes dense tile pairs through the blocked-
     SDDMM pair path (ops/pairs.pair_partial_dot, streamed past the
     memory budget — pair_partial_dot_streamed): one reshaped-row
@@ -80,7 +80,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
     return PullEngine(sg, make_program(), mesh=mesh,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill,
-                      pair_stream=pair_stream, tile_e=tile_e)
+                      pair_stream=pair_stream, tile_e=tile_e,
+                      health=health)
 
 
 def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):

@@ -38,7 +38,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  starts=None, exchange: str = "auto",
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None,
-                 owner_minmax_fused: bool = False) -> PushEngine:
+                 owner_minmax_fused: bool = False,
+                 health: bool = False) -> PushEngine:
     """pair_threshold enables pair-lane delivery on dense iterations
     (best after graph.pair_relabel, passing its ``starts`` through;
     labels are vertex ids, so map results back through the relabel
@@ -53,7 +54,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill, exchange=exchange,
                       enable_sparse=enable_sparse, owner_tile_e=owner_tile_e,
-                      owner_minmax_fused=owner_minmax_fused)
+                      owner_minmax_fused=owner_minmax_fused,
+                      health=health)
 
 
 def run(g: Graph, num_parts: int = 1, mesh=None, max_iters=None,

@@ -83,7 +83,8 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                  starts=None, exchange: str = "auto",
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None,
-                 owner_minmax_fused: bool = False) -> PushEngine:
+                 owner_minmax_fused: bool = False,
+                 health: bool = False) -> PushEngine:
     """delta: bucket width for delta-stepping priority ordering
     (weighted runs); "auto" picks a heuristic; None disables (plain
     Bellman-Ford frontier relaxation).  pair_threshold enables pair-
@@ -105,7 +106,8 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                       pair_min_fill=pair_min_fill,
                       exchange=exchange, enable_sparse=enable_sparse,
                       owner_tile_e=owner_tile_e,
-                      owner_minmax_fused=owner_minmax_fused)
+                      owner_minmax_fused=owner_minmax_fused,
+                      health=health)
 
 
 def run(g: Graph, start_vertex: int = 0, num_parts: int = 1, mesh=None,

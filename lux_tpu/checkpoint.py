@@ -13,6 +13,19 @@ stakes, so it is first-class here:
   (``run_checkpointed``).
 - Push engines: converge runs in segments of ``max_iters`` so a
   preempted convergence resumes from the last completed segment.
+
+Integrity + generations (round 9): ``save`` records a per-leaf CRC32
+alongside the payload and rotates the previous file to
+``<path>.prev`` before the atomic rename, keeping TWO generations on
+disk.  ``load`` re-checksums every leaf, so a bit-flipped — or torn
+but still zip-well-formed — payload raises a typed
+:class:`CorruptCheckpointError` instead of resuming silently (the
+zip container's own CRC only covers its members as written; a
+payload rewritten wrong with a consistent member CRC passes it).
+``load_any`` is the resume entry point: a corrupt newest generation
+falls back one generation (emitting a ``checkpoint_fallback``
+telemetry event), and the resilience supervisor then replays the
+lost segment instead of dying — or resuming garbage.
 """
 
 from __future__ import annotations
@@ -20,8 +33,45 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import zlib
 
 import numpy as np
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint file failed integrity verification (CRC mismatch,
+    truncated/garbage container, missing members).  Carries ``path``;
+    resilience.classify treats it as RETRYABLE — the retry's resume
+    goes through ``load_any``, which falls back one generation."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(f"{path}: corrupt checkpoint — {detail}")
+        self.path = path
+        self.detail = detail
+
+
+def prev_path(path: str) -> str:
+    """The previous-generation file ``save`` rotates into."""
+    return path + ".prev"
+
+
+def any_generation(path: str) -> bool:
+    """True if either generation exists on disk."""
+    return os.path.exists(path) or os.path.exists(prev_path(path))
+
+
+def corrupt_path(path: str) -> str:
+    """Where ``load_any`` quarantines a corrupt newest generation."""
+    return path + ".corrupt"
+
+
+def remove(path: str) -> None:
+    """Remove BOTH generations (fresh-start paths must clear the
+    fallback too, or a stale .prev could resurrect after one crash)
+    plus any quarantined corrupt file."""
+    for p in (path, prev_path(path), corrupt_path(path)):
+        if os.path.exists(p):
+            os.unlink(p)
 
 
 def _to_host(tree):
@@ -30,24 +80,39 @@ def _to_host(tree):
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
-def save(path: str, state, meta: dict | None = None) -> None:
+def _leaf_crc(leaf: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(leaf).tobytes()) & 0xFFFFFFFF
+
+
+def save(path: str, state, meta: dict | None = None,
+         rotate: bool = True) -> None:
     """Atomically write a checkpoint: ``state`` is a pytree of arrays
-    (list/tuple/dict nesting), ``meta`` a JSON-serializable dict."""
+    (list/tuple/dict nesting), ``meta`` a JSON-serializable dict.
+
+    A per-leaf CRC32 rides alongside the payload (``load`` verifies
+    it), and with ``rotate`` (the default) an existing file at
+    ``path`` becomes the previous generation ``<path>.prev`` before
+    the atomic rename — ``load_any``'s corruption fallback."""
     import jax
 
     leaves, _treedef = jax.tree.flatten(_to_host(state))
     payload = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
+    crcs = [_leaf_crc(leaf) for leaf in leaves]
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, _meta=json.dumps(meta or {}),
-                     _n=len(leaves), **payload)
+                     _n=len(leaves), _crc=json.dumps(crcs), **payload)
             # os.replace is atomic against process kill, but only an
             # fsync before the rename makes the checkpoint durable
             # against host crash / power loss.
             f.flush()
             os.fsync(f.fileno())
+        if rotate and os.path.exists(path):
+            # a crash between the two renames leaves only .prev —
+            # exactly the state load_any's fallback recovers from
+            os.replace(path, prev_path(path))
         os.replace(tmp, path)
         try:
             dfd = os.open(d, os.O_RDONLY)
@@ -63,15 +128,79 @@ def save(path: str, state, meta: dict | None = None) -> None:
         raise
 
 
-def load(path: str):
+def load(path: str, verify: bool = True):
     """Returns (leaves list, meta dict).  Leaves are in the order they
     were flattened at save time; re-assemble with your own structure
-    (engines' states are flat tuples, so this is direct)."""
-    with np.load(path, allow_pickle=False) as z:
-        n = int(z["_n"])
-        meta = json.loads(str(z["_meta"]))
-        leaves = [z[f"leaf_{i}"] for i in range(n)]
+    (engines' states are flat tuples, so this is direct).
+
+    Unreadable containers (truncated file, garbage bytes, missing
+    members) and — with ``verify`` — per-leaf CRC mismatches raise
+    :class:`CorruptCheckpointError`; a missing FILE keeps raising
+    FileNotFoundError (absent and corrupt are different conditions:
+    only the latter has a generation to fall back to)."""
+    import zipfile
+
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            n = int(z["_n"])
+            meta = json.loads(str(z["_meta"]))
+            leaves = [z[f"leaf_{i}"] for i in range(n)]
+            crcs = (json.loads(str(z["_crc"]))
+                    if "_crc" in z.files else None)
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, KeyError, ValueError, EOFError,
+            OSError, json.JSONDecodeError) as e:
+        raise CorruptCheckpointError(
+            path, f"unreadable ({type(e).__name__}: {e})") from e
+    if verify and crcs is not None:
+        if len(crcs) != len(leaves):
+            raise CorruptCheckpointError(
+                path, f"{len(leaves)} leaves but {len(crcs)} CRCs")
+        for i, (want, leaf) in enumerate(zip(crcs, leaves)):
+            got = _leaf_crc(leaf)
+            if got != want:
+                raise CorruptCheckpointError(
+                    path, f"leaf {i} CRC32 {got:#010x} != recorded "
+                          f"{want:#010x} (bit flip or torn write)")
     return leaves, meta
+
+
+def load_any(path: str):
+    """Newest-first load with generation fallback: returns (leaves,
+    meta, used_path).  A corrupt newest generation emits a
+    ``checkpoint_fallback`` telemetry event and falls back to
+    ``<path>.prev``; raises CorruptCheckpointError when the only (or
+    both) generations are corrupt, FileNotFoundError when neither
+    exists."""
+    from lux_tpu import telemetry
+
+    prev = prev_path(path)
+    if os.path.exists(path):
+        try:
+            leaves, meta = load(path)
+            return leaves, meta, path
+        except CorruptCheckpointError as e:
+            if not os.path.exists(prev):
+                raise
+            telemetry.current().emit(
+                "checkpoint_fallback", path=path, fallback=prev,
+                error=str(e)[:200])
+            leaves, meta = load(prev)
+            # QUARANTINE the corrupt newest (kept on disk for
+            # forensics): if it stayed at ``path``, the next save's
+            # rotation would promote it to .prev — destroying the
+            # only good generation while the new write is still in
+            # flight.  It also makes repeat load_any calls (the
+            # supervisor's resume bookkeeping + the actual resume)
+            # read and report the corruption only once.
+            try:
+                os.replace(path, corrupt_path(path))
+            except OSError:
+                pass
+            return leaves, meta, prev
+    leaves, meta = load(prev)
+    return leaves, meta, prev
 
 
 def _check_leaves(path, expect, leaves):
@@ -117,29 +246,32 @@ def run_checkpointed(eng, state, num_iters: int, path: str,
     resume=True loads the checkpoint at ``path`` (if present), places
     its state on the engine's devices (eng.place) and continues from
     its iteration counter — the passed ``state`` supplies the pytree
-    structure.  ``on_segment(state, done)`` runs BEFORE each save and
-    may raise (the save is skipped, so the checkpoint stays at the
-    last good segment) or return a replacement state (which is what
-    gets checkpointed — the fault-injection harness relies on the
-    guard raising before a corrupted state can reach the save)."""
+    structure.  A corrupt newest generation falls back to
+    ``<path>.prev`` (load_any) and the segments past its iteration
+    counter are simply re-run — replay, not loss.  ``on_segment(state,
+    done)`` runs BEFORE each save and may raise (the save is skipped,
+    so the checkpoint stays at the last good segment) or return a
+    replacement state (which is what gets checkpointed — the
+    fault-injection harness relies on the guard raising before a
+    corrupted state can reach the save)."""
     import jax
 
     from lux_tpu.segmented import run_segments
 
     from lux_tpu import telemetry
 
-    if resume and os.path.exists(path):
-        leaves, meta = load(path)
+    if resume and any_generation(path):
+        leaves, meta, used = load_any(path)
         treedef = jax.tree.structure(state)
         if meta.get("kind") != "pull" or treedef.num_leaves != len(leaves):
             raise ValueError(
-                f"{path} is not a matching pull-engine checkpoint "
+                f"{used} is not a matching pull-engine checkpoint "
                 f"(kind={meta.get('kind')!r}, {len(leaves)} arrays)")
-        _check_leaves(path, jax.tree.leaves(state), leaves)
+        _check_leaves(used, jax.tree.leaves(state), leaves)
         state = eng.place(jax.tree.unflatten(treedef, leaves))
         start_iter = int(meta["iter"])
         telemetry.current().emit("checkpoint_resume", engine="pull",
-                                 iter=start_iter, path=path)
+                                 iter=start_iter, path=used)
 
     def seg_hook(s, done):
         out = None
@@ -167,11 +299,11 @@ def converge_checkpointed(eng, path: str, segment=50,
     from lux_tpu import telemetry
     from lux_tpu.segmented import converge_segments
 
-    if resume and os.path.exists(path):
-        leaves, meta = load(path)
+    if resume and any_generation(path):
+        leaves, meta, used = load_any(path)
         if meta.get("kind") != "push" or len(leaves) != 2:
             raise ValueError(
-                f"{path} is not a push-engine checkpoint "
+                f"{used} is not a push-engine checkpoint "
                 f"(kind={meta.get('kind')!r}, {len(leaves)} arrays)")
         try:                            # abstract: no device work
             import jax
@@ -179,11 +311,11 @@ def converge_checkpointed(eng, path: str, segment=50,
         except Exception:               # noqa: BLE001 — untraceable
             expect = None
         if expect is not None and len(expect) == len(leaves):
-            _check_leaves(path, expect, leaves)
+            _check_leaves(used, expect, leaves)
         label, active = eng.place(*leaves)
         done = int(meta["iter"])
         telemetry.current().emit("checkpoint_resume", engine="push",
-                                 iter=done, path=path)
+                                 iter=done, path=used)
     else:
         label, active = eng.init_state()
         done = 0

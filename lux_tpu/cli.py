@@ -27,6 +27,11 @@ Flags (reference names kept):
                 the fused loop (push: frontier/edges, pull: residual/
                 changed), replayed after the run — works on the fused
                 AND the supervised/segmented paths
+  -health       device-side health watchdog (lux_tpu/health.py):
+                NaN/Inf, divergence/oscillation, frontier stalls trip
+                a typed HealthError with the check/part/iteration
+  -validate     structural .lux validation at load (lux_tpu/format.
+                validate_graph; offline: scripts/fsck_lux.py)
 
 Timing methodology matches the reference: wall clock around the
 iteration loop only, printed as ``ELAPSED TIME = ... s`` plus GTEPS
@@ -63,6 +68,24 @@ def _common(ap: argparse.ArgumentParser):
                     help="devices in the parts mesh")
     ap.add_argument("-check", action="store_true")
     ap.add_argument("-verbose", action="store_true")
+    ap.add_argument("-validate", action="store_true",
+                    help="validate the .lux file's structural "
+                         "invariants at load (monotone row_ptrs, "
+                         "col_idx in range, section sizes, degree "
+                         "consistency — lux_tpu/format.validate_graph"
+                         "); a malformed file exits with a typed "
+                         "error instead of running to a wrong answer "
+                         "(offline form: scripts/fsck_lux.py)")
+    ap.add_argument("-health", action="store_true",
+                    help="run under the device-side health watchdog "
+                         "(lux_tpu/health.py): NaN/Inf state, "
+                         "divergent/oscillating residuals and "
+                         "frontier stalls accumulate an O(1) health "
+                         "word inside the fused loop, checked at "
+                         "run/segment boundaries; a trip raises a "
+                         "typed HealthError naming the check, part "
+                         "and iteration.  Compiles a separate loop "
+                         "variant; the default programs are untouched")
     ap.add_argument("-profile", default=None, metavar="DIR",
                     help="capture an XLA profiler trace of the timed "
                          "run into DIR (view in TensorBoard/Perfetto)")
@@ -146,6 +169,7 @@ def _common(ap: argparse.ArgumentParser):
 
 
 def _load(args, weighted: bool):
+    from lux_tpu.format import GraphFormatError
     from lux_tpu.graph import Graph
 
     import os
@@ -153,7 +177,14 @@ def _load(args, weighted: bool):
         print(f"error: graph file not found: {args.file}", file=sys.stderr)
         raise SystemExit(2)
     t0 = time.perf_counter()
-    g = Graph.from_file(args.file, weighted=weighted or None)
+    try:
+        g = Graph.from_file(args.file, weighted=weighted or None,
+                            validate=getattr(args, "validate", False))
+    except GraphFormatError as e:
+        # a malformed graph is a typed, named refusal — never a run
+        # that silently computes wrong answers through clamping gathers
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(2)
     if args.verbose:
         print(f"loaded nv={g.nv} ne={g.ne} weighted={g.weights is not None}"
               f" ({time.perf_counter() - t0:.2f}s)")
@@ -298,8 +329,9 @@ def _run_supervised(eng, sup, args, ni=None):
             result = eng.unpad(label)
         elapsed = time.perf_counter() - t0
     finally:
-        if not args.resume and os.path.exists(path):
-            os.unlink(path)
+        if not args.resume:
+            from lux_tpu import checkpoint
+            checkpoint.remove(path)     # both generations
     print(f"# supervisor: attempts={report.attempts} "
           f"segments={report.segments} "
           f"resumed_from={report.resumed_from}")
@@ -366,7 +398,8 @@ def cmd_pagerank(argv):
         eng = pagerank.build_engine(g_run, num_parts, mesh, sg=sg,
                                     pair_threshold=args.pair,
                                     pair_min_fill=args.min_fill,
-                                    exchange=args.exchange)
+                                    exchange=args.exchange,
+                                    health=args.health)
         if args.tol is not None:
             if args.retries > 0 or args.seg_budget > 0 or args.resume:
                 print("note: -tol runs one monolithic convergence "
@@ -449,14 +482,16 @@ def _push_app(argv, prog_name):
                                     sg=sg, pair_threshold=args.pair,
                                     pair_min_fill=args.min_fill,
                                     exchange=args.exchange,
-                                    enable_sparse=bool(args.sparse))
+                                    enable_sparse=bool(args.sparse),
+                                    health=args.health)
         else:
             eng = components.build_engine(g_run, num_parts=num_parts,
                                           mesh=mesh, sg=sg,
                                           pair_threshold=args.pair,
                                           pair_min_fill=args.min_fill,
                                           exchange=args.exchange,
-                                          enable_sparse=bool(args.sparse))
+                                          enable_sparse=bool(args.sparse),
+                                          health=args.health)
         sup = _supervisor_opts(args, prog_name)
         if sup is not None:
             labels, iters, elapsed, it_exec, mark = _run_supervised(
@@ -517,7 +552,8 @@ def cmd_colfilter(argv):
         sg = _build_sg(args, g_run, num_parts, starts)
         eng = colfilter.build_engine(g_run, num_parts, mesh, sg=sg,
                                      pair_threshold=args.pair,
-                                     pair_min_fill=args.min_fill)
+                                     pair_min_fill=args.min_fill,
+                                     health=args.health)
         sup = _supervisor_opts(args, "colfilter")
         if sup is not None:
             state, total, elapsed, ni, mark = _run_supervised(

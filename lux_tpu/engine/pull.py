@@ -182,7 +182,8 @@ class PullEngine:
                  exchange: str = "auto",
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
-                 stats_cap: int | None = None):
+                 stats_cap: int | None = None,
+                 health: bool = False):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -237,6 +238,10 @@ class PullEngine:
         self.program = program
         self.mesh = mesh
         self.use_mxu = use_mxu
+        # health=True: run()/segmented drivers use the watchdog loop
+        # variants (run_health / run_until_health, compiled lazily);
+        # False leaves every watchdog-free program untouched
+        self.health = bool(health)
         from lux_tpu.telemetry import DEFAULT_STATS_CAP
         self.stats_cap = int(stats_cap or DEFAULT_STATS_CAP)
         self.reduce_method = resolve_reduce_method(reduce_method)
@@ -692,6 +697,12 @@ class PullEngine:
             return run_segments(self, state, num_iters,
                                 DurationBudget(seg_budget))
         if fused:
+            if self.health:
+                from lux_tpu import health as hw
+                state, _it, _rb, _cb, h = self.run_health(state,
+                                                          num_iters)
+                hw.ensure_ok(h, engine="pull", where="pull run")
+                return state
             return self._run_fused(state, num_iters)
         for _ in range(num_iters):
             state = self.step(state)
@@ -749,7 +760,13 @@ class PullEngine:
         def run(state, tol, max_iters, *gargs):
             def cond(c):
                 it, s, res = c
-                return (res > tol) & (it < max_iters)
+                # NOT (res <= tol), never (res > tol): a NaN residual
+                # compares False BOTH ways, and the latter would exit
+                # the loop reporting convergence on a garbage state
+                # (round-9 tentpole).  Non-finite residuals keep
+                # iterating until max_iters; run_until_health trips
+                # the watchdog on them immediately.
+                return jnp.logical_not(res <= tol) & (it < max_iters)
 
             def body(c):
                 it, s, _ = c
@@ -773,7 +790,8 @@ class PullEngine:
         def run(state, tol, max_iters, *gargs):
             def cond(c):
                 it, s, res, rb, cb = c
-                return (res > tol) & (it < max_iters)
+                # non-finite-safe, see _run_until's cond
+                return jnp.logical_not(res <= tol) & (it < max_iters)
 
             def body(c):
                 it, s, _res, rb, cb = c
@@ -812,6 +830,106 @@ class PullEngine:
         (state, iterations, final_residual) as device scalars."""
         return self._run_until(state, jnp.float32(tol),
                                jnp.int32(max_iters), *self.graph_args)
+
+    # -- health-watchdog loop variants (lux_tpu/health.py) -------------
+
+    @functools.cached_property
+    def _run_health_fused(self):
+        """run_stats + the in-loop health word: a while_loop (num_iters
+        is a traced argument — one compiled program for every segment
+        size) whose condition ALSO exits the iteration after a check
+        trips, so a diverging run stops burning device time the moment
+        the watchdog sees it."""
+        from lux_tpu import health as hw
+        core = self._step_core
+        cap = self.stats_cap
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def run(state, num_iters, h0, win0, *gargs):
+            def cond(c):
+                it, s, rb, cb, h, win = c
+                return (it < num_iters) & (h[0] == 0)
+
+            def body(c):
+                it, s, rb, cb, h, win = c
+                new = core(s, *gargs)
+                r, cnt = self._iter_counters(new, s)
+                h, win = hw.pull_update(h, win, new, r)
+                return (it + 1, new, rb.at[it].set(r, mode="drop"),
+                        cb.at[it].set(cnt, mode="drop"), h, win)
+
+            it, s, rb, cb, h, win = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), state, jnp.zeros((cap,), jnp.float32),
+                 jnp.zeros((cap,), jnp.uint32), h0, win0))
+            return s, it, rb, cb, h, win
+
+        def call(state, n, watch=None):
+            if watch is None:
+                watch = (hw.init_word(), hw.init_window())
+            s, it, rb, cb, h, win = run(state, jnp.int32(n), *watch,
+                                        *self.graph_args)
+            return s, it, rb, cb, (h, win)
+
+        return call
+
+    def run_health(self, state, num_iters: int, watch=None):
+        """``run_stats`` under the device-side health watchdog:
+        returns (state, iters_executed, residual_buf, changed_buf,
+        watch) where watch = (health int32[6], residual window).  The
+        loop EXITS the iteration a check trips (iters_executed <
+        num_iters then); fetch + decode the word once per run/segment
+        with ``health.ensure_ok(watch)`` — 24 bytes, no in-loop host
+        syncs.  Pass the previous segment's ``watch`` back in so the
+        trailing-window checks keep their history across segment
+        boundaries.  Compiled lazily; the watchdog-free programs are
+        untouched."""
+        return self._run_health_fused(state, num_iters, watch)
+
+    @functools.cached_property
+    def _run_until_health(self):
+        from lux_tpu import health as hw
+        core = self._step_core
+        cap = self.stats_cap
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def run(state, tol, max_iters, *gargs):
+            def cond(c):
+                it, s, res, rb, cb, h, win = c
+                return (jnp.logical_not(res <= tol)
+                        & (it < max_iters) & (h[0] == 0))
+
+            def body(c):
+                it, s, _res, rb, cb, h, win = c
+                new = core(s, *gargs)
+                r, cnt = self._iter_counters(new, s)
+                h, win = hw.pull_update(h, win, new, r)
+                return (it + 1, new, r,
+                        rb.at[it].set(r, mode="drop"),
+                        cb.at[it].set(cnt, mode="drop"), h, win)
+
+            it, s, res, rb, cb, h, win = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), state, jnp.float32(jnp.inf),
+                 jnp.zeros((cap,), jnp.float32),
+                 jnp.zeros((cap,), jnp.uint32), hw.init_word(),
+                 hw.init_window()))
+            return s, it, res, rb, cb, h, win
+
+        return run
+
+    def run_until_health(self, state, tol: float,
+                         max_iters: int = np.iinfo(np.int32).max):
+        """``run_until_stats`` under the health watchdog: returns
+        (state, it, residual, residual_buf, changed_buf, watch) with
+        watch = (health int32[6], residual window).  The
+        non-finite-safe predicate means a NaN residual can never
+        report convergence; the watchdog additionally stops the loop
+        at the tripping iteration instead of spinning to max_iters."""
+        s, it, res, rb, cb, h, win = self._run_until_health(
+            state, jnp.float32(tol), jnp.int32(max_iters),
+            *self.graph_args)
+        return s, it, res, rb, cb, (h, win)
 
     def unpad(self, state) -> np.ndarray:
         """Padded device state -> [nv, ...] user order (host).

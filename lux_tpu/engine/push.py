@@ -101,7 +101,8 @@ class PushEngine:
                  exchange: str = "auto",
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
-                 stats_cap: int | None = None):
+                 stats_cap: int | None = None,
+                 health: bool = False):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -131,6 +132,10 @@ class PushEngine:
         self.program = program
         self.mesh = mesh
         self.delta = delta
+        # health=True: run()/segmented drivers use the watchdog loop
+        # variant (converge_health, compiled lazily); False leaves
+        # every watchdog-free program untouched
+        self.health = bool(health)
         from lux_tpu.telemetry import DEFAULT_STATS_CAP
         self.stats_cap = int(stats_cap or DEFAULT_STATS_CAP)
         self.sparse_threshold = sparse_threshold
@@ -503,7 +508,8 @@ class PushEngine:
 
     # -- compiled whole-run / single-step ------------------------------
 
-    def _build(self, converge: bool, stats: bool = False):
+    def _build(self, converge: bool, stats: bool = False,
+               health: bool = False):
         """stats=True (converge only) additionally accumulates
         device-side per-iteration counters INSIDE the while_loop into
         fixed [stats_cap] buffers: frontier size (int32) and frontier
@@ -511,8 +517,16 @@ class PushEngine:
         lux_tpu/telemetry.py for the exact semantics.  Out-degrees
         come from the FULL graph (self.sg, pair rows included), passed
         as one extra sharded argument so the counter-free program
-        never carries them."""
+        never carries them.
+
+        health=True (implies stats) additionally accumulates the O(1)
+        health word (lux_tpu/health.py: NaN labels — +Inf stays the
+        legitimate unreached sentinel — and the truncation-livelock
+        frontier stall) and EXITS the while_loop the iteration a check
+        trips, so a livelocked run stops instead of spinning to
+        max_iters."""
         assert not stats or converge
+        assert not health or stats
         keys = sorted(self.arrays)
         graph_args = tuple(self.arrays[k] for k in keys)
         on_mesh = self.mesh is not None
@@ -535,6 +549,40 @@ class PushEngine:
             if on_mesh:
                 return jax.lax.pmin(x, PARTS_AXIS)
             return x
+
+        if health:
+            from lux_tpu import health as hw
+            P_local = (sg.num_parts if not on_mesh
+                       else sg.num_parts // self.mesh.devices.size)
+            _BIG = jnp.int32(np.iinfo(np.int32).max)
+
+            def health_step(h, stall, old_l, new_l, old_cnt,
+                            new_cnt):
+                """One relax iteration's health update (runs INSIDE
+                shard_map — everything psum/pmin'd so the word is
+                identical on every device)."""
+                badp = hw.nan_parts(new_l)          # [P_local] int32
+                nf = global_sum(badp)
+                chg = global_sum((new_l != old_l).astype(jnp.int32))
+                base = jnp.int32(0)
+                if on_mesh:
+                    base = (jax.lax.axis_index(PARTS_AXIS)
+                            * jnp.int32(P_local))
+                loc = hw.first_bad_part(badp)
+                cand = pmin_fn(jnp.where(loc >= 0, base + loc, _BIG))
+                part = jnp.where(cand == _BIG, -1,
+                                 cand).astype(jnp.int32)
+                # truncation livelock: non-empty frontier, identical
+                # active count, bit-identical labels — for STALL_N
+                # consecutive relax steps (a zero-progress step that
+                # SHRINKS the active set is legitimate and resets)
+                stalled = ((chg == 0) & (new_cnt > 0)
+                           & (new_cnt == old_cnt))
+                stall = jnp.where(stalled, stall + jnp.int32(1),
+                                  jnp.int32(0))
+                flags = ((nf > 0) * hw.NONFINITE_STATE
+                         + (stall >= hw.STALL_N) * hw.FRONTIER_STALL)
+                return hw.record(h, flags, part, nf, new_cnt), stall
 
         def dense_body(label, active, g):
             if self.exchange == "owner":
@@ -567,6 +615,11 @@ class PushEngine:
         use_delta = converge and self.delta is not None
 
         def inner(label, active, max_iters, *gargs):
+            if health:
+                # previous segment's watchdog carry (word + stall
+                # counter) — threaded so a stall spanning a segment
+                # boundary still accumulates
+                h0, stall0, gargs = gargs[0], gargs[1], gargs[2:]
             if stats:
                 deg_full, gargs = gargs[0], gargs[1:]
             g = dict(zip(keys, gargs))
@@ -609,7 +662,10 @@ class PushEngine:
                 # non-empty.
                 def cond(c):
                     it, lbl, act, B, cnt = c[:5]
-                    return (cnt > 0) & (it < max_iters)
+                    ok = (cnt > 0) & (it < max_iters)
+                    if health:        # exit the loop on a tripped word
+                        ok = ok & (c[7][0] == 0)
+                    return ok
 
                 def wbody(c):
                     it, lbl, act, B, cnt = c[:5]
@@ -623,13 +679,22 @@ class PushEngine:
                             # this relax — the series timed_phases'
                             # delta schedule reports; advances relax
                             # nothing and write no entry
-                            fsz, fed = buf
+                            fsz, fed = buf[:2]
                             buf = (fsz.at[it].set(nf, mode="drop"),
                                    fed.at[it].set(esum(front),
-                                                  mode="drop"))
+                                                  mode="drop")) \
+                                + buf[2:]
                         nl, na = body(lbl, front, nf, g)
-                        return (it + 1, nl, (act & ~front) | na, B,
-                                *buf)
+                        merged = (act & ~front) | na
+                        if health:
+                            # the watchdog watches relax steps only:
+                            # advances relax nothing and terminate on
+                            # their own (see `advance` below)
+                            h, stall = health_step(
+                                buf[2], buf[3], lbl, nl, cnt,
+                                global_sum(merged))
+                            buf = buf[:2] + (h, stall)
+                        return (it + 1, nl, merged, B, *buf)
 
                     def advance(it, lbl, act, B, *buf):
                         # Strict progress: with float labels a delta
@@ -658,20 +723,28 @@ class PushEngine:
                 if stats:
                     init = init + (jnp.zeros((cap_n,), jnp.int32),
                                    jnp.zeros((cap_n,), jnp.uint32))
+                if health:
+                    init = init + (h0, stall0)
                 out = jax.lax.while_loop(cond, wbody, init)
                 it, lbl, act = out[0], out[1], out[2]
+                if health:
+                    return lbl, act, it, out[5], out[6], out[7], \
+                        out[8]
                 if stats:
                     return lbl, act, it, out[5], out[6]
                 return lbl, act, it
 
             def cond(c):
                 it, lbl, act, cnt = c[:4]
-                return (cnt > 0) & (it < max_iters)
+                ok = (cnt > 0) & (it < max_iters)
+                if health:            # exit the loop on a tripped word
+                    ok = ok & (c[6][0] == 0)
+                return ok
 
             def wbody(c):
                 it, lbl, act, cnt = c[:4]
                 if stats:
-                    fsz, fed = c[4:]
+                    fsz, fed = c[4], c[5]
                     # edges relaxed by THIS iteration: out-edges of
                     # the frontier entering it
                     fed = fed.at[it].set(esum(act), mode="drop")
@@ -681,6 +754,11 @@ class PushEngine:
                     # frontier AFTER the iteration — exactly the
                     # series the stepwise -verbose path printed
                     fsz = fsz.at[it].set(ncnt, mode="drop")
+                    if health:
+                        h, stall = health_step(c[6], c[7], lbl,
+                                               nl, cnt, ncnt)
+                        return (it + 1, nl, na, ncnt, fsz, fed, h,
+                                stall)
                     return it + 1, nl, na, ncnt, fsz, fed
                 return it + 1, nl, na, ncnt
 
@@ -690,8 +768,12 @@ class PushEngine:
             if stats:
                 init = init + (jnp.zeros((cap_n,), jnp.int32),
                                jnp.zeros((cap_n,), jnp.uint32))
+            if health:
+                init = init + (h0, stall0)
             out = jax.lax.while_loop(cond, wbody, init)
             it, lbl, act = out[0], out[1], out[2]
+            if health:
+                return lbl, act, it, out[4], out[5], out[6], out[7]
             if stats:
                 return lbl, act, it, out[4], out[5]
             return lbl, act, it
@@ -705,11 +787,18 @@ class PushEngine:
                 # counters are psum-replicated scalars written into
                 # replicated buffers
                 out_specs = out_specs + (P(), P())
-            inner = jax.shard_map(
-                inner, mesh=self.mesh,
-                in_specs=(P(PARTS_AXIS), P(PARTS_AXIS), P()) +
-                         (P(PARTS_AXIS),) * (len(keys) + int(stats)),
-                out_specs=out_specs)
+            if health:
+                # the health word + stall counter are built from
+                # psum/pmin'd scalars, identical on every device
+                out_specs = out_specs + (P(), P())
+            in_specs = (P(PARTS_AXIS), P(PARTS_AXIS), P())
+            if health:
+                in_specs = in_specs + (P(), P())    # h0, stall0
+            in_specs = in_specs + \
+                (P(PARTS_AXIS),) * (len(keys) + int(stats))
+            inner = jax.shard_map(inner, mesh=self.mesh,
+                                  in_specs=in_specs,
+                                  out_specs=out_specs)
 
         jitted = jax.jit(inner, donate_argnums=(0, 1))
 
@@ -722,6 +811,20 @@ class PushEngine:
             else:
                 deg_full = jnp.asarray(deg_full)
             extra = (deg_full,)
+
+        if health:
+            from lux_tpu import health as _hw
+
+            def call(label, active, max_iters=np.iinfo(np.int32).max,
+                     watch=None):
+                if watch is None:
+                    watch = (_hw.init_word(), jnp.int32(0))
+                l, a, it, fsz, fed, h, stall = jitted(
+                    label, active, jnp.int32(max_iters), *watch,
+                    *extra, *graph_args)
+                return l, a, it, fsz, fed, (h, stall)
+
+            return call
 
         def call(label, active, max_iters=np.iinfo(np.int32).max):
             return jitted(label, active, jnp.int32(max_iters), *extra,
@@ -763,6 +866,26 @@ class PushEngine:
         cap = np.iinfo(np.int32).max if max_iters is None else max_iters
         return self._converge_stats_fn(label, active, cap)
 
+    @functools.cached_property
+    def _converge_health_fn(self):
+        return self._build(converge=True, stats=True, health=True)
+
+    def converge_health(self, label, active,
+                        max_iters: int | None = None, watch=None):
+        """``converge_stats`` under the device-side health watchdog
+        (lux_tpu/health.py): returns (label, active, iters, frontier
+        buf, edges buf, watch) with watch = (health int32[6], stall
+        counter).  The while_loop EXITS the iteration a check trips
+        (NaN labels; the truncation-livelock frontier stall), so
+        ``iters`` then counts only the completed healthy iterations;
+        fetch + decode the word once per run/segment with
+        ``health.ensure_ok(watch)``, and pass the previous segment's
+        ``watch`` back in so a stall spanning a boundary still
+        accumulates.  Compiled lazily — the watchdog-free programs
+        are untouched."""
+        cap = np.iinfo(np.int32).max if max_iters is None else max_iters
+        return self._converge_health_fn(label, active, cap, watch)
+
     def run(self, max_iters: int | None = None, verbose: bool = False,
             seg_budget: float | None = None):
         """init -> converge -> host label array [nv]; returns
@@ -793,6 +916,15 @@ class PushEngine:
                     self, label, active,
                     DurationBudget(seg_budget, per_size_compile=False),
                     max_iters)
+            elif self.health:
+                from lux_tpu import health as hw
+                label, active, itd, fsz, fed, h = self.converge_health(
+                    label, active, max_iters)
+                it = int(jax.device_get(itd))
+                if st is not None:
+                    st.begin_run()
+                    st.extend_push(fsz, fed, it)
+                hw.ensure_ok(h, engine="push", where="push converge")
             elif st is not None:
                 st.begin_run()
                 label, active, itd, fsz, fed = self.converge_stats(

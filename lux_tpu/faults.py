@@ -10,6 +10,14 @@ those failures at SEGMENT BOUNDARIES on a deterministic schedule
 classify/retry/resume path (lux_tpu/resilience.py) is exercised by
 the CPU test suite.
 
+Round 9 adds the data-plane corruption classes: type-appropriate
+state corruption (NaN for float states, the program's
+identity/sentinel for integer labels — all four apps are
+corruption-testable) and on-disk checkpoint corruption (a zip-valid
+bit flip only the per-leaf CRC can catch, and a truncation), each
+followed by an injected crash so checkpoint.py's generation-fallback
+resume path is exercised end-to-end by the CPU suite.
+
 Faults key on a global boundary COUNTER, not on iteration numbers:
 after a crash-and-resume the counter has advanced past the fired
 fault, so a schedule never re-fires and every supervised run
@@ -27,7 +35,13 @@ import numpy as np
 
 CRASH = "crash"     # raise InjectedWorkerCrash (retryable)
 DELAY = "delay"     # sleep delay_s (exercises slow-segment paths)
-NAN = "nan"         # NaN-corrupt the first floating state leaf
+NAN = "nan"         # corrupt the first float leaf (NaN) — or, for
+#                     integer-labeled programs, poke the program's
+#                     identity/sentinel value (corrupt_state)
+CKPT_BITFLIP = "ckpt_bitflip"    # flip a payload bit in the newest
+#                                  checkpoint generation, then crash
+CKPT_TRUNCATE = "ckpt_truncate"  # truncate the newest checkpoint
+#                                  generation, then crash
 
 
 class InjectedWorkerCrash(RuntimeError):
@@ -50,8 +64,15 @@ class FaultPlan:
     schedule: dict
     delay_s: float = 0.0
     nan_count: int = 1
+    # sentinel poked into integer-labeled states by a NAN action (the
+    # supervisor passes the program identity per-call; this is the
+    # standalone-use default)
+    int_value: int | None = None
     boundaries: int = dataclasses.field(default=0, init=False)
     fired: list = dataclasses.field(default_factory=list, init=False)
+    # newest checkpoint generation the CKPT_* actions corrupt; bound
+    # by the resilience supervisor (bind_checkpoint)
+    ckpt_path: str | None = dataclasses.field(default=None, init=False)
 
     @classmethod
     def seeded(cls, seed: int, n: int = 16, p_crash: float = 0.25,
@@ -72,7 +93,14 @@ class FaultPlan:
         return cls(schedule=schedule, delay_s=delay_s,
                    nan_count=nan_count)
 
-    def fire(self, state):
+    def bind_checkpoint(self, path: str) -> None:
+        """Point the CKPT_* actions at a run's checkpoint file (the
+        resilience supervisor calls this with its checkpoint path)."""
+        self.ckpt_path = path
+
+    def fire(self, state, int_value: int | None = None):
+        import os
+
         i = self.boundaries
         self.boundaries += 1
         action = self.schedule.get(i)
@@ -86,7 +114,21 @@ class FaultPlan:
             time.sleep(self.delay_s)
             return None
         if action == NAN:
-            return nan_corrupt(state, self.nan_count)
+            return corrupt_state(
+                state, self.nan_count,
+                int_value if int_value is not None else self.int_value)
+        if action in (CKPT_BITFLIP, CKPT_TRUNCATE):
+            # the torn-write scenario: the on-disk newest generation
+            # is damaged AND the worker dies — the retry's resume must
+            # detect the corruption (CRC) and fall back one generation
+            if self.ckpt_path and os.path.exists(self.ckpt_path):
+                if action == CKPT_BITFLIP:
+                    bitflip_checkpoint(self.ckpt_path)
+                else:
+                    truncate_checkpoint(self.ckpt_path)
+            raise InjectedWorkerCrash(
+                f"injected worker crash after {action} at segment "
+                f"boundary {i}")
         raise ValueError(f"unknown fault action {action!r}")
 
 
@@ -108,5 +150,87 @@ def nan_corrupt(state, count: int = 1):
     if not done:
         raise ValueError(
             "no floating leaf to NaN-corrupt (integer-labeled "
-            "programs need a CRASH/DELAY fault instead)")
+            "programs: use int_corrupt / corrupt_state with the "
+            "program's identity sentinel)")
     return jax.tree.unflatten(treedef, out)
+
+
+def int_corrupt(state, count: int = 1, value: int | None = None):
+    """Host copy of ``state`` with ``value`` poked into the first
+    ``count`` cells of its first INTEGER (non-bool) leaf — the
+    one-sentinel convention's corruption for integer-labeled programs
+    (sssp hop counts, components ids): poke the program's
+    identity/sentinel, i.e. a lost update, never out-of-band garbage
+    a max-program would propagate."""
+    import jax
+
+    if value is None:
+        raise ValueError(
+            "int_corrupt needs the program's identity/sentinel value "
+            "(e.g. sssp.HOP_INF, components' -1)")
+    leaves, treedef = jax.tree.flatten(state)
+    out, done = [], False
+    for leaf in leaves:
+        arr = np.array(leaf)
+        if (not done and arr.size
+                and np.issubdtype(arr.dtype, np.integer)):
+            arr.reshape(-1)[:count] = arr.dtype.type(value)
+            done = True
+        out.append(arr)
+    if not done:
+        raise ValueError("no integer leaf to corrupt")
+    return jax.tree.unflatten(treedef, out)
+
+
+def corrupt_state(state, count: int = 1, int_value: int | None = None):
+    """Type-appropriate state corruption: NaN into the first float
+    leaf when one exists, else the sentinel ``int_value`` into the
+    first integer leaf — what makes every app corruption-testable
+    under a seeded ``p_nan`` plan (the old float-only nan_corrupt
+    crashed the harness on sssp/components)."""
+    import jax
+
+    if any(np.issubdtype(np.asarray(x).dtype, np.floating)
+           for x in jax.tree.leaves(state)):
+        return nan_corrupt(state, count)
+    return int_corrupt(state, count, int_value)
+
+
+# -- checkpoint-file injectors (exercise checkpoint.py's CRC +
+#    generation-fallback path deterministically) -----------------------
+
+def bitflip_checkpoint(path: str, leaf: int = 0, bit: int = 0) -> None:
+    """Flip one bit in ``leaf``'s payload INSIDE the npz container,
+    rewriting the zip so its own member CRC stays consistent — the
+    torn-but-well-formed corruption only checkpoint.py's per-leaf
+    CRC32 can catch (a raw on-disk flip would already fail the zip
+    layer).  The flipped bit is in the last payload byte, safely past
+    the .npy header."""
+    import io
+    import zipfile
+
+    name = f"leaf_{leaf}.npy"
+    with zipfile.ZipFile(path, "r") as z:
+        items = [(zi.filename, z.read(zi.filename))
+                 for zi in z.infolist()]
+    out = io.BytesIO()
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_STORED) as z:
+        for fname, data in items:
+            if fname == name:
+                data = bytearray(data)
+                data[-1] ^= (1 << (bit & 7))
+                data = bytes(data)
+            z.writestr(fname, data)
+    with open(path, "wb") as f:
+        f.write(out.getvalue())
+
+
+def truncate_checkpoint(path: str, keep: float = 0.5) -> None:
+    """Truncate the file to ``keep`` of its size — the torn-write /
+    partial-download corruption (an unreadable container, caught by
+    checkpoint.load's CorruptCheckpointError wrapping)."""
+    import os
+
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, int(size * keep)))

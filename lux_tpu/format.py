@@ -21,6 +21,18 @@ core/pull_model.inl:288-319):
 The file does not self-describe whether weights/degrees are present (the
 reference decides at compile time via the EDGE_WEIGHT macro); we infer
 from file size, with explicit overrides available.
+
+Validation (round 9): the reference trusts its inputs completely, and
+so did ``read_lux`` — and because XLA's gathers CLAMP out-of-range
+indices, a malformed file (non-monotone ``row_ptrs``, out-of-range
+``col_idx``) flowed through the engines and produced WRONG RESULTS
+instead of an error.  ``validate_graph`` is the crash-don't-corrupt
+conversion: structural invariants checked once at load time
+(``read_lux(validate=True)``, the apps' ``-validate`` flag,
+``scripts/fsck_lux.py`` offline), each failure a typed
+:class:`GraphFormatError` naming the check and the first offending
+index.  ``ShardedGraph.build`` asserts the same invariants on its
+shard boundaries (lux_tpu/graph.py).
 """
 
 from __future__ import annotations
@@ -34,6 +46,21 @@ HEADER_SIZE = 12  # reference FILE_HEADER_SIZE: sizeof(V_ID) + sizeof(E_ID)
 
 V_DTYPE = np.dtype("<u4")  # V_ID
 E_DTYPE = np.dtype("<u8")  # E_ID
+
+
+class GraphFormatError(ValueError):
+    """A .lux file (or in-memory CSC graph) failed structural
+    validation.  ``check`` names the violated invariant (one of:
+    header, section_size, weighted_mismatch, ambiguous_layout,
+    row_ptrs_monotone, row_ptrs_total, col_idx_range,
+    degrees_length, degrees_consistent, partition_starts,
+    partition_edges)."""
+
+    def __init__(self, path: str, check: str, detail: str):
+        super().__init__(f"{path}: invalid graph [{check}] — {detail}")
+        self.path = path
+        self.check = check
+        self.detail = detail
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,19 +90,23 @@ def _infer_sections(path: str, nv: int, ne: int,
         if matches and not filtered:
             have = "a weighted" if matches[0][0] else "an unweighted"
             want = "weighted" if weighted else "unweighted"
-            raise ValueError(
-                f"{path}: looks like {have} graph but was opened as "
+            raise GraphFormatError(
+                path, "weighted_mismatch",
+                f"looks like {have} graph but was opened as "
                 f"{want} (nv={nv} ne={ne} size={size})")
         matches = filtered
     if not matches:
-        raise ValueError(
-            f"{path}: size {size} does not match any .lux layout for "
-            f"nv={nv} ne={ne} (expected one of {sorted(candidates.values())})")
+        raise GraphFormatError(
+            path, "section_size",
+            f"size {size} does not match any .lux layout for "
+            f"nv={nv} ne={ne} (expected one of "
+            f"{sorted(candidates.values())}) — truncated or torn file?")
     if len(matches) > 1:
         # Possible when weight bytes == degree bytes (e.g. nv == ne with
         # 4-byte weights): the file cannot be parsed without being told.
-        raise ValueError(
-            f"{path}: ambiguous layout ({matches}); pass weighted=True/"
+        raise GraphFormatError(
+            path, "ambiguous_layout",
+            f"size matches layouts {matches}; pass weighted=True/"
             f"False explicitly")
     return matches[0]
 
@@ -86,7 +117,9 @@ def peek_lux(path: str, weighted: bool | None = None,
     with open(path, "rb") as f:
         head = f.read(HEADER_SIZE)
     if len(head) != HEADER_SIZE:
-        raise ValueError(f"{path}: too short for a .lux header")
+        raise GraphFormatError(path, "header",
+                               f"only {len(head)} bytes, a .lux "
+                               f"header is {HEADER_SIZE}")
     nv = int(np.frombuffer(head, V_DTYPE, count=1, offset=0)[0])
     ne = int(np.frombuffer(head, E_DTYPE, count=1, offset=4)[0])
     has_w, has_d = _infer_sections(path, nv, ne, weighted, weight_dtype)
@@ -94,8 +127,74 @@ def peek_lux(path: str, weighted: bool | None = None,
                          weight_dtype=np.dtype(weight_dtype))
 
 
+def validate_graph(nv: int, ne: int, row_ptrs, col_idx,
+                   degrees=None, path: str = "<arrays>") -> None:
+    """Structural CSC invariants — every violation is a
+    :class:`GraphFormatError` naming the check and the first offending
+    index, never a wrong-answer run downstream (XLA's clamping gathers
+    would otherwise absorb out-of-range indices silently):
+
+    - ``row_ptrs`` are monotone non-decreasing END offsets;
+    - ``row_ptrs[-1] == ne`` (and an empty graph has ne == 0);
+    - every ``col_idx`` source lies in ``[0, nv)``;
+    - ``degrees`` (when present) has length nv and is EXACTLY the
+      out-degree histogram of ``col_idx``.
+
+    O(nv + ne) vectorized numpy — the same order as reading the file.
+    """
+    row_ptrs = np.asarray(row_ptrs)
+    col_idx = np.asarray(col_idx)
+    if row_ptrs.shape[0] != nv:
+        raise GraphFormatError(
+            path, "row_ptrs_total",
+            f"{row_ptrs.shape[0]} row_ptrs for nv={nv}")
+    if nv:
+        d = np.diff(row_ptrs.astype(np.int64))
+        if row_ptrs[0] > ne or (d < 0).any():
+            at = (0 if row_ptrs[0] > ne
+                  else int(np.argmax(d < 0)) + 1)
+            raise GraphFormatError(
+                path, "row_ptrs_monotone",
+                f"end offsets decrease at vertex {at} "
+                f"(row_ptrs[{at}]={int(row_ptrs[at])})")
+        if int(row_ptrs[-1]) != ne:
+            raise GraphFormatError(
+                path, "row_ptrs_total",
+                f"row_ptrs[-1]={int(row_ptrs[-1])} != ne={ne}")
+    elif ne:
+        raise GraphFormatError(path, "row_ptrs_total",
+                               f"nv=0 but ne={ne}")
+    if col_idx.shape[0] != ne:
+        raise GraphFormatError(
+            path, "col_idx_range",
+            f"{col_idx.shape[0]} col_idx entries for ne={ne}")
+    if ne:
+        c64 = col_idx.astype(np.int64, copy=False)
+        bad = (c64 < 0) | (c64 >= nv)
+        if bad.any():
+            at = int(np.argmax(bad))
+            raise GraphFormatError(
+                path, "col_idx_range",
+                f"col_idx[{at}]={int(c64[at])} outside [0, {nv})")
+    if degrees is not None:
+        degrees = np.asarray(degrees)
+        if degrees.shape[0] != nv:
+            raise GraphFormatError(
+                path, "degrees_length",
+                f"{degrees.shape[0]} degrees for nv={nv}")
+        want = np.bincount(col_idx.astype(np.int64, copy=False),
+                           minlength=nv)
+        got = degrees.astype(np.int64, copy=False)
+        if not np.array_equal(got, want):
+            at = int(np.argmax(got != want))
+            raise GraphFormatError(
+                path, "degrees_consistent",
+                f"degrees[{at}]={int(got[at])} but col_idx counts "
+                f"{int(want[at])} out-edges")
+
+
 def read_lux(path: str, weighted: bool | None = None, weight_dtype=np.int32,
-             mmap: bool = True):
+             mmap: bool = True, validate: bool = False):
     """Read a .lux file.
 
     Returns (header, row_ptrs[u8 nv], col_idx[u4 ne], weights|None,
@@ -104,6 +203,11 @@ def read_lux(path: str, weighted: bool | None = None, weight_dtype=np.int32,
     through RAM (the analogue of the reference's per-partition
     fseeko/fread loads, pull_model.inl:288-319; the real native path is
     lux_tpu.native's C++ loader).
+
+    validate=True runs the structural ``validate_graph`` pass (section
+    sizes are always checked via peek_lux's layout inference) — a
+    malformed file raises :class:`GraphFormatError` instead of flowing
+    into the engines' clamping gathers.
     """
     hdr = peek_lux(path, weighted, weight_dtype)
     off = HEADER_SIZE
@@ -128,6 +232,9 @@ def read_lux(path: str, weighted: bool | None = None, weight_dtype=np.int32,
     degrees = None
     if hdr.has_degrees:
         degrees = arr(V_DTYPE, hdr.nv, off)
+    if validate:
+        validate_graph(hdr.nv, hdr.ne, row_ptrs, col_idx,
+                       degrees=degrees, path=path)
     return hdr, row_ptrs, col_idx, weights, degrees
 
 

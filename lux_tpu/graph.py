@@ -40,6 +40,31 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _check_partition_starts(starts: np.ndarray, num_parts: int,
+                            nv: int) -> None:
+    """Partition cut-point invariants (ShardedGraph.build): length
+    num_parts+1, 0 .. nv, monotone non-decreasing.  A bad ``starts``
+    (hand-rolled, or derived from a corrupt file) would otherwise
+    build negative-size parts whose gathers silently clamp."""
+    if starts.shape[0] != num_parts + 1:
+        raise luxfmt.GraphFormatError(
+            "starts", "partition_starts",
+            f"{starts.shape[0]} cut points for {num_parts} parts "
+            f"(need num_parts + 1)")
+    if int(starts[0]) != 0 or int(starts[-1]) != nv:
+        raise luxfmt.GraphFormatError(
+            "starts", "partition_starts",
+            f"cut points must span [0, {nv}], got "
+            f"[{int(starts[0])}, {int(starts[-1])}]")
+    d = np.diff(starts)
+    if (d < 0).any():
+        at = int(np.argmax(d < 0))
+        raise luxfmt.GraphFormatError(
+            "starts", "partition_starts",
+            f"cut points decrease at part {at} "
+            f"({int(starts[at])} -> {int(starts[at + 1])})")
+
+
 @dataclasses.dataclass
 class Graph:
     """Host CSC graph: row_ptrs are END offsets (see format.py)."""
@@ -53,13 +78,19 @@ class Graph:
 
     @classmethod
     def from_file(cls, path: str, weighted: bool | None = None,
-                  weight_dtype=np.int32, use_native: bool = False
-                  ) -> "Graph":
+                  weight_dtype=np.int32, use_native: bool = False,
+                  validate: bool = False) -> "Graph":
         """Load a .lux file.  use_native=True routes the bulk reads
         through the C++ pthread-pread loader (lux_tpu.native), the
         analogue of the reference's native per-partition load tasks
         (reference pull_model.inl:253-320); falls back to mmap when
-        the native library is unavailable."""
+        the native library is unavailable.
+
+        validate=True runs format.validate_graph on the loaded arrays
+        (both load paths) — a malformed file raises a typed
+        format.GraphFormatError instead of producing wrong results
+        through XLA's clamping gathers (the apps' -validate flag and
+        scripts/fsck_lux.py surface this)."""
         if use_native:
             from lux_tpu import native
             if native.available():
@@ -69,13 +100,16 @@ class Graph:
                     weighted=hdr.has_weights, weight_dtype=weight_dtype)
                 # degrees: col_idx is already in RAM, so count there
                 # rather than re-reading 4*ne bytes from disk
+                if validate:
+                    luxfmt.validate_graph(hdr.nv, hdr.ne, row_ptrs,
+                                          col_idx, path=path)
                 degrees = np.bincount(col_idx,
                                       minlength=hdr.nv).astype(np.uint32)
                 return cls(nv=hdr.nv, ne=hdr.ne, row_ptrs=row_ptrs,
                            col_idx=col_idx, weights=weights,
                            out_degrees=degrees)
         hdr, row_ptrs, col_idx, weights, degrees = luxfmt.read_lux(
-            path, weighted, weight_dtype)
+            path, weighted, weight_dtype, validate=validate)
         if degrees is None:
             # The reference recomputes out-degrees at load time anyway
             # (PullScanTask, reference pull_model.inl:322-345).
@@ -326,6 +360,7 @@ class ShardedGraph:
         if starts is None:
             starts = edge_balanced_bounds(g.row_ptrs, num_parts)
         starts = np.asarray(starts, np.int64)
+        _check_partition_starts(starts, num_parts, g.nv)
         nv_part = (starts[1:] - starts[:-1]).astype(np.int32)
         ne_part = part_edge_counts(g.row_ptrs, starts).astype(np.int64)
         vpad = _round_up(max(1, int(nv_part.max())), vpad_align)
@@ -365,11 +400,29 @@ class ShardedGraph:
             nep = int(ne_part[p])
             ebegin = int(rp[v0 - 1]) if v0 else 0
             eend = ebegin + nep
-            srcs = col[ebegin:eend].astype(np.int64)
-            src_slot[r, :nep] = v_slot[srcs]
-            # local dst of each edge: expand per-vertex in-degree runs
+            # shard-boundary invariants (the same checks
+            # format.validate_graph runs on the whole file, asserted
+            # here on each part's slice so an unvalidated malformed
+            # graph still errors instead of building garbage gathers)
             local_ends = (rp[v0:v1] - ebegin).astype(np.int64)
             in_deg = np.diff(np.concatenate(([0], local_ends)))
+            if nep < 0 or (in_deg < 0).any() or (
+                    v1 > v0 and int(local_ends[-1]) != nep):
+                raise luxfmt.GraphFormatError(
+                    f"part {p}", "partition_edges",
+                    f"row_ptrs not monotone within vertices "
+                    f"[{v0}, {v1}) or edge count {nep} inconsistent "
+                    f"with the part's end offsets")
+            srcs = col[ebegin:eend].astype(np.int64)
+            if srcs.size and (int(srcs.min()) < 0
+                              or int(srcs.max()) >= g.nv):
+                bad = int(srcs.max()) if int(srcs.max()) >= g.nv \
+                    else int(srcs.min())
+                raise luxfmt.GraphFormatError(
+                    f"part {p}", "col_idx_range",
+                    f"edge source {bad} outside [0, {g.nv})")
+            src_slot[r, :nep] = v_slot[srcs]
+            # local dst of each edge: expand per-vertex in-degree runs
             dst_local[r, :nep] = np.repeat(
                 np.arange(v1 - v0, dtype=np.int32), in_deg)
             if edge_weight is not None:

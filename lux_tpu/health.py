@@ -1,0 +1,258 @@
+"""Device-side health watchdog: O(1) in-loop checks, host-side trips.
+
+The reference has no numeric guards at all, and until round 9 neither
+did the fused loops here: a NaN residual compared False against
+``run_until``'s ``res > tol`` predicate and the loop EXITED, reporting
+convergence on a garbage state.  This module is the guarded-execution
+layer closing that class of silent wrongness:
+
+- Engines grow health-recording loop variants (compiled lazily beside
+  the untouched watchdog-free programs, exactly like the round-7
+  counter variants): ``PullEngine.run_health`` / ``run_until_health``
+  and ``PushEngine.converge_health`` accumulate a fixed ``int32[6]``
+  HEALTH WORD inside the ``fori_loop``/``while_loop`` and EXIT the
+  loop the iteration a fatal flag trips — no in-loop host syncs, the
+  word is fetched once per run/segment boundary (24 bytes), the same
+  O(KB)-per-segment discipline as the telemetry counters.
+- A tripped word raises a typed :class:`HealthError` carrying the
+  diagnosis (which checks, which iteration, which part);
+  ``resilience.classify`` treats it as FATAL-with-diagnosis — the
+  corruption is in the state itself, so a resume from the last
+  checkpoint cannot be trusted blindly and a human (or the caller)
+  decides.
+
+Health word layout (int32[6], see ARCHITECTURE.md "Data integrity &
+guarded execution"):
+
+    [0] flags      bitmask of tripped checks (0 = healthy)
+    [1] iteration  first iteration any check tripped (-1 = none)
+    [2] part       first part with non-finite state at trip (-1 = n/a)
+    [3] count      non-finite values at trip (clamped to int32)
+    [4] aux        pull: float32 residual at trip, bitcast to int32;
+                   push: global frontier size at trip
+    [5] tick       iterations the watchdog has observed — the word
+                   (plus its window/stall aux, the WATCH tuple) is
+                   THREADED across segment boundaries by the
+                   segmented drivers, so trailing-window checks keep
+                   their history when segments are shorter than the
+                   window and trip iterations are global to the run
+
+Checks, engine by engine:
+
+- pull (``NONFINITE_STATE``/``NONFINITE_RESIDUAL``): any NaN/Inf in
+  the new state / in the iteration residual.
+- pull ``DIVERGENCE``: the trailing ``WINDOW`` residuals are strictly
+  increasing AND grew by more than ``DIVERGENCE_GROWTH`` over the
+  window — a blowing-up iteration caught before it reaches Inf/NaN.
+- pull ``OSCILLATION``: the trailing-window residual differences
+  strictly alternate in sign with no net decrease — a limit cycle
+  that will never satisfy any tolerance.
+- push ``NONFINITE_STATE``: NaN labels (+Inf is the legitimate
+  unreached sentinel and never trips).
+- push ``FRONTIER_STALL``: ``STALL_N`` consecutive iterations with a
+  non-empty frontier, an unchanged active count and bit-identical
+  labels — the truncation livelock debug.converge_guarded could only
+  catch host-side per segment, now caught (and EXITED) in-loop.
+
+Window checks need ``WINDOW`` iterations of history, so runs shorter
+than the window can only trip the non-finite checks — deliberate:
+short probes never false-positive on startup transients.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# flag bits — one per check; FLAG_NAMES is the wire/diagnosis naming
+NONFINITE_STATE = 1
+NONFINITE_RESIDUAL = 2
+DIVERGENCE = 4
+OSCILLATION = 8
+FRONTIER_STALL = 16
+
+FLAG_NAMES = {
+    NONFINITE_STATE: "nonfinite_state",
+    NONFINITE_RESIDUAL: "nonfinite_residual",
+    DIVERGENCE: "divergence",
+    OSCILLATION: "oscillation",
+    FRONTIER_STALL: "frontier_stall",
+}
+
+# trailing-residual window (pull divergence/oscillation) — must be
+# small: it rides the loop carry of every health-variant iteration
+WINDOW = 8
+# divergence needs strict growth AND this much net blow-up over the
+# window, so a noisy-but-converging SGD trajectory cannot trip it
+DIVERGENCE_GROWTH = 16.0
+# consecutive no-progress iterations before a push stall trips
+STALL_N = 16
+
+HEALTH_LEN = 6
+
+
+class HealthError(RuntimeError):
+    """The watchdog tripped.  Carries the diagnosis: ``checks`` (list
+    of FLAG_NAMES values), ``iteration`` (global, -1 unknown), ``part``
+    (-1 n/a), ``engine`` ('pull'|'push').  resilience.classify treats
+    it as FATAL — the corruption is in the state, not the transport,
+    so blind retry/resume would rerun into the same diagnosis."""
+
+    def __init__(self, message: str, *, checks=(), iteration: int = -1,
+                 part: int = -1, engine: str = "?", count: int = 0):
+        super().__init__(message)
+        self.checks = list(checks)
+        self.iteration = int(iteration)
+        self.part = int(part)
+        self.engine = str(engine)
+        self.count = int(count)
+
+
+# -- device-side word construction (jnp; traced inside engine loops) ---
+
+def init_word():
+    import jax.numpy as jnp
+    return jnp.array([0, -1, -1, 0, 0, 0], jnp.int32)
+
+
+def init_window():
+    import jax.numpy as jnp
+    return jnp.zeros((WINDOW,), jnp.float32)
+
+
+def record(h, flags, part, count, aux):
+    """Fold one iteration's tripped ``flags`` into the word ``h``:
+    flags accumulate (OR), the diagnosis slots are written only by the
+    FIRST tripping iteration (at the current tick, h[5], which this
+    also advances)."""
+    import jax.numpy as jnp
+    flags = flags.astype(jnp.int32)
+    tick = h[5]
+    first = (h[0] == 0) & (flags != 0)
+    h = h.at[0].set(h[0] | flags)
+    h = h.at[1].set(jnp.where(first, tick, h[1]))
+    h = h.at[2].set(jnp.where(first, part.astype(jnp.int32), h[2]))
+    h = h.at[3].set(jnp.where(first, count.astype(jnp.int32), h[3]))
+    h = h.at[4].set(jnp.where(first, aux.astype(jnp.int32), h[4]))
+    h = h.at[5].set(tick + 1)
+    return h
+
+
+def nonfinite_parts(state):
+    """Per-part non-finite counts [num_parts] int32 (zeros for
+    integer states — integers cannot hold NaN/Inf)."""
+    import jax.numpy as jnp
+    if not jnp.issubdtype(state.dtype, jnp.inexact):
+        return jnp.zeros((state.shape[0],), jnp.int32)
+    bad = ~jnp.isfinite(state)
+    return jnp.sum(bad.reshape(state.shape[0], -1),
+                   axis=1).astype(jnp.int32)
+
+
+def nan_parts(state):
+    """Per-part NaN counts [rows] int32 — the push-label check:
+    +/-Inf is the legitimate unreached sentinel and never trips
+    (zeros for integer labels)."""
+    import jax.numpy as jnp
+    if not jnp.issubdtype(state.dtype, jnp.inexact):
+        return jnp.zeros((state.shape[0],), jnp.int32)
+    bad = jnp.isnan(state)
+    return jnp.sum(bad.reshape(state.shape[0], -1),
+                   axis=1).astype(jnp.int32)
+
+
+def first_bad_part(bad_pp):
+    """Index of the first part with non-finite values, -1 if none."""
+    import jax.numpy as jnp
+    any_bad = jnp.any(bad_pp > 0)
+    return jnp.where(any_bad, jnp.argmax(bad_pp > 0), -1).astype(
+        jnp.int32)
+
+
+def _f32_bits(x):
+    import jax
+    import jax.numpy as jnp
+    return jax.lax.bitcast_convert_type(x.astype(jnp.float32),
+                                        jnp.int32)
+
+
+def pull_update(h, win, state, res):
+    """One pull iteration's health update.  ``state`` is the NEW
+    global [num_parts, vpad, ...] state (sharded arrays are fine —
+    this runs in the jit wrapper OUTSIDE shard_map), ``res`` the
+    iteration's max-abs residual.  Returns (word, window) — thread
+    both across segments so the trailing-window checks keep their
+    history (the word's tick, h[5], indexes the ring)."""
+    import jax.numpy as jnp
+    tick = h[5]
+    bad_pp = nonfinite_parts(state)
+    nf = jnp.sum(bad_pp)
+    res_bad = ~jnp.isfinite(res)
+    win = win.at[tick % WINDOW].set(res.astype(jnp.float32))
+    # chronological view of the ring (oldest first)
+    chron = jnp.roll(win, -(tick % WINDOW) - 1)
+    d = chron[1:] - chron[:-1]
+    full = tick >= WINDOW - 1
+    div = (full & jnp.all(d > 0)
+           & (chron[-1] > DIVERGENCE_GROWTH * chron[0]))
+    osc = (full & jnp.all(d[1:] * d[:-1] < 0)
+           & (chron[-1] + chron[-2] >= chron[0] + chron[1]))
+    flags = ((nf > 0) * NONFINITE_STATE
+             + res_bad * NONFINITE_RESIDUAL
+             + div * DIVERGENCE + osc * OSCILLATION)
+    return record(h, flags, first_bad_part(bad_pp), nf,
+                  _f32_bits(res)), win
+
+
+# -- host-side decode / raise ------------------------------------------
+
+def _fetch(hvec) -> np.ndarray:
+    import jax
+    if isinstance(hvec, (tuple, list)):    # a WATCH tuple (word, aux)
+        hvec = hvec[0]
+    return np.asarray(jax.device_get(hvec)).astype(np.int64)
+
+
+def flag_names(flags: int) -> list[str]:
+    return [name for bit, name in sorted(FLAG_NAMES.items())
+            if flags & bit]
+
+
+def digest(hvec, engine: str, base_iter: int = 0) -> dict:
+    """Host-side diagnosis dict of a (possibly device) health word.
+    ``base_iter`` offsets the in-run iteration to a global count when
+    the run was one segment of a longer whole."""
+    h = _fetch(hvec)
+    flags = int(h[0])
+    out = {"engine": engine, "tripped": bool(flags),
+           "flags": flag_names(flags)}
+    if flags:
+        out["iteration"] = int(h[1]) + base_iter if h[1] >= 0 else -1
+        out["part"] = int(h[2])
+        out["count"] = int(h[3])
+        if engine == "pull":
+            out["residual"] = float(
+                np.int32(h[4]).view(np.float32))
+        else:
+            out["frontier"] = int(h[4])
+    return out
+
+
+def ensure_ok(hvec, engine: str, base_iter: int = 0,
+              where: str = "run") -> dict:
+    """Fetch + decode one health word; healthy returns the digest, a
+    tripped word emits a ``health_trip`` telemetry event and raises
+    HealthError with the full diagnosis."""
+    from lux_tpu import telemetry
+
+    d = digest(hvec, engine, base_iter)
+    if not d["tripped"]:
+        return d
+    telemetry.current().emit("health_trip", where=where, **d)
+    detail = (f"residual={d.get('residual'):.6g}" if engine == "pull"
+              else f"frontier={d.get('frontier')}")
+    raise HealthError(
+        f"{where}: health watchdog tripped "
+        f"[{'+'.join(d['flags'])}] at iteration {d['iteration']}, "
+        f"part {d['part']} ({d['count']} bad values, {detail})",
+        checks=d["flags"], iteration=d["iteration"], part=d["part"],
+        engine=engine, count=d["count"])

@@ -70,10 +70,22 @@ _FATAL_OSERRORS = (FileNotFoundError, NotADirectoryError,
 def classify(exc: BaseException) -> str:
     """RETRYABLE or FATAL for one failure (see module docstring for
     the taxonomy)."""
-    from lux_tpu import debug, faults
+    from lux_tpu import checkpoint, debug, faults, health
 
     if isinstance(exc, faults.InjectedWorkerCrash):
         return RETRYABLE
+    if isinstance(exc, health.HealthError):
+        return FATAL            # fatal-with-diagnosis: the watchdog
+        #                         saw corruption in the STATE itself
+        #                         (which check/part/iteration is on
+        #                         the exception) — blind retry/resume
+        #                         reruns into the same diagnosis
+    if isinstance(exc, checkpoint.CorruptCheckpointError):
+        return RETRYABLE        # the retry's resume goes through
+        #                         load_any, which falls back one
+        #                         GENERATION and replays the lost
+        #                         segment — never the deterministic-
+        #                         OSError fatal bucket below
     if isinstance(exc, debug.StallError):
         return FATAL
     if isinstance(exc, debug.DivergenceError):
@@ -179,15 +191,36 @@ def _make_segment(segment, seg_budget, per_size_compile=True):
     return segment
 
 
+def _int_sentinel(eng):
+    """The integer identity/sentinel value of the engine's program (the
+    one-sentinel convention: faults.corrupt_state pokes it into
+    integer-labeled states — sssp hop counts, components ids — so a
+    seeded NAN plan can corrupt all four apps instead of crashing on
+    the float-only nan_corrupt).  None for float programs."""
+    ident = getattr(getattr(eng, "program", None), "identity", None)
+    if ident is None:
+        return None
+    ident = np.asarray(ident)
+    return int(ident) if np.issubdtype(ident.dtype, np.integer) else None
+
+
 def _record_resume(path, report):
     from lux_tpu import checkpoint
 
-    if os.path.exists(path):
+    if checkpoint.any_generation(path):
         try:
-            _leaves, meta = checkpoint.load(path)
+            # generation-fallback-aware: records the iteration the
+            # resume will ACTUALLY continue from (the .prev one when
+            # the newest file is corrupt — a meta-only peek would
+            # misreport the corrupt file's own counter, so this pays
+            # the verifying load).  load_any QUARANTINES a corrupt
+            # newest, so the fallback detection, its event and its
+            # CRC cost all happen ONCE here; the attempt's resume
+            # then reads the good generation directly.
+            _leaves, meta, _used = checkpoint.load_any(path)
             report.resumed_from.append(int(meta.get("iter", 0)))
-        except Exception:           # noqa: BLE001 — a torn/alien file
-            pass                    # just means a fresh start
+        except Exception:           # noqa: BLE001 — all gens corrupt
+            pass                    # the attempt itself will surface it
 
 
 def supervised_run(eng, num_iters: int, path: str, *,
@@ -207,14 +240,17 @@ def supervised_run(eng, num_iters: int, path: str, *,
     from lux_tpu import checkpoint, debug
 
     report = report or RunReport()
-    if not resume and os.path.exists(path):
-        os.unlink(path)
+    if not resume:
+        checkpoint.remove(path)     # BOTH generations: a stale .prev
+        #                             must not resurrect either
+    if faults is not None and hasattr(faults, "bind_checkpoint"):
+        faults.bind_checkpoint(path)
 
     def hook(s, done):
         report.segments += 1
         out = None
         if faults is not None:
-            res = faults.fire(s)
+            res = faults.fire(s, int_value=_int_sentinel(eng))
             if res is not None:
                 s = out = eng.place(res)
         if guard:
@@ -237,7 +273,7 @@ def supervised_run(eng, num_iters: int, path: str, *,
             _record_resume(path, report)
             if k == 0 and report.resumed_from:
                 report.initial_resume = report.resumed_from[0]
-        will_load = do_resume and os.path.exists(path)
+        will_load = do_resume and checkpoint.any_generation(path)
         if will_load and state0 is None:
             import jax
             try:                    # structure-only: no placement
@@ -285,14 +321,17 @@ def supervised_converge(eng, path: str, *,
     from lux_tpu import checkpoint, debug
 
     report = report or RunReport()
-    if not resume and os.path.exists(path):
-        os.unlink(path)
+    if not resume:
+        checkpoint.remove(path)
+    if faults is not None and hasattr(faults, "bind_checkpoint"):
+        faults.bind_checkpoint(path)
 
     def hook(lbl, act, total, cnt):
         report.segments += 1
         out = None
         if faults is not None:
-            res = faults.fire((lbl, act))
+            res = faults.fire((lbl, act),
+                              int_value=_int_sentinel(eng))
             if res is not None:
                 lbl, act = eng.place(*[np.asarray(x) for x in res])
                 out = (lbl, act)

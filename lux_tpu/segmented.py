@@ -127,24 +127,40 @@ def run_segments(eng, state, num_iters: int, segment,
 
     tel = telemetry.current()
     st = tel.iter_stats
+    guarded = getattr(eng, "health", False)
     if st is not None and start_iter == 0:
         st.begin_run()          # a resume keeps accumulating instead
     budget = segment if isinstance(segment, DurationBudget) else None
     timed = budget is not None or tel.events is not None
     done = start_iter
     seg_idx = 0
+    watch = None           # threaded across segments: the trailing-
+    #                        window checks keep their history even
+    #                        when segments are shorter than the window
     while done < num_iters:
         n = _next_n(segment, num_iters - done)
         t0 = time.perf_counter()
         with step_annotation("lux_segment", seg_idx):
-            if st is not None:
+            if guarded:
+                state, _itd, res_b, chg_b, watch = eng.run_health(
+                    state, n, watch)
+            elif st is not None:
                 state, res_b, chg_b = eng.run_stats(state, n)
             else:
                 state = eng.run(state, n)
-            if timed or st is not None:
+            if timed or st is not None or guarded:
                 from lux_tpu.timing import fence
                 fence(state)   # O(1)-byte fence, not a download
         dt = time.perf_counter() - t0
+        if guarded:
+            # a tripped watchdog raises BEFORE the segment hook, so a
+            # corrupted state can never reach a checkpoint save (the
+            # trip iteration is already global: the threaded watch's
+            # tick counts across segments; start_iter offsets resumes)
+            from lux_tpu import health
+            health.ensure_ok(watch, engine="pull",
+                             base_iter=start_iter,
+                             where=f"pull segment {seg_idx}")
         if budget is not None:
             budget.observe(n, dt)
         done += n
@@ -190,17 +206,23 @@ def converge_segments(eng, label, active, segment,
 
     tel = telemetry.current()
     st = tel.iter_stats
+    guarded = getattr(eng, "health", False)
     if st is not None and start_iter == 0:
         st.begin_run()
     budget = segment if isinstance(segment, DurationBudget) else None
     total = start_iter
     seg_idx = 0
+    watch = None           # threaded: a stall spanning a segment
+    #                        boundary still accumulates
     cap = np.iinfo(np.int32).max if max_iters is None else max_iters
     while total < cap:
         n = _next_n(segment, cap - total)
         t0 = time.perf_counter()
         with step_annotation("lux_segment", seg_idx):
-            if st is not None:
+            if guarded:
+                label, active, it, fsz, fed, watch = \
+                    eng.converge_health(label, active, n, watch)
+            elif st is not None:
                 label, active, it, fsz, fed = eng.converge_stats(
                     label, active, n)
             else:
@@ -209,6 +231,14 @@ def converge_segments(eng, label, active, segment,
             # the completion fence (tunnel-safe, O(1) bytes)
             it = int(np.asarray(jax.device_get(it)))
         dt = time.perf_counter() - t0
+        if guarded:
+            # raise BEFORE the segment hook: a corrupted/livelocked
+            # state never reaches a checkpoint save (trip iterations
+            # are global via the threaded watch's tick)
+            from lux_tpu import health
+            health.ensure_ok(watch, engine="push",
+                             base_iter=start_iter,
+                             where=f"push segment {seg_idx}")
         if budget is not None and it > 0:
             budget.observe(it, dt)
         total += it

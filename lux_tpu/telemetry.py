@@ -11,7 +11,11 @@ supervisor (resilience.py), the CLI and bench.py all emit into:
   line: ``{"t": ..., "kind": ..., ...}``).  Segment start/stop with
   measured rates, checkpoint save/resume, classified retries, outlier
   discards and duration-budget decisions all become events instead of
-  ad-hoc prints.  ``scripts/events_summary.py`` renders a log into the
+  ad-hoc prints — round 9 adds the guarded-execution events:
+  ``health`` (per-run watchdog digest), ``health_trip`` (the
+  diagnosis of a tripped watchdog: checks, iteration, part) and
+  ``checkpoint_fallback`` (a corrupt newest generation replaced by
+  ``.prev``).  ``scripts/events_summary.py`` renders a log into the
   reference-style loadTime/compTime/updateTime table and
   ``scripts/check_bench.py`` validates the schema.
 - ``IterStats``: the host-side accumulator for DEVICE-SIDE iteration

@@ -93,13 +93,20 @@ def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None,
 
     tel = telemetry.current()
     st = tel.iter_stats
+    guarded = getattr(eng, "health", False)
 
     def one(state):
+        if guarded:
+            # the watchdog loop variant IS the timed program; the
+            # 24-byte word is checked after the elapsed time is
+            # recorded, so the check is never billed
+            s, _it, rb, cb, h = eng.run_health(state, num_iters)
+            return s, rb, cb, h
         if st is not None:
-            return eng.run_stats(state, num_iters)
-        return eng.run(state, num_iters), None, None
+            return (*eng.run_stats(state, num_iters), None)
+        return eng.run(state, num_iters), None, None, None
 
-    state, res_b, chg_b = one(eng.init_state())
+    state, res_b, chg_b, hvec = one(eng.init_state())
     fence(state)
     elapsed = []
     with _trace_ctx(trace_dir):
@@ -108,11 +115,16 @@ def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None,
             fence(state)       # H2D upload is async: keep it untimed
             with step_annotation("lux_timed_run", i):
                 t0 = time.perf_counter()
-                state, res_b, chg_b = one(state)
+                state, res_b, chg_b, hvec = one(state)
                 fence(state)   # O(1)-byte fence, not a state download
                 elapsed.append(time.perf_counter() - t0)
             tel.emit("timed_run", repeat=i, iters=num_iters,
                      seconds=round(elapsed[-1], 6))
+    if guarded:
+        from lux_tpu import health
+        tel.emit("health", **health.ensure_ok(
+            hvec, engine="pull", where="timed pull run"),
+            iters=num_iters)
     if st is not None:
         st.begin_run()         # counters describe the LAST timed run
         st.extend_pull(res_b, chg_b, num_iters)
@@ -133,12 +145,16 @@ def timed_converge(eng, max_iters=None, verbose: bool = False,
 
     tel = telemetry.current()
     st = tel.iter_stats
+    guarded = getattr(eng, "health", False)
 
     def one(label, active):
+        if guarded:
+            return eng.converge_health(label, active, max_iters)
         if st is not None:
-            return eng.converge_stats(label, active, max_iters)
+            return (*eng.converge_stats(label, active, max_iters),
+                    None)
         l, a, it = eng.converge(label, active, max_iters)
-        return l, a, it, None, None
+        return l, a, it, None, None, None
 
     if verbose and st is None:
         # one extra run purely to replay counters; with an active
@@ -146,7 +162,7 @@ def timed_converge(eng, max_iters=None, verbose: bool = False,
         # counters instead (printing here would double the series)
         eng.run(max_iters=max_iters, verbose=True)
     label, active = eng.init_state()
-    l2, a2, _it, _f, _e = one(label, active)        # compile
+    l2, a2, _it, _f, _e, _h = one(label, active)    # compile
     fence(l2)
     elapsed = []
     with _trace_ctx(trace_dir):
@@ -155,11 +171,17 @@ def timed_converge(eng, max_iters=None, verbose: bool = False,
             fence((label, active))   # keep the async upload untimed
             with step_annotation("lux_timed_converge", i):
                 t0 = time.perf_counter()
-                label, active, it_d, fsz, fed = one(label, active)
+                label, active, it_d, fsz, fed, hvec = one(label,
+                                                          active)
                 iters = int(fetch(it_d))
                 elapsed.append(time.perf_counter() - t0)
             tel.emit("timed_run", repeat=i, iters=iters,
                      seconds=round(elapsed[-1], 6))
+    if guarded:
+        from lux_tpu import health
+        tel.emit("health", **health.ensure_ok(
+            hvec, engine="push", where="timed converge"),
+            iters=iters)
     if st is not None:
         st.begin_run()
         st.extend_push(fsz, fed, iters)
@@ -179,24 +201,33 @@ def timed_run_until(eng, tol: float, max_iters: int,
 
     tel = telemetry.current()
     st = tel.iter_stats
+    guarded = getattr(eng, "health", False)
 
     def one(state, cap):
+        if guarded:
+            return eng.run_until_health(state, tol, max_iters=cap)
         if st is not None:
-            return eng.run_until_stats(state, tol, max_iters=cap)
+            return (*eng.run_until_stats(state, tol, max_iters=cap),
+                    None)
         s, it, res = eng.run_until(state, tol, max_iters=cap)
-        return s, it, res, None, None
+        return s, it, res, None, None, None
 
-    s0, _it, _res, _rb, _cb = one(eng.init_state(), 1)
+    s0, _it, _res, _rb, _cb, _h = one(eng.init_state(), 1)
     fence(s0)
     state0 = eng.init_state()
     fence(state0)              # keep the async upload untimed
     with _trace_ctx(trace_dir):
         t0 = time.perf_counter()
-        state, it, res, rb, cb = one(state0, max_iters)
+        state, it, res, rb, cb, hvec = one(state0, max_iters)
         iters = int(fetch(it))
         elapsed = time.perf_counter() - t0
     tel.emit("timed_run", repeat=0, iters=iters,
              seconds=round(elapsed, 6))
+    if guarded:
+        from lux_tpu import health
+        tel.emit("health", **health.ensure_ok(
+            hvec, engine="pull", where="timed run_until"),
+            iters=iters)
     if st is not None:
         st.begin_run()
         st.extend_pull(rb, cb, iters)

@@ -56,6 +56,13 @@ Checked per metric line:
   telemetry fails strict mode like the round-6 keys (the round-1..6
   artifacts predate it: -legacy-ok).
 
+- telemetry.health (round 9, bench.py -health): the device-side
+  watchdog digest — optional and null when off; present it must be a
+  clean bill ({engine, tripped=false, flags=[], iters >= 0}; known
+  check names only) — a tripped watchdog fails its config with a
+  _FAILED line, so a published metric line claiming a trip is a
+  contradiction and fails the audit.
+
 Exit status: 0 clean, 1 any error (loud, listed on stderr).
 """
 
@@ -331,6 +338,8 @@ def check_telemetry(name: str, obj: dict) -> list[str]:
                     f"{implied:.4f} GTEPS — matches no recorded "
                     f"sample; seconds and samples disagree")
 
+    errs += check_health_digest(name, tel)
+
     cnt = tel["counters"]
     if cnt is not None:
         if (not isinstance(cnt, dict)
@@ -349,6 +358,55 @@ def check_telemetry(name: str, obj: dict) -> list[str]:
             if numeric:
                 errs.append(f"{name}: telemetry.counters non-finite "
                             f"fields {numeric}")
+    return errs
+
+
+HEALTH_FLAGS = {"nonfinite_state", "nonfinite_residual", "divergence",
+                "oscillation", "frontier_stall"}
+
+
+def check_health_digest(name: str, tel: dict) -> list[str]:
+    """Round-9 watchdog digest (bench.py -health): optional (older
+    artifacts predate it), null when the watchdog was off; present it
+    must be {engine: push|pull, tripped: bool, flags: [known names],
+    iters: int >= 0}.  tripped=true with no flags — or flags on a
+    clean line at all — is a contradiction: a tripped watchdog fails
+    the config, so a metric line's digest must be a clean bill."""
+    if "health" not in tel:
+        return []
+    h = tel["health"]
+    if h is None:
+        return []
+    if not isinstance(h, dict):
+        return [f"{name}: telemetry.health must be null or a dict, "
+                f"got {h!r}"]
+    errs = []
+    if h.get("engine") not in ("push", "pull"):
+        errs.append(f"{name}: telemetry.health.engine="
+                    f"{h.get('engine')!r} not push|pull")
+    if not isinstance(h.get("tripped"), bool):
+        errs.append(f"{name}: telemetry.health.tripped must be a "
+                    f"bool, got {h.get('tripped')!r}")
+    flags = h.get("flags")
+    if (not isinstance(flags, list)
+            or not all(isinstance(f, str) for f in flags)):
+        errs.append(f"{name}: telemetry.health.flags must be a list "
+                    f"of check names, got {flags!r}")
+    else:
+        unknown = sorted(set(flags) - HEALTH_FLAGS)
+        if unknown:
+            errs.append(f"{name}: telemetry.health.flags has unknown "
+                        f"checks {unknown}")
+        if h.get("tripped") is True or flags:
+            errs.append(
+                f"{name}: telemetry.health reports a TRIP "
+                f"(tripped={h.get('tripped')}, flags={flags}) — a "
+                f"tripped watchdog fails its config with a _FAILED "
+                f"line and cannot publish a metric line")
+    it = h.get("iters")
+    if not isinstance(it, int) or isinstance(it, bool) or it < 0:
+        errs.append(f"{name}: telemetry.health.iters={it!r} must be "
+                    f"an int >= 0")
     return errs
 
 

@@ -16,6 +16,10 @@ human shape — and audits it while doing so:
   double-count, i.e. the fenced slice timings are lying.  Summing
   UNDER the elapsed is expected: the elapsed legitimately includes
   checkpoint saves and host driver time between slices.
+- round 9: ``health_trip`` events (the device-side watchdog,
+  lux_tpu/health.py) must carry flags/iteration/part/engine — an
+  undiagnosable trip fails the audit; ``health`` digests and
+  ``checkpoint_fallback`` generation-fallback events are rendered.
 
 Usage:
     python scripts/events_summary.py FILE [FILE...]
@@ -31,9 +35,13 @@ import sys
 
 KNOWN = {"run_start", "config_start", "header", "timed_run",
          "segment", "run_done", "iter_stats", "phases",
-         "checkpoint_save", "checkpoint_resume", "retry", "failure",
-         "budget_lock", "budget_halve", "outlier_discard",
-         "outlier_rerun"}
+         "checkpoint_save", "checkpoint_resume", "checkpoint_fallback",
+         "retry", "failure", "budget_lock", "budget_halve",
+         "outlier_discard", "outlier_rerun", "health", "health_trip"}
+
+# a health_trip without these fields cannot be diagnosed — the whole
+# point of the watchdog is a NAMED check at a NAMED iteration
+HEALTH_TRIP_REQUIRED = ("flags", "iteration", "part", "engine")
 
 
 def load_events(path: str):
@@ -161,6 +169,30 @@ def render_run(run, out=sys.stdout) -> list[str]:
     for r in by.get("checkpoint_resume", []):
         print(f"  resumed from iter {r.get('iter')} "
               f"({r.get('path')})", file=out)
+    for r in by.get("checkpoint_fallback", []):
+        print(f"  CHECKPOINT FALLBACK: {r.get('path')} corrupt -> "
+              f"{r.get('fallback')} ({r.get('error')})", file=out)
+    for h in by.get("health", []):
+        flags = h.get("flags")
+        if (not isinstance(flags, list)
+                or not all(isinstance(f, str) for f in flags)
+                or not isinstance(h.get("tripped"), bool)):
+            errs.append(f"{title}: malformed health event (flags "
+                        f"must be a list of names, tripped a bool): "
+                        f"{h!r}"[:200])
+            continue
+        print(f"  watchdog ({h.get('engine')}): "
+              f"{'TRIPPED ' + '+'.join(flags) if h['tripped'] else 'clean'}"
+              f" over {h.get('iters')} iters", file=out)
+    for h in by.get("health_trip", []):
+        missing = [k for k in HEALTH_TRIP_REQUIRED if k not in h]
+        if missing:
+            errs.append(f"{title}: health_trip event missing "
+                        f"{missing} — an undiagnosable trip: {h!r}"[:200])
+            continue
+        print(f"  WATCHDOG TRIPPED ({h['engine']}): "
+              f"{'+'.join(h['flags'])} at iteration {h['iteration']}"
+              f", part {h['part']} ({h.get('where', '?')})", file=out)
     for r in by.get("retry", []):
         print(f"  retry: attempt {r.get('attempt')} "
               f"{r.get('error')} [{r.get('classification')}] "

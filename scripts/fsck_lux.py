@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Offline .lux structural checker (round-9 validated loading).
+
+The engines' gathers CLAMP out-of-range indices, so a malformed .lux
+file — non-monotone ``row_ptrs``, out-of-range ``col_idx``, a
+truncated payload, inconsistent trailing degrees — used to flow
+through a run and produce wrong results instead of an error.  This
+checker runs ``format.validate_graph`` (the same pass as the apps'
+``-validate`` flag) against files at rest, so bad conversions and
+torn copies fail HERE, before a multi-hour run:
+
+- header + section sizes vs file length (format.peek_lux layout
+  inference — a truncated file can't match any layout);
+- ``row_ptrs`` monotone END offsets with ``row_ptrs[-1] == ne``;
+- every ``col_idx`` source in ``[0, nv)``;
+- trailing degrees (when present) exactly the out-degree histogram.
+
+Usage:
+    python scripts/fsck_lux.py [-weighted | -unweighted] FILE...
+
+Weightedness is inferred from the file size by default (pass
+-weighted/-unweighted for the ambiguous nv*4 == ne*w case).
+
+Exit status: 0 every file clean, 1 any failure (listed on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lux_tpu import format as luxfmt  # noqa: E402
+
+
+def fsck(path: str, weighted: bool | None) -> str | None:
+    """Returns None when clean, the failure message otherwise."""
+    try:
+        hdr, _rp, _ci, _w, degrees = luxfmt.read_lux(
+            path, weighted=weighted, validate=True)
+    except luxfmt.GraphFormatError as e:
+        return f"[{e.check}] {e.detail}"
+    except (OSError, ValueError) as e:
+        return f"[unreadable] {type(e).__name__}: {e}"
+    print(f"{path}: OK nv={hdr.nv} ne={hdr.ne} "
+          f"weights={'yes' if hdr.has_weights else 'no'} "
+          f"degrees={'yes' if hdr.has_degrees else 'no'}")
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate .lux graph files (structural invariants "
+                    "+ section sizes); see lux_tpu/format.py")
+    ap.add_argument("files", nargs="+", metavar="FILE")
+    grp = ap.add_mutually_exclusive_group()
+    grp.add_argument("-weighted", action="store_true",
+                     help="treat the files as weighted (default: "
+                          "infer from file size)")
+    grp.add_argument("-unweighted", action="store_true",
+                     help="treat the files as unweighted")
+    args = ap.parse_args(argv)
+    weighted = True if args.weighted else \
+        False if args.unweighted else None
+
+    bad = 0
+    for path in args.files:
+        err = fsck(path, weighted)
+        if err is not None:
+            bad += 1
+            print(f"ERROR: {path}: {err}", file=sys.stderr)
+    if bad:
+        print(f"fsck_lux: {bad} of {len(args.files)} file(s) FAILED",
+              file=sys.stderr)
+        return 1
+    print(f"fsck_lux: {len(args.files)} file(s) OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

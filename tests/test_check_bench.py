@@ -102,6 +102,45 @@ def test_bad_lines_fail(tmp_path, mutate, needle):
     assert needle in r.stderr
 
 
+def test_health_digest_accepted_and_typechecked(tmp_path):
+    """Round-9 telemetry.health digest (bench.py -health): a clean
+    digest passes, null passes (watchdog off), and malformed or
+    contradictory digests fail."""
+    good = json.loads(json.dumps(GOOD_LINE))
+    good["telemetry"]["health"] = {"engine": "pull", "tripped": False,
+                                   "flags": [], "iters": 10}
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(good) + "\n")
+    assert run_check(p).returncode == 0, run_check(p).stderr
+    good["telemetry"]["health"] = None
+    p.write_text(json.dumps(good) + "\n")
+    assert run_check(p).returncode == 0
+
+
+@pytest.mark.parametrize("health,needle", [
+    ({"engine": "gpu", "tripped": False, "flags": [], "iters": 10},
+     "not push|pull"),
+    ({"engine": "pull", "tripped": "no", "flags": [], "iters": 10},
+     "tripped must be a bool"),
+    ({"engine": "pull", "tripped": False, "flags": ["made_up"],
+      "iters": 10}, "unknown checks"),
+    ({"engine": "pull", "tripped": True,
+      "flags": ["nonfinite_state"], "iters": 10},
+     "cannot publish a metric line"),
+    ({"engine": "pull", "tripped": False, "flags": [], "iters": -1},
+     "iters"),
+    ("clean", "null or a dict"),
+])
+def test_bad_health_digests_fail(tmp_path, health, needle):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["telemetry"]["health"] = health
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert needle in r.stderr
+
+
 def test_failed_config_line_schema(tmp_path):
     good = {"metric": "sssp_FAILED", "error": "RuntimeError: worker",
             "attempts": 3, "failure_class": "retryable"}

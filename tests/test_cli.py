@@ -39,6 +39,41 @@ def test_pagerank_cli(lux_file, capsys):
     assert "[PASS]" in out
 
 
+def test_health_flag_cli(lux_file, weighted_lux_file, capsys):
+    """-health runs the watchdog loop variants on the fused AND the
+    supervised paths, for pull and push apps alike."""
+    rc = cli.main(["pagerank", "-file", lux_file, "-ni", "3",
+                   "-np", "2", "-health"])
+    assert rc == 0
+    rc = cli.main(["sssp", "-file", lux_file, "-start", "0",
+                   "-health"])
+    assert rc == 0
+    rc = cli.main(["components", "-file", lux_file, "-health",
+                   "-retries", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ELAPSED TIME" in out
+
+
+def test_validate_flag_cli(lux_file, tmp_path, capsys):
+    """-validate: a good file runs; a corrupted one exits 2 with the
+    typed check name, never a wrong-answer run."""
+    rc = cli.main(["pagerank", "-file", lux_file, "-ni", "2",
+                   "-validate"])
+    assert rc == 0
+    bad = tmp_path / "bad.lux"
+    bad.write_bytes(open(lux_file, "rb").read())
+    with open(bad, "r+b") as f:
+        f.seek(12 + 8 * 120)                 # col_idx[0] out of range
+        f.write(np.array([10 ** 6], np.uint32).tobytes())
+    with pytest.raises(SystemExit) as ei:
+        cli.main(["pagerank", "-file", str(bad), "-ni", "2",
+                  "-validate"])
+    assert ei.value.code == 2
+    err = capsys.readouterr().err
+    assert "col_idx_range" in err
+
+
 def test_pagerank_cli_supervised_resume(lux_file, tmp_path, capsys):
     """-retries/-seg-budget/-resume run the supervised path
     (lux_tpu/resilience.py) and a second invocation resumes from the

@@ -132,6 +132,60 @@ def test_timed_event_missing_seconds_fails(tmp_path):
     assert "without numeric 'seconds'" in r.stderr
 
 
+def test_health_events_render(tmp_path):
+    """Round-9 guarded-execution events: watchdog digests/trips and
+    checkpoint generation fallbacks render by name."""
+    events = [
+        {"t": 1.0, "kind": "run_start", "app": "pagerank"},
+        {"t": 1.1, "kind": "health", "engine": "pull",
+         "tripped": False, "flags": [], "iters": 20},
+        {"t": 1.2, "kind": "health_trip", "engine": "pull",
+         "flags": ["divergence"], "iteration": 7, "part": 1,
+         "count": 0, "tripped": True, "where": "pull segment 1"},
+        {"t": 1.3, "kind": "checkpoint_fallback",
+         "path": "/tmp/c.npz", "fallback": "/tmp/c.npz.prev",
+         "error": "leaf 0 CRC32 mismatch"},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "watchdog (pull): clean over 20 iters" in r.stdout
+    assert "WATCHDOG TRIPPED (pull): divergence at iteration 7, " \
+           "part 1" in r.stdout
+    assert "CHECKPOINT FALLBACK: /tmp/c.npz corrupt" in r.stdout
+
+
+def test_malformed_health_event_fails_not_crashes(tmp_path):
+    """A health digest with null/missing flags must produce a NAMED
+    audit error, never a TypeError traceback."""
+    events = [
+        {"t": 1.0, "kind": "run_start", "app": "pagerank"},
+        {"t": 1.1, "kind": "health", "engine": "pull",
+         "tripped": True},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "malformed health event" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+def test_undiagnosable_health_trip_fails(tmp_path):
+    """A health_trip without flags/iteration/part/engine defeats the
+    watchdog's purpose — the audit fails it."""
+    events = [
+        {"t": 1.0, "kind": "run_start", "app": "pagerank"},
+        {"t": 1.1, "kind": "health_trip", "tripped": True},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "health_trip" in r.stderr and "missing" in r.stderr
+
+
 def test_multi_run_log_splits(tmp_path):
     events = GOOD + [
         {"t": 2.0, "kind": "config_start", "config": "sssp"},

@@ -85,3 +85,136 @@ def test_write_rejects_inconsistent():
     with pytest.raises(ValueError):
         luxfmt.write_lux("/tmp/never.lux", np.array([1, 2], np.uint64),
                          np.array([0, 0, 0], np.uint32))
+
+
+# -- round-9 validated loading: every malformed-input class is a TYPED
+#    error naming the check, never a wrong-answer run ------------------
+
+def _write_good(tmp_path, degrees=True):
+    src, dst = uniform_random_edges(60, 400, seed=11)
+    g = Graph.from_edges(src, dst, 60)
+    p = tmp_path / "v.lux"
+    luxfmt.write_lux(str(p), g.row_ptrs, g.col_idx,
+                     degrees=g.out_degrees if degrees else None)
+    return p, g
+
+
+def test_validate_accepts_good_file(tmp_path):
+    p, g = _write_good(tmp_path)
+    hdr, rp, ci, _w, deg = luxfmt.read_lux(str(p), validate=True)
+    np.testing.assert_array_equal(np.asarray(ci), g.col_idx)
+    g2 = Graph.from_file(str(p), validate=True)
+    np.testing.assert_array_equal(g2.col_idx, g.col_idx)
+
+
+def test_validate_nonmonotone_row_ptrs(tmp_path):
+    p, _g = _write_good(tmp_path)
+    with open(p, "r+b") as f:
+        f.seek(12 + 8 * 2)                  # row_ptrs[2] -> 0
+        f.write(np.array([0], np.uint64).tobytes())
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.read_lux(str(p), validate=True)
+    assert ei.value.check == "row_ptrs_monotone"
+    # WITHOUT validate it loads silently — exactly the hole -validate
+    # closes (XLA gathers would clamp, producing wrong results)
+    luxfmt.read_lux(str(p))
+
+
+def test_validate_out_of_range_col_idx(tmp_path):
+    p, _g = _write_good(tmp_path)
+    with open(p, "r+b") as f:
+        f.seek(12 + 8 * 60)                 # col_idx[0] -> 999
+        f.write(np.array([999], np.uint32).tobytes())
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.read_lux(str(p), validate=True)
+    assert ei.value.check == "col_idx_range"
+
+
+def test_validate_truncated_payload(tmp_path):
+    p, _g = _write_good(tmp_path)
+    blob = p.read_bytes()
+    p.write_bytes(blob[:-7])
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.read_lux(str(p), validate=True)
+    assert ei.value.check == "section_size"
+
+
+def test_validate_degree_mismatch(tmp_path):
+    src, dst = uniform_random_edges(60, 400, seed=11)
+    g = Graph.from_edges(src, dst, 60)
+    deg = g.out_degrees.copy()
+    deg[3] += 1
+    p = tmp_path / "d.lux"
+    luxfmt.write_lux(str(p), g.row_ptrs, g.col_idx, degrees=deg)
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.read_lux(str(p), validate=True)
+    assert ei.value.check == "degrees_consistent"
+
+
+def test_weighted_mismatch_is_typed(tmp_path):
+    """Opening an unweighted file as weighted raises the TYPED error
+    (the CLI's -validate handler catches GraphFormatError only)."""
+    p, _g = _write_good(tmp_path, degrees=False)
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.peek_lux(str(p), weighted=True)
+    assert ei.value.check == "weighted_mismatch"
+
+
+def test_validate_graph_arrays_direct():
+    luxfmt.validate_graph(3, 2, np.array([1, 2, 2], np.uint64),
+                          np.array([0, 2], np.uint32))
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.validate_graph(3, 5, np.array([1, 2, 2], np.uint64),
+                              np.array([0, 2], np.uint32))
+    assert ei.value.check == "row_ptrs_total"
+
+
+def test_sharded_build_rejects_bad_partition():
+    from lux_tpu.graph import ShardedGraph
+
+    src, dst = uniform_random_edges(50, 300, seed=3)
+    g = Graph.from_edges(src, dst, 50)
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        ShardedGraph.build(g, 2, starts=np.array([0, 40, 30]))
+    assert ei.value.check == "partition_starts"
+    with pytest.raises(luxfmt.GraphFormatError):
+        ShardedGraph.build(g, 2, starts=np.array([0, 25, 49]))
+
+
+def test_sharded_build_rejects_corrupt_row_ptrs():
+    """A malformed graph fed straight to the partition build (no
+    -validate on the load) still errors on its shard boundaries."""
+    from lux_tpu.graph import Graph as G
+    from lux_tpu.graph import ShardedGraph
+
+    src, dst = uniform_random_edges(50, 300, seed=3)
+    g = G.from_edges(src, dst, 50)
+    rp = g.row_ptrs.copy()
+    rp[10] = 0                              # non-monotone
+    bad = G(nv=g.nv, ne=g.ne, row_ptrs=rp, col_idx=g.col_idx,
+            weights=None, out_degrees=g.out_degrees)
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        ShardedGraph.build(bad, 2)
+    assert ei.value.check in ("partition_edges", "partition_starts")
+
+
+def test_fsck_lux_script(tmp_path):
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = Path(__file__).resolve().parent.parent / "scripts" / \
+        "fsck_lux.py"
+    good, _g = _write_good(tmp_path)
+    bad = tmp_path / "bad.lux"
+    bad.write_bytes(good.read_bytes())
+    with open(bad, "r+b") as f:
+        f.seek(12 + 8 * 60)
+        f.write(np.array([999], np.uint32).tobytes())
+    r = subprocess.run([sys.executable, str(script), str(good)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0 and "OK" in r.stdout
+    r = subprocess.run([sys.executable, str(script), str(good),
+                        str(bad)], capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "col_idx_range" in r.stderr and "1 of 2" in r.stderr

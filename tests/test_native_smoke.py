@@ -37,12 +37,24 @@ def test_make_smoke_and_bindings():
     from lux_tpu import native
     assert native.available()
 
+    # the converter's OUTPUT must pass the round-9 structural checker
+    # (fsck_lux / format.validate_graph): a converter that emits
+    # non-monotone row_ptrs or out-of-range sources fails HERE
+    lux = os.path.join(NATIVE_DIR, "build", "smoke.lux")
+    import sys
+    fsck = os.path.join(os.path.dirname(NATIVE_DIR), "..", "scripts",
+                        "fsck_lux.py")
+    proc = subprocess.run([sys.executable, fsck, lux],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
+
     # the converter's smoke output loads through the pthread loader
     # with the exact 3-edge weighted graph (dst-sorted: 2->0, 0->1,
-    # 1->2 with weights 1, 5, 3)
+    # 1->2 with weights 1, 5, 3) — validate= runs the same pass on
+    # the native load path
     from lux_tpu.graph import Graph
-    lux = os.path.join(NATIVE_DIR, "build", "smoke.lux")
-    g = Graph.from_file(lux, use_native=True)
+    g = Graph.from_file(lux, use_native=True, validate=True)
     assert (g.nv, g.ne) == (3, 3)
     src, dst = g.edge_arrays()
     np.testing.assert_array_equal(src, [2, 0, 1])

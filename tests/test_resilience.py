@@ -45,6 +45,10 @@ NOSLEEP = dict(sleep=lambda s: None)
     (FileNotFoundError(2, "No such file or directory"),
      resilience.FATAL),
     (PermissionError(13, "Permission denied"), resilience.FATAL),
+    # round 9: a corrupt checkpoint retries INTO generation fallback
+    # (load_any); a tripped health watchdog is fatal-with-diagnosis
+    (ckpt.CorruptCheckpointError("/tmp/x.npz", "leaf 0 CRC"),
+     resilience.RETRYABLE),
 ])
 def test_classify(exc, want):
     assert resilience.classify(exc) == want
@@ -143,6 +147,29 @@ def test_nan_corrupt_pokes_first_float_leaf():
     assert np.isnan(out[1][:2]).all() and np.isfinite(out[1][2:]).all()
     with pytest.raises(ValueError):
         faults.nan_corrupt((np.arange(3),))  # no float leaf
+
+
+def test_int_corrupt_pokes_sentinel():
+    """The one-sentinel convention: integer-labeled states corrupt by
+    poking the program's identity (a lost update), skipping bool
+    leaves (the active mask)."""
+    state = (np.array([True, False]),
+             np.arange(6, dtype=np.int32))
+    out = faults.int_corrupt(state, count=2, value=-1)
+    np.testing.assert_array_equal(out[0], state[0])
+    np.testing.assert_array_equal(out[1], [-1, -1, 2, 3, 4, 5])
+    with pytest.raises(ValueError, match="sentinel"):
+        faults.int_corrupt(state, count=1)      # value required
+    with pytest.raises(ValueError):
+        faults.int_corrupt((np.ones(3, np.float32),), value=0)
+
+
+def test_corrupt_state_is_type_appropriate():
+    fl = faults.corrupt_state((np.ones(4, np.float32),), count=1)
+    assert np.isnan(fl[0][0])
+    it = faults.corrupt_state((np.arange(4, dtype=np.int32),),
+                              count=1, int_value=7)
+    assert it[0][0] == 7
 
 
 # -- supervised crash recovery vs oracles (the acceptance test) --------
@@ -264,6 +291,93 @@ def test_supervised_run_explicit_resume(tmp_path):
         eng.unpad(state), pagerank.reference_pagerank(g, 10),
         rtol=1e-5)
     assert report.resumed_from == [4]
+
+
+# -- checkpoint corruption -> generation fallback (round 9) ------------
+
+def _plain_pagerank_state(g, ni):
+    eng = pagerank.build_engine(g, num_parts=2)
+    return eng.unpad(eng.run(eng.init_state(), ni))
+
+
+@pytest.mark.parametrize("action", [faults.CKPT_BITFLIP,
+                                    faults.CKPT_TRUNCATE])
+def test_supervised_pull_corrupt_checkpoint_falls_back(tmp_path,
+                                                       action):
+    """The torn-write scenario: the newest checkpoint generation is
+    corrupted and the worker dies.  The retry's resume detects the
+    corruption (CRC / typed container error), falls back one
+    generation, replays the lost segment, and the final state is
+    BITWISE the uninterrupted run's."""
+    from lux_tpu import telemetry
+
+    g, eng, path = _pagerank_setup(tmp_path)
+    # boundary 2: generations iter-3 (.prev) and iter-6 exist; the
+    # newest is corrupted + crash -> fallback resumes from 3
+    plan = faults.FaultPlan(schedule={2: action})
+    ev = telemetry.EventLog()
+    with telemetry.use(events=ev):
+        state, report = resilience.supervised_run(
+            eng, 10, path, segment=3, faults=plan,
+            policy=resilience.RetryPolicy(retries=2, **NOSLEEP))
+    np.testing.assert_array_equal(eng.unpad(state),
+                                  _plain_pagerank_state(g, 10))
+    assert report.attempts == 2
+    assert plan.fired == [(2, action)]
+    assert report.resumed_from == [3]      # the FALLBACK generation
+    assert ev.counts().get("checkpoint_fallback", 0) >= 1
+    assert ckpt.load(path)[1]["iter"] == 10
+
+
+def test_supervised_converge_corrupt_checkpoint_falls_back(tmp_path):
+    src, dst = uniform_random_edges(200, 1500, seed=62)
+    g = Graph.from_edges(src, dst, 200)
+    eng = sssp.build_engine(g, start_vertex=0, num_parts=2)
+    path = str(tmp_path / "ss.npz")
+    plan = faults.FaultPlan(schedule={2: faults.CKPT_TRUNCATE})
+    label, _active, total, report = resilience.supervised_converge(
+        eng, path, segment=2, faults=plan,
+        policy=resilience.RetryPolicy(retries=2, **NOSLEEP))
+    got = eng.unpad(label)
+    want = sssp.reference_sssp(g, 0)
+    reach = ~sssp.unreachable(got)
+    np.testing.assert_array_equal(got[reach], want[reach])
+    assert report.attempts == 2 and plan.fired
+    assert report.resumed_from and report.resumed_from[0] >= 2
+
+
+def test_corrupt_only_generation_exhausts_retries(tmp_path):
+    """With no second generation to fall back to, a corrupt newest
+    checkpoint surfaces LOUDLY (typed, after the retry budget) —
+    never a silent fresh restart."""
+    g, eng, path = _pagerank_setup(tmp_path)
+    plan = faults.FaultPlan(schedule={1: faults.CKPT_BITFLIP})
+    with pytest.raises(ckpt.CorruptCheckpointError):
+        resilience.supervised_run(
+            eng, 10, path, segment=3, faults=plan,
+            policy=resilience.RetryPolicy(retries=2, **NOSLEEP))
+
+
+def test_seeded_nan_plan_works_on_integer_programs(tmp_path):
+    """The round-9 satellite: a seeded plan with p_nan > 0 used to
+    crash the harness on integer-labeled programs (sssp hops) with
+    nan_corrupt's ValueError.  The supervisor now pokes the program's
+    identity sentinel instead; the run completes and at most
+    nan_count labels differ from the oracle (the poked cells)."""
+    src, dst = uniform_random_edges(200, 1500, seed=62)
+    g = Graph.from_edges(src, dst, 200)
+    eng = sssp.build_engine(g, start_vertex=0, num_parts=2)
+    path = str(tmp_path / "ss.npz")
+    plan = faults.FaultPlan(schedule={1: faults.NAN}, nan_count=1)
+    label, _active, total, report = resilience.supervised_converge(
+        eng, path, segment=2, faults=plan,
+        policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+    assert plan.fired == [(1, faults.NAN)]
+    got = eng.unpad(label)
+    want = sssp.reference_sssp(g, 0)
+    reach = ~sssp.unreachable(got)
+    mism = int((got[reach] != want[reach]).sum())
+    assert mism <= plan.nan_count
 
 
 # -- duration-budgeted segmentation ------------------------------------
