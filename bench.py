@@ -39,6 +39,15 @@ line's ``telemetry.health`` (null when off); a tripped watchdog
 fails the config with a _FAILED line.  scripts/check_bench.py
 type-checks the digest.
 
+Static audit (round 10, lux_tpu/audit.py): ``-audit`` (default
+"warn") traces every config's compiled program variants at build time
+and records the digest in each metric line's ``audit`` field — a
+metric produced by a build that violates the framework's structural
+invariants (two gathers in a dense iteration, a baked-in constant
+past the 413 wall, a broken owner collective schedule...) is rejected
+by scripts/check_bench.py, and ``-audit error`` refuses to run it at
+all.
+
 Resilience (round 6, lux_tpu/resilience.py): each config runs under
 the supervisor — transient failures (worker death, tunnel drops)
 retry with backoff up to ``-retries`` times, deterministic ones (OOM,
@@ -112,6 +121,32 @@ def _print_coverage(args, eng):
     if args.verbose and eng.pairs is not None:
         cov = eng.pairs.stats["coverage"]
         print(f"# pair-lane coverage {cov * 100:.1f}%", file=sys.stderr)
+
+
+def _audit_build(eng, args, extra):
+    """Static program audit of the freshly built engine
+    (lux_tpu/audit.py, round 10): traces every compiled loop variant
+    — nothing executes, so the cost is size-independent — and records
+    the digest in the metric line's ``audit`` field.
+    scripts/check_bench.py REJECTS metric lines whose digest carries
+    errors, so a benchmark number can never be published off a build
+    that violates the framework's structural invariants; ``-audit
+    error`` additionally fails the config at build time (typed
+    AuditError, classified fatal)."""
+    if args.audit == "off":
+        return
+    from lux_tpu import audit
+
+    findings = audit.audit_engine(eng, mode=None)
+    d = audit.digest(findings, mode=args.audit)
+    extra["audit"] = d
+    if d["errors"] and args.audit == "error":
+        audit.raise_findings(findings, where=type(eng).__name__)
+    # findings print UNCONDITIONALLY: under the default 'warn' a
+    # violating build would otherwise burn the whole benchmark run
+    # silently and only be rejected by check_bench afterwards
+    for f in findings:
+        print(f"# audit: {f}", file=sys.stderr)
 
 
 def bench_fused(eng, ne, ni, verbose, repeats):
@@ -190,6 +225,7 @@ def run_config(config, args):
                                     health=args.health)
         extra.update(relabel=True, pair_threshold=pair_t, np=np_parts,
                      exchange=eng.exchange, min_fill=args.min_fill)
+        _audit_build(eng, args, extra)
         _print_coverage(args, eng)
         samples, rerun = bench_fused(eng, g.ne, args.ni, args.verbose,
                                      args.repeats)
@@ -211,6 +247,7 @@ def run_config(config, args):
             eng = colfilter.build_engine(g, num_parts=args.np,
                                          health=args.health)
             extra.update(relabel=False, pair_threshold=None)
+        _audit_build(eng, args, extra)
         _print_coverage(args, eng)
         samples, rerun = bench_fused(eng, g.ne, args.ni, args.verbose,
                                      args.repeats)
@@ -259,6 +296,7 @@ def run_config(config, args):
                          min_fill=args.min_fill, np=np_parts,
                          exchange=eng.exchange,
                          delta="auto" if weighted else None)
+        _audit_build(eng, args, extra)
         _print_coverage(args, eng)
         samples, rerun = bench_converge(eng, g.ne, args.verbose,
                                         args.repeats)
@@ -383,6 +421,16 @@ def main() -> int:
                          "within tunnel noise of watchdog-off, "
                          "PERF_NOTES round 9), so keep it OFF for "
                          "headline numbers")
+    ap.add_argument("-audit", default="warn",
+                    choices=["off", "warn", "error"],
+                    help="static program audit of every config's "
+                         "engine build (lux_tpu/audit.py; tracing "
+                         "only, no extra compiles).  The digest "
+                         "lands in each metric line's 'audit' field "
+                         "and scripts/check_bench.py REJECTS lines "
+                         "from an audit-failing build; 'error' "
+                         "additionally fails the config at build "
+                         "time, 'off' omits the field")
     ap.add_argument("-verbose", action="store_true")
     args = ap.parse_args()
     if args.repeats < 1:

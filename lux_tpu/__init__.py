@@ -27,6 +27,8 @@ Layout:
   parallel/     mesh construction and sharding helpers
   apps/         PageRank, SSSP/BFS, ConnectedComponents, CollabFilter
   check.py      fixed-point correctness audits (the reference's -check)
+  audit.py      compile-time program auditor (jaxpr invariant checks;
+                repo-wide: python -m lux_tpu.audit)
   native/       C++ converter CLI and partition-slice loader
 """
 
@@ -43,3 +45,18 @@ from lux_tpu.partition import edge_balanced_bounds
 from lux_tpu.checkpoint import CorruptCheckpointError
 from lux_tpu.format import GraphFormatError
 from lux_tpu.health import HealthError
+
+# round-10 static-guarantee typed error (ARCHITECTURE.md "Static
+# guarantees"); the check-specific subclasses live in lux_tpu.audit.
+# Lazy (module __getattr__): an eager import here would pre-load
+# lux_tpu.audit into sys.modules and make ``python -m lux_tpu.audit``
+# execute the module twice (runpy RuntimeWarning + duplicate class
+# objects that break isinstance across the copies).
+
+
+def __getattr__(name):
+    if name == "AuditError":
+        from lux_tpu.audit import AuditError
+        return AuditError
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

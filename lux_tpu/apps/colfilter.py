@@ -59,7 +59,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  pair_threshold: int | None = None,
                  pair_min_fill: int | str | None = None,
                  pair_stream: bool | None = None,
-                 starts=None, health: bool = False) -> PullEngine:
+                 starts=None, health: bool = False,
+                 audit: str | None = None) -> PullEngine:
     """pair_threshold routes dense tile pairs through the blocked-
     SDDMM pair path (ops/pairs.pair_partial_dot, streamed past the
     memory budget — pair_partial_dot_streamed): one reshaped-row
@@ -81,7 +82,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill,
                       pair_stream=pair_stream, tile_e=tile_e,
-                      health=health)
+                      health=health, audit=audit)
 
 
 def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):

@@ -39,7 +39,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
-                 health: bool = False) -> PushEngine:
+                 health: bool = False,
+                 audit: str | None = None) -> PushEngine:
     """pair_threshold enables pair-lane delivery on dense iterations
     (best after graph.pair_relabel, passing its ``starts`` through;
     labels are vertex ids, so map results back through the relabel
@@ -55,7 +56,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                       pair_min_fill=pair_min_fill, exchange=exchange,
                       enable_sparse=enable_sparse, owner_tile_e=owner_tile_e,
                       owner_minmax_fused=owner_minmax_fused,
-                      health=health)
+                      health=health, audit=audit)
 
 
 def run(g: Graph, num_parts: int = 1, mesh=None, max_iters=None,

@@ -54,14 +54,17 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  starts=None, tile_e: int | None = None,
                  exchange: str = "auto",
                  owner_tile_e: int | None = None,
-                 health: bool = False) -> PullEngine:
+                 health: bool = False,
+                 audit: str | None = None) -> PullEngine:
     """starts: partition cut points (e.g. from graph.pair_relabel for
     balanced multi-part pair delivery).  tile_e default: 128 with pair
     delivery (residual edges are sparse; shorter chunks waste far
     fewer padded gather slots), else 512.  exchange='owner' switches
     to owner-side message generation (ops/owner.py) — the fast path
     once the state table outgrows ~64 MB.  health=True runs the
-    device-side health watchdog loop variants (lux_tpu/health.py)."""
+    device-side health watchdog loop variants (lux_tpu/health.py).
+    audit='warn'|'error' statically audits every compiled program
+    variant at build time (lux_tpu/audit.py)."""
     if sg is None:
         sg = ShardedGraph.build(g, num_parts, starts=starts,
                                 pair_threshold=pair_threshold)
@@ -71,7 +74,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill, tile_e=tile_e,
                       exchange=exchange, owner_tile_e=owner_tile_e,
-                      health=health)
+                      health=health, audit=audit)
 
 
 

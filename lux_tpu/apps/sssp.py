@@ -84,7 +84,8 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
-                 health: bool = False) -> PushEngine:
+                 health: bool = False,
+                 audit: str | None = None) -> PushEngine:
     """delta: bucket width for delta-stepping priority ordering
     (weighted runs); "auto" picks a heuristic; None disables (plain
     Bellman-Ford frontier relaxation).  pair_threshold enables pair-
@@ -107,7 +108,7 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                       exchange=exchange, enable_sparse=enable_sparse,
                       owner_tile_e=owner_tile_e,
                       owner_minmax_fused=owner_minmax_fused,
-                      health=health)
+                      health=health, audit=audit)
 
 
 def run(g: Graph, start_vertex: int = 0, num_parts: int = 1, mesh=None,

@@ -32,6 +32,10 @@ Flags (reference names kept):
                 a typed HealthError with the check/part/iteration
   -validate     structural .lux validation at load (lux_tpu/format.
                 validate_graph; offline: scripts/fsck_lux.py)
+  -audit MODE   static program audit at engine build (lux_tpu/audit.
+                py): warn prints findings, error refuses a violating
+                build with a typed AuditError (exit 2).  Repo-wide
+                form: python -m lux_tpu.audit
 
 Timing methodology matches the reference: wall clock around the
 iteration loop only, printed as ``ELAPSED TIME = ... s`` plus GTEPS
@@ -86,6 +90,16 @@ def _common(ap: argparse.ArgumentParser):
                          "typed HealthError naming the check, part "
                          "and iteration.  Compiles a separate loop "
                          "variant; the default programs are untouched")
+    ap.add_argument("-audit", default=None, choices=["warn", "error"],
+                    help="statically audit every compiled program "
+                         "variant at engine build (lux_tpu/audit.py: "
+                         "gather budget, baked-constant ceiling, "
+                         "dtype discipline, collective schedule, "
+                         "identity inits, no in-loop callbacks — "
+                         "traced jaxprs only, nothing executes).  "
+                         "'warn' prints AuditWarnings; 'error' "
+                         "refuses to run a violating build (exit 2, "
+                         "typed AuditError)")
     ap.add_argument("-profile", default=None, metavar="DIR",
                     help="capture an XLA profiler trace of the timed "
                          "run into DIR (view in TensorBoard/Perfetto)")
@@ -399,7 +413,8 @@ def cmd_pagerank(argv):
                                     pair_threshold=args.pair,
                                     pair_min_fill=args.min_fill,
                                     exchange=args.exchange,
-                                    health=args.health)
+                                    health=args.health,
+                                    audit=args.audit)
         if args.tol is not None:
             if args.retries > 0 or args.seg_budget > 0 or args.resume:
                 print("note: -tol runs one monolithic convergence "
@@ -483,7 +498,8 @@ def _push_app(argv, prog_name):
                                     pair_min_fill=args.min_fill,
                                     exchange=args.exchange,
                                     enable_sparse=bool(args.sparse),
-                                    health=args.health)
+                                    health=args.health,
+                                    audit=args.audit)
         else:
             eng = components.build_engine(g_run, num_parts=num_parts,
                                           mesh=mesh, sg=sg,
@@ -491,7 +507,8 @@ def _push_app(argv, prog_name):
                                           pair_min_fill=args.min_fill,
                                           exchange=args.exchange,
                                           enable_sparse=bool(args.sparse),
-                                          health=args.health)
+                                          health=args.health,
+                                          audit=args.audit)
         sup = _supervisor_opts(args, prog_name)
         if sup is not None:
             labels, iters, elapsed, it_exec, mark = _run_supervised(
@@ -553,7 +570,8 @@ def cmd_colfilter(argv):
         eng = colfilter.build_engine(g_run, num_parts, mesh, sg=sg,
                                      pair_threshold=args.pair,
                                      pair_min_fill=args.min_fill,
-                                     health=args.health)
+                                     health=args.health,
+                                     audit=args.audit)
         sup = _supervisor_opts(args, "colfilter")
         if sup is not None:
             state, total, elapsed, ni, mark = _run_supervised(
@@ -620,7 +638,17 @@ def main(argv=None) -> int:
         print(f"unknown app {app!r}; choose from {list(_APPS)}",
               file=sys.stderr)
         return 2
-    return _APPS[app](argv[1:])
+    try:
+        return _APPS[app](argv[1:])
+    except Exception as e:
+        from lux_tpu.audit import AuditError
+        if isinstance(e, AuditError):
+            # -audit error: a violating build is a typed, named
+            # refusal (like GraphFormatError), never a run whose
+            # numbers silently embed the violation
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        raise
 
 
 if __name__ == "__main__":

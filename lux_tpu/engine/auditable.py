@@ -1,0 +1,56 @@
+"""Shared static-audit surface for the engines (lux_tpu/audit.py).
+
+Both engines register every compiled loop variant as
+``(jitted fn, example-args thunk)`` so the auditor can trace the
+EXACT programs the engine runs (reference analogue: the compile-time
+template contract of core/graph.h:146-225, here checked post-trace
+instead of pre-compile).  The thunks build abstract
+``ShapeDtypeStruct`` stand-ins where possible; the one materialized
+host init they require is stashed in ``_pending_init`` for the next
+``init_state`` call, so an audited-then-run engine pays for exactly
+one init.
+"""
+
+from __future__ import annotations
+
+
+class AuditableEngine:
+    """Mixin: compiled-variant registry + lazy-variant forcing.
+
+    Subclasses set ``_AUDIT_LAZY`` (attribute names whose
+    cached_property builders register variants) and populate
+    ``self._audit_variants = {}`` before building programs.
+    """
+
+    _AUDIT_LAZY: tuple = ()
+
+    def _register_variant(self, name, jitted, args_thunk):
+        """Expose one compiled loop variant to the static program
+        auditor: the jitted callable plus a thunk building example
+        (abstract where possible) arguments for ``jitted.trace`` —
+        the auditor only traces, it never executes or compiles."""
+        self._audit_variants[name] = (jitted, args_thunk)
+
+    def audit_programs(self):
+        """name -> (jitted, example-args thunk) for every program
+        variant this engine can run, the lazily compiled ones forced
+        (built, not compiled)."""
+        for attr in self._AUDIT_LAZY:
+            getattr(self, attr)
+        return dict(self._audit_variants)
+
+    def _consume_pending_init(self):
+        """The audit's init probe, if one is stashed (see
+        ``_audit_state_sds`` in each engine) — consumed at most once.
+        Program inits in this repo are pure functions of sg, so the
+        stashed first init IS the init."""
+        pending = getattr(self, "_pending_init", None)
+        self._pending_init = None
+        return pending
+
+    def _drop_pending_init(self):
+        """Release the stash without consuming it — called by
+        ``place()`` (the checkpoint-resume path): a caller placing
+        external state will never need the probe, and holding a full
+        padded host init for the engine's lifetime is GBs at scale."""
+        self._pending_init = None

@@ -95,6 +95,10 @@ def expand_frontier(ids, vals, src_ids, src_off, nv: int,
         # collides.)
         marks = jnp.zeros((edge_budget + 1,), jnp.int32)
         qidx = jnp.arange(Q, dtype=jnp.int32) + 1
+        # audit: allow(identity-init) — 0 deliberately marks "no item
+        # starts here": values are 1-based queue indices >= 1, and the
+        # cummax - 1 below maps an untouched 0 back to no-owner (an
+        # int32-min init would overflow that - 1).
         marks = marks.at[jnp.minimum(start, edge_budget)].max(
             jnp.where(deg > 0, qidx, 0))
         owner = jax.lax.cummax(marks[:edge_budget]) - 1      # [EB]

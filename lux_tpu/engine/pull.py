@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from lux_tpu.engine.auditable import AuditableEngine
 from lux_tpu.engine.program import PartCtx, PullProgram, vmask_of
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
@@ -162,7 +163,7 @@ def build_graph_arrays(sg: ShardedGraph, layout: str, needs_dst: bool,
     return arrays, lay
 
 
-class PullEngine:
+class PullEngine(AuditableEngine):
     """Compiled pull-model iterations for one ShardedGraph + program.
 
     With ``mesh=None`` everything runs on one device (parts stacked on
@@ -183,7 +184,8 @@ class PullEngine:
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
                  stats_cap: int | None = None,
-                 health: bool = False):
+                 health: bool = False,
+                 audit: str | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -284,7 +286,15 @@ class PullEngine:
         if mesh is not None:
             arrays = shard_over_parts(mesh, arrays, sg.num_parts)
         self.arrays = arrays
+        # compiled-variant registry for the static program auditor
+        # (lux_tpu/audit.py): name -> (jitted fn, example-args thunk)
+        self._audit_variants: dict = {}
         self._step_fn = self._build_step()
+        if audit is not None:
+            # mode validation lives in audit_engine (typed ValueError
+            # on anything but 'warn'/'error')
+            from lux_tpu import audit as _audit
+            _audit.audit_engine(self, mode=audit)
 
     # -- pair-lane fast path (ops/pairs.py) ----------------------------
 
@@ -329,7 +339,9 @@ class PullEngine:
     # -- state placement ----------------------------------------------
 
     def init_state(self):
-        state = self.program.init(self.sg)
+        state = self._consume_pending_init()
+        if state is None:
+            state = self.program.init(self.sg)
         if self.mesh is not None:
             return shard_over_parts(self.mesh, [np.asarray(state)],
                                     self.sg.num_parts)[0]
@@ -339,6 +351,7 @@ class PullEngine:
         """Put a host state pytree on the engine's devices with the
         parts sharding (mirrors init_state's placement; used by
         checkpoint/resilience resume)."""
+        self._drop_pending_init()     # resume never needs the probe
         leaves, treedef = jax.tree.flatten(state)
         if self.mesh is not None:
             leaves = shard_over_parts(
@@ -633,6 +646,9 @@ class PullEngine:
                     f"lux_{self.program.name}")(core)
             self._step_core = core
             jitted = jax.jit(core, donate_argnums=0)
+            self._register_variant(
+                "step", jitted,
+                lambda: (self._audit_state_sds, *self.graph_args))
             return lambda state: jitted(state, *self.graph_args)
 
         if self.mesh is None:
@@ -657,7 +673,29 @@ class PullEngine:
             core = jax.named_scope(f"lux_{self.program.name}")(core)
         self._step_core = core
         jitted = jax.jit(core, donate_argnums=0)
+        self._register_variant(
+            "step", jitted,
+            lambda: (self._audit_state_sds, *self.graph_args))
         return lambda state: jitted(state, *self.graph_args)
+
+    # -- static-audit surface (engine/auditable.py) --------------------
+
+    # every lazily compiled loop variant, forced (built, not
+    # compiled) so the registry is complete for a full audit
+    _AUDIT_LAZY = ("_run_fused", "_run_stats_fused", "_run_until",
+                   "_run_until_stats", "_run_health_fused",
+                   "_run_until_health")
+
+    @functools.cached_property
+    def _audit_state_sds(self):
+        """Abstract stand-in for the iterated state (shape/dtype from
+        the program's init, no device placement).  The materialized
+        init is STASHED for the next ``init_state`` call, so an
+        audited-then-run engine (bench.py -audit) pays for exactly
+        one host init, same as an unaudited one."""
+        st = np.asarray(self.program.init(self.sg))
+        self._pending_init = st
+        return jax.ShapeDtypeStruct(st.shape, st.dtype)
 
     # -- public API ---------------------------------------------------
 
@@ -682,6 +720,9 @@ class PullEngine:
             return jax.lax.fori_loop(
                 0, num_iters, lambda _, s: core(s, *gargs), state)
 
+        self._register_variant(
+            "run", run,
+            lambda: (self._audit_state_sds, 3, *self.graph_args))
         return lambda state, n: run(state, n, *self.graph_args)
 
     def run(self, state, num_iters: int, fused: bool = True,
@@ -740,6 +781,9 @@ class PullEngine:
                 (state, jnp.zeros((cap,), jnp.float32),
                  jnp.zeros((cap,), jnp.uint32)))
 
+        self._register_variant(
+            "run_stats", run,
+            lambda: (self._audit_state_sds, 3, *self.graph_args))
         return lambda state, n: run(state, n, *self.graph_args)
 
     def run_stats(self, state, num_iters: int):
@@ -779,6 +823,12 @@ class PullEngine:
                 cond, body, (jnp.int32(0), state, jnp.float32(jnp.inf)))
             return s, it, res
 
+        self._register_variant(
+            "run_until", run,
+            lambda: (self._audit_state_sds,
+                     jax.ShapeDtypeStruct((), jnp.float32),
+                     jax.ShapeDtypeStruct((), jnp.int32),
+                     *self.graph_args))
         return run
 
     @functools.cached_property
@@ -808,6 +858,12 @@ class PullEngine:
                  jnp.zeros((cap,), jnp.uint32)))
             return s, it, res, rb, cb
 
+        self._register_variant(
+            "run_until_stats", run,
+            lambda: (self._audit_state_sds,
+                     jax.ShapeDtypeStruct((), jnp.float32),
+                     jax.ShapeDtypeStruct((), jnp.int32),
+                     *self.graph_args))
         return run
 
     def run_until_stats(self, state, tol: float,
@@ -871,6 +927,12 @@ class PullEngine:
                                         *self.graph_args)
             return s, it, rb, cb, (h, win)
 
+        self._register_variant(
+            "run_health", run,
+            lambda: (self._audit_state_sds,
+                     jax.ShapeDtypeStruct((), jnp.int32),
+                     hw.init_word(), hw.init_window(),
+                     *self.graph_args))
         return call
 
     def run_health(self, state, num_iters: int, watch=None):
@@ -916,6 +978,12 @@ class PullEngine:
                  hw.init_window()))
             return s, it, res, rb, cb, h, win
 
+        self._register_variant(
+            "run_until_health", run,
+            lambda: (self._audit_state_sds,
+                     jax.ShapeDtypeStruct((), jnp.float32),
+                     jax.ShapeDtypeStruct((), jnp.int32),
+                     *self.graph_args))
         return run
 
     def run_until_health(self, state, tol: float,

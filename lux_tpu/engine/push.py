@@ -50,6 +50,7 @@ import numpy as np
 from jax.sharding import PartitionSpec
 
 from lux_tpu.engine import frontier as fr
+from lux_tpu.engine.auditable import AuditableEngine
 from lux_tpu.engine.program import vmask_of
 from lux_tpu.graph import ShardedGraph
 from lux_tpu.ops.segment import segment_reduce
@@ -84,7 +85,7 @@ class PushProgram:
         return cand < old if self.reduce == "min" else cand > old
 
 
-class PushEngine:
+class PushEngine(AuditableEngine):
     """Compiled frontier iterations for one ShardedGraph + PushProgram."""
 
     def __init__(self, sg: ShardedGraph, program: PushProgram, mesh=None,
@@ -102,7 +103,8 @@ class PushEngine:
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
                  stats_cap: int | None = None,
-                 health: bool = False):
+                 health: bool = False,
+                 audit: str | None = None):
         if mesh is not None and sg.num_parts % mesh.devices.size != 0:
             raise ValueError(
                 f"num_parts={sg.num_parts} not divisible by mesh size "
@@ -235,18 +237,31 @@ class PushEngine:
         if mesh is not None:
             arrays = shard_over_parts(mesh, arrays, sg.num_parts)
         self.arrays = arrays
+        # compiled-variant registry for the static program auditor
+        # (lux_tpu/audit.py): name -> (jitted fn, example-args thunk)
+        self._audit_variants: dict = {}
         self._step_fn = self._build(converge=False)
         self._converge_fn = self._build(converge=True)
+        if audit is not None:
+            # mode validation lives in audit_engine (typed ValueError
+            # on anything but 'warn'/'error')
+            from lux_tpu import audit as _audit
+            _audit.audit_engine(self, mode=audit)
 
     # ------------------------------------------------------------------
 
     def init_state(self):
-        label0, active0 = self.program.init(self.sg)
+        pending = self._consume_pending_init()
+        if pending is not None:
+            label0, active0 = pending
+        else:
+            label0, active0 = self.program.init(self.sg)
         return self.place(label0, active0)
 
     def place(self, label, active):
         """Put host (or replicated) state arrays on the engine's
         devices with the parts sharding (used by checkpoint resume)."""
+        self._drop_pending_init()     # resume never needs the probe
         if self.mesh is not None:
             return tuple(shard_over_parts(
                 self.mesh, [np.asarray(label), np.asarray(active)],
@@ -812,6 +827,21 @@ class PushEngine:
                 deg_full = jnp.asarray(deg_full)
             extra = (deg_full,)
 
+        vname = ("converge" if converge else "step") + \
+            ("_health" if health else "_stats" if stats else "")
+
+        def _args_thunk():
+            lab_sds, act_sds = self._audit_state_sds
+            watch = ()
+            if health:
+                from lux_tpu import health as _hw0
+                watch = (_hw0.init_word(), jnp.int32(0))
+            return (lab_sds, act_sds,
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    *watch, *extra, *graph_args)
+
+        self._register_variant(vname, jitted, _args_thunk)
+
         if health:
             from lux_tpu import health as _hw
 
@@ -831,6 +861,22 @@ class PushEngine:
                           *graph_args)
 
         return call
+
+    # -- static-audit surface (engine/auditable.py) --------------------
+
+    _AUDIT_LAZY = ("_converge_stats_fn", "_converge_health_fn")
+
+    @functools.cached_property
+    def _audit_state_sds(self):
+        """Abstract (label, active) stand-ins — init runs ONCE per
+        engine, not once per audited variant, and the materialized
+        arrays are stashed for the next ``init_state`` call so an
+        audited-then-run engine pays for exactly one host init."""
+        lab0, act0 = self.program.init(self.sg)
+        lab0, act0 = np.asarray(lab0), np.asarray(act0)
+        self._pending_init = (lab0, act0)
+        return (jax.ShapeDtypeStruct(lab0.shape, lab0.dtype),
+                jax.ShapeDtypeStruct(act0.shape, act0.dtype))
 
     # -- public API ----------------------------------------------------
 

@@ -19,6 +19,12 @@ t*128+rel).  Unused lanes carry rel = -1 (matches no lane; int8).
 Rows are grouped per destination tile and depth-classed so the
 cross-row combine is a static reshape-reduce, like experiments/router.py's
 slotted classes.
+
+Reference analogue: the CTA-shared staging of hub vertices in the
+reference's GPU kernels (reference colfilter_gpu.cu:41-102 stages a
+tile of destination state in shared memory; reference
+pull_model.inl:454-461 materializes the whole remote region) — here
+the "shared tile" is the 128-lane vector register shape itself.
 """
 
 from __future__ import annotations

@@ -74,6 +74,15 @@ def classify(exc: BaseException) -> str:
 
     if isinstance(exc, faults.InjectedWorkerCrash):
         return RETRYABLE
+    from lux_tpu import audit
+    if isinstance(exc, audit.AuditError):
+        return FATAL            # a static-audit violation is a
+        #                         property of the BUILD: retrying
+        #                         re-traces the same program into the
+        #                         same typed refusal (and the finding
+        #                         text may mention 'tunnel'/'413',
+        #                         which must not hit the retryable
+        #                         message scan below)
     if isinstance(exc, health.HealthError):
         return FATAL            # fatal-with-diagnosis: the watchdog
         #                         saw corruption in the STATE itself

@@ -56,6 +56,15 @@ Checked per metric line:
   telemetry fails strict mode like the round-6 keys (the round-1..6
   artifacts predate it: -legacy-ok).
 
+- audit (round 10, bench.py -audit / lux_tpu/audit.py): optional
+  digest of the static program audit that ran at the config's engine
+  build — {mode: warn|error, errors: int, warnings: int,
+  failed_checks: [known check names]}.  A digest with errors (or any
+  failed_checks) on a PUBLISHED metric line is rejected: the number
+  was measured on a build that violates the framework's structural
+  invariants (double gather, baked-in constants, broken collective
+  schedule...), so it cannot stand as a metric of record.
+
 - telemetry.health (round 9, bench.py -health): the device-side
   watchdog digest — optional and null when off; present it must be a
   clean bill ({engine, tripped=false, flags=[], iters >= 0}; known
@@ -210,6 +219,8 @@ def check_line(obj: dict, *, legacy_ok: bool):
     else:
         errs += check_telemetry(name, obj)
 
+    errs += check_audit_field(name, obj)
+
     if NETFLIX_METRIC.match(name):
         errs += check_netflix_fields(name, obj)
     else:
@@ -358,6 +369,52 @@ def check_telemetry(name: str, obj: dict) -> list[str]:
             if numeric:
                 errs.append(f"{name}: telemetry.counters non-finite "
                             f"fields {numeric}")
+    return errs
+
+
+AUDIT_CHECKS = {"gather-budget", "const-bytes", "dtype-discipline",
+                "loop-invariant", "collective-schedule",
+                "callback-in-loop", "identity-init", "ledger-drift"}
+
+
+def check_audit_field(name: str, obj: dict) -> list[str]:
+    """Round-10 static-audit digest (bench.py -audit,
+    lux_tpu/audit.py): optional (older artifacts and -audit off omit
+    it); present it must be well-formed AND a clean bill — a metric
+    line produced by an audit-failing build is rejected outright."""
+    if "audit" not in obj:
+        return []
+    a = obj["audit"]
+    if a is None:
+        return []
+    if not isinstance(a, dict):
+        return [f"{name}: audit must be null or a dict, got {a!r}"]
+    errs = []
+    if a.get("mode") not in ("warn", "error"):
+        errs.append(f"{name}: audit.mode={a.get('mode')!r} not "
+                    f"warn|error")
+    for k in ("errors", "warnings"):
+        v = a.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{name}: audit.{k}={v!r} must be an "
+                        f"int >= 0")
+    fc = a.get("failed_checks")
+    if not isinstance(fc, list) or not all(isinstance(c, str)
+                                           for c in fc):
+        errs.append(f"{name}: audit.failed_checks must be a list of "
+                    f"check names, got {fc!r}")
+    else:
+        unknown = sorted(set(fc) - AUDIT_CHECKS)
+        if unknown:
+            errs.append(f"{name}: audit.failed_checks has unknown "
+                        f"checks {unknown}")
+        if a.get("errors") or fc:
+            errs.append(
+                f"{name}: metric line produced by an -audit-FAILING "
+                f"build (errors={a.get('errors')}, "
+                f"failed_checks={fc}) — a number measured on a build "
+                f"that violates the structural invariants cannot be "
+                f"a metric of record (lux_tpu/audit.py)")
     return errs
 
 

@@ -1,0 +1,423 @@
+#!/usr/bin/env python
+"""AST-level convention linter for the lux_tpu Python tree.
+
+The companion of lux_tpu/audit.py: where the auditor checks TRACED
+jaxprs, this checks SOURCE against the repo conventions that cannot
+be seen from a jaxpr (CLAUDE.md "Conventions"):
+
+  jit-closure   A function handed to ``jax.jit`` (decorator, direct
+                call, or ``functools.partial(jax.jit, ...)``) closes
+                over a name bound in an enclosing function to an
+                array-constructing expression (``jnp.asarray(...)``,
+                ``self.arrays[...]``, ...).  Engines must take graph
+                arrays as jit ARGUMENTS — a closed-over array bakes
+                into the XLA program as a constant (the HTTP-413
+                remote-compile wall; the jaxpr-level twin is the
+                auditor's const-bytes ceiling).
+  oracle        Every app module (lux_tpu/apps/*.py) must define a
+                top-level NumPy oracle named ``reference_*`` — the
+                "new device code gets an oracle test first"
+                convention.
+  citation      Every module in lux_tpu/engine/ and lux_tpu/ops/
+                must cite the reference implementation (a
+                ``file:line`` pattern like ``pull_model.inl:423``) in
+                its module docstring, for parity auditing.
+
+Suppression: an explicit ``# audit: allow(<check>)`` pragma on the
+flagged line, or in the contiguous comment block directly above it,
+with a one-line justification — the same syntax the jaxpr auditor
+honors through eqn source info.
+
+Usage:  python scripts/lint_lux.py [PATHS...]   (default: lux_tpu)
+Exit status: 0 clean, 1 any unsuppressed finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PRAGMA_RE = re.compile(r"#\s*audit:\s*allow\(([a-z-]+)\)")
+
+CITATION_RE = re.compile(r"[\w/]+\.(?:h|cc|cu|cuh|inl|py|md):\d+")
+
+# expressions whose result is (or wraps) a device/host array big
+# enough to matter if baked into a jit as a constant
+ARRAY_MAKER_FUNCS = {
+    "asarray", "array", "zeros", "ones", "full", "arange", "empty",
+    "linspace", "zeros_like", "ones_like", "full_like", "stack",
+    "concatenate", "pad",
+}
+ARRAY_MAKER_MODULES = {"jnp", "np", "numpy", "jax"}
+ARRAY_ATTR_SOURCES = {"arrays", "graph_args"}
+
+
+class Finding:
+    def __init__(self, path, line, check, message):
+        self.path, self.line, self.check, self.message = \
+            path, line, check, message
+
+    def __str__(self):
+        rel = os.path.relpath(self.path, REPO)
+        return f"{rel}:{self.line}: [{self.check}] {self.message}"
+
+
+def _suppressed(lines, line_no: int, check: str) -> bool:
+    """Pragma on the flagged line or the contiguous comment block
+    directly above it (mirrors lux_tpu/audit._pragma_allows)."""
+
+    def hit(text):
+        return any(m.group(1) == check
+                   for m in PRAGMA_RE.finditer(text))
+
+    if 0 < line_no <= len(lines) and hit(lines[line_no - 1]):
+        return True
+    ln = line_no - 2
+    while ln >= 0:
+        stripped = lines[ln].strip()
+        if stripped.startswith("#"):
+            if hit(stripped):
+                return True
+            ln -= 1
+        elif not stripped or stripped.startswith("@"):
+            # blank lines and decorators don't break the pragma
+            # block (a pragma above a @jax.jit stack covers the def)
+            ln -= 1
+        else:
+            break
+    return False
+
+
+# ---------------------------------------------------------------------
+# check: jit-closure
+
+
+def _is_array_maker(expr: ast.expr) -> bool:
+    """Does this RHS construct an array?  (Heuristic on the repo's
+    idioms: jnp/np makers, ``self.arrays[...]`` / ``.graph_args``
+    access, or a tuple/starred of the same.)"""
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if (f.attr in ARRAY_MAKER_FUNCS
+                    and isinstance(base, ast.Name)
+                    and base.id in ARRAY_MAKER_MODULES):
+                return True
+            # jnp.asarray(...).reshape(...) etc.
+            if isinstance(base, ast.Call):
+                return _is_array_maker(base)
+        if isinstance(f, ast.Name) and f.id in ("dev",):
+            # the engines' ``dev = jnp.asarray`` placement helper
+            return True
+    if isinstance(expr, ast.Subscript):
+        v = expr.value
+        if isinstance(v, ast.Attribute) and v.attr in ARRAY_ATTR_SOURCES:
+            return True
+        if isinstance(v, ast.Name) and v.id in ARRAY_ATTR_SOURCES:
+            return True
+    if isinstance(expr, ast.Attribute) and expr.attr in ARRAY_ATTR_SOURCES:
+        return True
+    return False
+
+
+def _jitted_functions(tree: ast.Module):
+    """Yield (FunctionDef/Lambda node, report_line) for every function
+    the module hands to jax.jit."""
+
+    def is_jax_jit(node: ast.expr) -> bool:
+        return (isinstance(node, ast.Attribute) and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax") or (
+            isinstance(node, ast.Name) and node.id == "jit")
+
+    def is_partial_jit(call: ast.Call) -> bool:
+        f = call.func
+        is_partial = (isinstance(f, ast.Attribute)
+                      and f.attr == "partial") or (
+            isinstance(f, ast.Name) and f.id == "partial")
+        return (is_partial and call.args
+                and is_jax_jit(call.args[0]))
+
+    # name -> def node, per enclosing function body (for jax.jit(name))
+    defs_by_scope: dict[int, dict] = {}
+
+    class Scoper(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = []
+            self.out = []
+
+        def _local_defs(self):
+            return defs_by_scope.setdefault(
+                id(self.stack[-1]) if self.stack else 0, {})
+
+        def visit_FunctionDef(self, node):
+            self._local_defs()[node.name] = node
+            for dec in node.decorator_list:
+                if is_jax_jit(dec) or (isinstance(dec, ast.Call)
+                                       and (is_jax_jit(dec.func)
+                                            or is_partial_jit(dec))):
+                    self.out.append((node, node.lineno))
+            self.stack.append(node)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            if is_jax_jit(node.func) and node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Lambda):
+                    self.out.append((target, node.lineno))
+                elif isinstance(target, ast.Name):
+                    fn = self._local_defs().get(target.id)
+                    if fn is not None:
+                        self.out.append((fn, node.lineno))
+            self.generic_visit(node)
+
+    s = Scoper()
+    s.visit(tree)
+    return s.out
+
+
+class _ScopeInfo:
+    """Names assigned per function scope, with array-maker marks."""
+
+    def __init__(self):
+        self.assigned: dict[str, bool] = {}   # name -> is array maker
+
+
+def _collect_scopes(tree):
+    """function node -> (_ScopeInfo, parent chain)."""
+    info: dict = {}
+    parents: dict = {}
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = [None]
+
+        def _scope(self):
+            return info.setdefault(self.stack[-1], _ScopeInfo())
+
+        def visit_FunctionDef(self, node):
+            self._scope().assigned[node.name] = False
+            parents[node] = self.stack[-1]
+            self.stack.append(node)
+            sc = self._scope()
+            for a in node.args.args + node.args.kwonlyargs \
+                    + node.args.posonlyargs:
+                sc.assigned[a.arg] = False
+            if node.args.vararg:
+                sc.assigned[node.args.vararg.arg] = False
+            if node.args.kwarg:
+                sc.assigned[node.args.kwarg.arg] = False
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            parents[node] = self.stack[-1]
+            self.stack.append(node)
+            sc = self._scope()
+            for a in node.args.args:
+                sc.assigned[a.arg] = False
+            self.generic_visit(node)
+            self.stack.pop()
+
+        def visit_Assign(self, node):
+            sc = self._scope()
+            maker = _is_array_maker(node.value)
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        sc.assigned[n.id] = maker or \
+                            sc.assigned.get(n.id, False)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            if isinstance(node.target, ast.Name):
+                self._scope().assigned.setdefault(node.target.id, False)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    self._scope().assigned.setdefault(n.id, False)
+            self.generic_visit(node)
+
+        def visit_comprehension_target(self, node):
+            pass
+
+    V().visit(tree)
+    return info, parents
+
+
+def _free_loads(fn):
+    """Names loaded in ``fn`` but not bound there (params, local
+    assigns, inner defs, comprehension targets all bind)."""
+    bound = set()
+    args = fn.args
+    for a in args.args + args.kwonlyargs + getattr(args, "posonlyargs",
+                                                   []):
+        bound.add(a.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    loads = {}
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(n.name)
+            elif isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Store):
+                    bound.add(n.id)
+                elif isinstance(n.ctx, ast.Load):
+                    loads.setdefault(n.id, n.lineno)
+            elif isinstance(n, ast.comprehension):
+                for t in ast.walk(n.target):
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+    return {k: v for k, v in loads.items() if k not in bound}
+
+
+def check_jit_closures(path, tree, lines):
+    findings = []
+    info, parents = _collect_scopes(tree)
+    for fn, line in _jitted_functions(tree):
+        free = _free_loads(fn)
+        # walk the enclosing scope chain out to module scope (None)
+        chain, scope = [], parents.get(fn)
+        while scope is not None:
+            chain.append(scope)
+            scope = parents.get(scope)
+        chain.append(None)
+        flagged = set()
+        for scope in chain:
+            sc = info.get(scope)
+            if sc is None:
+                continue
+            for name in sorted(free):
+                if name in flagged or not sc.assigned.get(name, False):
+                    continue
+                flagged.add(name)
+                if not _suppressed(lines, line, "jit-closure"):
+                    findings.append(Finding(
+                        path, line, "jit-closure",
+                        f"jitted function closes over array {name!r} "
+                        f"bound in an enclosing scope — pass it as a "
+                        f"jit ARGUMENT (closed-over arrays bake into "
+                        f"the program as constants; remote compiles "
+                        f"413 on them)"))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# check: oracle presence
+
+
+def check_oracle(path, tree, lines):
+    name = os.path.basename(path)
+    if name == "__init__.py":
+        return []
+    has = any(isinstance(n, ast.FunctionDef)
+              and n.name.startswith("reference_")
+              for n in tree.body)
+    if has or _suppressed(lines, 1, "oracle"):
+        return []
+    return [Finding(
+        path, 1, "oracle",
+        "app module has no top-level reference_* NumPy oracle — "
+        "every algorithm needs one (CLAUDE.md: new device code gets "
+        "an oracle test first)")]
+
+
+# ---------------------------------------------------------------------
+# check: citation presence
+
+
+def check_citation(path, tree, lines):
+    if os.path.basename(path) == "__init__.py":
+        return []
+    doc = ast.get_docstring(tree) or ""
+    if CITATION_RE.search(doc) or _suppressed(lines, 1, "citation"):
+        return []
+    return [Finding(
+        path, 1, "citation",
+        "module docstring cites no reference file:line — engine/ops "
+        "modules must anchor their design to the reference "
+        "implementation for parity auditing (CLAUDE.md conventions)")]
+
+
+# ---------------------------------------------------------------------
+# driver
+
+
+def lint_file(path: str):
+    with open(path) as f:
+        src = f.read()
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, "parse",
+                        f"syntax error: {e.msg}")]
+    findings = check_jit_closures(path, tree, lines)
+    norm = path.replace(os.sep, "/")
+    if "/lux_tpu/apps/" in norm:
+        findings += check_oracle(path, tree, lines)
+    if "/lux_tpu/engine/" in norm or "/lux_tpu/ops/" in norm:
+        findings += check_citation(path, tree, lines)
+    return findings
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths):
+    findings = []
+    for f in iter_py_files(paths):
+        findings += lint_file(os.path.abspath(f))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="AST convention linter (jit closures, app "
+                    "oracles, reference citations)")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO, "lux_tpu")])
+    ap.add_argument("-q", action="store_true", dest="quiet")
+    args = ap.parse_args(argv)
+
+    findings = lint_paths(args.paths)
+    for f in findings:
+        print(str(f), file=sys.stderr)
+    if findings:
+        print(f"lint_lux: {len(findings)} finding(s) — FAILED",
+              file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print("lint_lux: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

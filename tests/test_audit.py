@@ -1,0 +1,459 @@
+"""lux_tpu/audit.py: the compile-time program auditor.
+
+Three layers:
+- one deliberately-violating synthetic program per check class, each
+  raising the NAMED AuditError subclass;
+- bitwise no-op proof: ``audit=`` never alters compiled outputs;
+- the repo-wide audit + AST lint (the tier-1 gate): every engine
+  configuration's every program variant, clean on the CPU backend —
+  budgeted well under 60 s.
+"""
+
+import functools
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lux_tpu import audit
+from lux_tpu.audit import (AuditError, CallbackInLoopError,
+                           CollectiveScheduleError, ConstBytesError,
+                           DtypeDisciplineError, GatherBudgetError,
+                           IdentityInitError, LedgerDriftError,
+                           LoopInvariantError, ProgramSpec)
+from lux_tpu.graph import Graph
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _graph(nv=256, ne=2048, weighted=False, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.integers(1, 6, ne).astype(np.float32) if weighted else None
+    return Graph.from_edges(rng.integers(0, nv, ne),
+                            rng.integers(0, nv, ne), nv, weights=w)
+
+
+def _raise_all(findings, **kw):
+    audit.raise_findings(findings, **kw)
+
+
+# ---------------------------------------------------------------------
+# synthetic violators — one per check class
+
+
+def test_gather_budget_violation():
+    """Two per-element gathers from the state table inside one fused
+    loop body: the dense-iteration contract is ONE (mask pre-gather,
+    PERF_NOTES)."""
+    table_shape = (1024,)
+
+    def bad(s, table, idx):
+        def body(i, acc):
+            a = jnp.take(table, idx + i, axis=0)        # gather 1
+            b = jnp.take(table, idx * 2 + i, axis=0)    # gather 2
+            return acc + jnp.sum(a) + jnp.sum(b)
+
+        return jax.lax.fori_loop(0, 4, body, s)
+
+    closed = jax.make_jaxpr(bad)(
+        jnp.float32(0), jnp.zeros(table_shape, jnp.float32),
+        jnp.zeros((16,), jnp.int32))
+    spec = ProgramSpec(table_shape=table_shape, gather_budget=1)
+    findings = audit.audit_jaxpr(closed, spec, where="synthetic")
+    assert any(f.check == "gather-budget" for f in findings)
+    with pytest.raises(GatherBudgetError):
+        _raise_all(findings)
+
+    # the same body under budget 2 is clean
+    spec2 = ProgramSpec(table_shape=table_shape, gather_budget=2)
+    fs2 = audit.check_gather_budget(closed, spec2, "synthetic")
+    assert fs2 == []
+
+
+def test_const_bytes_violation():
+    """A closed-over 2 MB constant bakes into the program — the
+    HTTP-413 remote-compile wall, caught before any tunnel
+    round-trip."""
+    big = jnp.zeros((1 << 19,), jnp.float32)          # 2 MiB
+    closed = jax.make_jaxpr(lambda x: x + jnp.sum(big))(
+        jnp.float32(1))
+    findings = audit.audit_jaxpr(closed, ProgramSpec(),
+                                 where="synthetic")
+    assert any(f.check == "const-bytes" for f in findings)
+    with pytest.raises(ConstBytesError):
+        _raise_all(findings)
+
+    # passing the array as an ARGUMENT is the fix
+    ok = jax.make_jaxpr(lambda x, b: x + jnp.sum(b))(
+        jnp.float32(1), big)
+    assert audit.check_const_bytes(ok, ProgramSpec(), "s") == []
+
+
+def test_dtype_discipline_violation():
+    """f64 avals (or any promotion past the state dtype) are
+    forbidden — TPUs run 32-bit and silent x64 promotions double
+    every table."""
+    from jax.experimental import enable_x64
+    with enable_x64():
+        closed = jax.make_jaxpr(
+            lambda x: x.astype(jnp.float64) * 2.0)(
+            jnp.ones((8,), jnp.float32))
+    findings = audit.audit_jaxpr(closed, ProgramSpec(),
+                                 where="synthetic")
+    assert any(f.check == "dtype-discipline" for f in findings)
+    with pytest.raises(DtypeDisciplineError):
+        _raise_all(findings)
+
+    # an 8-byte state dtype legitimizes 8-byte avals
+    spec = ProgramSpec(state_itemsize=8)
+    assert audit.check_dtypes(closed, spec, "s") == []
+
+
+def test_loop_invariant_violation():
+    """An expensive dot of two loop-invariant operands inside a
+    fori_loop body: XLA hoists it, so a benchmark timing the loop
+    measures nothing (the CLAUDE.md trap) — a warning-class
+    finding."""
+
+    def bad(A, B, s0):
+        def body(i, s):
+            return s + jnp.sum(jnp.dot(A, B))     # A, B invariant
+
+        return jax.lax.fori_loop(0, 8, body, s0)
+
+    closed = jax.make_jaxpr(bad)(
+        jnp.zeros((64, 64), jnp.float32),
+        jnp.zeros((64, 64), jnp.float32), jnp.float32(0))
+    findings = audit.audit_jaxpr(closed, ProgramSpec(),
+                                 where="synthetic")
+    inv = [f for f in findings if f.check == "loop-invariant"]
+    assert inv and all(f.severity == "warn" for f in inv)
+    _raise_all(findings)          # warnings alone do not raise...
+    with pytest.raises(LoopInvariantError):      # ...unless asked
+        _raise_all(findings, warnings_as_errors=True)
+
+    # a dot CONSUMING the carry is loop-variant and clean
+    def good2(A, s0):
+        def body(i, s):
+            return s + jnp.dot(A, s)
+        return jax.lax.fori_loop(0, 8, body, s0)
+
+    ok = jax.make_jaxpr(good2)(jnp.zeros((64, 64), jnp.float32),
+                               jnp.zeros((64,), jnp.float32))
+    assert audit.check_loop_invariant(ok, ProgramSpec(), "s") == []
+
+
+def test_collective_schedule_violation():
+    """A 'ring' taking ndev hops instead of ndev-1, and an owner
+    exchange without its generation scan."""
+    from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh
+    from jax.sharding import PartitionSpec as P
+    mesh = make_mesh(2)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(PARTS_AXIS), out_specs=P(PARTS_AXIS))
+    def bad_ring(x):
+        for _ in range(2):                       # ndev hops: one too many
+            x = jax.lax.ppermute(x, PARTS_AXIS, [(0, 1), (1, 0)])
+        return x
+
+    closed = jax.make_jaxpr(bad_ring)(jnp.zeros((2, 8), jnp.float32))
+    spec = ProgramSpec(ppermute_hops=1, ring_size=2)
+    findings = audit.audit_jaxpr(closed, spec, where="synthetic")
+    assert any(f.check == "collective-schedule" for f in findings)
+    with pytest.raises(CollectiveScheduleError):
+        _raise_all(findings)
+
+    # missing generation scan (require_scan_len with no scan at all)
+    closed2 = jax.make_jaxpr(lambda x: x * 2)(jnp.zeros((4,)))
+    fs2 = audit.check_collectives(
+        closed2, ProgramSpec(require_scan_len=4), "synthetic")
+    assert fs2 and fs2[0].check == "collective-schedule"
+    with pytest.raises(CollectiveScheduleError):
+        _raise_all(fs2)
+
+    # a scan of the right LENGTH that never gathers from the state
+    # shard (e.g. the fused iteration loop when num_iters happens to
+    # equal the local part count) must NOT satisfy the owner check
+    closed3 = jax.make_jaxpr(
+        lambda x: jax.lax.fori_loop(0, 4, lambda i, s: s * 2.0, x))(
+        jnp.float32(1))
+    fs3 = audit.check_collectives(
+        closed3, ProgramSpec(require_scan_len=4,
+                             require_scan_shard_shape=(64,)),
+        "synthetic")
+    assert fs3 and fs3[0].check == "collective-schedule"
+
+
+def test_callback_in_loop_violation():
+    """A host callback inside a fused loop is a per-iteration tunnel
+    round-trip — the exact failure the fused designs exist to
+    avoid."""
+
+    def bad(s):
+        def body(i, acc):
+            jax.debug.print("iter {i}", i=i)
+            return acc + 1.0
+
+        return jax.lax.fori_loop(0, 4, body, s)
+
+    closed = jax.make_jaxpr(bad)(jnp.float32(0))
+    findings = audit.audit_jaxpr(closed, ProgramSpec(),
+                                 where="synthetic")
+    assert any(f.check == "callback-in-loop" for f in findings)
+    with pytest.raises(CallbackInLoopError):
+        _raise_all(findings)
+
+    # pure_callback is flagged too
+    def bad2(s):
+        def body(i, acc):
+            v = jax.pure_callback(
+                lambda a: a, jax.ShapeDtypeStruct((), jnp.float32),
+                acc)
+            return acc + v
+
+        return jax.lax.fori_loop(0, 4, body, s)
+
+    closed2 = jax.make_jaxpr(bad2)(jnp.float32(0))
+    fs2 = audit.check_callbacks(closed2, ProgramSpec(), "s")
+    assert fs2
+
+    # the SAME callback outside any loop is fine (fetch at segment
+    # boundaries is the sanctioned pattern)
+    closed3 = jax.make_jaxpr(
+        lambda s: jax.pure_callback(
+            lambda a: a, jax.ShapeDtypeStruct((), jnp.float32), s))(
+        jnp.float32(0))
+    assert audit.check_callbacks(closed3, ProgramSpec(), "s") == []
+
+
+def test_identity_init_violation():
+    """A scatter-min onto a zeros-initialized buffer clamps every
+    positive result — init must be the reduce identity (+inf)."""
+    closed = jax.make_jaxpr(
+        lambda v, i: jnp.zeros((8,), jnp.float32).at[i].min(v))(
+        jnp.ones((16,), jnp.float32), jnp.zeros((16,), jnp.int32))
+    findings = audit.audit_jaxpr(closed, ProgramSpec(),
+                                 where="synthetic")
+    assert any(f.check == "identity-init" for f in findings)
+    with pytest.raises(IdentityInitError):
+        _raise_all(findings)
+
+    # the identity-initialized form is clean, and so is reducing
+    # onto CARRIED data (a semantic relaxation, not an init)
+    ok = jax.make_jaxpr(
+        lambda v, i: jnp.full((8,), jnp.inf, jnp.float32)
+        .at[i].min(v))(
+        jnp.ones((16,), jnp.float32), jnp.zeros((16,), jnp.int32))
+    assert audit.check_identity_inits(ok, ProgramSpec(), "s") == []
+    carried = jax.make_jaxpr(
+        lambda lab, v, i: lab.at[i].min(v))(
+        jnp.ones((8,), jnp.float32), jnp.ones((16,), jnp.float32),
+        jnp.zeros((16,), jnp.int32))
+    assert audit.check_identity_inits(carried, ProgramSpec(),
+                                      "s") == []
+
+
+def test_ledger_drift_violation():
+    """On a toy graph the tiled arrays' chunk padding dwarfs the
+    epad-priced ledger; a near-zero tolerance turns that into the
+    drift error (the stated default tolerance absorbs it only on
+    dense graphs — see the audit module docstring)."""
+    from lux_tpu.apps import pagerank
+    eng = pagerank.build_engine(_graph(64, 400), num_parts=2)
+    findings = audit.check_ledger(eng, tol=0.001)
+    assert findings and findings[0].check == "ledger-drift"
+    with pytest.raises(LedgerDriftError):
+        _raise_all(findings)
+
+    # a bench-shaped graph passes at the stated tolerance
+    eng2 = pagerank.build_engine(_graph(2048, 32768, seed=2),
+                                 num_parts=2)
+    assert audit.check_ledger(eng2, tol=0.5) == []
+
+
+# ---------------------------------------------------------------------
+# allow= / pragma mechanics
+
+
+def test_frontier_pragma_is_honored():
+    """The push sparse path's CSR-expand scatter-max deliberately
+    inits with 0 (1-based marks; see engine/frontier.py) — its
+    ``# audit: allow(identity-init)`` pragma must suppress the
+    finding, which the clean repo-wide audit depends on."""
+    from lux_tpu.apps import sssp
+    eng = sssp.build_engine(_graph(), 0, num_parts=2)
+    findings = audit.audit_engine(eng, mode=None)
+    assert [f for f in findings if f.check == "identity-init"] == []
+
+
+# ---------------------------------------------------------------------
+# audit= is a bitwise no-op on compiled outputs
+
+
+def test_audit_never_alters_pull_outputs():
+    from lux_tpu.apps import pagerank
+    g = _graph()
+    eng_a = pagerank.build_engine(g, num_parts=2, audit="error")
+    eng_b = pagerank.build_engine(g, num_parts=2)
+    out_a = np.asarray(eng_a.run(eng_a.init_state(), 4))
+    out_b = np.asarray(eng_b.run(eng_b.init_state(), 4))
+    np.testing.assert_array_equal(out_a, out_b)   # bitwise
+
+
+def test_audit_never_alters_push_outputs():
+    from lux_tpu.apps import sssp
+    g = _graph()
+    eng_a = sssp.build_engine(g, 0, num_parts=2, audit="error")
+    eng_b = sssp.build_engine(g, 0, num_parts=2)
+    lab_a, it_a = eng_a.run()
+    lab_b, it_b = eng_b.run()
+    assert it_a == it_b
+    np.testing.assert_array_equal(lab_a, lab_b)   # bitwise
+
+
+def test_audit_warn_mode_warns_not_raises(monkeypatch):
+    """mode='warn' surfaces findings as AuditWarnings and returns
+    them; mode='error' raises."""
+    from lux_tpu.apps import pagerank
+    g = _graph(64, 400)
+    eng = pagerank.build_engine(g, num_parts=2)
+
+    # inject a failing check by shrinking the const ceiling to 0
+    real_spec = audit.engine_spec
+
+    def tight_spec(engine, aval):
+        return audit.ProgramSpec(
+            **{**real_spec(engine, aval).__dict__,
+               "const_bytes_max": -1})
+
+    monkeypatch.setattr(audit, "engine_spec", tight_spec)
+    with pytest.warns(audit.AuditWarning):
+        fs = audit.audit_engine(eng, mode="warn")
+    assert any(f.check == "const-bytes" for f in fs)
+    with pytest.raises(ConstBytesError):
+        audit.audit_engine(eng, mode="error")
+    # allow= is the pragma mechanism's programmatic form
+    fs = audit.audit_engine(eng, mode="error",
+                            allow={"const-bytes"})
+    assert fs == []
+
+
+# ---------------------------------------------------------------------
+# the tier-1 gate: repo-wide audit + AST lint, clean and fast
+
+
+def test_repo_audit_clean():
+    """Every engine configuration x every program variant traces and
+    audits clean on the CPU backend (pragma-exempted findings
+    included); the ledger cross-validation runs on the bench-shaped
+    configs.  Budget: well under 60 s (measured ~5 s)."""
+    findings = audit.run_repo_audit(ledger=True)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_repo_audit_cli():
+    """``python -m lux_tpu.audit`` (tracing-only form) exits 0."""
+    assert audit.main(["-no-ledger"]) == 0
+
+
+def test_lint_repo_clean():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+
+
+def test_lint_detects_and_suppresses(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\nimport jax.numpy as jnp\n\n\n"
+        "def build(x):\n"
+        "    big = jnp.asarray(x)\n\n"
+        "    @jax.jit\n"
+        "    def step(s):\n"
+        "        return s + big\n\n"
+        "    return step\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(bad)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "jit-closure" in r.stderr
+
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import jax\nimport jax.numpy as jnp\n\n\n"
+        "def build(x):\n"
+        "    big = jnp.asarray(x)\n\n"
+        "    # audit: allow(jit-closure) — test fixture\n"
+        "    @jax.jit\n"
+        "    def step(s):\n"
+        "        return s + big\n\n"
+        "    return step\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(ok)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+
+def test_unknown_audit_mode_is_typed_error():
+    """A typo'd mode must not silently disable enforcement — both
+    the engine param and audit_engine reject it."""
+    from lux_tpu.apps import pagerank
+    g = _graph(64, 400)
+    with pytest.raises(ValueError, match="audit mode"):
+        pagerank.build_engine(g, num_parts=2, audit="Error")
+    eng = pagerank.build_engine(g, num_parts=2)
+    with pytest.raises(ValueError, match="audit mode"):
+        audit.audit_engine(eng, mode="off")
+
+
+def test_audit_errors_classify_fatal():
+    """A static-audit violation is a property of the BUILD: the
+    resilience supervisor must never retry it — even when the finding
+    text happens to contain words ('tunnel', '413') the retryable
+    message scan matches."""
+    from lux_tpu import resilience
+    assert resilience.classify(
+        CallbackInLoopError("a host round-trip per iteration "
+                            "through the tunnel")) == "fatal"
+    assert resilience.classify(
+        ConstBytesError("remote compiler rejects ... HTTP 413")) \
+        == "fatal"
+
+
+def test_gather_budget_pragma_exempts_eqn(tmp_path):
+    """An explicit source pragma on a gather excludes it from the
+    budget count (the eqn-anchored exemption form)."""
+    import importlib.util
+    mod_path = tmp_path / "praggather.py"
+    mod_path.write_text(
+        "import jax\nimport jax.numpy as jnp\n\n\n"
+        "def bad(s, table, idx):\n"
+        "    def body(i, acc):\n"
+        "        a = jnp.take(table, idx + i, axis=0)\n"
+        "        # audit: allow(gather-budget) — test fixture\n"
+        "        b = jnp.take(table, idx * 2 + i, axis=0)\n"
+        "        return acc + jnp.sum(a) + jnp.sum(b)\n\n"
+        "    return jax.lax.fori_loop(0, 4, body, s)\n")
+    spec_m = importlib.util.spec_from_file_location("praggather",
+                                                    mod_path)
+    mod = importlib.util.module_from_spec(spec_m)
+    spec_m.loader.exec_module(mod)
+    closed = jax.make_jaxpr(mod.bad)(
+        jnp.float32(0), jnp.zeros((1024,), jnp.float32),
+        jnp.zeros((16,), jnp.int32))
+    spec = ProgramSpec(table_shape=(1024,), gather_budget=1)
+    assert audit.check_gather_budget(closed, spec, "s") == []
+
+
+def test_digest_shape():
+    fs = [audit.Finding("gather-budget", "error", "x", "d"),
+          audit.Finding("loop-invariant", "warn", "x", "d")]
+    d = audit.digest(fs, mode="error")
+    assert d == {"mode": "error", "errors": 1, "warnings": 1,
+                 "failed_checks": ["gather-budget"]}

@@ -141,6 +141,50 @@ def test_bad_health_digests_fail(tmp_path, health, needle):
     assert needle in r.stderr
 
 
+def test_audit_digest_accepted(tmp_path):
+    """Round-10 audit digest (bench.py -audit, lux_tpu/audit.py): a
+    clean digest passes, null passes (-audit off), absence passes
+    (older artifacts)."""
+    good = json.loads(json.dumps(GOOD_LINE))
+    good["audit"] = {"mode": "warn", "errors": 0, "warnings": 1,
+                     "failed_checks": []}
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(good) + "\n")
+    r = run_check(p)
+    assert r.returncode == 0, r.stderr
+    good["audit"] = None
+    p.write_text(json.dumps(good) + "\n")
+    assert run_check(p).returncode == 0
+
+
+@pytest.mark.parametrize("audit,needle", [
+    ({"mode": "loud", "errors": 0, "warnings": 0,
+      "failed_checks": []}, "not warn|error"),
+    ({"mode": "warn", "errors": -1, "warnings": 0,
+      "failed_checks": []}, "audit.errors"),
+    ({"mode": "warn", "errors": 0, "warnings": 0,
+      "failed_checks": ["made-up-check"]}, "unknown checks"),
+    ({"mode": "warn", "errors": 2, "warnings": 0,
+      "failed_checks": ["gather-budget"]}, "audit-FAILING build"),
+    ({"mode": "warn", "errors": 0, "warnings": 0,
+      "failed_checks": ["identity-init"]}, "audit-FAILING build"),
+    ({"mode": "warn", "errors": 0, "warnings": 0,
+      "failed_checks": "gather-budget"}, "failed_checks must be"),
+    ("clean", "null or a dict"),
+])
+def test_bad_audit_digests_fail(tmp_path, audit, needle):
+    """A published metric line whose build failed the static audit is
+    a contradiction — the number was measured on a build violating
+    the structural invariants."""
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["audit"] = audit
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert needle in r.stderr
+
+
 def test_failed_config_line_schema(tmp_path):
     good = {"metric": "sssp_FAILED", "error": "RuntimeError: worker",
             "attempts": 3, "failure_class": "retryable"}

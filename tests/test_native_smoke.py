@@ -69,3 +69,32 @@ def test_make_smoke_and_bindings():
     assert sorted(pay.tolist()) == list(range(5))
     np.testing.assert_array_equal(keys, np.sort(
         np.array([5, 1, 4, 1, 3])))
+
+
+@pytest.mark.slow
+def test_make_sanitize():
+    """``make sanitize``: converter/loader/rmat/sort under
+    -fsanitize=address,undefined -Wall -Werror, plus a native driver
+    running the 3-edge smoke through the loader, a tiny R-MAT and the
+    threaded radix sort.  Memory errors and UB in the native tools
+    fail this (slow-marked) test instead of corrupting a multi-GB
+    benchmark load; the sanitized binaries live in build/sanitize and
+    never shadow the fast artifacts."""
+    cxx = os.environ.get("CXX", "g++").split()[0]
+    if shutil.which("make") is None or shutil.which(cxx) is None:
+        pytest.skip(f"no make/{cxx} toolchain on this machine")
+    # ASan availability probe (some minimal images lack libasan):
+    # compiling an empty program tells us without failing the test
+    probe = subprocess.run(
+        [cxx, "-fsanitize=address,undefined", "-x", "c++", "-", "-o",
+         "/dev/null"], input="int main(){return 0;}",
+        capture_output=True, text=True, timeout=120)
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks asan/ubsan runtime")
+    proc = subprocess.run(["make", "-C", NATIVE_DIR, "sanitize"],
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"native sanitize failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+    assert "sanitize_driver OK" in proc.stdout
+    assert "sanitize OK" in proc.stdout
