@@ -337,7 +337,11 @@ def config_telemetry(events, start_idx, iter_stats):
     (null when the watchdog was off; a TRIPPED watchdog raises and
     the config emits a _FAILED line instead, so a digest here always
     reports a clean bill: tripped=false plus what was checked).
-    scripts/check_bench.py type-checks all three."""
+    Round 11 adds ``topology``: null normally, a {shrinks, ndev_final}
+    digest when the run's events record a mid-run mesh shrink —
+    scripts/check_bench.py REJECTS such lines (a degraded-mesh GTEPS
+    must never be compared against full-mesh lines silently).
+    scripts/check_bench.py type-checks all four."""
     runs = [{"repeat": ev["repeat"], "iters": ev["iters"],
              "seconds": ev["seconds"]}
             for ev in events.events[start_idx:]
@@ -347,10 +351,19 @@ def config_telemetry(events, start_idx, iter_stats):
         if ev["kind"] == "health":
             health = {k: v for k, v in ev.items()
                       if k not in ("t", "kind", "where")}
+    shrinks = [ev for ev in events.events[start_idx:]
+               if ev["kind"] == "mesh_shrink"]
+    topology = None
+    if shrinks:
+        last = shrinks[-1]
+        topology = {"shrinks": len(shrinks),
+                    "ndev_final": last.get("to_ndev",
+                                           last.get("to_nproc"))}
     return {"runs": runs,
             "counters": (iter_stats.summary()
                          if iter_stats is not None else None),
-            "health": health}
+            "health": health,
+            "topology": topology}
 
 
 def main() -> int:

@@ -1032,6 +1032,19 @@ def run_repo_audit(verbose: bool = False, ledger: bool = True):
                         lambda: sssp.build_engine(g, 0, num_parts=2,
                                                   mesh=mesh),
                         False))
+    if ndev >= 4:
+        from lux_tpu.parallel.mesh import make_mesh
+        mesh4 = make_mesh(4)
+        # the POST-SHRINK shape (round 11, elastic recovery): parts
+        # fixed at 8, device mapping changed to a smaller mesh — the
+        # owner generation scan must cover 2 device-local parts and
+        # the collective schedule must hold at the new ndev (the
+        # acceptance gate resilience's re-placement relies on)
+        configs.append(("pagerank_mesh4x8parts_owner_shrunk",
+                        lambda: pagerank.build_engine(
+                            g, num_parts=8, mesh=mesh4,
+                            exchange="owner"),
+                        False))
 
     all_findings = []
     for label, build, do_ledger in configs:

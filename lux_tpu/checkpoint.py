@@ -26,6 +26,19 @@ payload rewritten wrong with a consistent member CRC passes it).
 falls back one generation (emitting a ``checkpoint_fallback``
 telemetry event), and the resilience supervisor then replays the
 lost segment instead of dying — or resuming garbage.
+
+Placement metadata + re-placement (round 11): every save records the
+engine's mesh shape, device count and config fingerprint
+(``placement``: ndev / num_parts / vpad / exchange).  Resume
+VALIDATES num_parts/vpad/exchange — a mismatch is a wrong-config
+checkpoint and errors — while an ndev difference is the ELASTIC
+RE-PLACEMENT contract: the saved state is the global host view, so
+``eng.place`` re-shards it onto the resuming engine's (smaller or
+larger) mesh, recorded as a ``replace`` telemetry event.  Multi-
+process runs assemble the global view collectively
+(multihost.fetch_global) and write from process 0 only (a shared
+checkpoint dir), so the checkpoint a degraded relaunch resumes from
+is always whole.
 """
 
 from __future__ import annotations
@@ -75,9 +88,16 @@ def remove(path: str) -> None:
 
 
 def _to_host(tree):
+    """Fetch a (possibly mesh-sharded) pytree to host numpy as the
+    GLOBAL view.  Multi-process arrays are assembled over the process
+    group (multihost.fetch_global — a collective: every process must
+    call save() together, which the lockstep segmented drivers do);
+    single-process arrays take the plain device_get path."""
+    from lux_tpu.parallel.multihost import fetch_global
+
     import jax
 
-    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    return jax.tree.map(lambda x: np.asarray(fetch_global(x)), tree)
 
 
 def _leaf_crc(leaf: np.ndarray) -> int:
@@ -96,6 +116,11 @@ def save(path: str, state, meta: dict | None = None,
     import jax
 
     leaves, _treedef = jax.tree.flatten(_to_host(state))
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # the global view above was assembled COLLECTIVELY (all
+        # processes participate); one writer per shared checkpoint
+        # dir — every process resumes from the same file
+        return
     payload = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
     crcs = [_leaf_crc(leaf) for leaf in leaves]
     d = os.path.dirname(os.path.abspath(path)) or "."
@@ -219,6 +244,54 @@ def _check_leaves(path, expect, leaves):
                 f"graph/scale?")
 
 
+def _placement_of(eng) -> dict:
+    """{"placement": {...}} metadata fragment for a save — the mesh
+    shape, device count and engine config fingerprint (round 11:
+    checkpoint metadata records where and how the state was running,
+    so a resume can tell a legitimate re-placement from a wrong-config
+    checkpoint).  Empty for engines without the surface."""
+    meta = getattr(eng, "placement_meta", None)
+    if meta is None:
+        return {}
+    return {"placement": meta()}
+
+
+def _check_placement(used: str, meta: dict, eng, kind: str) -> None:
+    """Validate a checkpoint's recorded placement against the resuming
+    engine.  num_parts / vpad / exchange must MATCH (parts and the
+    padded layout are fixed across any recovery; a different exchange
+    mode reduces floats in a different order, so resuming across one
+    silently breaks bitwise reproducibility).  A DEVICE-COUNT
+    difference is not an error — it is the re-placement contract:
+    checkpoints hold the global ``[P, vpad, ...]`` host view, which
+    ``eng.place`` re-shards onto any mesh whose size divides
+    num_parts — and is ROUTED, not ignored: a ``replace`` telemetry
+    event records the old -> new mesh (lux_tpu/resilience.py's
+    elastic path and the degraded relaunch both resume through
+    here)."""
+    from lux_tpu import telemetry
+
+    pl = meta.get("placement")
+    want = getattr(eng, "placement_meta", None)
+    if not isinstance(pl, dict) or want is None:
+        return                      # legacy checkpoint / bare engine
+    want = want()
+    for key in ("num_parts", "vpad", "exchange"):
+        if key in pl and pl[key] != want[key]:
+            raise ValueError(
+                f"{used} was written with {key}={pl[key]!r}, this "
+                f"engine has {key}={want[key]!r} — re-placement keeps "
+                f"the partitioning and exchange FIXED and changes "
+                f"only the device mapping (rebuild the engine with "
+                f"the checkpoint's config, or start fresh)")
+    old_ndev = pl.get("ndev")
+    if isinstance(old_ndev, int) and old_ndev != want["ndev"]:
+        telemetry.current().emit(
+            "replace", engine=kind, from_ndev=old_ndev,
+            to_ndev=want["ndev"], iter=int(meta.get("iter", 0)),
+            path=used)
+
+
 def _timed_save(path, state, meta):
     """save() wrapped in a profiler annotation + telemetry event (the
     full-state fetch a checkpoint costs is worth seeing by name in
@@ -268,6 +341,7 @@ def run_checkpointed(eng, state, num_iters: int, path: str,
                 f"{used} is not a matching pull-engine checkpoint "
                 f"(kind={meta.get('kind')!r}, {len(leaves)} arrays)")
         _check_leaves(used, jax.tree.leaves(state), leaves)
+        _check_placement(used, meta, eng, "pull")
         state = eng.place(jax.tree.unflatten(treedef, leaves))
         start_iter = int(meta["iter"])
         telemetry.current().emit("checkpoint_resume", engine="pull",
@@ -279,7 +353,8 @@ def run_checkpointed(eng, state, num_iters: int, path: str,
             res = on_segment(s, done)
             if res is not None:
                 s = out = res
-        _timed_save(path, (s,), {"iter": done, "kind": "pull"})
+        _timed_save(path, (s,), {"iter": done, "kind": "pull",
+                                 **_placement_of(eng)})
         return out
 
     return run_segments(eng, state, num_iters, segment,
@@ -312,6 +387,7 @@ def converge_checkpointed(eng, path: str, segment=50,
             expect = None
         if expect is not None and len(expect) == len(leaves):
             _check_leaves(used, expect, leaves)
+        _check_placement(used, meta, eng, "push")
         label, active = eng.place(*leaves)
         done = int(meta["iter"])
         telemetry.current().emit("checkpoint_resume", engine="push",
@@ -327,7 +403,8 @@ def converge_checkpointed(eng, path: str, segment=50,
             if res is not None:
                 lbl, act = res
                 out = res
-        _timed_save(path, (lbl, act), {"iter": total, "kind": "push"})
+        _timed_save(path, (lbl, act), {"iter": total, "kind": "push",
+                                       **_placement_of(eng)})
         return out
 
     return converge_segments(

@@ -20,6 +20,11 @@ Flags (reference names kept):
                 the ~55 s tunnel wall, PERF_NOTES round 5)
   -resume CKPT  checkpoint path to save to / resume from
                 (all three: lux_tpu/resilience.py)
+  -elastic      degraded-mesh recovery (round 11): a topology fault
+                (device loss, coordination-service heartbeat loss)
+                rebuilds the mesh over the surviving devices and
+                resumes from the segment checkpoint instead of dying
+                (supervised path + -mesh > 1 only)
   -events FILE  append structured JSONL telemetry events (header with
                 graph shape + HBM estimate, per-run/segment timings,
                 retries, checkpoints; lux_tpu/telemetry.py)
@@ -147,6 +152,16 @@ def _common(ap: argparse.ArgumentParser):
                          "each XLA execution to stay under S seconds "
                          "(the ~55 s tunnel duration wall, PERF_NOTES "
                          "round 5); implies the supervised path")
+    ap.add_argument("-elastic", action="store_true",
+                    help="with the supervised path (-retries/"
+                         "-seg-budget/-resume) and -mesh > 1: survive "
+                         "device loss.  A TOPOLOGY-classified failure "
+                         "(device unavailable, coordination-service "
+                         "heartbeat loss) rebuilds the mesh over the "
+                         "surviving devices — the largest count "
+                         "dividing -np — re-places the checkpointed "
+                         "state, and resumes degraded instead of "
+                         "dying (lux_tpu/resilience.py round 11)")
     ap.add_argument("-resume", default=None, metavar="CKPT",
                     help="checkpoint file: save after every segment "
                          "and resume from it if it exists; implies "
@@ -292,6 +307,12 @@ def _supervisor_opts(args, app):
     -retries / -seg-budget / -resume asks for the resilience
     supervisor (lux_tpu/resilience.py)."""
     if not (args.retries > 0 or args.seg_budget > 0 or args.resume):
+        if getattr(args, "elastic", False):
+            # never drop a recovery flag silently: without the
+            # supervised path there is no checkpoint to re-place from
+            print("note: -elastic implies the supervised path; add "
+                  "-retries/-seg-budget/-resume or it cannot recover "
+                  "anything; ignored")
         return None
     import os
     import tempfile
@@ -316,7 +337,7 @@ def _supervisor_opts(args, app):
     return path, kw
 
 
-def _run_supervised(eng, sup, args, ni=None):
+def _run_supervised(eng, sup, args, ni=None, make_engine=None):
     """One supervised execution (pull fixed-``ni``, or push converge
     when ni is None), printing the supervisor report and reclaiming
     the implicit (non -resume) recovery checkpoint on BOTH success
@@ -325,12 +346,28 @@ def _run_supervised(eng, sup, args, ni=None):
     ``billed`` excludes iterations a previous invocation's -resume
     checkpoint already did (in-run retries bill in full — redone
     segments and backoff are this run's cost, resilience.RunReport
-    .initial_resume)."""
+    .initial_resume).
+
+    make_engine(mesh) — the app's engine factory — plus -elastic arms
+    degraded-mesh recovery: a topology fault rebuilds over the
+    survivors and resumes instead of dying."""
     import os
 
     from lux_tpu import resilience
 
     path, kw = sup
+    if getattr(args, "elastic", False):
+        if make_engine is not None and args.mesh > 1:
+            kw = dict(kw, elastic=make_engine)
+            if kw["policy"].retries < 1:
+                # the topology handler only runs with retry budget
+                # left (supervise: k < retries) — armed-but-inert
+                # must not be silent
+                print("note: -elastic needs -retries >= 1 to re-place "
+                      "after a topology fault; a fault will be fatal")
+        else:
+            print("note: -elastic needs -mesh > 1 (a single device "
+                  "has no topology to shrink); ignored")
     t0 = time.perf_counter()
     try:
         if ni is not None:
@@ -349,6 +386,14 @@ def _run_supervised(eng, sup, args, ni=None):
     print(f"# supervisor: attempts={report.attempts} "
           f"segments={report.segments} "
           f"resumed_from={report.resumed_from}")
+    if report.topology:
+        hops = " -> ".join(
+            [str(report.topology[0]['from_ndev'])]
+            + [str(t['to_ndev']) for t in report.topology])
+        print(f"# supervisor: DEGRADED — mesh shrank {hops} devices "
+              f"(lost {[t['lost_devices'] for t in report.topology]}); "
+              f"results are exact, timings are not comparable to "
+              f"full-mesh runs")
     billed = total - (report.initial_resume or 0)
     return (result, total, elapsed, billed,
             " (supervised; incl. checkpoint saves)")
@@ -409,12 +454,19 @@ def cmd_pagerank(argv):
         mesh, num_parts = _mesh_and_parts(args)
         g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
         sg = _build_sg(args, g_run, num_parts, starts)
-        eng = pagerank.build_engine(g_run, num_parts, mesh, sg=sg,
-                                    pair_threshold=args.pair,
-                                    pair_min_fill=args.min_fill,
-                                    exchange=args.exchange,
-                                    health=args.health,
-                                    audit=args.audit)
+        def make_eng(m):
+            # the -elastic factory: same graph/config, new mesh —
+            # engines compile per-mesh automatically (arrays are jit
+            # arguments), and the rebuilt engine re-audits under the
+            # same -audit mode at the new device count
+            return pagerank.build_engine(g_run, num_parts, m, sg=sg,
+                                         pair_threshold=args.pair,
+                                         pair_min_fill=args.min_fill,
+                                         exchange=args.exchange,
+                                         health=args.health,
+                                         audit=args.audit)
+
+        eng = make_eng(mesh)
         if args.tol is not None:
             if args.retries > 0 or args.seg_budget > 0 or args.resume:
                 print("note: -tol runs one monolithic convergence "
@@ -431,7 +483,7 @@ def cmd_pagerank(argv):
             sup = _supervisor_opts(args, "pagerank")
             if sup is not None:
                 state, total, elapsed, ni, mark = _run_supervised(
-                    eng, sup, args, ni=args.ni)
+                    eng, sup, args, ni=args.ni, make_engine=make_eng)
             else:
                 state, [elapsed] = timed_fused_run(
                     eng, args.ni, trace_dir=args.profile)
@@ -491,28 +543,30 @@ def _push_app(argv, prog_name):
             delta = args.delta
             if delta is not None and delta != "auto":
                 delta = float(delta)
-            eng = sssp.build_engine(g_run, start_vertex=start,
-                                    num_parts=num_parts, mesh=mesh,
-                                    weighted=weighted, delta=delta,
-                                    sg=sg, pair_threshold=args.pair,
-                                    pair_min_fill=args.min_fill,
-                                    exchange=args.exchange,
-                                    enable_sparse=bool(args.sparse),
-                                    health=args.health,
-                                    audit=args.audit)
+
+            def make_eng(m):
+                return sssp.build_engine(
+                    g_run, start_vertex=start, num_parts=num_parts,
+                    mesh=m, weighted=weighted, delta=delta, sg=sg,
+                    pair_threshold=args.pair,
+                    pair_min_fill=args.min_fill,
+                    exchange=args.exchange,
+                    enable_sparse=bool(args.sparse),
+                    health=args.health, audit=args.audit)
         else:
-            eng = components.build_engine(g_run, num_parts=num_parts,
-                                          mesh=mesh, sg=sg,
-                                          pair_threshold=args.pair,
-                                          pair_min_fill=args.min_fill,
-                                          exchange=args.exchange,
-                                          enable_sparse=bool(args.sparse),
-                                          health=args.health,
-                                          audit=args.audit)
+            def make_eng(m):
+                return components.build_engine(
+                    g_run, num_parts=num_parts, mesh=m, sg=sg,
+                    pair_threshold=args.pair,
+                    pair_min_fill=args.min_fill,
+                    exchange=args.exchange,
+                    enable_sparse=bool(args.sparse),
+                    health=args.health, audit=args.audit)
+        eng = make_eng(mesh)
         sup = _supervisor_opts(args, prog_name)
         if sup is not None:
             labels, iters, elapsed, it_exec, mark = _run_supervised(
-                eng, sup, args)
+                eng, sup, args, make_engine=make_eng)
         else:
             labels, iters, [elapsed] = timed_converge(
                 eng, verbose=args.verbose, trace_dir=args.profile)
@@ -567,15 +621,18 @@ def cmd_colfilter(argv):
         mesh, num_parts = _mesh_and_parts(args)
         g_run, _perm, starts = _relabel_for_pairs(args, g, num_parts)
         sg = _build_sg(args, g_run, num_parts, starts)
-        eng = colfilter.build_engine(g_run, num_parts, mesh, sg=sg,
-                                     pair_threshold=args.pair,
-                                     pair_min_fill=args.min_fill,
-                                     health=args.health,
-                                     audit=args.audit)
+        def make_eng(m):
+            return colfilter.build_engine(g_run, num_parts, m, sg=sg,
+                                          pair_threshold=args.pair,
+                                          pair_min_fill=args.min_fill,
+                                          health=args.health,
+                                          audit=args.audit)
+
+        eng = make_eng(mesh)
         sup = _supervisor_opts(args, "colfilter")
         if sup is not None:
             state, total, elapsed, ni, mark = _run_supervised(
-                eng, sup, args, ni=args.ni)
+                eng, sup, args, ni=args.ni, make_engine=make_eng)
         else:
             state, [elapsed] = timed_fused_run(eng, args.ni,
                                                trace_dir=args.profile)

@@ -15,7 +15,12 @@ from __future__ import annotations
 
 
 class AuditableEngine:
-    """Mixin: compiled-variant registry + lazy-variant forcing.
+    """Mixin: compiled-variant registry + lazy-variant forcing, plus
+    the shared PLACEMENT surface (round 11): both engines place state
+    with the same (sg, mesh, exchange) triple, and the elastic
+    recovery path (lux_tpu/resilience.py, checkpoint.py) reasons
+    about placement through ``ndev`` / ``placement_meta`` instead of
+    poking at engine internals.
 
     Subclasses set ``_AUDIT_LAZY`` (attribute names whose
     cached_property builders register variants) and populate
@@ -23,6 +28,27 @@ class AuditableEngine:
     """
 
     _AUDIT_LAZY: tuple = ()
+
+    @property
+    def ndev(self) -> int:
+        """Devices this engine's state is placed over (1 = no mesh)."""
+        mesh = getattr(self, "mesh", None)
+        return 1 if mesh is None else int(mesh.devices.size)
+
+    def placement_meta(self) -> dict:
+        """The placement/config fingerprint checkpoints record
+        (checkpoint.py): a resume validates num_parts/vpad/exchange
+        (P and the padded layout are FIXED across a recovery; a
+        different exchange mode is a different float-reduction order,
+        so silently resuming across one would break bitwise
+        reproducibility), while an ``ndev`` difference is the
+        RE-PLACEMENT contract — the global host view re-shards onto
+        any mesh whose size divides num_parts."""
+        sg = self.sg
+        return {"ndev": self.ndev,
+                "num_parts": int(sg.num_parts),
+                "vpad": int(sg.vpad),
+                "exchange": getattr(self, "exchange", None)}
 
     def _register_variant(self, name, jitted, args_thunk):
         """Expose one compiled loop variant to the static program

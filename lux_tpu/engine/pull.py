@@ -350,7 +350,11 @@ class PullEngine(AuditableEngine):
     def place(self, state):
         """Put a host state pytree on the engine's devices with the
         parts sharding (mirrors init_state's placement; used by
-        checkpoint/resilience resume)."""
+        checkpoint/resilience resume).  This is also the elastic
+        RE-PLACEMENT entry point (round 11): the input is the global
+        ``[P, vpad, ...]`` view, so the same call re-shards a
+        checkpoint written on an 8-device mesh onto this engine's
+        4-device one — parts fixed, device mapping changed."""
         self._drop_pending_init()     # resume never needs the probe
         leaves, treedef = jax.tree.flatten(state)
         if self.mesh is not None:

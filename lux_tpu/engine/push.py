@@ -260,7 +260,10 @@ class PushEngine(AuditableEngine):
 
     def place(self, label, active):
         """Put host (or replicated) state arrays on the engine's
-        devices with the parts sharding (used by checkpoint resume)."""
+        devices with the parts sharding (used by checkpoint resume).
+        Like PullEngine.place, this is the elastic re-placement entry
+        point: the global ``[P, vpad]`` label/active views re-shard
+        onto whatever mesh THIS engine was built over (round 11)."""
         self._drop_pending_init()     # resume never needs the probe
         if self.mesh is not None:
             return tuple(shard_over_parts(

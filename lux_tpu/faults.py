@@ -18,6 +18,14 @@ bit flip only the per-leaf CRC can catch, and a truncation), each
 followed by an injected crash so checkpoint.py's generation-fallback
 resume path is exercised end-to-end by the CPU suite.
 
+Round 11 adds the TOPOLOGY fault classes: DEVICE_LOSS (named mesh
+devices become unavailable) and WORKER_KILL (a whole worker process
+dies — simulated in-process via a typed raise, or REAL via
+``hard_kill`` + os._exit for the multi-process heartbeat harness), so
+the elastic degraded-mesh recovery path (resilience.supervised_run's
+``elastic=``, lux_tpu/heartbeat.py) is deterministically exercised on
+the 8-virtual-device CPU mesh and in the 2-subprocess harness.
+
 Faults key on a global boundary COUNTER, not on iteration numbers:
 after a crash-and-resume the counter has advanced past the fired
 fault, so a schedule never re-fires and every supervised run
@@ -42,11 +50,49 @@ CKPT_BITFLIP = "ckpt_bitflip"    # flip a payload bit in the newest
 #                                  checkpoint generation, then crash
 CKPT_TRUNCATE = "ckpt_truncate"  # truncate the newest checkpoint
 #                                  generation, then crash
+DEVICE_LOSS = "device_loss"      # raise InjectedDeviceLoss naming the
+#                                  mesh devices that "died" (TOPOLOGY
+#                                  class: the elastic supervisor
+#                                  shrinks the mesh over the survivors)
+WORKER_KILL = "worker_kill"      # raise InjectedWorkerKill (a whole
+#                                  worker process gone, its devices
+#                                  with it) — or, with hard_kill=True,
+#                                  REALLY kill this process (the
+#                                  2-subprocess harness's genuine
+#                                  death, detected by the peers'
+#                                  heartbeat deadline)
+
+
+# exit code of a hard_kill WORKER_KILL: distinguishable from a crash
+# (nonzero, outside the shell/signal ranges) in the harness's asserts
+HARD_KILL_CODE = 113
 
 
 class InjectedWorkerCrash(RuntimeError):
     """Synthetic analogue of the tunnel's transient worker death;
     resilience.classify treats it as retryable."""
+
+
+class InjectedDeviceLoss(RuntimeError):
+    """Synthetic topology fault: named devices of the engine's mesh
+    became unavailable.  Carries ``lost_devices`` (device ids);
+    resilience.classify treats it as TOPOLOGY — retrying on the same
+    mesh cannot help, but re-placement onto the survivors can."""
+
+    def __init__(self, msg: str, lost_devices=()):
+        super().__init__(msg)
+        self.lost_devices = tuple(int(d) for d in lost_devices)
+
+
+class InjectedWorkerKill(RuntimeError):
+    """Synthetic topology fault: a whole worker process died, taking
+    its devices with it (the message mimics the coordination-service
+    heartbeat signature real deaths surface as).  Carries
+    ``lost_devices`` like InjectedDeviceLoss; classified TOPOLOGY."""
+
+    def __init__(self, msg: str, lost_devices=()):
+        super().__init__(msg)
+        self.lost_devices = tuple(int(d) for d in lost_devices)
 
 
 @dataclasses.dataclass
@@ -68,6 +114,16 @@ class FaultPlan:
     # supervisor passes the program identity per-call; this is the
     # standalone-use default)
     int_value: int | None = None
+    # devices a DEVICE_LOSS/WORKER_KILL takes: an explicit tuple of
+    # device ids, or an int N = the LAST N devices of the engine's
+    # mesh (the supervisor passes the mesh's device ids per-call, so
+    # the loss is deterministic for a given mesh)
+    lose: int | tuple = 1
+    # WORKER_KILL with hard_kill=True calls os._exit(HARD_KILL_CODE)
+    # instead of raising — the 2-subprocess harness's REAL process
+    # death, which peers can only see through the heartbeat deadline
+    # (lux_tpu/heartbeat.py)
+    hard_kill: bool = False
     boundaries: int = dataclasses.field(default=0, init=False)
     fired: list = dataclasses.field(default_factory=list, init=False)
     # newest checkpoint generation the CKPT_* actions corrupt; bound
@@ -98,7 +154,20 @@ class FaultPlan:
         resilience supervisor calls this with its checkpoint path)."""
         self.ckpt_path = path
 
-    def fire(self, state, int_value: int | None = None):
+    def _lost_ids(self, device_ids) -> tuple:
+        """The device ids a DEVICE_LOSS/WORKER_KILL takes, resolved
+        against the caller's mesh device ids (``lose`` int = the last
+        N of them; explicit tuples pass through)."""
+        if isinstance(self.lose, (tuple, list)):
+            return tuple(int(d) for d in self.lose)
+        ids = tuple(int(d) for d in (device_ids or ()))
+        n = max(0, int(self.lose))
+        # max(0, ...): lose >= the whole mesh takes EVERY device (a
+        # negative slice start would wrap and under-report the loss)
+        return ids[max(0, len(ids) - n):] if n and ids else ()
+
+    def fire(self, state, int_value: int | None = None,
+             device_ids=None):
         import os
 
         i = self.boundaries
@@ -117,6 +186,22 @@ class FaultPlan:
             return corrupt_state(
                 state, self.nan_count,
                 int_value if int_value is not None else self.int_value)
+        if action == DEVICE_LOSS:
+            lost = self._lost_ids(device_ids)
+            raise InjectedDeviceLoss(
+                f"injected device loss at segment boundary {i}: "
+                f"devices {list(lost)} unavailable", lost)
+        if action == WORKER_KILL:
+            lost = self._lost_ids(device_ids)
+            if self.hard_kill:
+                # a REAL death: no exception, no cleanup, no goodbye —
+                # exactly what a preempted/killed worker looks like to
+                # its peers (heartbeat deadline, lux_tpu/heartbeat.py)
+                os._exit(HARD_KILL_CODE)
+            raise InjectedWorkerKill(
+                f"injected worker death at segment boundary {i}: "
+                f"coordination service heartbeat to the worker "
+                f"holding devices {list(lost)} timed out", lost)
         if action in (CKPT_BITFLIP, CKPT_TRUNCATE):
             # the torn-write scenario: the on-disk newest generation
             # is damaged AND the worker dies — the retry's resume must

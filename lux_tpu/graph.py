@@ -331,6 +331,18 @@ class ShardedGraph:
     # process-independent static shapes).
     max_out_degree: int = 0
 
+    def compatible_mesh_sizes(self, available: int) -> list[int]:
+        """Device counts this padded layout can run on UNCHANGED,
+        descending: the divisors of num_parts no larger than
+        ``available``.  Parts P are fixed across an elastic mesh
+        shrink (resilience.py round 11) — every program shape, the
+        pair plan, and the checkpointed global ``[P, vpad, ...]``
+        view depend only on P, so re-placement onto any of these
+        sizes is pure device re-mapping, no host rebuild."""
+        cap = min(int(self.num_parts), int(available))
+        return [d for d in range(cap, 0, -1)
+                if self.num_parts % d == 0]
+
     def part_ids(self) -> np.ndarray:
         """Global part id of each materialized array row."""
         if self.local_parts is None:

@@ -74,6 +74,26 @@ class DurationBudget:
         self._measured = 0
         self._seen: set[int] = set()
 
+    def reset_rate(self, reason: str = "") -> None:
+        """Forget the measured rate and re-enter warmup.  Called on a
+        TOPOLOGY change (resilience's elastic re-placement): a
+        per-iteration rate learned on 8 devices is stale on 4 — the
+        locked segment size would roughly double the execution time
+        and blow the duration wall on the first post-shrink segment.
+        The per-size compile exemptions reset too (every size is a
+        fresh compile on the new mesh)."""
+        from lux_tpu import telemetry
+
+        telemetry.current().emit("budget_reset", reason=reason,
+                                 locked=self.locked,
+                                 per_iter_s=(None if self.per_iter is
+                                             None else
+                                             round(self.per_iter, 6)))
+        self.locked = None
+        self.per_iter = None
+        self._measured = 0
+        self._seen.clear()
+
     def next_n(self, remaining: int) -> int:
         n = self.locked if self.locked is not None else self.probe_n
         return max(1, min(n, remaining, self.max_segment))

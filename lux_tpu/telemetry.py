@@ -15,7 +15,15 @@ supervisor (resilience.py), the CLI and bench.py all emit into:
   ``health`` (per-run watchdog digest), ``health_trip`` (the
   diagnosis of a tripped watchdog: checks, iteration, part) and
   ``checkpoint_fallback`` (a corrupt newest generation replaced by
-  ``.prev``).  ``scripts/events_summary.py`` renders a log into the
+  ``.prev``); round 11 adds the elastic-recovery trail
+  (lux_tpu/resilience.py, heartbeat.py): ``topology_fault`` (a
+  TOPOLOGY-classified failure, handled or not), ``mesh_shrink`` (the
+  decision: from/to device count, lost devices — or the heartbeat
+  protocol's from/to process count), ``replace`` (a checkpoint
+  written at one device count resumed on another), ``budget_reset``
+  (the duration budget's learned rate discarded on a topology
+  change) and ``straggler`` (a live-but-behind heartbeat peer).
+  ``scripts/events_summary.py`` renders a log into the
   reference-style loadTime/compTime/updateTime table and
   ``scripts/check_bench.py`` validates the schema.
 - ``IterStats``: the host-side accumulator for DEVICE-SIDE iteration

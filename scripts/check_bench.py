@@ -65,6 +65,13 @@ Checked per metric line:
   invariants (double gather, baked-in constants, broken collective
   schedule...), so it cannot stand as a metric of record.
 
+- telemetry.topology (round 11, lux_tpu/resilience.py elastic
+  recovery): optional; null when the mesh never changed.  A non-null
+  digest ({shrinks, ndev_final}) REJECTS the line — a mid-run mesh
+  shrink means part of the measurement ran degraded, and a
+  degraded-mesh GTEPS must never be compared against full-mesh lines
+  silently.
+
 - telemetry.health (round 9, bench.py -health): the device-side
   watchdog digest — optional and null when off; present it must be a
   clean bill ({engine, tripped=false, flags=[], iters >= 0}; known
@@ -350,6 +357,7 @@ def check_telemetry(name: str, obj: dict) -> list[str]:
                     f"sample; seconds and samples disagree")
 
     errs += check_health_digest(name, tel)
+    errs += check_topology_digest(name, tel)
 
     cnt = tel["counters"]
     if cnt is not None:
@@ -464,6 +472,46 @@ def check_health_digest(name: str, tel: dict) -> list[str]:
     if not isinstance(it, int) or isinstance(it, bool) or it < 0:
         errs.append(f"{name}: telemetry.health.iters={it!r} must be "
                     f"an int >= 0")
+    return errs
+
+
+def check_topology_digest(name: str, tel: dict) -> list[str]:
+    """Round-11 elastic-recovery digest (bench.py, lux_tpu/
+    resilience.py): optional (older artifacts predate it), null when
+    the mesh never changed.  Present-and-nonnull it must be
+    {shrinks: int >= 1, ndev_final: int >= 1} — and it FAILS the
+    line: a mid-run mesh shrink means the number was measured partly
+    on N devices and partly on fewer, so a degraded-mesh GTEPS must
+    never publish as (or be compared against) a full-mesh metric
+    line.  Rerun on the stable topology instead."""
+    if "topology" not in tel:
+        return []
+    topo = tel["topology"]
+    if topo is None:
+        return []
+    if not isinstance(topo, dict):
+        return [f"{name}: telemetry.topology must be null or a dict, "
+                f"got {topo!r}"]
+    errs = []
+    sh = topo.get("shrinks")
+    if not isinstance(sh, int) or isinstance(sh, bool) or sh < 1:
+        # a null digest means "no shrink"; a non-null one must record
+        # at least one — shrinks=0 here would be a digest that claims
+        # degradation happened while dodging the rejection below
+        errs.append(f"{name}: telemetry.topology.shrinks={sh!r} must "
+                    f"be an int >= 1 (a null digest means no shrink)")
+        sh = None
+    nf = topo.get("ndev_final")
+    if nf is not None and (not isinstance(nf, int)
+                           or isinstance(nf, bool) or nf < 1):
+        errs.append(f"{name}: telemetry.topology.ndev_final={nf!r} "
+                    f"must be an int >= 1")
+    if sh:
+        errs.append(
+            f"{name}: telemetry.topology records {sh} mid-run mesh "
+            f"shrink(s) (final ndev {nf}) — a degraded-mesh GTEPS "
+            f"must never be compared against full-mesh lines; rerun "
+            f"the config on the stable topology")
     return errs
 
 

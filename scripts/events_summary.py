@@ -20,6 +20,12 @@ human shape — and audits it while doing so:
   lux_tpu/health.py) must carry flags/iteration/part/engine — an
   undiagnosable trip fails the audit; ``health`` digests and
   ``checkpoint_fallback`` generation-fallback events are rendered.
+- round 11 (elastic recovery, lux_tpu/resilience.py): a
+  ``topology_fault`` without its error FAILS, as does a
+  ``mesh_shrink`` that does not record a shrinking from/to device
+  (or heartbeat-protocol process) count, and a ``replace`` without
+  its from/to mesh — a degraded continuation must be fully diagnosed
+  in its event trail.  ``budget_reset`` and ``straggler`` render.
 
 Usage:
     python scripts/events_summary.py FILE [FILE...]
@@ -37,11 +43,25 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "segment", "run_done", "iter_stats", "phases",
          "checkpoint_save", "checkpoint_resume", "checkpoint_fallback",
          "retry", "failure", "budget_lock", "budget_halve",
-         "outlier_discard", "outlier_rerun", "health", "health_trip"}
+         "budget_reset", "outlier_discard", "outlier_rerun", "health",
+         "health_trip", "topology_fault", "mesh_shrink", "replace",
+         "straggler"}
 
 # a health_trip without these fields cannot be diagnosed — the whole
 # point of the watchdog is a NAMED check at a NAMED iteration
 HEALTH_TRIP_REQUIRED = ("flags", "iteration", "part", "engine")
+
+
+def _shrink_pair(ev):
+    """(from, to) of a mesh_shrink/replace event — device counts for
+    the in-process elastic path, process counts for the heartbeat
+    shrink protocol.  None when neither pair is present/numeric."""
+    for a, b in (("from_ndev", "to_ndev"), ("from_nproc", "to_nproc")):
+        f, t = ev.get(a), ev.get(b)
+        if (isinstance(f, int) and not isinstance(f, bool)
+                and isinstance(t, int) and not isinstance(t, bool)):
+            return f, t
+    return None
 
 
 def load_events(path: str):
@@ -193,6 +213,45 @@ def render_run(run, out=sys.stdout) -> list[str]:
         print(f"  WATCHDOG TRIPPED ({h['engine']}): "
               f"{'+'.join(h['flags'])} at iteration {h['iteration']}"
               f", part {h['part']} ({h.get('where', '?')})", file=out)
+    for tf in by.get("topology_fault", []):
+        if not tf.get("error"):
+            errs.append(f"{title}: topology_fault event without an "
+                        f"'error': {tf!r}"[:200])
+            continue
+        print(f"  TOPOLOGY FAULT: {tf['error']} (attempt "
+              f"{tf.get('attempt')}, "
+              f"{'re-placed' if tf.get('handled') else 'UNHANDLED'})",
+              file=out)
+    for ms in by.get("mesh_shrink", []):
+        pair = _shrink_pair(ms)
+        if pair is None or pair[1] >= pair[0]:
+            errs.append(f"{title}: mesh_shrink event must record a "
+                        f"SHRINKING from/to device (or process) "
+                        f"count: {ms!r}"[:200])
+            continue
+        unit = "process" if "from_nproc" in ms else "device"
+        # in-process shrinks name the LOST devices; the heartbeat
+        # protocol names the SURVIVORS — never conflate the two
+        who = (f"lost {ms['lost']}" if "lost" in ms
+               else f"survivors {ms.get('survivors')}")
+        print(f"  MESH SHRINK: {pair[0]} -> {pair[1]} {unit}s "
+              f"({who}, parts {ms.get('parts', '?')})", file=out)
+    for rp in by.get("replace", []):
+        pair = _shrink_pair(rp)
+        if pair is None:
+            errs.append(f"{title}: replace event without numeric "
+                        f"from_ndev/to_ndev: {rp!r}"[:200])
+            continue
+        print(f"  re-placement: checkpoint from a {pair[0]}-device "
+              f"mesh resumed on {pair[1]} (iter {rp.get('iter')}, "
+              f"{rp.get('path')})", file=out)
+    for br in by.get("budget_reset", []):
+        print(f"  budget rate reset ({br.get('reason') or '?'}; "
+              f"was locked at {br.get('locked')})", file=out)
+    for sgl in by.get("straggler", []):
+        print(f"  straggler: peer(s) {sgl.get('peers')} "
+              f"{sgl.get('behind_s')}s behind at boundary "
+              f"{sgl.get('boundary')}", file=out)
     for r in by.get("retry", []):
         print(f"  retry: attempt {r.get('attempt')} "
               f"{r.get('error')} [{r.get('classification')}] "

@@ -311,3 +311,47 @@ def test_bad_bigscale_lines_fail(tmp_path, mutate, needle):
     r = _audit_one(tmp_path, obj)
     assert r.returncode == 1, "audit passed a bad bigscale line"
     assert needle in r.stderr, r.stderr
+
+
+# -- round-11 telemetry.topology (degraded-mesh rejection) -------------
+
+def test_null_topology_digest_accepted(tmp_path):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["telemetry"]["topology"] = None
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 0, r.stderr
+
+
+def test_mid_run_mesh_shrink_rejected(tmp_path):
+    """The round-11 satellite: a metric line whose telemetry records
+    a mid-run mesh shrink must FAIL — a degraded-mesh GTEPS compared
+    against full-mesh lines silently is exactly the kind of quiet
+    apples-to-oranges this checker exists to prevent."""
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["telemetry"]["topology"] = {"shrinks": 1, "ndev_final": 4}
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert "mesh shrink" in r.stderr
+    assert "degraded-mesh" in r.stderr
+
+
+@pytest.mark.parametrize("topo,needle", [
+    ({"shrinks": "two"}, "shrinks"),
+    ({"shrinks": 0, "ndev_final": 0}, "ndev_final"),
+    # a non-null digest claiming zero shrinks dodges the rejection
+    # while asserting degradation metadata exists — malformed
+    ({"shrinks": 0, "ndev_final": 4}, "null digest means no shrink"),
+    ("shrunk", "must be null or a dict"),
+])
+def test_malformed_topology_digests_fail(tmp_path, topo, needle):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["telemetry"]["topology"] = topo
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert needle in r.stderr, r.stderr

@@ -220,3 +220,50 @@ def test_unknown_app(capsys):
 def test_help(capsys):
     assert cli.main([]) == 2
     assert cli.main(["-h"]) == 0
+
+
+def test_elastic_flag_without_mesh_notes_and_runs(lux_file, capsys):
+    """-elastic on a single-device run has no topology to shrink: the
+    CLI says so and the supervised run still completes (round 11)."""
+    rc = cli.main(["pagerank", "-file", lux_file, "-ni", "4",
+                   "-np", "2", "-retries", "1", "-elastic"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "-elastic needs -mesh > 1" in out
+    assert "GTEPS" in out
+
+
+def test_elastic_flag_armed_with_mesh(lux_file, capsys):
+    """-elastic with a real mesh arms the supervised path (no fault
+    fires here — the recovery itself is exercised in
+    tests/test_elastic.py; this is the CLI wiring)."""
+    rc = cli.main(["pagerank", "-file", lux_file, "-ni", "4",
+                   "-np", "2", "-mesh", "2", "-retries", "1",
+                   "-elastic"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "-elastic needs" not in out
+    assert "supervisor: attempts=1" in out
+
+
+def test_elastic_flag_without_supervised_path_notes(lux_file, capsys):
+    """-elastic with no -retries/-seg-budget/-resume has no
+    checkpoint to re-place from: the CLI says so instead of silently
+    dropping the recovery flag."""
+    rc = cli.main(["pagerank", "-file", lux_file, "-ni", "3",
+                   "-np", "2", "-mesh", "2", "-elastic"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "-elastic implies the supervised path" in out
+
+
+def test_elastic_flag_with_zero_retries_notes(lux_file, capsys):
+    """Armed-but-inert: with -resume but -retries 0 the topology
+    handler is never consulted — the CLI warns."""
+    import os
+    rc = cli.main(["pagerank", "-file", lux_file, "-ni", "3",
+                   "-np", "2", "-mesh", "2", "-elastic",
+                   "-seg-budget", "30"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "-elastic needs -retries >= 1" in out

@@ -1,0 +1,543 @@
+"""Elastic degraded-mesh recovery (round-11 ISSUE tentpole): topology
+fault classification, checkpoint re-placement onto surviving devices,
+heartbeat supervision, and the injectable DEVICE_LOSS / WORKER_KILL
+fault actions.
+
+The acceptance scenario: a deterministically injected device loss
+mid-run on the 8-virtual-device CPU mesh (tests/conftest.py) resumes
+on 4 devices and produces final state BITWISE-identical to an
+uninterrupted 4-device run — for a pull app (pagerank) and a push app
+(sssp) — and the static audit passes at both mesh sizes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from lux_tpu import checkpoint as ckpt
+from lux_tpu import faults, heartbeat, resilience, telemetry
+from lux_tpu.apps import colfilter, components, pagerank, sssp
+from lux_tpu.convert import uniform_random_edges
+from lux_tpu.graph import Graph, ShardedGraph
+from lux_tpu.parallel.mesh import make_mesh
+from lux_tpu.segmented import DurationBudget
+
+NOSLEEP = dict(sleep=lambda s: None, jitter=0)
+
+
+def _graph(nv=256, ne=1800, seed=61, weighted=False):
+    src, dst = uniform_random_edges(nv, ne, seed=seed)
+    g = Graph.from_edges(src, dst, nv)
+    if weighted:
+        rng = np.random.default_rng(seed + 1)
+        g.weights = rng.integers(1, 6, size=g.ne).astype(np.float32)
+    return g
+
+
+# -- classification ----------------------------------------------------
+
+@pytest.mark.parametrize("exc,want", [
+    (faults.InjectedDeviceLoss("chip gone", (7,)), resilience.TOPOLOGY),
+    (faults.InjectedWorkerKill("worker gone", (6, 7)),
+     resilience.TOPOLOGY),
+    (heartbeat.WorkerLostError([1], 3, 55.0), resilience.TOPOLOGY),
+    (RuntimeError("failed to connect to coordination service at "
+                  "10.0.0.1:8471"), resilience.TOPOLOGY),
+    (RuntimeError("Device TPU_3 is unavailable"), resilience.TOPOLOGY),
+    (RuntimeError("heartbeat timed out waiting for task 2"),
+     resilience.TOPOLOGY),
+    # the PR-1 classes are untouched: a generic worker death stays
+    # retryable (same mesh, fresh attempt), OOM stays fatal
+    (RuntimeError("TPU worker terminated unexpectedly"),
+     resilience.RETRYABLE),
+    (RuntimeError("RESOURCE_EXHAUSTED: out of memory"),
+     resilience.FATAL),
+    (ConnectionError("heartbeat socket reset"), resilience.RETRYABLE),
+    #  ^ typed transport outranks the topology message scan (PR-1
+    #    convention: typed checks beat words)
+])
+def test_classify_topology(exc, want):
+    assert resilience.classify(exc) == want
+
+
+# -- RetryPolicy decorrelated jitter -----------------------------------
+
+def test_jitter_is_seeded_and_deterministic():
+    a = resilience.RetryPolicy(jitter_seed=7)
+    b = resilience.RetryPolicy(jitter_seed=7)
+    da = [a.delay_s(k) for k in range(6)]
+    assert da == [b.delay_s(k) for k in range(6)]
+    # stable within one instance (supervise reads it once per failure,
+    # but a re-read must not advance the stream)
+    assert a.delay_s(2) == da[2]
+    # bounded by [backoff_s, max_backoff_s]
+    assert all(1.0 <= d <= 60.0 for d in da)
+
+
+def test_jitter_decorrelates_across_seeds():
+    # two "worker processes": different seeds, different schedules —
+    # the whole point (synchronized backoff is a retry stampede)
+    da = [resilience.RetryPolicy(jitter_seed=1).delay_s(k)
+          for k in range(6)]
+    db = [resilience.RetryPolicy(jitter_seed=2).delay_s(k)
+          for k in range(6)]
+    assert da != db
+
+
+def test_jitter_zero_restores_exponential():
+    p = resilience.RetryPolicy(backoff_s=1.0, backoff_factor=2.0,
+                               max_backoff_s=5.0, jitter=0)
+    assert [p.delay_s(k) for k in range(4)] == [1.0, 2.0, 4.0, 5.0]
+
+
+# -- DurationBudget rate reset on topology change ----------------------
+
+def test_duration_budget_reset_rate_reenters_warmup():
+    ev = telemetry.EventLog()
+    b = DurationBudget(budget_s=1.0, probe_n=2, warmup=2)
+    b.observe(2, 10.0)
+    b.observe(2, 0.1)
+    assert b.locked == 16
+    with telemetry.use(events=ev):
+        b.reset_rate(reason="mesh_shrink")
+    assert b.locked is None and b.per_iter is None
+    assert b.next_n(100) == 2          # back to the probe size
+    assert ev.counts().get("budget_reset") == 1
+    assert ev.events[-1]["reason"] == "mesh_shrink"
+    # the per-size compile exemption reset too: every size is a fresh
+    # compile on the new mesh
+    assert not b._seen
+
+
+# -- compatible mesh sizes ---------------------------------------------
+
+def test_compatible_mesh_sizes():
+    g = _graph(nv=64, ne=400)
+    sg = ShardedGraph.build(g, num_parts=8)
+    assert sg.compatible_mesh_sizes(8) == [8, 4, 2, 1]
+    assert sg.compatible_mesh_sizes(7) == [4, 2, 1]
+    assert sg.compatible_mesh_sizes(3) == [2, 1]
+    assert sg.compatible_mesh_sizes(1) == [1]
+
+
+# -- fault actions -----------------------------------------------------
+
+def test_device_loss_action_names_mesh_tail():
+    plan = faults.FaultPlan(schedule={0: faults.DEVICE_LOSS}, lose=2)
+    with pytest.raises(faults.InjectedDeviceLoss) as ei:
+        plan.fire(np.zeros(3), device_ids=[0, 1, 2, 5, 7])
+    assert ei.value.lost_devices == (5, 7)
+    assert resilience.classify(ei.value) == resilience.TOPOLOGY
+    assert plan.fired == [(0, faults.DEVICE_LOSS)]
+
+
+def test_device_loss_explicit_ids():
+    plan = faults.FaultPlan(schedule={0: faults.DEVICE_LOSS},
+                            lose=(3,))
+    with pytest.raises(faults.InjectedDeviceLoss) as ei:
+        plan.fire(np.zeros(3), device_ids=[0, 1, 2, 3])
+    assert ei.value.lost_devices == (3,)
+
+
+def test_worker_kill_action_raises_typed_without_hard_kill():
+    plan = faults.FaultPlan(schedule={0: faults.WORKER_KILL}, lose=4)
+    with pytest.raises(faults.InjectedWorkerKill) as ei:
+        plan.fire(np.zeros(3), device_ids=list(range(8)))
+    assert ei.value.lost_devices == (4, 5, 6, 7)
+    assert "coordination service heartbeat" in str(ei.value)
+    assert resilience.classify(ei.value) == resilience.TOPOLOGY
+
+
+# -- the acceptance scenario: 8 -> 4 bitwise re-placement --------------
+
+def _pr_factory(g):
+    sg = ShardedGraph.build(g, num_parts=8)
+    return lambda mesh: pagerank.build_engine(g, num_parts=8,
+                                              mesh=mesh, sg=sg)
+
+
+def test_pull_device_loss_resumes_bitwise_on_4(tmp_path):
+    """Device loss at a segment boundary on the 8-device mesh: the
+    supervisor shrinks to 4 survivors, re-places the checkpoint, and
+    the final state is BITWISE the uninterrupted 4-device run's."""
+    g = _graph()
+    factory = _pr_factory(g)
+    eng8 = factory(make_mesh(8))
+    plan = faults.FaultPlan(schedule={1: faults.DEVICE_LOSS}, lose=1)
+    path = str(tmp_path / "pr.npz")
+    ev = telemetry.EventLog()
+    with telemetry.use(events=ev):
+        state, report = resilience.supervised_run(
+            eng8, 10, path, segment=3, faults=plan, elastic=factory,
+            policy=resilience.RetryPolicy(retries=2, **NOSLEEP))
+    eng4 = factory(make_mesh(4))
+    want = eng4.run(eng4.init_state(), 10)
+    np.testing.assert_array_equal(eng8.unpad(state), eng4.unpad(want))
+    assert report.topology == [
+        {"from_ndev": 8, "to_ndev": 4, "lost_devices": [7]}]
+    assert report.attempts == 2
+    c = ev.counts()
+    assert c.get("topology_fault") == 1
+    assert c.get("mesh_shrink") == 1
+    assert c.get("replace") == 1           # the checkpoint re-shard
+    # the run finished DEGRADED and its report says so
+    assert report.as_dict()["topology"][0]["to_ndev"] == 4
+
+
+def test_push_device_loss_resumes_bitwise_on_4(tmp_path):
+    """Same acceptance scenario for the push engine (sssp): the
+    re-placed convergence finishes bitwise-equal to an uninterrupted
+    4-device run."""
+    g = _graph(nv=256, ne=2000, seed=62)
+    sg = ShardedGraph.build(g, num_parts=8)
+
+    def factory(mesh):
+        return sssp.build_engine(g, start_vertex=0, num_parts=8,
+                                 mesh=mesh, sg=sg)
+
+    eng8 = factory(make_mesh(8))
+    plan = faults.FaultPlan(schedule={1: faults.DEVICE_LOSS}, lose=1)
+    path = str(tmp_path / "ss.npz")
+    label, _active, total, report = resilience.supervised_converge(
+        eng8, path, segment=2, faults=plan, elastic=factory,
+        policy=resilience.RetryPolicy(retries=2, **NOSLEEP))
+    eng4 = factory(make_mesh(4))
+    l4, a4 = eng4.init_state()
+    l4, _a4, _it = eng4.converge(l4, a4)
+    np.testing.assert_array_equal(eng8.unpad(label), eng4.unpad(l4))
+    assert report.topology == [
+        {"from_ndev": 8, "to_ndev": 4, "lost_devices": [7]}]
+    assert total > 0
+
+
+def test_audit_passes_at_both_mesh_sizes():
+    """The acceptance gate: the static audit's collective-schedule
+    check must hold at the ORIGINAL ndev and at the post-shrink one
+    (owner scan covers 2 device-local parts there)."""
+    from lux_tpu import audit
+
+    g = _graph(nv=128, ne=900, seed=63)
+    sg = ShardedGraph.build(g, num_parts=8)
+    for nd in (8, 4):
+        eng = pagerank.build_engine(g, num_parts=8,
+                                    mesh=make_mesh(nd), sg=sg,
+                                    exchange="owner")
+        errs = [f for f in audit.audit_engine(eng, mode=None)
+                if f.severity == "error"]
+        assert not errs, f"ndev={nd}: {errs}"
+
+
+def test_pull_double_shrink_8_4_2(tmp_path):
+    """Two topology faults in one run: 8 -> 4 -> 2, each re-placed,
+    final state bitwise the uninterrupted 2-device run's."""
+    g = _graph()
+    factory = _pr_factory(g)
+    eng8 = factory(make_mesh(8))
+    plan = faults.FaultPlan(
+        schedule={1: faults.DEVICE_LOSS, 3: faults.DEVICE_LOSS},
+        lose=1)
+    path = str(tmp_path / "pr2.npz")
+    state, report = resilience.supervised_run(
+        eng8, 12, path, segment=3, faults=plan, elastic=factory,
+        policy=resilience.RetryPolicy(retries=3, **NOSLEEP))
+    eng2 = factory(make_mesh(2))
+    want = eng2.run(eng2.init_state(), 12)
+    np.testing.assert_array_equal(eng8.unpad(state), eng2.unpad(want))
+    assert [(t["from_ndev"], t["to_ndev"]) for t in report.topology] \
+        == [(8, 4), (4, 2)]
+
+
+# -- DEVICE_LOSS / WORKER_KILL coverage across all four apps -----------
+
+def test_components_worker_kill_recovers(tmp_path):
+    g = _graph(nv=200, ne=1500, seed=64)
+    sg = ShardedGraph.build(g, num_parts=8)
+
+    def factory(mesh):
+        return components.build_engine(g, num_parts=8, mesh=mesh,
+                                       sg=sg)
+
+    eng8 = factory(make_mesh(8))
+    # a dead WORKER takes its devices with it: 2 of 8 here
+    plan = faults.FaultPlan(schedule={1: faults.WORKER_KILL}, lose=2)
+    path = str(tmp_path / "cc.npz")
+    label, _active, _total, report = resilience.supervised_converge(
+        eng8, path, segment=2, faults=plan, elastic=factory,
+        policy=resilience.RetryPolicy(retries=2, **NOSLEEP))
+    eng4 = factory(make_mesh(4))
+    l4, a4 = eng4.init_state()
+    l4, _a4, _it = eng4.converge(l4, a4)
+    np.testing.assert_array_equal(eng8.unpad(label), eng4.unpad(l4))
+    assert report.topology[0]["from_ndev"] == 8
+    assert report.topology[0]["to_ndev"] == 4
+    assert report.topology[0]["lost_devices"] == [6, 7]
+
+
+def test_colfilter_worker_kill_recovers(tmp_path):
+    g = _graph(nv=128, ne=1500, seed=65, weighted=True)
+    sg = ShardedGraph.build(g, num_parts=8)
+
+    def factory(mesh):
+        return colfilter.build_engine(g, num_parts=8, mesh=mesh,
+                                      sg=sg)
+
+    eng8 = factory(make_mesh(8))
+    plan = faults.FaultPlan(schedule={1: faults.WORKER_KILL}, lose=2)
+    path = str(tmp_path / "cf.npz")
+    state, report = resilience.supervised_run(
+        eng8, 6, path, segment=2, faults=plan, elastic=factory,
+        policy=resilience.RetryPolicy(retries=2, **NOSLEEP))
+    eng4 = factory(make_mesh(4))
+    want = eng4.run(eng4.init_state(), 6)
+    np.testing.assert_allclose(eng8.unpad(state), eng4.unpad(want),
+                               rtol=1e-6)
+    assert report.topology[0]["to_ndev"] == 4
+
+
+# -- unhandled topology faults stay fatal ------------------------------
+
+def test_topology_fault_without_elastic_is_fatal(tmp_path):
+    """No elastic factory: a topology fault must NOT blind-retry on
+    the same dead mesh — it re-raises even with retry budget left."""
+    g = _graph()
+    eng = pagerank.build_engine(g, num_parts=8, mesh=make_mesh(8))
+    plan = faults.FaultPlan(schedule={1: faults.DEVICE_LOSS}, lose=1)
+    report = resilience.RunReport()
+    with pytest.raises(faults.InjectedDeviceLoss):
+        resilience.supervised_run(
+            eng, 10, str(tmp_path / "x.npz"), segment=3, faults=plan,
+            policy=resilience.RetryPolicy(retries=3, **NOSLEEP),
+            report=report)
+    # no blind retry happened: the topology fault was fatal at once
+    assert report.attempts == 1
+    assert report.failures[0][2] == resilience.TOPOLOGY
+
+
+def test_single_device_engine_has_no_topology_to_shrink(tmp_path):
+    g = _graph(nv=64, ne=400)
+    eng = pagerank.build_engine(g, num_parts=2)      # mesh=None
+
+    def factory(mesh):                               # never callable
+        raise AssertionError("must not rebuild without a mesh")
+
+    plan = faults.FaultPlan(schedule={1: faults.DEVICE_LOSS}, lose=1)
+    with pytest.raises(faults.InjectedDeviceLoss):
+        resilience.supervised_run(
+            eng, 10, str(tmp_path / "x.npz"), segment=3, faults=plan,
+            elastic=factory,
+            policy=resilience.RetryPolicy(retries=2, **NOSLEEP))
+
+
+# -- checkpoint placement metadata -------------------------------------
+
+def test_checkpoint_records_placement(tmp_path):
+    g = _graph(nv=64, ne=400)
+    eng = pagerank.build_engine(g, num_parts=2)
+    path = str(tmp_path / "pr.npz")
+    resilience.supervised_run(
+        eng, 4, path, segment=2,
+        policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+    _leaves, meta = ckpt.load(path)
+    pl = meta["placement"]
+    assert pl["ndev"] == 1 and pl["num_parts"] == 2
+    assert pl["vpad"] == eng.sg.vpad
+    assert pl["exchange"] == "gather"
+
+
+def test_resume_routes_mesh_mismatch_into_replacement(tmp_path):
+    """A checkpoint written on 8 devices resumed by a 4-device engine
+    is NOT an error: the global host view re-shards (eng.place), a
+    ``replace`` event records it, and the result is bitwise the
+    uninterrupted 4-device run's — the re-placement contract."""
+    g = _graph()
+    factory = _pr_factory(g)
+    path = str(tmp_path / "pr.npz")
+    eng8 = factory(make_mesh(8))
+    resilience.supervised_run(
+        eng8, 4, path, segment=2,
+        policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+    eng4 = factory(make_mesh(4))
+    ev = telemetry.EventLog()
+    with telemetry.use(events=ev):
+        state, report = resilience.supervised_run(
+            eng4, 10, path, segment=4, resume=True,
+            policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+    assert ev.counts().get("replace") == 1
+    rp = [e for e in ev.events if e["kind"] == "replace"][0]
+    assert (rp["from_ndev"], rp["to_ndev"]) == (8, 4)
+    want = factory(make_mesh(4)).run(factory(make_mesh(4)).init_state(),
+                                     10)
+    np.testing.assert_array_equal(eng4.unpad(state), eng8.unpad(want))
+
+
+def test_resume_rejects_exchange_mismatch(tmp_path):
+    """Exchange modes reduce floats in different orders: resuming a
+    gather-engine checkpoint into an owner engine (or vice versa)
+    would silently break bitwise reproducibility — typed refusal."""
+    g = _graph(nv=64, ne=400)
+    eng = pagerank.build_engine(g, num_parts=2)
+    path = str(tmp_path / "pr.npz")
+    resilience.supervised_run(
+        eng, 4, path, segment=2,
+        policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+    leaves, meta = ckpt.load(path)
+    meta["placement"]["exchange"] = "owner"
+    ckpt.save(path, tuple(leaves), meta)
+    with pytest.raises(ValueError, match="exchange"):
+        resilience.supervised_run(
+            eng, 8, path, segment=2, resume=True,
+            policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+
+
+def test_resume_rejects_num_parts_mismatch(tmp_path):
+    g = _graph(nv=64, ne=400)
+    eng = pagerank.build_engine(g, num_parts=2)
+    path = str(tmp_path / "pr.npz")
+    resilience.supervised_run(
+        eng, 4, path, segment=2,
+        policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+    leaves, meta = ckpt.load(path)
+    meta["placement"]["num_parts"] = 4
+    ckpt.save(path, tuple(leaves), meta)
+    with pytest.raises(ValueError, match="num_parts"):
+        resilience.supervised_run(
+            eng, 8, path, segment=2, resume=True,
+            policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+
+
+def test_legacy_checkpoint_without_placement_resumes(tmp_path):
+    """Pre-round-11 checkpoints carry no placement block; they keep
+    resuming through the shape/dtype check alone."""
+    g = _graph(nv=64, ne=400)
+    eng = pagerank.build_engine(g, num_parts=2)
+    path = str(tmp_path / "pr.npz")
+    resilience.supervised_run(
+        eng, 4, path, segment=2,
+        policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+    leaves, meta = ckpt.load(path)
+    del meta["placement"]
+    ckpt.save(path, tuple(leaves), meta)
+    state, report = resilience.supervised_run(
+        eng, 8, path, segment=4, resume=True,
+        policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+    np.testing.assert_allclose(
+        eng.unpad(state), pagerank.reference_pagerank(g, 8), rtol=1e-5)
+
+
+# -- heartbeat supervision (fake clock) --------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def _board(tmp_path, pid, clock, nproc=2, deadline=10.0, **kw):
+    return heartbeat.Heartbeat(
+        path=str(tmp_path), pid=pid, nproc=nproc, deadline_s=deadline,
+        poll_s=0.5, now=clock.now, sleep=clock.sleep, **kw)
+
+
+def test_heartbeat_sync_returns_when_peers_reach_boundary(tmp_path):
+    clk = _Clock()
+    b0 = _board(tmp_path, 0, clk)
+    b1 = _board(tmp_path, 1, clk)
+    b1.beat(0)
+    b0.sync(0)                         # peer already there: no wait
+    assert clk.t == 0.0
+    assert b0.survivors() == [0, 1]
+
+
+def test_heartbeat_dead_peer_raises_worker_lost(tmp_path):
+    clk = _Clock()
+    b0 = _board(tmp_path, 0, clk)
+    b1 = _board(tmp_path, 1, clk)
+    b1.beat(0)                         # then silence
+    with pytest.raises(heartbeat.WorkerLostError) as ei:
+        b0.sync(1)
+    assert ei.value.lost == (1,) and ei.value.boundary == 1
+    assert clk.t > 10.0                # waited the full deadline
+    assert resilience.classify(ei.value) == resilience.TOPOLOGY
+    assert b0.survivors() == [0]
+
+
+def test_heartbeat_never_started_peer_gets_launch_grace(tmp_path):
+    clk = _Clock()
+    b0 = _board(tmp_path, 0, clk)      # peer 1 never writes anything
+    with pytest.raises(heartbeat.WorkerLostError) as ei:
+        b0.sync(0)
+    assert ei.value.lost == (1,)
+
+
+def test_heartbeat_done_peer_satisfies_sync(tmp_path):
+    clk = _Clock()
+    b0 = _board(tmp_path, 0, clk)
+    b1 = _board(tmp_path, 1, clk)
+    b1.finish()
+    b0.sync(7)                         # finished peers never block
+    assert b0.survivors() == [0, 1]
+
+
+def test_heartbeat_straggler_emits_event_then_catches_up(tmp_path):
+    clk = _Clock()
+    b0 = _board(tmp_path, 0, clk, deadline=20.0)
+    b1 = _board(tmp_path, 1, clk, deadline=20.0)
+    b1.beat(0)
+    orig_sleep = clk.sleep
+
+    def sleep(s):                      # the peer recovers at t=15
+        orig_sleep(s)
+        if clk.t >= 15:
+            b1.beat(1)
+
+    b0.sleep = sleep
+    ev = telemetry.EventLog()
+    with telemetry.use(events=ev):
+        b0.sync(1)
+    assert ev.counts().get("straggler") == 1
+    assert ev.events[0]["peers"] == [1]
+
+
+def test_heartbeat_propose_shrink_agrees(tmp_path):
+    clk = _Clock()
+    b0 = _board(tmp_path, 0, clk)
+    b1 = _board(tmp_path, 1, clk, nproc=3)
+    # worker 2 died; 0 (coordinator) proposes, 1 reads the same record
+    t0 = b0.propose_shrink([0, 1], generation=1)
+    t1 = b1.propose_shrink([0, 1], generation=1)
+    assert t0 == t1
+    assert t0["survivors"] == [0, 1] and t0["nproc"] == 2
+
+
+def test_supervised_run_syncs_heartbeat_per_segment(tmp_path):
+    """The distributed supervision wiring: a supervised run beats at
+    every segment boundary and finishes done — a (simulated) peer
+    board sees it alive throughout and finished at the end."""
+    g = _graph(nv=64, ne=400)
+    eng = pagerank.build_engine(g, num_parts=2)
+    hb = heartbeat.Heartbeat(path=str(tmp_path / "hb"), pid=0,
+                             nproc=1, deadline_s=30.0)
+    state, report = resilience.supervised_run(
+        eng, 6, str(tmp_path / "pr.npz"), segment=2, heartbeat=hb,
+        policy=resilience.RetryPolicy(retries=0, **NOSLEEP))
+    np.testing.assert_allclose(
+        eng.unpad(state), pagerank.reference_pagerank(g, 6), rtol=1e-5)
+    last = hb.read(0)
+    assert last["done"] is True
+    assert report.segments == 3
+
+
+def test_device_loss_lose_more_than_mesh_takes_everything():
+    """lose >= the whole mesh must name EVERY device (a wrapped
+    negative slice would under-report the loss and let the handler
+    'shrink' a mesh with no survivors)."""
+    plan = faults.FaultPlan(schedule={0: faults.DEVICE_LOSS}, lose=3)
+    with pytest.raises(faults.InjectedDeviceLoss) as ei:
+        plan.fire(np.zeros(3), device_ids=[4, 9])
+    assert ei.value.lost_devices == (4, 9)

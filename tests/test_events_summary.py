@@ -198,3 +198,93 @@ def test_multi_run_log_splits(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "== pagerank ==" in r.stdout and "== sssp ==" in r.stdout
     assert "timed runs: 1" in r.stdout
+
+
+# -- round-11 elastic-recovery events ----------------------------------
+
+ELASTIC = [
+    {"t": 3.0, "kind": "run_start", "app": "pagerank"},
+    {"t": 3.1, "kind": "topology_fault", "attempt": 0,
+     "error": "InjectedDeviceLoss",
+     "message": "devices [7] unavailable", "handled": True},
+    {"t": 3.2, "kind": "mesh_shrink", "from_ndev": 8, "to_ndev": 4,
+     "lost": [7], "parts": 8, "error": "InjectedDeviceLoss",
+     "rebuild_seconds": 0.4},
+    {"t": 3.3, "kind": "budget_reset", "reason": "mesh_shrink",
+     "locked": 16, "per_iter_s": 0.05},
+    {"t": 3.4, "kind": "replace", "engine": "pull", "from_ndev": 8,
+     "to_ndev": 4, "iter": 3, "path": "/tmp/x.npz"},
+    {"t": 3.5, "kind": "straggler", "boundary": 2, "peers": [1],
+     "behind_s": 6.2},
+]
+
+
+def test_elastic_events_render(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, ELASTIC)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "TOPOLOGY FAULT: InjectedDeviceLoss" in out
+    assert "re-placed" in out
+    assert "MESH SHRINK: 8 -> 4 devices" in out
+    assert "re-placement: checkpoint from a 8-device mesh" in out
+    assert "budget rate reset (mesh_shrink" in out
+    assert "straggler: peer(s) [1]" in out
+
+
+def test_heartbeat_protocol_shrink_renders(tmp_path):
+    """The multi-process shrink records process counts, not device
+    counts — both spellings must render."""
+    events = [
+        {"t": 4.0, "kind": "run_start", "app": "pagerank"},
+        {"t": 4.1, "kind": "mesh_shrink", "protocol": "heartbeat",
+         "from_nproc": 2, "to_nproc": 1, "survivors": [0],
+         "generation": 1},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "MESH SHRINK: 2 -> 1 process" in r.stdout
+    # the heartbeat record names SURVIVORS — rendering them under a
+    # "lost" label would invert the diagnosis
+    assert "survivors [0]" in r.stdout and "lost [0]" not in r.stdout
+
+
+def test_topology_fault_without_error_fails(tmp_path):
+    events = [
+        {"t": 5.0, "kind": "run_start", "app": "pagerank"},
+        {"t": 5.1, "kind": "topology_fault", "handled": False},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "topology_fault" in r.stderr
+
+
+def test_non_shrinking_mesh_shrink_fails(tmp_path):
+    """A mesh_shrink that does not shrink (or has no counts at all)
+    is an undiagnosable topology change."""
+    for bad in ({"t": 6.1, "kind": "mesh_shrink", "from_ndev": 4,
+                 "to_ndev": 8},
+                {"t": 6.1, "kind": "mesh_shrink", "lost": [7]}):
+        p = tmp_path / "ev.jsonl"
+        write_log(p, [{"t": 6.0, "kind": "run_start",
+                       "app": "pagerank"}, bad])
+        r = run_summary(p)
+        assert r.returncode == 1
+        assert "mesh_shrink" in r.stderr
+
+
+def test_replace_without_mesh_pair_fails(tmp_path):
+    events = [
+        {"t": 7.0, "kind": "run_start", "app": "pagerank"},
+        {"t": 7.1, "kind": "replace", "iter": 3},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "replace" in r.stderr

@@ -116,9 +116,15 @@ def test_supervise_exhaustion_reraises_last():
 
 
 def test_retry_policy_backoff():
+    # jitter=0: the exact exponential schedule (round 11 made
+    # decorrelated jitter the default — see tests/test_elastic.py)
     p = resilience.RetryPolicy(backoff_s=1.0, backoff_factor=2.0,
-                               max_backoff_s=5.0)
+                               max_backoff_s=5.0, jitter=0)
     assert [p.delay_s(k) for k in range(4)] == [1.0, 2.0, 4.0, 5.0]
+    # the default (jittered) schedule stays within the same envelope
+    j = resilience.RetryPolicy(backoff_s=1.0, backoff_factor=2.0,
+                               max_backoff_s=5.0, jitter_seed=3)
+    assert all(1.0 <= j.delay_s(k) <= 5.0 for k in range(4))
 
 
 # -- fault plans -------------------------------------------------------
