@@ -60,6 +60,18 @@ trail: "attempts" (total timed runs incl. outlier reruns),
 whole config was retried.  scripts/check_bench.py validates the
 schema.
 
+Observatory (round 12, lux_tpu/observe.py): the session-calibration
+probe runs once up front and every metric line carries its
+``calibration`` digest (measured probe ns/elem vs the canonical
+PERF_NOTES figures, platform, ndev, grade) — scripts/check_bench.py
+REJECTS lines from "degraded" or "uncalibrated" sessions, so the 10x
+tunnel-variance trap is detected and labeled instead of entering the
+trajectory.  Every run also appends its lines to the persistent perf
+ledger (``-ledger``, default PERFLEDGER.jsonl) and writes the
+machine-readable BENCH_rNN.json artifact itself (``-json-out``,
+default auto-numbered — the empty bench trajectory was a
+hand-assembly gap, not a measurement gap).
+
 Configs (-config runs one):
   pagerank        PageRank, pull model, fixed iterations   (BASELINE #1/#4)
   pagerank-mp     PageRank, np=4 multi-part OWNER exchange + pair
@@ -309,11 +321,15 @@ def run_config(config, args):
 
 
 def emit(name, samples, extra, attempts=None, discarded=(),
-         telemetry=None):
+         telemetry=None, calibration=None):
     """One JSON metric line.  attempts = total timed runs (originals
     + outlier reruns); discarded = samples thrown out by the >3x rule
     — recorded, never silently medianed; telemetry = per-run seconds
-    + counter digest (scripts/check_bench.py validates the schema)."""
+    + counter digest; calibration = the session-calibration
+    fingerprint digest (lux_tpu/observe.py — labels the line with
+    this process's measured probe rate so a degraded tunnel session
+    is detected, not medianed).  scripts/check_bench.py validates
+    all of it.  Returns the line dict (artifact/ledger writers)."""
     gteps = median(samples)
     result = {
         "metric": f"{name}_gteps_per_chip",
@@ -324,9 +340,47 @@ def emit(name, samples, extra, attempts=None, discarded=(),
         "attempts": len(samples) if attempts is None else attempts,
         "discarded": [round(d, 4) for d in discarded],
         **({"telemetry": telemetry} if telemetry is not None else {}),
+        "calibration": calibration,
         **extra,
     }
     print(json.dumps(result), flush=True)
+    return result
+
+
+def next_artifact_path(directory=".") -> str:
+    """BENCH_rNN.json with NN = one past the highest existing round —
+    the bench trajectory was EMPTY because artifact assembly was a
+    manual step; now the driver metric file writes itself."""
+    import os
+    import re
+
+    best = 0
+    for name in os.listdir(directory or "."):
+        m = re.match(r"^BENCH_r(\d+)\.json$", name)
+        if m:
+            best = max(best, int(m.group(1)))
+    return os.path.join(directory or ".", f"BENCH_r{best + 1:02d}.json")
+
+
+def write_artifact(path, lines, calibration, rc, argv):
+    """The machine-readable bench artifact (schema shared with
+    scripts/check_bench.py's driver-artifact reader: metric lines
+    live in 'tail', one JSON object per line)."""
+    doc = {
+        "round": None,
+        "cmd": "python bench.py " + " ".join(argv),
+        "rc": rc,
+        "calibration": calibration,
+        "tail": "\n".join(json.dumps(ln) for ln in lines),
+    }
+    import re
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    if m:
+        doc["round"] = int(m.group(1))
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# wrote {path} ({len(lines)} metric line(s))",
+          file=sys.stderr)
 
 
 def config_telemetry(events, start_idx, iter_stats):
@@ -350,7 +404,8 @@ def config_telemetry(events, start_idx, iter_stats):
     for ev in events.events[start_idx:]:
         if ev["kind"] == "health":
             health = {k: v for k, v in ev.items()
-                      if k not in ("t", "kind", "where")}
+                      if k not in ("t", "tm", "pid", "session",
+                                   "kind", "where")}
     shrinks = [ev for ev in events.events[start_idx:]
                if ev["kind"] == "mesh_shrink"]
     topology = None
@@ -444,6 +499,17 @@ def main() -> int:
                          "from an audit-failing build; 'error' "
                          "additionally fails the config at build "
                          "time, 'off' omits the field")
+    ap.add_argument("-json-out", default="auto", dest="json_out",
+                    metavar="auto|off|FILE",
+                    help="write the machine-readable BENCH artifact "
+                         "('auto' = next BENCH_rNN.json in the cwd — "
+                         "the hand-assembly gap that left the bench "
+                         "trajectory empty; 'off' disables)")
+    ap.add_argument("-ledger", default="PERFLEDGER.jsonl",
+                    metavar="FILE",
+                    help="append every metric line to the persistent "
+                         "perf ledger (lux_tpu/observe.py; 'off' "
+                         "disables)")
     ap.add_argument("-verbose", action="store_true")
     args = ap.parse_args()
     if args.repeats < 1:
@@ -459,7 +525,7 @@ def main() -> int:
     else:
         args.min_fill_dot = args.min_fill
 
-    from lux_tpu import resilience, telemetry
+    from lux_tpu import observe, resilience, telemetry
 
     configs = ([args.config] if args.config and not args.all
                else ["cc", "sssp", "sssp-delta", "colfilter",
@@ -469,6 +535,31 @@ def main() -> int:
     # timed_run events are the per-config telemetry field; -events
     # additionally streams them to disk as JSONL)
     events = telemetry.EventLog(args.events)
+    # session calibration FIRST (lux_tpu/observe.py): the fixed-cost
+    # reference probe stamps every metric line with this process's
+    # measured primitive rate vs the canonical figures, so a
+    # degraded-tunnel session is labeled at the source.  A probe
+    # crash must not take down the bench — the lines then carry
+    # calibration=null, which check_bench fails LOUDLY, never
+    # silently.
+    fingerprint = None
+    with telemetry.use(events=events):
+        try:
+            fingerprint = observe.calibrate()
+        except Exception as e:  # noqa: BLE001
+            print(f"# calibration probe failed "
+                  f"({type(e).__name__}: {e}); metric lines will "
+                  f"carry calibration=null", file=sys.stderr)
+    cal_digest = None if fingerprint is None else fingerprint.digest()
+    if fingerprint is not None and fingerprint.grade == "degraded":
+        print(f"# WARNING: DEGRADED session — gather probe "
+              f"{fingerprint.deviation:.2f}x off canonical "
+              f"(PERF_NOTES tunnel variance); lines are labeled and "
+              f"check_bench will reject them from the trajectory",
+              file=sys.stderr)
+    ledger = (None if args.ledger == "off"
+              else observe.PerfLedger(args.ledger))
+    metric_lines = []
     for config in configs:
         report = resilience.RunReport()
         policy = resilience.RetryPolicy(retries=max(0, args.retries),
@@ -509,20 +600,48 @@ def main() -> int:
                 # configs or the tail-line headline metric the driver
                 # records
                 failures += 1
-                print(json.dumps(
-                    {"metric": f"{config}_FAILED",
-                     "error": f"{type(e).__name__}: {e}"[:300],
-                     "attempts": report.attempts,
-                     "failure_class": resilience.classify(e)}),
-                    flush=True)
+                failed = {"metric": f"{config}_FAILED",
+                          "error": f"{type(e).__name__}: {e}"[:300],
+                          "attempts": report.attempts,
+                          "failure_class": resilience.classify(e)}
+                print(json.dumps(failed), flush=True)
+                metric_lines.append(failed)
                 continue
         if report.attempts > 1:
             extra = dict(extra, run_attempts=report.attempts)
-        emit(name, samples, extra, attempts=attempts,
-             discarded=discarded,
-             telemetry=config_telemetry(events, idx0, st))
+        line = emit(name, samples, extra, attempts=attempts,
+                    discarded=discarded,
+                    telemetry=config_telemetry(events, idx0, st),
+                    calibration=cal_digest)
+        metric_lines.append(line)
+        if ledger is not None and fingerprint is not None:
+            try:
+                ledger.append("bench", line, fingerprint)
+            except OSError as e:
+                print(f"# perf-ledger append failed: {e}",
+                      file=sys.stderr)
     events.close()
-    return 1 if failures == len(configs) else 0
+    rc = 1 if failures == len(configs) else 0
+    if args.json_out != "off" and metric_lines:
+        grade = (cal_digest or {}).get("grade")
+        if args.json_out == "auto" and grade != "canonical":
+            # the BENCH_rNN series IS the trajectory: an auto-minted
+            # artifact from a CPU smoke run or a degraded tunnel
+            # session would enter it (and trip the repo artifact
+            # audit).  The ledger keeps the labeled lines; an
+            # explicit -json-out FILE still writes anywhere.
+            print(f"# artifact suppressed (session grade="
+                  f"{grade}); lines are in the ledger only — pass "
+                  f"-json-out FILE to force a file", file=sys.stderr)
+        else:
+            path = (next_artifact_path() if args.json_out == "auto"
+                    else args.json_out)
+            try:
+                write_artifact(path, metric_lines, cal_digest, rc,
+                               sys.argv[1:])
+            except OSError as e:
+                print(f"# artifact write failed: {e}", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

@@ -29,6 +29,10 @@ Layout:
   check.py      fixed-point correctness audits (the reference's -check)
   audit.py      compile-time program auditor (jaxpr invariant checks;
                 repo-wide: python -m lux_tpu.audit)
+  observe.py    performance observatory: session-calibration probe,
+                phase-cost attribution vs scalemodel, persistent perf
+                ledger + carried-debt registry
+                (report: python -m lux_tpu.observe)
   native/       C++ converter CLI and partition-slice loader
 """
 

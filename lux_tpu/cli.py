@@ -41,6 +41,11 @@ Flags (reference names kept):
                 py): warn prints findings, error refuses a violating
                 build with a typed AuditError (exit 2).  Repo-wide
                 form: python -m lux_tpu.audit
+  -calibrate    session-calibration probe before the run (lux_tpu/
+                observe.py): prints/emits the fingerprint (measured
+                probe ns/elem vs canonical, platform, ndev, grade) —
+                a degraded tunnel session is labeled up front.
+                Phase-decomposition report: python -m lux_tpu.observe
 
 Timing methodology matches the reference: wall clock around the
 iteration loop only, printed as ``ELAPSED TIME = ... s`` plus GTEPS
@@ -195,6 +200,14 @@ def _common(ap: argparse.ArgumentParser):
                          "separate fenced programs — read relative "
                          "weights, not GTEPS; iter 0 includes "
                          "compilation)")
+    ap.add_argument("-calibrate", action="store_true",
+                    help="run the session-calibration probe "
+                         "(lux_tpu/observe.py) before the run and "
+                         "print/emit the fingerprint — labels this "
+                         "process's measured primitive rate vs the "
+                         "canonical PERF_NOTES figures, so a "
+                         "degraded tunnel session is detected before "
+                         "any number is read")
 
 
 def _load(args, weighted: bool):
@@ -252,6 +265,24 @@ def _print_phases(report, tel=None):
                           for k, v in t.items()} for t in report])
 
 
+def _maybe_calibrate(args):
+    """-calibrate: run (or reuse) the session probe and print the
+    fingerprint header; inside a telemetry scope the ``calibration``
+    event lands in the log too (observe.calibrate emits it)."""
+    if not getattr(args, "calibrate", False):
+        return
+    from lux_tpu import observe
+    fp = observe.calibrate()
+    print(f"# calibration: session {fp.session} {fp.platform}/"
+          f"{fp.backend} ndev={fp.ndev} grade={fp.grade} — gather "
+          f"{fp.probe['gather_small_ns']:.2f} ns/elem "
+          f"({fp.deviation:.2f}x canonical)")
+    if fp.grade == "degraded":
+        print("# WARNING: DEGRADED session (PERF_NOTES tunnel "
+              "variance) — numbers from this process are labeled, "
+              "not trusted")
+
+
 @contextlib.contextmanager
 def _telemetry(args, app):
     """Scope the run's telemetry sinks (lux_tpu/telemetry.py) from
@@ -261,6 +292,7 @@ def _telemetry(args, app):
     from lux_tpu import telemetry
 
     if not (args.events or args.iter_stats):
+        _maybe_calibrate(args)
         yield telemetry.current()
         return
     ev = telemetry.EventLog(args.events) if args.events else None
@@ -270,6 +302,7 @@ def _telemetry(args, app):
             tel.emit("run_start", schema=telemetry.SCHEMA, app=app,
                      file=args.file, mesh=args.mesh,
                      np=args.np or None)
+            _maybe_calibrate(args)
             yield tel
     finally:
         if ev is not None:

@@ -182,6 +182,77 @@ def project_pull(ne: int, nv: int, chips: int, *,
                       gteps_per_chip=gteps / chips, efficiency=eff)
 
 
+def phase_model(*, engine: str, exchange: str, ne: int, nv: int,
+                kdim: int = 1, pair_coverage: float = 0.0,
+                pair_row_inflation: float = 1.0,
+                chunk_inflation: float = 1.2,
+                state_bytes_per_vertex: int = 4,
+                dot: bool = False, scale: float = 1.0) -> dict:
+    """Per-PHASE predicted nanoseconds for ONE engine iteration — the
+    model side of the observatory's measured-vs-model drift check
+    (lux_tpu/observe.py).  Keys match the engines' ``timed_phases``
+    phase names; a value of None means the phase has no measured
+    constant to price it (verdict "unmodeled" downstream) — honesty
+    over coverage, per the round-3 rule that un-measured figures are
+    flagged models.
+
+    ``scale`` rescales every priced constant by the session
+    calibration factor (observe.session_scale: this session's measured
+    gather rate over the canonical figure), so predictions are in THIS
+    session's nanoseconds — that is what makes a CPU or degraded-
+    tunnel comparison meaningful at all.
+
+    Phase attribution of the project_pull aggregate:
+    - gather/relax       per-edge delivery (the ~90%% term): residual
+                         edges at the gather rate + pair rows at the
+                         150+5.5K ns row cost
+    - gen_exchange       owner path: the whole per-slot scan
+                         (gather+partials+combine folded, per padded
+                         slot) + the pair-row term
+    - gather_reduce /    streamed single-phase delivery: same total as
+      relax_reduce /     gather+reduce (the fused block loop)
+      dot_reduce
+    - apply/update       per-vertex epilogue (STATE_NS_PER_VERTEX)
+    - exchange           all_gather materialization: free on one chip
+                         (a reshape), ICI-priced per mesh chip
+    - reduce             no isolated measured constant: None
+    """
+    if engine not in ("pull", "push"):
+        raise ValueError(f"unknown engine {engine!r}")
+    cov = pair_coverage
+    pair_rows = ne * cov * pair_row_inflation / 128.0
+    pair_ns = pair_rows * pair_row_ns(kdim) * scale
+    residual_ne = ne * (1.0 - cov)
+    state_bytes = nv * state_bytes_per_vertex
+
+    if exchange == "owner":
+        deliver = residual_ne * chunk_inflation * OWNER_SLOT_NS * scale
+    else:
+        rate = (GATHER_BIG_NS if state_bytes > BIG_TABLE_BYTES
+                else GATHER_SMALL_NS)
+        if dot:
+            rate = residual_edge_ns(kdim)
+        deliver = residual_ne * rate * scale
+    apply_ns = nv * STATE_NS_PER_VERTEX * scale
+
+    model: dict[str, float | None] = {}
+    if exchange == "owner":
+        model["gen_exchange"] = deliver + pair_ns
+    else:
+        # single-chip all_gather is a reshape; comm pricing only
+        # applies on a mesh (project_pull) — unmodeled here
+        model["exchange"] = None
+        if dot:
+            model["dot_reduce"] = deliver + pair_ns
+        else:
+            key = "relax" if engine == "push" else "gather"
+            model[key] = deliver + pair_ns
+            model["reduce"] = None
+            model[f"{key}_reduce"] = deliver + pair_ns
+    model["update" if engine == "push" else "apply"] = apply_ns
+    return model
+
+
 def project_table(ne: int, nv: int, chip_counts=(1, 4, 8, 16, 64),
                   **kw) -> str:
     """Markdown projection table for PERF_NOTES."""

@@ -60,13 +60,30 @@ Counter semantics (what the buffers mean, engine by engine):
 
 from __future__ import annotations
 
+import binascii
 import contextlib
 import contextvars
 import dataclasses
 import json
+import os
 import time
 
 SCHEMA = 1
+
+# One id per PROCESS, minted at import: heartbeat drills append
+# multiple processes' events into one shared file, and wall clocks
+# ("t") skew across hosts while monotonic clocks ("tm") only order
+# within a process — (session, pid) is the merge key that makes the
+# combined log unambiguous (scripts/events_summary.py groups on it).
+# The observatory's calibration fingerprint (lux_tpu/observe.py)
+# embeds the same id, so a bench metric line, its event trail and its
+# PERFLEDGER records all name the same session.
+_SESSION = binascii.hexlify(os.urandom(6)).decode()
+
+
+def session_id() -> str:
+    """This process's 12-hex-char telemetry session id."""
+    return _SESSION
 
 # engines size their counter buffers with this unless overridden;
 # int32+uint32 per entry -> 32 KB fetched per run at the default
@@ -86,7 +103,13 @@ class EventLog:
         self._f = open(path, "a") if path else None
 
     def emit(self, kind: str, **fields) -> dict:
-        ev = {"t": round(time.time(), 6), "kind": str(kind), **fields}
+        # tm (monotonic) orders events WITHIN a process; t (wall)
+        # only roughly aligns processes.  pid+session disambiguate
+        # multi-process logs sharing one file (heartbeat drills).
+        ev = {"t": round(time.time(), 6),
+              "tm": round(time.monotonic(), 6),
+              "pid": os.getpid(), "session": _SESSION,
+              "kind": str(kind), **fields}
         self.events.append(ev)
         if self._f is not None:
             self._f.write(json.dumps(ev) + "\n")

@@ -66,6 +66,45 @@ def fence(x) -> None:
     fetch(_cksum_jit(*jax.tree.leaves(x)))
 
 
+def loop_bench(step, carry0, k: int, repeats: int = 3,
+               clock=time.perf_counter):
+    """The trusted microbenchmark recipe (PERF_NOTES rounds 2-3) as a
+    library call: ``step(carry) -> (scalar, carry)`` runs ``k`` times
+    inside ONE jitted ``fori_loop`` with a loop-DEPENDENT carry and a
+    scalar output, so XLA can neither hoist the work out of the loop
+    nor dead-code it, and the scalar fetch is the completion fence —
+    no multi-MB transfer is ever billed to the timed window.
+
+    Big operands ride the carry (jit ARGUMENTS, never closed-over
+    constants — the HTTP-413 wall); leave inputs you don't mutate in
+    the carry untouched.  One compile happens on the warmup call;
+    ``repeats`` timed calls follow on the warm cache.
+
+    ``clock`` is injectable for deterministic tests
+    (tests/test_observe.py).  Returns (seconds_per_step list — one
+    entry per repeat — and the warmup's scalar output).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def run(c0):
+        def body(_, c):
+            acc, cur = c
+            sv, cur = step(cur)
+            return (acc + sv, cur)
+        return jax.lax.fori_loop(0, k, body,
+                                 (jnp.float32(0), c0))[0]
+
+    r = jax.jit(run)
+    out = float(fetch(r(carry0)))      # compile + warm; fetch = fence
+    samples = []
+    for _ in range(repeats):
+        t0 = clock()
+        float(fetch(r(carry0)))
+        samples.append((clock() - t0) / k)
+    return samples, out
+
+
 def _trace_ctx(trace_dir):
     from lux_tpu.profiling import trace
     return trace(trace_dir)

@@ -298,7 +298,21 @@ def main():
         "telemetry": {"runs": [
             {"repeat": i, "iters": int(iters), "seconds": e}
             for i, e in enumerate(elapsed)], "counters": None},
+        # session-calibration fingerprint (lux_tpu/observe.py):
+        # check_bench rejects lines from degraded/uncalibrated
+        # sessions, so a 10x tunnel collapse is labeled at the source
+        "calibration": _calibration(),
         "iters": int(iters)}))
+
+
+def _calibration():
+    from lux_tpu import observe
+    try:
+        return observe.fingerprint_digest()
+    except Exception as e:  # noqa: BLE001 — labeling must not kill the run
+        print(f"# calibration probe failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return None
 
 
 if __name__ == "__main__":

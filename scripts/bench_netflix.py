@@ -149,7 +149,21 @@ def main():
         "telemetry": {"runs": [
             {"repeat": i, "iters": cfg["ni"], "seconds": e}
             for i, e in enumerate(elapsed)], "counters": None},
+        # session-calibration fingerprint (lux_tpu/observe.py):
+        # check_bench rejects lines from degraded/uncalibrated
+        # sessions, so a 10x tunnel collapse is labeled at the source
+        "calibration": _calibration(),
         "rmse": [round(r, 6) for r in (rmse0, rmse1, rmse2)]}))
+
+
+def _calibration():
+    from lux_tpu import observe
+    try:
+        return observe.fingerprint_digest()
+    except Exception as e:  # noqa: BLE001 — labeling must not kill the run
+        print(f"# calibration probe failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return None
 
 
 if __name__ == "__main__":

@@ -72,6 +72,17 @@ Checked per metric line:
   degraded-mesh GTEPS must never be compared against full-mesh lines
   silently.
 
+- calibration (round 12, lux_tpu/observe.py): the session-calibration
+  fingerprint digest every bench.py / bench_netflix / bench_bigscale
+  line now carries — {session, platform, backend, ndev, grade,
+  deviation, probe}.  Missing fails strict mode (pre-round-12
+  artifacts: -legacy-ok); null (a crashed probe) or any grade other
+  than "canonical" REJECTS the line: a session whose reference probe
+  ran >3x off the canonical PERF_NOTES figures (the 10x
+  tunnel-variance trap) or on a non-canonical platform is detected
+  and labeled at the source, and its numbers never enter the
+  trajectory silently.
+
 - telemetry.health (round 9, bench.py -health): the device-side
   watchdog digest — optional and null when off; present it must be a
   clean bill ({engine, tripped=false, flags=[], iters >= 0}; known
@@ -228,6 +239,13 @@ def check_line(obj: dict, *, legacy_ok: bool):
 
     errs += check_audit_field(name, obj)
 
+    if "calibration" not in obj:
+        (warns if legacy_ok else errs).append(
+            f"{name}: missing calibration field (pre-round-12 "
+            f"schema)")
+    else:
+        errs += check_calibration_field(name, obj)
+
     if NETFLIX_METRIC.match(name):
         errs += check_netflix_fields(name, obj)
     else:
@@ -377,6 +395,83 @@ def check_telemetry(name: str, obj: dict) -> list[str]:
             if numeric:
                 errs.append(f"{name}: telemetry.counters non-finite "
                             f"fields {numeric}")
+    return errs
+
+
+CAL_GRADES = ("canonical", "degraded", "uncalibrated")
+CAL_DEVIATION_BOUND = 3.0     # lux_tpu/observe.py DEVIATION_BOUND
+
+
+def check_calibration_field(name: str, obj: dict) -> list[str]:
+    """Round-12 session-calibration digest (lux_tpu/observe.py,
+    bench.py): a null field means the probe crashed — LOUDLY rejected
+    (the line is unlabeled).  Present it must be well-formed AND
+    grade "canonical": a "degraded" line was measured in a session
+    whose reference probe ran >3x off the canonical figures (the 10x
+    tunnel-variance trap, detected), and an "uncalibrated" line was
+    measured on a platform with no canonical figures at all (e.g. the
+    CPU test mesh) — neither may enter the trajectory silently.  A
+    "canonical" grade contradicting its own deviation number is also
+    rejected."""
+    cal = obj["calibration"]
+    if cal is None:
+        return [f"{name}: calibration is null — the session probe "
+                f"crashed, so the line is unlabeled and cannot enter "
+                f"the trajectory (rerun; lux_tpu/observe.py)"]
+    if not isinstance(cal, dict):
+        return [f"{name}: calibration must be null or a dict, got "
+                f"{cal!r}"]
+    errs = []
+    if not isinstance(cal.get("session"), str) or not cal.get("session"):
+        errs.append(f"{name}: calibration.session must be a non-empty "
+                    f"string, got {cal.get('session')!r}")
+    for k in ("platform", "backend"):
+        if not isinstance(cal.get(k), str):
+            errs.append(f"{name}: calibration.{k} must be a string, "
+                        f"got {cal.get(k)!r}")
+    nd = cal.get("ndev")
+    if not isinstance(nd, int) or isinstance(nd, bool) or nd < 1:
+        errs.append(f"{name}: calibration.ndev={nd!r} must be an "
+                    f"int >= 1")
+    probe = cal.get("probe")
+    if (not isinstance(probe, dict) or not probe
+            or not all(_is_num(v) and v >= 0 for v in probe.values())):
+        errs.append(f"{name}: calibration.probe must be a dict of "
+                    f"finite measured figures, got {probe!r}")
+    grade = cal.get("grade")
+    dev = cal.get("deviation")
+    if grade not in CAL_GRADES:
+        errs.append(f"{name}: calibration.grade={grade!r} not one of "
+                    f"{CAL_GRADES}")
+    elif grade != "canonical":
+        errs.append(
+            f"{name}: metric line from a {grade.upper()} session "
+            f"(probe deviation {dev!r}x vs canonical) — degraded or "
+            f"uncalibrated samples never enter the bench trajectory "
+            f"silently; rerun in a healthy tunnel session "
+            f"(lux_tpu/observe.py)")
+    if not _is_num(dev) or dev <= 0:
+        errs.append(f"{name}: calibration.deviation={dev!r} must be "
+                    f"a finite positive number")
+    elif grade == "canonical" and (dev > CAL_DEVIATION_BOUND
+                                   or dev < 1.0 / CAL_DEVIATION_BOUND):
+        errs.append(
+            f"{name}: calibration claims grade=canonical but "
+            f"deviation={dev} is outside "
+            f"[1/{CAL_DEVIATION_BOUND:g}, {CAL_DEVIATION_BOUND:g}]x "
+            f"— the digest contradicts itself")
+    aud = cal.get("audit")
+    if not isinstance(aud, dict) or not all(
+            isinstance(aud.get(k), int) and not isinstance(aud[k], bool)
+            and aud[k] >= 0 for k in ("errors", "warnings")):
+        errs.append(f"{name}: calibration.audit must be a dict with "
+                    f"int errors/warnings >= 0, got {aud!r}")
+    elif aud["errors"]:
+        errs.append(
+            f"{name}: calibration.audit records {aud['errors']} "
+            f"error(s) — the probe programs failed their own static "
+            f"audit (hoistable loop body / baked constant), so the "
+            f"fingerprint measured nothing and cannot label a line")
     return errs
 
 

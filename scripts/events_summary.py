@@ -26,6 +26,15 @@ human shape — and audits it while doing so:
   (or heartbeat-protocol process) count, and a ``replace`` without
   its from/to mesh — a degraded continuation must be fully diagnosed
   in its event trail.  ``budget_reset`` and ``straggler`` render.
+- round 12 (observatory, lux_tpu/observe.py): every event now carries
+  a monotonic ``tm`` plus ``pid``/``session`` fields, so
+  multi-process logs (heartbeat drills append several processes into
+  ONE file) merge unambiguously: events are grouped per
+  (session, pid) stream before run-splitting, each stream renders
+  under its own header, and a stream whose ``tm`` goes BACKWARDS
+  fails the audit (two processes' events conflated under one pid
+  means the merge key is lying).  ``calibration`` fingerprints and
+  ``drift``/``phase_cost`` attribution events render.
 
 Usage:
     python scripts/events_summary.py FILE [FILE...]
@@ -45,7 +54,8 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "retry", "failure", "budget_lock", "budget_halve",
          "budget_reset", "outlier_discard", "outlier_rerun", "health",
          "health_trip", "topology_fault", "mesh_shrink", "replace",
-         "straggler"}
+         "straggler", "calibration", "phase_cost", "drift",
+         "debt_collected"}
 
 # a health_trip without these fields cannot be diagnosed — the whole
 # point of the watchdog is a NAMED check at a NAMED iteration
@@ -86,8 +96,42 @@ def load_events(path: str):
     return events, errs
 
 
+def split_streams(events):
+    """Partition a flat (possibly multi-process) event list into
+    per-process streams keyed by (session, pid) — the round-12 merge
+    key that makes several processes appending into ONE file
+    unambiguous.  Events predating the fields (or hand-written logs)
+    share the legacy ``None`` stream.  Returns ([(key, events)],
+    errors) in first-appearance order; a stream whose monotonic
+    ``tm`` DECREASES is an error — one (session, pid) key can only
+    belong to one process, whose monotonic clock never goes back."""
+    streams, order, errs = {}, [], []
+    for ev in events:
+        key = None
+        if "session" in ev or "pid" in ev:
+            key = (ev.get("session"), ev.get("pid"))
+        if key not in streams:
+            streams[key] = []
+            order.append(key)
+        streams[key].append(ev)
+    for key in order:
+        last = None
+        for ev in streams[key]:
+            tm = ev.get("tm")
+            if not isinstance(tm, (int, float)) \
+                    or isinstance(tm, bool):
+                continue
+            if last is not None and tm < last:
+                errs.append(
+                    f"stream {key}: monotonic tm went backwards "
+                    f"({last} -> {tm}) — two processes' events "
+                    f"conflated under one (session, pid) key")
+            last = tm
+    return [(k, streams[k]) for k in order], errs
+
+
 def split_runs(events):
-    """Group the flat stream into runs at run_start/config_start
+    """Group one stream into runs at run_start/config_start
     boundaries (one CLI invocation / bench config each); a log
     without boundary events is one anonymous run."""
     runs, cur = [], []
@@ -262,6 +306,25 @@ def render_run(run, out=sys.stdout) -> list[str]:
     for d in by.get("outlier_discard", []):
         print(f"  outlier discarded: {d.get('sample')} "
               f"(median {d.get('median')})", file=out)
+    for c in by.get("calibration", []):
+        probe = c.get("probe") or {}
+        print(f"  calibration: session {c.get('session')} "
+              f"{c.get('platform')}/{c.get('backend')} "
+              f"ndev={c.get('ndev')} grade={c.get('grade')} "
+              f"(gather {probe.get('gather_small_ns')} ns/elem, "
+              f"deviation {c.get('deviation')}x)", file=out)
+    pc = by.get("phase_cost", [])
+    if pc:
+        apps = sorted({p.get("app") for p in pc})
+        print(f"  phase attribution: {len(pc)} phase(s) over "
+              f"{', '.join(str(a) for a in apps)}", file=out)
+    for d in by.get("drift", []):
+        print(f"  DRIFT ({d.get('app')}/{d.get('phase')}): "
+              f"{d.get('verdict')} — measured {d.get('measured_s')}s "
+              f"vs model {d.get('predicted_s')}s "
+              f"({d.get('ratio')}x)", file=out)
+    for d in by.get("debt_collected", []):
+        print(f"  carried debt collected: {d.get('debt')}", file=out)
 
     done = by.get("run_done", [])
     if done:
@@ -299,8 +362,13 @@ def main(argv=None) -> int:
             all_errs.append(f"{path}: unreadable ({e})")
             continue
         all_errs += [f"{path}: {e}" for e in errs]
-        for run in split_runs(events):
-            all_errs += [f"{path}: {e}" for e in render_run(run)]
+        streams, serrs = split_streams(events)
+        all_errs += [f"{path}: {e}" for e in serrs]
+        for key, stream in streams:
+            if key is not None and len(streams) > 1:
+                print(f"-- process session={key[0]} pid={key[1]} --")
+            for run in split_runs(stream):
+                all_errs += [f"{path}: {e}" for e in render_run(run)]
     for e in all_errs:
         print(f"ERROR: {e}", file=sys.stderr)
     if all_errs:

@@ -1,16 +1,24 @@
-"""Isolate TPU gather lowering variants: plain vs vmapped vs one-dim."""
+"""Isolate TPU gather lowering variants: plain vs vmapped vs one-dim.
+
+Round 12: ported onto the observatory recipe (lux_tpu.timing
+.loop_bench — loop-dependent inputs, scalar output, one jit, fetch
+fence).  The original block_until_ready timing pattern is exactly the
+trap PERF_NOTES documents (early returns through the tunnel + XLA
+hoisting loop-invariant work), so these figures supersede it.
+"""
 
 from __future__ import annotations
-
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lux_tpu.observe import median_mad
+from lux_tpu.timing import loop_bench
+
 V = 1 << 21
 N = 57636 * 1024  # ~59M slots
-REPS = 5
+K = 5
 
 rng = np.random.default_rng(0)
 state = jnp.asarray(rng.random(V, np.float32))
@@ -19,49 +27,36 @@ idx_2d = idx_flat.reshape(-1, 1024)
 idx_3d = idx_flat.reshape(-1, 8, 128)
 
 
-def timeit(name, fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    dt = (time.perf_counter() - t0) / REPS
-    print(f"{name:44s} {dt * 1e3:8.2f} ms  ({N / dt / 1e9:6.2f} G/s)")
+def timeit(name, gather_fn, idx):
+    """gather_fn(state, idx) -> gathered values; timed with a
+    loop-dependent state carry so the gather cannot hoist."""
+    def step(c):
+        s, i = c
+        sv = jnp.sum(gather_fn(s, i))
+        return sv, (s + sv * 1e-30, i)
+
+    samples, _ = loop_bench(step, (state, idx), K, repeats=3)
+    dt, mad = median_mad(samples)
+    print(f"{name:44s} {dt * 1e3:8.2f} ms  ({N / dt / 1e9:6.2f} G/s, "
+          f"mad {mad * 1e3:.2f} ms)")
     return dt
 
 
-timeit("take flat [N]", jax.jit(lambda s, i: jnp.take(s, i)), state,
-       idx_flat)
-timeit("take 2d [C,1024]", jax.jit(lambda s, i: jnp.take(s, i)), state,
+timeit("take flat [N]", lambda s, i: jnp.take(s, i), idx_flat)
+timeit("take 2d [C,1024]", lambda s, i: jnp.take(s, i), idx_2d)
+timeit("take 3d [C,8,128]", lambda s, i: jnp.take(s, i), idx_3d)
+
+timeit("vmapped take [1,C,1024]",
+       jax.vmap(lambda s, i: jnp.take(s, i), in_axes=(None, 0)),
+       idx_2d[None])
+timeit("vmapped take rows [C rows of 1024]",
+       jax.vmap(lambda s, i: jnp.take(s, i), in_axes=(None, 0)),
        idx_2d)
-timeit("take 3d [C,8,128]", jax.jit(lambda s, i: jnp.take(s, i)), state,
-       idx_3d)
 
-vm = jax.jit(jax.vmap(lambda s, i: jnp.take(s, i), in_axes=(None, 0)))
-timeit("vmapped take [1,C,1024]", vm, state, idx_2d[None])
+# exact engine formulation: reshape then take
+timeit("take axis=0 2d", lambda s, i: jnp.take(s, i, axis=0), idx_2d)
 
-vm1 = jax.jit(jax.vmap(lambda s, i: jnp.take(s, i), in_axes=(None, 0)))
-timeit("vmapped take rows [C rows of 1024]", vm1, state, idx_2d)
-
-# exact engine formulation: reshape then take then sum
-def engine_like(s, i):
-    v = jnp.take(s, i, axis=0)
-    return v
-
-timeit("take axis=0 2d", jax.jit(engine_like), state, idx_2d)
-
-# take_along_axis formulation
-def taa(s, i):
-    return jnp.take_along_axis(s[None, :].repeat(1, 0),
-                               i.reshape(1, -1), axis=1)
-
-# one-hot matmul small sanity skipped
-
-# sum fused
-def gsum(s, i):
-    return jnp.take(s, i.reshape(-1, 8, 128), axis=0).sum(axis=1)
-
-timeit("take+sum fused 3d", jax.jit(gsum), state, idx_flat)
+# sum fused over the middle axis
+timeit("take+sum fused 3d",
+       lambda s, i: jnp.take(s, i.reshape(-1, 8, 128), axis=0)
+       .sum(axis=1), idx_flat)

@@ -1,11 +1,16 @@
-"""Hoisting-proof microbenchmarks: every input is loop-dependent, output
-is a scalar, work runs K times inside one jit.  The ground truth for
-architecture selection."""
+"""Hoisting-proof microbenchmarks on the observatory recipe: every
+input is loop-dependent, output is a scalar, work runs K times inside
+one jit, the scalar fetch is the fence.  The ground truth for
+architecture selection.
+
+Round 12: the fence/loop-dependent-input boilerplate this script
+pioneered now lives in ``lux_tpu.timing.loop_bench`` (the calibration
+probe of ``lux_tpu/observe.py`` runs the same recipe at pinned
+shapes); this script keeps the architecture-selection kernels and
+reports median-of-3 per kernel.
+"""
 
 from __future__ import annotations
-
-import functools
-import time
 
 import jax
 import jax.numpy as jnp
@@ -13,18 +18,18 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from lux_tpu.observe import median_mad
+from lux_tpu.timing import loop_bench
+
 K = 10
 rng = np.random.default_rng(0)
 
 
-def bench(name, fn, args, n, unit="elem"):
-    run = jax.jit(fn)
-    out = run(*args)
-    float(out)
-    t0 = time.perf_counter()
-    float(run(*args))
-    dt = (time.perf_counter() - t0) / K
-    print(f"{name:46s} {dt * 1e3:8.2f} ms  ({dt / n * 1e9:6.2f} ns/{unit})")
+def bench(name, step, carry, n, unit="elem"):
+    samples, _ = loop_bench(step, carry, K, repeats=3)
+    dt, mad = median_mad(samples)
+    print(f"{name:46s} {dt * 1e3:8.2f} ms  "
+          f"({dt / n * 1e9:6.2f} ns/{unit}, mad {mad / n * 1e9:.2f})")
 
 
 # ---- 1. XLA gather, loop-dependent table --------------------------------
@@ -34,16 +39,13 @@ table0 = jnp.asarray(rng.random(V, np.float32))
 idx = jnp.asarray(rng.integers(0, V, N).astype(np.int32))
 
 
-def g_run(t0, i):
-    def body(_, c):
-        s, t = c
-        v = jnp.take(t, i, axis=0)
-        sv = jnp.sum(v)
-        return (s + sv, t + sv * 1e-30)
-    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), t0))[0]
+def g_step(c):
+    t, i = c
+    sv = jnp.sum(jnp.take(t, i, axis=0))
+    return sv, (t + sv * 1e-30, i)
 
 
-bench("xla gather 33.5M (loop-dep)", g_run, (table0, idx), N)
+bench("xla gather 33.5M (loop-dep)", g_step, (table0, idx), N)
 
 # ---- 2. pallas lane shuffle axis=1 --------------------------------------
 R = 1 << 18
@@ -67,16 +69,14 @@ def lane_shuffle(x, i):
     )(x, i)
 
 
-def s_run(x0, i):
-    def body(_, c):
-        s, x = c
-        v = lane_shuffle(x, i)
-        sv = jnp.sum(v[0])
-        return (s + sv, x + sv * 1e-30)
-    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), x0))[0]
+def s_step(c):
+    x, i = c
+    sv = jnp.sum(lane_shuffle(x, i)[0])
+    return sv, (x + sv * 1e-30, i)
 
 
-bench("pallas lane shuffle 33.5M (loop-dep)", s_run, (x0, sidx), R * 128)
+bench("pallas lane shuffle 33.5M (loop-dep)", s_step, (x0, sidx),
+      R * 128)
 
 # ---- 3. sublane gather axis=0, M=8 --------------------------------------
 sidx8 = jnp.asarray(rng.integers(0, 8, (R, 128)).astype(np.int32))
@@ -98,31 +98,27 @@ def sub_shuffle(x, i):
     )(x, i)
 
 
-def sub_run(x0, i):
-    def body(_, c):
-        s, x = c
-        v = sub_shuffle(x, i)
-        sv = jnp.sum(v[0])
-        return (s + sv, x + sv * 1e-30)
-    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), x0))[0]
+def sub_step(c):
+    x, i = c
+    sv = jnp.sum(sub_shuffle(x, i)[0])
+    return sv, (x + sv * 1e-30, i)
 
 
-bench("pallas sublane shuffle M=8 (loop-dep)", sub_run, (x0, sidx8), R * 128)
+bench("pallas sublane shuffle M=8 (loop-dep)", sub_step, (x0, sidx8),
+      R * 128)
 
 # ---- 4. transpose -------------------------------------------------------
 xt0 = jnp.asarray(rng.random((8192, 4096), np.float32))
 
 
-def t_run(x0):
-    def body(_, c):
-        s, x = c
-        v = x.T
-        sv = jnp.sum(v[0])
-        return (s + sv, x + sv * 1e-30)
-    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), x0))[0]
+def t_step(c):
+    (x,) = c
+    sv = jnp.sum(x.T[0])
+    return sv, (x + sv * 1e-30,)
 
 
-bench("xla transpose 33.5M f32 (loop-dep)", t_run, (xt0,), 8192 * 4096)
+bench("xla transpose 33.5M f32 (loop-dep)", t_step, (xt0,),
+      8192 * 4096)
 
 # ---- 5. v3 compare kernel -----------------------------------------------
 E = 512
@@ -158,16 +154,13 @@ def v3(vals, rel):
     )(vals, rel)
 
 
-def v3_run(v0, r):
-    def body(_, c):
-        s, x = c
-        out = v3(x, r)
-        sv = jnp.sum(out[0])
-        return (s + sv, x + sv * 1e-30)
-    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), v0))[0]
+def v3_step(c):
+    x, r = c
+    sv = jnp.sum(v3(x, r)[0])
+    return sv, (x + sv * 1e-30, r)
 
 
-bench("v3 compare reduce 33.5M edges (loop-dep)", v3_run, (vals0, rel),
+bench("v3 compare reduce 33.5M edges (loop-dep)", v3_step, (vals0, rel),
       E * NB * 128, "edge")
 
 # ---- 6. VPU chained adds ------------------------------------------------
@@ -191,21 +184,14 @@ def chain(x):
     )(x)
 
 
-def c_run(x0):
-    def body(_, c):
-        s, x = c
-        v = chain(x)
-        sv = jnp.sum(v[0])
-        return (s + sv, x + sv * 1e-30)
-    return jax.lax.fori_loop(0, K, body, (jnp.float32(0), x0))[0]
+def c_step(c):
+    (x,) = c
+    sv = jnp.sum(chain(x)[0])
+    return sv, (x + sv * 1e-30,)
 
 
-run = jax.jit(c_run)
-out = run(x0)
-float(out)
-t0 = time.perf_counter()
-float(run(x0))
-dt = (time.perf_counter() - t0) / K
+samples, _ = loop_bench(c_step, (x0,), K, repeats=3)
+dt, _mad = median_mad(samples)
 ops = 64 * R * 128
 print(f"{'vpu 64 ops/elem chain (loop-dep)':46s} {dt * 1e3:8.2f} ms  "
       f"({ops / dt / 1e12:6.2f} Tops/s)")

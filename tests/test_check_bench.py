@@ -17,6 +17,18 @@ REPO = Path(__file__).resolve().parent.parent
 SCRIPT = REPO / "scripts" / "check_bench.py"
 ARTIFACTS = sorted(REPO.glob("BENCH_r0*.json"))
 
+# round 12: the session-calibration fingerprint digest every new
+# metric line carries (lux_tpu/observe.py) — grade must be
+# "canonical" or the line is rejected from the trajectory
+GOOD_CAL = {
+    "schema": 1, "session": "a1b2c3d4e5f6", "platform": "tpu",
+    "backend": "tpu", "ndev": 1, "grade": "canonical",
+    "deviation": 1.07,
+    "probe": {"gather_small_ns": 9.6, "gather_small_mad_ns": 0.2,
+              "pair_dot_row_ns": 121.0, "pair_dot_row_mad_ns": 4.0},
+    "audit": {"errors": 0, "warnings": 0},
+}
+
 GOOD_LINE = {
     "metric": "pagerank_mp_rmat23_gteps_per_chip",
     "value": 0.1118, "unit": "GTEPS", "vs_baseline": 0.1118,
@@ -37,6 +49,7 @@ GOOD_LINE = {
                      "residual_last": 9.7e-8,
                      "changed_last": 12, "changed_sum": 480},
     },
+    "calibration": GOOD_CAL,
 }
 
 
@@ -185,6 +198,64 @@ def test_bad_audit_digests_fail(tmp_path, audit, needle):
     assert needle in r.stderr
 
 
+# -- round-12 calibration fingerprint (lux_tpu/observe.py) -------------
+
+def test_missing_calibration_fails_strict(tmp_path):
+    """Pre-round-12 lines lack the fingerprint; strict mode fails
+    loudly, -legacy-ok downgrades (historical artifacts)."""
+    d = json.loads(json.dumps(GOOD_LINE))
+    del d["calibration"]
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1 and "missing calibration" in r.stderr
+    assert run_check("-legacy-ok", p).returncode == 0
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    # a crashed probe (null) leaves the line unlabeled — rejected
+    (lambda c: None, "calibration is null"),
+    # the 10x tunnel session, detected and labeled — rejected
+    (lambda c: dict(c, grade="degraded", deviation=9.7),
+     "DEGRADED session"),
+    # CPU test-mesh numbers must never enter the TPU trajectory
+    (lambda c: dict(c, grade="uncalibrated", platform="cpu",
+                    deviation=0.16), "UNCALIBRATED session"),
+    # a self-contradicting digest (claims canonical, deviation 5x)
+    (lambda c: dict(c, deviation=5.0), "contradicts itself"),
+    (lambda c: dict(c, grade="excellent"), "calibration.grade"),
+    (lambda c: dict(c, deviation="fast"), "calibration.deviation"),
+    (lambda c: dict(c, probe={}), "calibration.probe"),
+    # a probe that failed its own static audit measured nothing
+    (lambda c: dict(c, audit={"errors": 1, "warnings": 0}),
+     "failed their own static audit"),
+    (lambda c: dict(c, audit=None), "calibration.audit"),
+    (lambda c: dict(c, ndev=0), "calibration.ndev"),
+    (lambda c: dict(c, session=""), "calibration.session"),
+    (lambda c: "calibrated", "null or a dict"),
+])
+def test_bad_calibration_digests_fail(tmp_path, mutate, needle):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["calibration"] = mutate(json.loads(json.dumps(GOOD_CAL)))
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert needle in r.stderr, r.stderr
+
+
+def test_fast_deviation_also_contradicts(tmp_path):
+    """deviation < 1/3 on a 'canonical' grade is as contradictory as
+    > 3 — a probe that measured 5x FASTER than canon is lying about
+    something (clock, fence, or shapes)."""
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["calibration"] = dict(GOOD_CAL, deviation=0.2)
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1 and "contradicts itself" in r.stderr
+
+
 def test_failed_config_line_schema(tmp_path):
     good = {"metric": "sssp_FAILED", "error": "RuntimeError: worker",
             "attempts": 3, "failure_class": "retryable"}
@@ -255,6 +326,7 @@ NETFLIX_LINE = {
         {"repeat": 1, "iters": 3, "seconds": 186e6 * 3 / 0.0905 / 1e9},
         {"repeat": 2, "iters": 3, "seconds": 186e6 * 3 / 0.0896 / 1e9},
     ], "counters": None},
+    "calibration": GOOD_CAL,
     "rmse": [2.926, 2.800, 2.714],
 }
 
@@ -268,6 +340,7 @@ BIGSCALE_LINE = {
     "telemetry": {"runs": [
         {"repeat": 0, "iters": 1, "seconds": 2_147_483_648 / 0.11 / 1e9},
     ], "counters": None},
+    "calibration": GOOD_CAL,
 }
 
 

@@ -200,6 +200,82 @@ def test_multi_run_log_splits(tmp_path):
     assert "timed runs: 1" in r.stdout
 
 
+# -- round-12 multi-process merge + observatory events -----------------
+
+def _proc_events(session, pid, app, tm0):
+    return [
+        {"t": 9.0, "tm": tm0, "pid": pid, "session": session,
+         "kind": "run_start", "app": app},
+        {"t": 9.1, "tm": tm0 + 0.1, "pid": pid, "session": session,
+         "kind": "timed_run", "repeat": 0, "iters": 3,
+         "seconds": 0.05},
+    ]
+
+
+def test_multi_process_log_merges_by_session_pid(tmp_path):
+    """Two processes interleaved in ONE shared file (the heartbeat
+    drill shape): events group per (session, pid) stream, each
+    rendering under its own process header — never conflated into one
+    run."""
+    a = _proc_events("aaaa11112222", 100, "pagerank", 5.0)
+    b = _proc_events("bbbb33334444", 200, "sssp", 50.0)
+    # fully interleaved on disk
+    merged = [a[0], b[0], a[1], b[1]]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, merged)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "-- process session=aaaa11112222 pid=100 --" in out
+    assert "-- process session=bbbb33334444 pid=200 --" in out
+    assert "== pagerank ==" in out and "== sssp ==" in out
+    # each stream keeps its own timed run (not 2 in one run)
+    assert out.count("timed runs: 1") == 2
+
+
+def test_backwards_monotonic_tm_fails(tmp_path):
+    """One (session, pid) stream whose monotonic clock goes BACKWARDS
+    means two processes' events were conflated under one key — the
+    merge audit fails."""
+    a = _proc_events("aaaa11112222", 100, "pagerank", 5.0)
+    a[1]["tm"] = 1.0                     # earlier than run_start's 5.0
+    p = tmp_path / "ev.jsonl"
+    write_log(p, a)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "monotonic tm went backwards" in r.stderr
+
+
+def test_observatory_events_render(tmp_path):
+    events = [
+        {"t": 9.0, "kind": "run_start", "app": "pagerank"},
+        {"t": 9.1, "kind": "calibration", "schema": 1,
+         "session": "aaaa11112222", "platform": "tpu",
+         "backend": "tpu", "ndev": 4, "grade": "canonical",
+         "deviation": 1.05,
+         "probe": {"gather_small_ns": 9.4}},
+        {"t": 9.2, "kind": "phase_cost", "app": "pagerank",
+         "phase": "gather", "median_s": 0.01, "mad_s": 0.001,
+         "predicted_s": 0.009, "verdict": "ok"},
+        {"t": 9.3, "kind": "drift", "app": "pagerank",
+         "phase": "apply", "verdict": "drift_slow",
+         "measured_s": 0.02, "predicted_s": 0.002, "ratio": 10.0,
+         "session": "aaaa11112222"},
+        {"t": 9.4, "kind": "debt_collected",
+         "debt": "pair-dot-row-k-sweep"},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "calibration: session aaaa11112222" in out
+    assert "grade=canonical" in out
+    assert "phase attribution: 1 phase(s)" in out
+    assert "DRIFT (pagerank/apply): drift_slow" in out
+    assert "carried debt collected: pair-dot-row-k-sweep" in out
+
+
 # -- round-11 elastic-recovery events ----------------------------------
 
 ELASTIC = [
