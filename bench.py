@@ -414,9 +414,16 @@ def config_telemetry(events, start_idx, iter_stats):
         topology = {"shrinks": len(shrinks),
                     "ndev_final": last.get("to_ndev",
                                            last.get("to_nproc"))}
+    # round 13 (lux_tpu/tracing.py era): the per-part imbalance digest
+    # — {kind, index (max/mean per-part work), parts (per-part
+    # totals)} — null when -iter-stats was off or the engine predates
+    # per-part counters.  check_bench cross-validates the index
+    # against the parts and the parts sum against the scalar counters.
     return {"runs": runs,
             "counters": (iter_stats.summary()
                          if iter_stats is not None else None),
+            "imbalance": (iter_stats.imbalance_digest()
+                          if iter_stats is not None else None),
             "health": health,
             "topology": topology}
 
@@ -510,8 +517,18 @@ def main() -> int:
                     help="append every metric line to the persistent "
                          "perf ledger (lux_tpu/observe.py; 'off' "
                          "disables)")
+    ap.add_argument("-flight", default=None, metavar="FILE",
+                    help="install the crash flight recorder "
+                         "(lux_tpu/tracing.py): the resilience "
+                         "supervisor dumps the recent-event ring + "
+                         "last health word to FILE on fatal/topology "
+                         "failures, so a config that dies through "
+                         "the tunnel stays diagnosable")
     ap.add_argument("-verbose", action="store_true")
     args = ap.parse_args()
+    if args.flight:
+        from lux_tpu import tracing
+        tracing.install_flight_recorder(args.flight)
     if args.repeats < 1:
         ap.error("-repeats must be >= 1")
     if args.min_fill < -1:

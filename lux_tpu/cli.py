@@ -200,6 +200,16 @@ def _common(ap: argparse.ArgumentParser):
                          "separate fenced programs — read relative "
                          "weights, not GTEPS; iter 0 includes "
                          "compilation)")
+    ap.add_argument("-flight", default=None, metavar="FILE",
+                    help="install the crash flight recorder "
+                         "(lux_tpu/tracing.py): a bounded ring of "
+                         "recent telemetry events plus the last "
+                         "health word and placement metadata, dumped "
+                         "atomically to FILE by the resilience "
+                         "supervisor on fatal failures and topology "
+                         "faults — a dead run through the tunnel "
+                         "stays diagnosable after the fact (render: "
+                         "scripts/events_summary.py -flight FILE)")
     ap.add_argument("-calibrate", action="store_true",
                     help="run the session-calibration probe "
                          "(lux_tpu/observe.py) before the run and "
@@ -291,6 +301,9 @@ def _telemetry(args, app):
     counter-free programs."""
     from lux_tpu import telemetry
 
+    if getattr(args, "flight", None):
+        from lux_tpu import tracing
+        tracing.install_flight_recorder(args.flight)
     if not (args.events or args.iter_stats):
         _maybe_calibrate(args)
         yield telemetry.current()
@@ -322,6 +335,10 @@ def _finish_run(tel, elapsed, iters):
     print("# iter-stats (device-side counters, fused run):")
     for line in st.replay_lines():
         print(line)
+    # per-part imbalance attribution (round 13): the measured skew
+    # signal the locality-aware partitioner will optimize
+    for line in st.parts_lines():
+        print(f"# {line}")
     # the digest's "kind" (push|pull) would shadow the event kind
     tel.emit("iter_stats", **{("engine" if k == "kind" else k): v
                               for k, v in st.summary().items()})

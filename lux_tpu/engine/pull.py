@@ -744,8 +744,8 @@ class PullEngine(AuditableEngine):
         if fused:
             if self.health:
                 from lux_tpu import health as hw
-                state, _it, _rb, _cb, h = self.run_health(state,
-                                                          num_iters)
+                state, _it, _rb, _cb, _rbp, _cbp, h = \
+                    self.run_health(state, num_iters)
                 hw.ensure_ok(h, engine="pull", where="pull run")
                 return state
             return self._run_fused(state, num_iters)
@@ -756,34 +756,45 @@ class PullEngine(AuditableEngine):
     def _iter_counters(self, new, old):
         """Per-iteration device-side counters shared by the stats
         loops: (max-abs state change — the residual run_until
-        converges on, count of vertices whose state changed).
-        Computed on the sharded global arrays like _run_until's
-        residual; O(state), tiny next to the O(edges) gather."""
+        converges on, count of vertices whose state changed), PLUS
+        the round-13 per-part split (residual per part [P] float32,
+        changed vertices per part [P] uint32).  The scalars are
+        derived FROM the per-part rows (max of maxes / sum of sums),
+        so max-over-parts and sum-over-parts are bitwise-exact by
+        construction.  Computed on the sharded global arrays like
+        _run_until's residual; O(state), tiny next to the O(edges)
+        gather — and NO gathers at all (audit gather-budget holds)."""
         d = jnp.abs(new.astype(jnp.float32) - old.astype(jnp.float32))
-        res = jnp.max(d)
+        res_p = jnp.max(d.reshape(d.shape[0], -1), axis=1)     # [P]
         if d.ndim > 2:                        # K-vector payloads
             d = d.reshape(d.shape[0], d.shape[1], -1).max(axis=-1)
-        changed = jnp.sum((d > 0).astype(jnp.uint32))
-        return res, changed
+        chg_p = jnp.sum((d > 0).astype(jnp.uint32), axis=1)    # [P]
+        return jnp.max(res_p), jnp.sum(chg_p), res_p, chg_p
+
+    def _stats_bufs(self):
+        cap, P = self.stats_cap, self.sg.num_parts
+        return (jnp.zeros((cap,), jnp.float32),
+                jnp.zeros((cap,), jnp.uint32),
+                jnp.zeros((cap, P), jnp.float32),
+                jnp.zeros((cap, P), jnp.uint32))
 
     @functools.cached_property
     def _run_stats_fused(self):
         core = self._step_core
-        cap = self.stats_cap
 
         @functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
         def run(state, num_iters, *gargs):
             def body(i, c):
-                s, res, chg = c
+                s, res, chg, resp, chgp = c
                 new = core(s, *gargs)
-                r, cnt = self._iter_counters(new, s)
+                r, cnt, rp, cp = self._iter_counters(new, s)
                 return (new, res.at[i].set(r, mode="drop"),
-                        chg.at[i].set(cnt, mode="drop"))
+                        chg.at[i].set(cnt, mode="drop"),
+                        resp.at[i].set(rp, mode="drop"),
+                        chgp.at[i].set(cp, mode="drop"))
 
             return jax.lax.fori_loop(
-                0, num_iters, body,
-                (state, jnp.zeros((cap,), jnp.float32),
-                 jnp.zeros((cap,), jnp.uint32)))
+                0, num_iters, body, (state, *self._stats_bufs()))
 
         self._register_variant(
             "run_stats", run,
@@ -793,11 +804,16 @@ class PullEngine(AuditableEngine):
     def run_stats(self, state, num_iters: int):
         """``run(fused=True)`` + device-side iteration counters
         accumulated inside the fori_loop: returns (state, residual
-        float32 [stats_cap], changed uint32 [stats_cap]) where
-        residual[i] is iteration i's max-abs state change and
-        changed[i] its changed-vertex count (see lux_tpu/telemetry.py;
-        writes past stats_cap drop).  Fetch the buffers once per
-        run/segment — a few KB, independent of graph size."""
+        float32 [stats_cap], changed uint32 [stats_cap], residual
+        per part float32 [stats_cap, P], changed per part uint32
+        [stats_cap, P]) where residual[i] is iteration i's max-abs
+        state change and changed[i] its changed-vertex count (see
+        lux_tpu/telemetry.py; writes past stats_cap drop).  The
+        per-part counters are the imbalance-attribution signal:
+        scalar = max/sum over the per-part row, bitwise
+        (tests/test_telemetry.py holds the NumPy per-part oracle).
+        Fetch the buffers once per run/segment — a few KB,
+        independent of graph size."""
         return self._run_stats_fused(state, num_iters)
 
     @functools.cached_property
@@ -838,29 +854,29 @@ class PullEngine(AuditableEngine):
     @functools.cached_property
     def _run_until_stats(self):
         core = self._step_core
-        cap = self.stats_cap
 
         @functools.partial(jax.jit, donate_argnums=0)
         def run(state, tol, max_iters, *gargs):
             def cond(c):
-                it, s, res, rb, cb = c
+                it, s, res = c[:3]
                 # non-finite-safe, see _run_until's cond
                 return jnp.logical_not(res <= tol) & (it < max_iters)
 
             def body(c):
-                it, s, _res, rb, cb = c
+                it, s, _res, rb, cb, rbp, cbp = c
                 new = core(s, *gargs)
-                r, cnt = self._iter_counters(new, s)
+                r, cnt, rp, cp = self._iter_counters(new, s)
                 return (it + 1, new, r,
                         rb.at[it].set(r, mode="drop"),
-                        cb.at[it].set(cnt, mode="drop"))
+                        cb.at[it].set(cnt, mode="drop"),
+                        rbp.at[it].set(rp, mode="drop"),
+                        cbp.at[it].set(cp, mode="drop"))
 
-            it, s, res, rb, cb = jax.lax.while_loop(
+            it, s, res, rb, cb, rbp, cbp = jax.lax.while_loop(
                 cond, body,
                 (jnp.int32(0), state, jnp.float32(jnp.inf),
-                 jnp.zeros((cap,), jnp.float32),
-                 jnp.zeros((cap,), jnp.uint32)))
-            return s, it, res, rb, cb
+                 *self._stats_bufs()))
+            return s, it, res, rb, cb, rbp, cbp
 
         self._register_variant(
             "run_until_stats", run,
@@ -873,9 +889,10 @@ class PullEngine(AuditableEngine):
     def run_until_stats(self, state, tol: float,
                         max_iters: int = np.iinfo(np.int32).max):
         """``run_until`` + the per-iteration residual/changed counters
-        of ``run_stats`` — closing the 'pull residuals are invisible
-        inside run_until' observability hole.  Returns (state, it,
-        residual, residual_buf, changed_buf)."""
+        of ``run_stats`` (per-part counters included, same oracle
+        contract) — closing the 'pull residuals are invisible inside
+        run_until' observability hole.  Returns (state, it, residual,
+        residual_buf, changed_buf, residual_parts, changed_parts)."""
         return self._run_until_stats(state, jnp.float32(tol),
                                      jnp.int32(max_iters),
                                      *self.graph_args)
@@ -902,34 +919,34 @@ class PullEngine(AuditableEngine):
         the watchdog sees it."""
         from lux_tpu import health as hw
         core = self._step_core
-        cap = self.stats_cap
 
         @functools.partial(jax.jit, donate_argnums=0)
         def run(state, num_iters, h0, win0, *gargs):
             def cond(c):
-                it, s, rb, cb, h, win = c
+                it, h = c[0], c[6]
                 return (it < num_iters) & (h[0] == 0)
 
             def body(c):
-                it, s, rb, cb, h, win = c
+                it, s, rb, cb, rbp, cbp, h, win = c
                 new = core(s, *gargs)
-                r, cnt = self._iter_counters(new, s)
+                r, cnt, rp, cp = self._iter_counters(new, s)
                 h, win = hw.pull_update(h, win, new, r)
                 return (it + 1, new, rb.at[it].set(r, mode="drop"),
-                        cb.at[it].set(cnt, mode="drop"), h, win)
+                        cb.at[it].set(cnt, mode="drop"),
+                        rbp.at[it].set(rp, mode="drop"),
+                        cbp.at[it].set(cp, mode="drop"), h, win)
 
-            it, s, rb, cb, h, win = jax.lax.while_loop(
+            it, s, rb, cb, rbp, cbp, h, win = jax.lax.while_loop(
                 cond, body,
-                (jnp.int32(0), state, jnp.zeros((cap,), jnp.float32),
-                 jnp.zeros((cap,), jnp.uint32), h0, win0))
-            return s, it, rb, cb, h, win
+                (jnp.int32(0), state, *self._stats_bufs(), h0, win0))
+            return s, it, rb, cb, rbp, cbp, h, win
 
         def call(state, n, watch=None):
             if watch is None:
                 watch = (hw.init_word(), hw.init_window())
-            s, it, rb, cb, h, win = run(state, jnp.int32(n), *watch,
-                                        *self.graph_args)
-            return s, it, rb, cb, (h, win)
+            s, it, rb, cb, rbp, cbp, h, win = run(
+                state, jnp.int32(n), *watch, *self.graph_args)
+            return s, it, rb, cb, rbp, cbp, (h, win)
 
         self._register_variant(
             "run_health", run,
@@ -940,9 +957,11 @@ class PullEngine(AuditableEngine):
         return call
 
     def run_health(self, state, num_iters: int, watch=None):
-        """``run_stats`` under the device-side health watchdog:
-        returns (state, iters_executed, residual_buf, changed_buf,
-        watch) where watch = (health int32[6], residual window).  The
+        """``run_stats`` under the device-side health watchdog
+        (per-part counters included, same oracle contract): returns
+        (state, iters_executed, residual_buf, changed_buf,
+        residual_parts, changed_parts, watch) where watch = (health
+        int32[6], residual window).  The
         loop EXITS the iteration a check trips (iters_executed <
         num_iters then); fetch + decode the word once per run/segment
         with ``health.ensure_ok(watch)`` — 24 bytes, no in-loop host
@@ -956,31 +975,31 @@ class PullEngine(AuditableEngine):
     def _run_until_health(self):
         from lux_tpu import health as hw
         core = self._step_core
-        cap = self.stats_cap
 
         @functools.partial(jax.jit, donate_argnums=0)
         def run(state, tol, max_iters, *gargs):
             def cond(c):
-                it, s, res, rb, cb, h, win = c
+                it, res, h = c[0], c[2], c[7]
                 return (jnp.logical_not(res <= tol)
                         & (it < max_iters) & (h[0] == 0))
 
             def body(c):
-                it, s, _res, rb, cb, h, win = c
+                it, s, _res, rb, cb, rbp, cbp, h, win = c
                 new = core(s, *gargs)
-                r, cnt = self._iter_counters(new, s)
+                r, cnt, rp, cp = self._iter_counters(new, s)
                 h, win = hw.pull_update(h, win, new, r)
                 return (it + 1, new, r,
                         rb.at[it].set(r, mode="drop"),
-                        cb.at[it].set(cnt, mode="drop"), h, win)
+                        cb.at[it].set(cnt, mode="drop"),
+                        rbp.at[it].set(rp, mode="drop"),
+                        cbp.at[it].set(cp, mode="drop"), h, win)
 
-            it, s, res, rb, cb, h, win = jax.lax.while_loop(
+            it, s, res, rb, cb, rbp, cbp, h, win = jax.lax.while_loop(
                 cond, body,
                 (jnp.int32(0), state, jnp.float32(jnp.inf),
-                 jnp.zeros((cap,), jnp.float32),
-                 jnp.zeros((cap,), jnp.uint32), hw.init_word(),
+                 *self._stats_bufs(), hw.init_word(),
                  hw.init_window()))
-            return s, it, res, rb, cb, h, win
+            return s, it, res, rb, cb, rbp, cbp, h, win
 
         self._register_variant(
             "run_until_health", run,
@@ -992,16 +1011,18 @@ class PullEngine(AuditableEngine):
 
     def run_until_health(self, state, tol: float,
                          max_iters: int = np.iinfo(np.int32).max):
-        """``run_until_stats`` under the health watchdog: returns
-        (state, it, residual, residual_buf, changed_buf, watch) with
-        watch = (health int32[6], residual window).  The
-        non-finite-safe predicate means a NaN residual can never
-        report convergence; the watchdog additionally stops the loop
-        at the tripping iteration instead of spinning to max_iters."""
-        s, it, res, rb, cb, h, win = self._run_until_health(
+        """``run_until_stats`` under the health watchdog (per-part
+        counters included, same oracle contract): returns (state, it,
+        residual, residual_buf, changed_buf, residual_parts,
+        changed_parts, watch) with watch = (health int32[6], residual
+        window).  The non-finite-safe predicate means a NaN residual
+        can never report convergence; the watchdog additionally stops
+        the loop at the tripping iteration instead of spinning to
+        max_iters."""
+        s, it, res, rb, cb, rbp, cbp, h, win = self._run_until_health(
             state, jnp.float32(tol), jnp.int32(max_iters),
             *self.graph_args)
-        return s, it, res, rb, cb, (h, win)
+        return s, it, res, rb, cb, rbp, cbp, (h, win)
 
     def unpad(self, state) -> np.ndarray:
         """Padded device state -> [nv, ...] user order (host).

@@ -535,7 +535,14 @@ class PushEngine(AuditableEngine):
         lux_tpu/telemetry.py for the exact semantics.  Out-degrees
         come from the FULL graph (self.sg, pair rows included), passed
         as one extra sharded argument so the counter-free program
-        never carries them.
+        never carries them.  Round 13: the same variant ALSO records
+        the per-part split into [stats_cap, P] buffers (frontier and
+        out-edges per part; the scalar entries are the SUMS of the
+        per-part rows, so sum-over-parts is bitwise-exact by
+        construction) — per-part values are reduced per local part
+        and all_gathered over the mesh (P ints per iteration over
+        ICI), adding NO state-table gathers (audit gather-budget
+        stays at the same budget).
 
         health=True (implies stats) additionally accumulates the O(1)
         health word (lux_tpu/health.py: NaN labels — +Inf stays the
@@ -642,15 +649,27 @@ class PushEngine(AuditableEngine):
                 deg_full, gargs = gargs[0], gargs[1:]
             g = dict(zip(keys, gargs))
 
-            def esum(act):
-                # out-edges of the frontier ``act`` — the relax work
-                # of the iteration.  uint32: a full 2^31+-edge
-                # frontier must not wrap int32.
+            def esum_parts(act):
+                # out-edges of the frontier ``act`` PER PART [P] —
+                # the relax work each part contributes this iteration
+                # (replicated via all_gather on a mesh: P ints per
+                # iteration over ICI, no state-table gathers).
+                # uint32: a full 2^31+-edge frontier must not wrap
+                # int32; the scalar counter is the SUM of this row,
+                # so sum-over-parts is bitwise-exact by construction.
                 e = jnp.sum(jnp.where(act, deg_full, 0)
-                            .astype(jnp.uint32))
+                            .astype(jnp.uint32), axis=1)
                 if on_mesh:
-                    e = jax.lax.psum(e, PARTS_AXIS)
+                    e = jax.lax.all_gather(e, PARTS_AXIS, tiled=True)
                 return e
+
+            def fcount_parts(act):
+                # active count per part [P] int32 (sums to the psum'd
+                # scalar frontier count exactly — integer addition)
+                c = jnp.sum(act.astype(jnp.int32), axis=1)
+                if on_mesh:
+                    c = jax.lax.all_gather(c, PARTS_AXIS, tiled=True)
+                return c
 
             if not converge:
                 cnt0 = global_sum(active)
@@ -682,7 +701,7 @@ class PushEngine(AuditableEngine):
                     it, lbl, act, B, cnt = c[:5]
                     ok = (cnt > 0) & (it < max_iters)
                     if health:        # exit the loop on a tripped word
-                        ok = ok & (c[7][0] == 0)
+                        ok = ok & (c[9][0] == 0)
                     return ok
 
                 def wbody(c):
@@ -696,12 +715,18 @@ class PushEngine(AuditableEngine):
                             # counters record the bucket front ENTERING
                             # this relax — the series timed_phases'
                             # delta schedule reports; advances relax
-                            # nothing and write no entry
-                            fsz, fed = buf[:2]
+                            # nothing and write no entry.  The scalar
+                            # edges entry is the sum of the per-part
+                            # row (bitwise, uint32 either way).
+                            fsz, fed, fszp, fedp = buf[:4]
+                            ep = esum_parts(front)
                             buf = (fsz.at[it].set(nf, mode="drop"),
-                                   fed.at[it].set(esum(front),
-                                                  mode="drop")) \
-                                + buf[2:]
+                                   fed.at[it].set(jnp.sum(ep),
+                                                  mode="drop"),
+                                   fszp.at[it].set(fcount_parts(front),
+                                                   mode="drop"),
+                                   fedp.at[it].set(ep, mode="drop")) \
+                                + buf[4:]
                         nl, na = body(lbl, front, nf, g)
                         merged = (act & ~front) | na
                         if health:
@@ -709,9 +734,9 @@ class PushEngine(AuditableEngine):
                             # advances relax nothing and terminate on
                             # their own (see `advance` below)
                             h, stall = health_step(
-                                buf[2], buf[3], lbl, nl, cnt,
+                                buf[4], buf[5], lbl, nl, cnt,
                                 global_sum(merged))
-                            buf = buf[:2] + (h, stall)
+                            buf = buf[:4] + (h, stall)
                         return (it + 1, nl, merged, B, *buf)
 
                     def advance(it, lbl, act, B, *buf):
@@ -739,61 +764,74 @@ class PushEngine(AuditableEngine):
                 init = (jnp.int32(0), label, active, B0,
                         global_sum(active))
                 if stats:
-                    init = init + (jnp.zeros((cap_n,), jnp.int32),
-                                   jnp.zeros((cap_n,), jnp.uint32))
+                    init = init + (
+                        jnp.zeros((cap_n,), jnp.int32),
+                        jnp.zeros((cap_n,), jnp.uint32),
+                        jnp.zeros((cap_n, sg.num_parts), jnp.int32),
+                        jnp.zeros((cap_n, sg.num_parts), jnp.uint32))
                 if health:
                     init = init + (h0, stall0)
                 out = jax.lax.while_loop(cond, wbody, init)
                 it, lbl, act = out[0], out[1], out[2]
                 if health:
                     return lbl, act, it, out[5], out[6], out[7], \
-                        out[8]
+                        out[8], out[9], out[10]
                 if stats:
-                    return lbl, act, it, out[5], out[6]
+                    return lbl, act, it, out[5], out[6], out[7], \
+                        out[8]
                 return lbl, act, it
 
             def cond(c):
                 it, lbl, act, cnt = c[:4]
                 ok = (cnt > 0) & (it < max_iters)
                 if health:            # exit the loop on a tripped word
-                    ok = ok & (c[6][0] == 0)
+                    ok = ok & (c[8][0] == 0)
                 return ok
 
             def wbody(c):
                 it, lbl, act, cnt = c[:4]
                 if stats:
-                    fsz, fed = c[4], c[5]
+                    fsz, fed, fszp, fedp = c[4:8]
                     # edges relaxed by THIS iteration: out-edges of
-                    # the frontier entering it
-                    fed = fed.at[it].set(esum(act), mode="drop")
+                    # the frontier entering it, per part; the scalar
+                    # is the row's sum (bitwise-exact, uint32)
+                    ep = esum_parts(act)
+                    fed = fed.at[it].set(jnp.sum(ep), mode="drop")
+                    fedp = fedp.at[it].set(ep, mode="drop")
                 nl, na = body(lbl, act, cnt, g)
                 ncnt = global_sum(na)
                 if stats:
                     # frontier AFTER the iteration — exactly the
                     # series the stepwise -verbose path printed
                     fsz = fsz.at[it].set(ncnt, mode="drop")
+                    fszp = fszp.at[it].set(fcount_parts(na),
+                                           mode="drop")
                     if health:
-                        h, stall = health_step(c[6], c[7], lbl,
+                        h, stall = health_step(c[8], c[9], lbl,
                                                nl, cnt, ncnt)
-                        return (it + 1, nl, na, ncnt, fsz, fed, h,
-                                stall)
-                    return it + 1, nl, na, ncnt, fsz, fed
+                        return (it + 1, nl, na, ncnt, fsz, fed, fszp,
+                                fedp, h, stall)
+                    return it + 1, nl, na, ncnt, fsz, fed, fszp, fedp
                 return it + 1, nl, na, ncnt
 
             it0 = jnp.int32(0)
             cnt0 = global_sum(active)
             init = (it0, label, active, cnt0)
             if stats:
-                init = init + (jnp.zeros((cap_n,), jnp.int32),
-                               jnp.zeros((cap_n,), jnp.uint32))
+                init = init + (
+                    jnp.zeros((cap_n,), jnp.int32),
+                    jnp.zeros((cap_n,), jnp.uint32),
+                    jnp.zeros((cap_n, sg.num_parts), jnp.int32),
+                    jnp.zeros((cap_n, sg.num_parts), jnp.uint32))
             if health:
                 init = init + (h0, stall0)
             out = jax.lax.while_loop(cond, wbody, init)
             it, lbl, act = out[0], out[1], out[2]
             if health:
-                return lbl, act, it, out[4], out[5], out[6], out[7]
+                return lbl, act, it, out[4], out[5], out[6], out[7], \
+                    out[8], out[9]
             if stats:
-                return lbl, act, it, out[4], out[5]
+                return lbl, act, it, out[4], out[5], out[6], out[7]
             return lbl, act, it
 
         if prog.name:
@@ -802,9 +840,10 @@ class PushEngine(AuditableEngine):
             P = PartitionSpec
             out_specs = (P(PARTS_AXIS), P(PARTS_AXIS), P())
             if stats:
-                # counters are psum-replicated scalars written into
-                # replicated buffers
-                out_specs = out_specs + (P(), P())
+                # counters are psum/all_gather-replicated values
+                # written into replicated buffers (scalar pair + the
+                # per-part [cap, P] pair)
+                out_specs = out_specs + (P(), P(), P(), P())
             if health:
                 # the health word + stall counter are built from
                 # psum/pmin'd scalars, identical on every device
@@ -852,10 +891,10 @@ class PushEngine(AuditableEngine):
                      watch=None):
                 if watch is None:
                     watch = (_hw.init_word(), jnp.int32(0))
-                l, a, it, fsz, fed, h, stall = jitted(
+                l, a, it, fsz, fed, fszp, fedp, h, stall = jitted(
                     label, active, jnp.int32(max_iters), *watch,
                     *extra, *graph_args)
-                return l, a, it, fsz, fed, (h, stall)
+                return l, a, it, fsz, fed, fszp, fedp, (h, stall)
 
             return call
 
@@ -904,11 +943,15 @@ class PushEngine(AuditableEngine):
         INSIDE the fused while_loop (compiled lazily on first use —
         the counter-free program is untouched).  Returns (label,
         active, iters, frontier int32 [stats_cap], edges uint32
-        [stats_cap]): classic engines record the post-iteration
-        frontier size (the stepwise -verbose series) and the entering
-        frontier's out-edge count; delta engines record each relax
-        step's bucket-front size and out-edges (see
-        lux_tpu/telemetry.py).  Writes past ``stats_cap`` drop;
+        [stats_cap], frontier_parts int32 [stats_cap, P], edges_parts
+        uint32 [stats_cap, P]): classic engines record the
+        post-iteration frontier size (the stepwise -verbose series)
+        and the entering frontier's out-edge count; delta engines
+        record each relax step's bucket-front size and out-edges (see
+        lux_tpu/telemetry.py).  The per-part counters are the round-13
+        imbalance-attribution signal: each scalar entry is the SUM of
+        its per-part row, bitwise (tests/test_telemetry.py holds the
+        NumPy per-part oracle).  Writes past ``stats_cap`` drop;
         entries past ``iters`` are zero.  Fetch the buffers once per
         run/segment (a few KB) — never inside a timed region's hot
         loop."""
@@ -923,8 +966,10 @@ class PushEngine(AuditableEngine):
                         max_iters: int | None = None, watch=None):
         """``converge_stats`` under the device-side health watchdog
         (lux_tpu/health.py): returns (label, active, iters, frontier
-        buf, edges buf, watch) with watch = (health int32[6], stall
-        counter).  The while_loop EXITS the iteration a check trips
+        buf, edges buf, frontier-parts buf, edges-parts buf, watch)
+        with watch = (health int32[6], stall counter) — the per-part
+        counters ride this variant too, same oracle contract as
+        ``converge_stats``.  The while_loop EXITS the iteration a check trips
         (NaN labels; the truncation-livelock frontier stall), so
         ``iters`` then counts only the completed healthy iterations;
         fetch + decode the word once per run/segment with
@@ -967,19 +1012,19 @@ class PushEngine(AuditableEngine):
                     max_iters)
             elif self.health:
                 from lux_tpu import health as hw
-                label, active, itd, fsz, fed, h = self.converge_health(
-                    label, active, max_iters)
+                label, active, itd, fsz, fed, fszp, fedp, h = \
+                    self.converge_health(label, active, max_iters)
                 it = int(jax.device_get(itd))
                 if st is not None:
                     st.begin_run()
-                    st.extend_push(fsz, fed, it)
+                    st.extend_push(fsz, fed, it, fszp, fedp)
                 hw.ensure_ok(h, engine="push", where="push converge")
             elif st is not None:
                 st.begin_run()
-                label, active, itd, fsz, fed = self.converge_stats(
-                    label, active, max_iters)
+                label, active, itd, fsz, fed, fszp, fedp = \
+                    self.converge_stats(label, active, max_iters)
                 it = int(jax.device_get(itd))
-                st.extend_push(fsz, fed, it)
+                st.extend_push(fsz, fed, it, fszp, fedp)
             else:
                 label, active, itd = self.converge(label, active,
                                                    max_iters)

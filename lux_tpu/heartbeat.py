@@ -129,6 +129,7 @@ class Heartbeat:
         a hang."""
         from lux_tpu import telemetry
 
+        t_sync = self.now()
         self.beat(boundary)
         warned = False
         while True:
@@ -148,6 +149,13 @@ class Heartbeat:
                 last = r["t"] if r is not None else self._t_start
                 late[p] = now - last
             if not late:
+                # one instant marker per reached boundary (round 13:
+                # the tracing exporter renders these on the timeline,
+                # so cross-process sync points are visible)
+                telemetry.current().emit(
+                    "heartbeat", boundary=int(boundary),
+                    nproc=int(self.nproc),
+                    waited_s=round(now - t_sync, 3))
                 return
             dead = sorted(p for p, age in late.items()
                           if age > self.deadline_s)

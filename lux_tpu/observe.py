@@ -602,6 +602,11 @@ DEBTS = (
          "on-device DEVICE_LOSS shrink drill (remote recompile + "
          "re-shard upload)", "PERF_NOTES round-11 pointer 1",
          min_ndev=2),
+    Debt("part-counters-ab",
+         "per-part counter variants (round 13, lux_tpu/tracing.py "
+         "era) on/off A/B through the tunnel — CPU A/B is within "
+         "noise; the on-device all_gather cost is unmeasured",
+         "PERF_NOTES round 13", min_ndev=2),
 )
 
 

@@ -250,6 +250,21 @@ class RunReport:
                     topology=[dict(t) for t in self.topology])
 
 
+def _flight_dump(exc: BaseException, kind: str) -> None:
+    """Crash flight recorder hook (lux_tpu/tracing.py, round 13):
+    dump the recent-event ring + last health word + placement
+    metadata to FLIGHT.json when a recorder is installed.  The dump
+    is best-effort by design — a postmortem writer must never mask
+    the fault it is recording."""
+    try:
+        from lux_tpu import tracing
+        tracing.flight_dump(
+            reason=f"{type(exc).__name__}: {exc}"[:300],
+            classification=kind)
+    except Exception:           # noqa: BLE001 — see docstring
+        pass
+
+
 def supervise(attempt: Callable, policy: RetryPolicy | None = None,
               report: RunReport | None = None, on_topology=None):
     """Run ``attempt(k)`` (k = 0-based attempt index) under classified
@@ -284,6 +299,10 @@ def supervise(attempt: Callable, policy: RetryPolicy | None = None,
                 tel.emit("topology_fault", attempt=k,
                          error=type(e).__name__, message=str(e)[:200],
                          handled=handled)
+                # flight recorder (round 13): a topology transition —
+                # handled or not — is postmortem-worthy; the dump
+                # happens AFTER the event so the ring includes it
+                _flight_dump(e, kind)
             fatal = (kind == FATAL
                      or (kind == TOPOLOGY and not handled)
                      or k >= policy.retries)
@@ -291,6 +310,8 @@ def supervise(attempt: Callable, policy: RetryPolicy | None = None,
                 tel.emit("failure", attempt=k,
                          error=type(e).__name__, message=str(e)[:200],
                          classification=kind)
+                if kind != TOPOLOGY:      # topology already dumped
+                    _flight_dump(e, kind)
                 raise
             if kind == TOPOLOGY:
                 continue            # re-placed: retry immediately

@@ -162,10 +162,11 @@ def run_segments(eng, state, num_iters: int, segment,
         t0 = time.perf_counter()
         with step_annotation("lux_segment", seg_idx):
             if guarded:
-                state, _itd, res_b, chg_b, watch = eng.run_health(
-                    state, n, watch)
+                state, _itd, res_b, chg_b, res_p, chg_p, watch = \
+                    eng.run_health(state, n, watch)
             elif st is not None:
-                state, res_b, chg_b = eng.run_stats(state, n)
+                state, res_b, chg_b, res_p, chg_p = eng.run_stats(
+                    state, n)
             else:
                 state = eng.run(state, n)
             if timed or st is not None or guarded:
@@ -196,7 +197,7 @@ def run_segments(eng, state, num_iters: int, segment,
         # survives: a crash in the save window makes the retry re-run
         # this slice, so appending earlier would double-count it
         if st is not None:
-            st.extend_pull(res_b, chg_b, n)
+            st.extend_pull(res_b, chg_b, n, res_p, chg_p)
     return state
 
 
@@ -240,11 +241,11 @@ def converge_segments(eng, label, active, segment,
         t0 = time.perf_counter()
         with step_annotation("lux_segment", seg_idx):
             if guarded:
-                label, active, it, fsz, fed, watch = \
+                label, active, it, fsz, fed, fszp, fedp, watch = \
                     eng.converge_health(label, active, n, watch)
             elif st is not None:
-                label, active, it, fsz, fed = eng.converge_stats(
-                    label, active, n)
+                label, active, it, fsz, fed, fszp, fedp = \
+                    eng.converge_stats(label, active, n)
             else:
                 label, active, it = eng.converge(label, active, n)
             # the scalar fetch depends on the whole while_loop: it is
@@ -275,7 +276,7 @@ def converge_segments(eng, label, active, segment,
         # survives: a crash in the save window makes the retry re-run
         # this slice, so appending earlier would double-count it
         if st is not None:
-            st.extend_push(fsz, fed, it)
+            st.extend_push(fsz, fed, it, fszp, fedp)
         if cnt == 0:
             break
     return label, active, total

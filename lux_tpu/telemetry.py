@@ -33,7 +33,15 @@ supervisor (resilience.py), the CLI and bench.py all emit into:
   into fixed-shape ``[stats_cap]`` buffers, fetched ONCE per run or
   segment boundary — a few KB independent of graph size, the same
   O(1)-style discipline as ``timing.fence``.  The hot loop gains no
-  host syncs and no extra gathers.
+  host syncs and no extra gathers.  Round 13 extends the same
+  variants with PER-PART counters (``[stats_cap, P]`` buffers:
+  push frontier/out-edges per part, pull residual/changed per part),
+  the measured skew signal ROADMAP item 4's locality-aware
+  partitioner optimizes: sum-over-parts bitwise-equals the scalar
+  series (integer sums; the pull residual is a max, whose
+  max-over-parts equals the scalar), and the derived IMBALANCE index
+  (max/mean per-part work) rides ``summary()`` into events, bench
+  metric lines (``telemetry.imbalance``) and RunReport.
 - a contextvar-scoped ``Telemetry`` handle (``use()``/``current()``)
   so the cross-cutting run paths (CLI supervised runs, bench configs,
   checkpointed segments) light up without threading parameters
@@ -89,31 +97,70 @@ def session_id() -> str:
 # int32+uint32 per entry -> 32 KB fetched per run at the default
 DEFAULT_STATS_CAP = 4096
 
+# Event observers (lux_tpu/tracing.py's flight recorder): every event
+# built by EventLog.emit — or by a sink-less Telemetry.emit while an
+# observer is installed — is offered to each observer.  Observer
+# failures are swallowed: a postmortem ride-along must never be able
+# to fail the run it exists to diagnose.
+_OBSERVERS: list = []
+
+
+def add_observer(fn) -> None:
+    if fn not in _OBSERVERS:
+        _OBSERVERS.append(fn)
+
+
+def remove_observer(fn) -> None:
+    if fn in _OBSERVERS:
+        _OBSERVERS.remove(fn)
+
+
+def make_event(kind: str, fields: dict) -> dict:
+    """One wire-format event dict.  tm (monotonic) orders events
+    WITHIN a process; t (wall) only roughly aligns processes.
+    pid+session disambiguate multi-process logs sharing one file
+    (heartbeat drills)."""
+    return {"t": round(time.time(), 6),
+            "tm": round(time.monotonic(), 6),
+            "pid": os.getpid(), "session": _SESSION,
+            "kind": str(kind), **fields}
+
+
+def _notify(ev: dict) -> None:
+    for fn in list(_OBSERVERS):
+        try:
+            fn(ev)
+        except Exception:       # noqa: BLE001 — see _OBSERVERS note
+            pass
+
 
 class EventLog:
     """Append-only structured event sink.
 
     Events are always kept in memory (``self.events``); with ``path``
     set, each event is also written immediately as one JSON line (so a
-    crashed run still leaves its trail on disk)."""
+    crashed run still leaves its trail on disk).  On-disk appends are
+    LINE-ATOMIC under concurrent multi-process writers (heartbeat
+    drills share one file): the fd is opened O_APPEND and each event
+    goes down as ONE ``os.write`` of one serialized buffer, so two
+    processes' lines can never interleave mid-line (POSIX appends are
+    atomic per write; buffered ``file.write`` may split a line across
+    syscalls)."""
 
     def __init__(self, path: str | None = None):
         self.path = path
         self.events: list[dict] = []
-        self._f = open(path, "a") if path else None
+        self._fd = (os.open(path, os.O_WRONLY | os.O_CREAT
+                            | os.O_APPEND, 0o644)
+                    if path else None)
 
     def emit(self, kind: str, **fields) -> dict:
-        # tm (monotonic) orders events WITHIN a process; t (wall)
-        # only roughly aligns processes.  pid+session disambiguate
-        # multi-process logs sharing one file (heartbeat drills).
-        ev = {"t": round(time.time(), 6),
-              "tm": round(time.monotonic(), 6),
-              "pid": os.getpid(), "session": _SESSION,
-              "kind": str(kind), **fields}
+        ev = make_event(kind, fields)
         self.events.append(ev)
-        if self._f is not None:
-            self._f.write(json.dumps(ev) + "\n")
-            self._f.flush()
+        if self._fd is not None:
+            # ONE buffer, ONE write: the line-atomicity contract
+            os.write(self._fd, (json.dumps(ev) + "\n").encode())
+        _notify(ev)
         return ev
 
     def counts(self) -> dict:
@@ -124,9 +171,9 @@ class EventLog:
         return out
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
 
     def __enter__(self):
         return self
@@ -149,6 +196,13 @@ class IterStats:
         self.edges: list[int] = []
         self.residual: list[float] = []
         self.changed: list[int] = []
+        # per-part series (round 13): one [P] row per iteration, from
+        # the engines' [stats_cap, P] buffers; empty when the run
+        # predates the per-part variants or passed no part buffers
+        self.frontier_parts: list[list[int]] = []
+        self.edges_parts: list[list[int]] = []
+        self.residual_parts: list[list[float]] = []
+        self.changed_parts: list[list[int]] = []
         self.truncated = False
 
     def __len__(self):
@@ -159,30 +213,115 @@ class IterStats:
         self.kind = None
         self.frontier, self.edges = [], []
         self.residual, self.changed = [], []
+        self.frontier_parts, self.edges_parts = [], []
+        self.residual_parts, self.changed_parts = [], []
         self.truncated = False
 
     def _fetch(self, buf, n: int):
+        """Fetch the first ``n`` rows of a counter buffer.  The slice
+        happens BEFORE the host fetch, so only the live prefix ships
+        through the tunnel — a [stats_cap, P] per-part buffer fetched
+        whole would be cap*P*8 bytes per segment; the prefix keeps the
+        per-boundary cost O(iters x P), i.e. KB for real segments."""
         import numpy as np
 
         from lux_tpu.timing import fetch
-        arr = np.asarray(fetch(buf))
-        if n > arr.shape[0]:
+        cap = buf.shape[0]
+        if n > cap:
             self.truncated = True
-        return arr[:min(int(n), arr.shape[0])]
+        return np.asarray(fetch(buf[:min(int(n), cap)]))
 
-    def extend_push(self, frontier_buf, edges_buf, n: int) -> None:
+    def extend_push(self, frontier_buf, edges_buf, n: int,
+                    frontier_parts=None, edges_parts=None) -> None:
         """Append ``n`` iterations from a push engine's counter
-        buffers (frontier int32 [cap], edges uint32 [cap])."""
+        buffers (frontier int32 [cap], edges uint32 [cap]; the
+        optional per-part buffers are int32/uint32 [cap, P])."""
         self.kind = "push"
         self.frontier += [int(x) for x in self._fetch(frontier_buf, n)]
         self.edges += [int(x) for x in self._fetch(edges_buf, n)]
+        if frontier_parts is not None:
+            self.frontier_parts += [
+                [int(x) for x in row]
+                for row in self._fetch(frontier_parts, n)]
+        if edges_parts is not None:
+            self.edges_parts += [
+                [int(x) for x in row]
+                for row in self._fetch(edges_parts, n)]
 
-    def extend_pull(self, residual_buf, changed_buf, n: int) -> None:
+    def extend_pull(self, residual_buf, changed_buf, n: int,
+                    residual_parts=None, changed_parts=None) -> None:
         """Append ``n`` iterations from a pull engine's counter
-        buffers (residual float32 [cap], changed uint32 [cap])."""
+        buffers (residual float32 [cap], changed uint32 [cap]; the
+        optional per-part buffers are float32/uint32 [cap, P])."""
         self.kind = "pull"
         self.residual += [float(x) for x in self._fetch(residual_buf, n)]
         self.changed += [int(x) for x in self._fetch(changed_buf, n)]
+        if residual_parts is not None:
+            self.residual_parts += [
+                [float(x) for x in row]
+                for row in self._fetch(residual_parts, n)]
+        if changed_parts is not None:
+            self.changed_parts += [
+                [int(x) for x in row]
+                for row in self._fetch(changed_parts, n)]
+
+    # -- per-part attribution (round 13) -------------------------------
+
+    def num_parts(self) -> int:
+        rows = (self.edges_parts if self.kind == "push"
+                else self.changed_parts)
+        return len(rows[0]) if rows else 0
+
+    def part_totals(self) -> list[int] | None:
+        """Per-part WORK totals over the run — frontier out-edges for
+        push (the relax work each part contributed), changed-vertex
+        counts for pull.  Sums over parts bitwise-equal the scalar
+        ``edges_sum``/``changed_sum`` (integer sums of the same
+        device-side values, reduced part-first instead of all at
+        once; on graphs past 2^32 edges per iteration the scalar's
+        device uint32 wraps while these host totals stay exact — the
+        validators compare mod 2^32).  None without per-part data."""
+        rows = (self.edges_parts if self.kind == "push"
+                else self.changed_parts)
+        if not rows:
+            return None
+        return [sum(r[p] for r in rows) for p in range(len(rows[0]))]
+
+    def imbalance(self) -> float | None:
+        """The imbalance index: max/mean of the per-part work totals
+        (1.0 = perfectly balanced) — the measured skew signal the
+        locality-aware partitioner (ROADMAP item 4) optimizes.  None
+        without per-part data or with zero total work."""
+        totals = self.part_totals()
+        if not totals or sum(totals) == 0:
+            return None
+        mean = sum(totals) / len(totals)
+        return max(totals) / mean
+
+    def imbalance_digest(self) -> dict | None:
+        """The ``telemetry.imbalance`` field of a bench metric line
+        (scripts/check_bench.py validates it against the counter
+        digest): {kind, index, parts} or None."""
+        totals = self.part_totals()
+        imb = self.imbalance()
+        if totals is None or imb is None:
+            return None
+        return {"kind": self.kind, "index": round(imb, 4),
+                "parts": totals}
+
+    def parts_lines(self):
+        """Human per-part attribution table (CLI -iter-stats replay /
+        events_summary's rendering source)."""
+        totals = self.part_totals()
+        if totals is None:
+            return
+        metric = "edges" if self.kind == "push" else "changed"
+        tot = sum(totals) or 1
+        imb = self.imbalance()
+        yield (f"per-part {metric} (imbalance "
+               f"{'n/a' if imb is None else f'{imb:.3f}'} max/mean):")
+        for p, v in enumerate(totals):
+            yield f"  part {p}: {v} ({v / tot * 100:.1f}%)"
 
     def summary(self) -> dict | None:
         """Compact digest for event logs / bench JSON lines /
@@ -202,6 +341,14 @@ class IterStats:
                        residual_last=self.residual[-1],
                        changed_last=self.changed[-1],
                        changed_sum=sum(self.changed))
+        totals = self.part_totals()
+        if totals is not None:
+            imb = self.imbalance()
+            out["parts"] = len(totals)
+            out["parts_edges" if self.kind == "push"
+                else "parts_changed"] = totals
+            if imb is not None:
+                out["imbalance"] = round(imb, 4)
         return out
 
     def replay_lines(self):
@@ -232,6 +379,12 @@ class Telemetry:
     def emit(self, kind: str, **fields):
         if self.events is not None:
             return self.events.emit(kind, **fields)
+        if _OBSERVERS:
+            # no event sink, but a flight recorder (or other observer)
+            # is installed: the ring still sees the trail
+            ev = make_event(kind, fields)
+            _notify(ev)
+            return ev
         return None
 
 

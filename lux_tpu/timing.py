@@ -139,13 +139,14 @@ def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None,
             # the watchdog loop variant IS the timed program; the
             # 24-byte word is checked after the elapsed time is
             # recorded, so the check is never billed
-            s, _it, rb, cb, h = eng.run_health(state, num_iters)
-            return s, rb, cb, h
+            s, _it, rb, cb, rbp, cbp, h = eng.run_health(state,
+                                                         num_iters)
+            return s, rb, cb, rbp, cbp, h
         if st is not None:
             return (*eng.run_stats(state, num_iters), None)
-        return eng.run(state, num_iters), None, None, None
+        return eng.run(state, num_iters), None, None, None, None, None
 
-    state, res_b, chg_b, hvec = one(eng.init_state())
+    state, res_b, chg_b, res_p, chg_p, hvec = one(eng.init_state())
     fence(state)
     elapsed = []
     with _trace_ctx(trace_dir):
@@ -154,7 +155,7 @@ def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None,
             fence(state)       # H2D upload is async: keep it untimed
             with step_annotation("lux_timed_run", i):
                 t0 = time.perf_counter()
-                state, res_b, chg_b, hvec = one(state)
+                state, res_b, chg_b, res_p, chg_p, hvec = one(state)
                 fence(state)   # O(1)-byte fence, not a state download
                 elapsed.append(time.perf_counter() - t0)
             tel.emit("timed_run", repeat=i, iters=num_iters,
@@ -166,7 +167,7 @@ def timed_fused_run(eng, num_iters: int, trace_dir: str | None = None,
             iters=num_iters)
     if st is not None:
         st.begin_run()         # counters describe the LAST timed run
-        st.extend_pull(res_b, chg_b, num_iters)
+        st.extend_pull(res_b, chg_b, num_iters, res_p, chg_p)
     return state, elapsed
 
 
@@ -193,7 +194,7 @@ def timed_converge(eng, max_iters=None, verbose: bool = False,
             return (*eng.converge_stats(label, active, max_iters),
                     None)
         l, a, it = eng.converge(label, active, max_iters)
-        return l, a, it, None, None, None
+        return l, a, it, None, None, None, None, None
 
     if verbose and st is None:
         # one extra run purely to replay counters; with an active
@@ -201,7 +202,7 @@ def timed_converge(eng, max_iters=None, verbose: bool = False,
         # counters instead (printing here would double the series)
         eng.run(max_iters=max_iters, verbose=True)
     label, active = eng.init_state()
-    l2, a2, _it, _f, _e, _h = one(label, active)    # compile
+    l2, a2, _it, _f, _e, _fp, _ep, _h = one(label, active)  # compile
     fence(l2)
     elapsed = []
     with _trace_ctx(trace_dir):
@@ -210,8 +211,8 @@ def timed_converge(eng, max_iters=None, verbose: bool = False,
             fence((label, active))   # keep the async upload untimed
             with step_annotation("lux_timed_converge", i):
                 t0 = time.perf_counter()
-                label, active, it_d, fsz, fed, hvec = one(label,
-                                                          active)
+                label, active, it_d, fsz, fed, fszp, fedp, hvec = \
+                    one(label, active)
                 iters = int(fetch(it_d))
                 elapsed.append(time.perf_counter() - t0)
             tel.emit("timed_run", repeat=i, iters=iters,
@@ -223,7 +224,7 @@ def timed_converge(eng, max_iters=None, verbose: bool = False,
             iters=iters)
     if st is not None:
         st.begin_run()
-        st.extend_push(fsz, fed, iters)
+        st.extend_push(fsz, fed, iters, fszp, fedp)
     return eng.unpad(label), iters, elapsed
 
 
@@ -249,15 +250,16 @@ def timed_run_until(eng, tol: float, max_iters: int,
             return (*eng.run_until_stats(state, tol, max_iters=cap),
                     None)
         s, it, res = eng.run_until(state, tol, max_iters=cap)
-        return s, it, res, None, None, None
+        return s, it, res, None, None, None, None, None
 
-    s0, _it, _res, _rb, _cb, _h = one(eng.init_state(), 1)
+    s0, _it, _res, _rb, _cb, _rp, _cp, _h = one(eng.init_state(), 1)
     fence(s0)
     state0 = eng.init_state()
     fence(state0)              # keep the async upload untimed
     with _trace_ctx(trace_dir):
         t0 = time.perf_counter()
-        state, it, res, rb, cb, hvec = one(state0, max_iters)
+        state, it, res, rb, cb, rbp, cbp, hvec = one(state0,
+                                                     max_iters)
         iters = int(fetch(it))
         elapsed = time.perf_counter() - t0
     tel.emit("timed_run", repeat=0, iters=iters,
@@ -269,5 +271,5 @@ def timed_run_until(eng, tol: float, max_iters: int,
             iters=iters)
     if st is not None:
         st.begin_run()
-        st.extend_pull(rb, cb, iters)
+        st.extend_pull(rb, cb, iters, rbp, cbp)
     return state, iters, float(fetch(res)), elapsed

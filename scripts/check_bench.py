@@ -65,6 +65,15 @@ Checked per metric line:
   invariants (double gather, baked-in constants, broken collective
   schedule...), so it cannot stand as a metric of record.
 
+- telemetry.imbalance (round 13, lux_tpu/tracing.py era): the
+  per-part imbalance digest — {kind, index = max/mean per-part work,
+  parts = per-part totals} — null when -iter-stats was off.  Checked:
+  index recomputes from the parts, and the parts SUM to the counter
+  digest's edges_sum/changed_sum (the same contradiction pattern as
+  the health digest: per-part and scalar counters are the same
+  device-side values reduced in a different order, so disagreement
+  means the published skew signal is lying).
+
 - telemetry.topology (round 11, lux_tpu/resilience.py elastic
   recovery): optional; null when the mesh never changed.  A non-null
   digest ({shrinks, ndev_final}) REJECTS the line — a mid-run mesh
@@ -376,6 +385,7 @@ def check_telemetry(name: str, obj: dict) -> list[str]:
 
     errs += check_health_digest(name, tel)
     errs += check_topology_digest(name, tel)
+    errs += check_imbalance_digest(name, tel)
 
     cnt = tel["counters"]
     if cnt is not None:
@@ -567,6 +577,72 @@ def check_health_digest(name: str, tel: dict) -> list[str]:
     if not isinstance(it, int) or isinstance(it, bool) or it < 0:
         errs.append(f"{name}: telemetry.health.iters={it!r} must be "
                     f"an int >= 0")
+    return errs
+
+
+def check_imbalance_digest(name: str, tel: dict) -> list[str]:
+    """Round-13 per-part imbalance digest (lux_tpu/tracing.py era,
+    telemetry.IterStats.imbalance_digest): optional (older artifacts
+    predate it), null when -iter-stats was off.  Present it must be
+    {kind: push|pull, index: finite >= 1, parts: non-empty list of
+    ints >= 0}, the index must equal max/mean of its own parts (to
+    rounding), and — the health-digest contradiction pattern — the
+    parts must SUM to the scalar counter digest's edges_sum (push) /
+    changed_sum (pull): a published imbalance that contradicts the
+    counters it claims to decompose is rejected."""
+    if "imbalance" not in tel:
+        return []
+    imb = tel["imbalance"]
+    if imb is None:
+        return []
+    if not isinstance(imb, dict):
+        return [f"{name}: telemetry.imbalance must be null or a "
+                f"dict, got {imb!r}"]
+    errs = []
+    kind = imb.get("kind")
+    if kind not in ("push", "pull"):
+        errs.append(f"{name}: telemetry.imbalance.kind={kind!r} not "
+                    f"push|pull")
+    parts = imb.get("parts")
+    ints = (isinstance(parts, list) and parts
+            and all(isinstance(p, int) and not isinstance(p, bool)
+                    and p >= 0 for p in parts))
+    if not ints:
+        errs.append(f"{name}: telemetry.imbalance.parts must be a "
+                    f"non-empty list of ints >= 0, got {parts!r}")
+    idx = imb.get("index")
+    if not _is_num(idx) or idx < 1.0 - 1e-9:
+        errs.append(f"{name}: telemetry.imbalance.index={idx!r} must "
+                    f"be a finite number >= 1 (max/mean)")
+    elif ints:
+        mean = sum(parts) / len(parts)
+        if mean <= 0:
+            errs.append(f"{name}: telemetry.imbalance over zero "
+                        f"total work — a digest with no work cannot "
+                        f"carry an index")
+        elif abs(idx - max(parts) / mean) > 1e-3 * max(
+                1.0, max(parts) / mean):
+            errs.append(
+                f"{name}: telemetry.imbalance.index={idx} "
+                f"contradicts its own parts (max/mean = "
+                f"{max(parts) / mean:.4f})")
+    cnt = tel.get("counters")
+    if ints and isinstance(cnt, dict) and cnt.get("kind") == kind:
+        scalar = cnt.get("edges_sum" if kind == "push"
+                         else "changed_sum")
+        # congruence mod 2^32: the scalar series entries are device
+        # uint32 sums (wrapping past 2^32 edges in one iteration on
+        # billion-edge graphs) while the parts totals sum exactly on
+        # the host — Σ(wrapped) ≡ Σ(exact) (mod 2^32) always holds
+        # for an honest line
+        if isinstance(scalar, int) and not isinstance(scalar, bool) \
+                and (sum(parts) - scalar) % (1 << 32):
+            errs.append(
+                f"{name}: telemetry.imbalance parts sum "
+                f"{sum(parts)} contradicts the counter digest's "
+                f"scalar {scalar} (mod 2^32) — per-part and scalar "
+                f"counters are the same device-side values and must "
+                f"agree")
     return errs
 
 

@@ -36,8 +36,19 @@ human shape — and audits it while doing so:
   means the merge key is lying).  ``calibration`` fingerprints and
   ``drift``/``phase_cost`` attribution events render.
 
+- round 13 (tracing & imbalance attribution, lux_tpu/tracing.py):
+  ``iter_stats`` digests carrying per-part counters render a
+  per-part table with the imbalance index, and the AUDIT checks that
+  the per-part totals SUM to the scalar counter (bitwise — the
+  engines reduce the same device-side values part-first) and that
+  the index equals max/mean of its own parts; ``heartbeat`` boundary
+  syncs and ``flight_dump`` records render; ``-flight FILE`` renders
+  a crash-flight-recorder FLIGHT.json postmortem instead of an event
+  log.
+
 Usage:
     python scripts/events_summary.py FILE [FILE...]
+    python scripts/events_summary.py -flight FLIGHT.json
 
 Exit status: 0 clean, 1 any error.
 """
@@ -55,7 +66,7 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "budget_reset", "outlier_discard", "outlier_rerun", "health",
          "health_trip", "topology_fault", "mesh_shrink", "replace",
          "straggler", "calibration", "phase_cost", "drift",
-         "debt_collected"}
+         "debt_collected", "heartbeat", "flight_dump"}
 
 # a health_trip without these fields cannot be diagnosed — the whole
 # point of the watchdog is a NAMED check at a NAMED iteration
@@ -149,6 +160,58 @@ def _fmt_s(x: float) -> str:
     return f"{x:9.3f} s"
 
 
+def _is_int(x) -> bool:
+    return isinstance(x, int) and not isinstance(x, bool)
+
+
+def render_parts_table(title, st, out) -> list[str]:
+    """Round-13 per-part attribution table of one ``iter_stats``
+    digest — and its audit: the per-part totals must SUM to the
+    scalar counter bitwise (the engines reduce the very same
+    device-side values part-first; a mismatch means the imbalance
+    signal is lying about the series it claims to decompose)."""
+    errs = []
+    metric = "edges" if "parts_edges" in st else \
+        "changed" if "parts_changed" in st else None
+    if metric is None:
+        return errs
+    parts = st.get(f"parts_{metric}")
+    if (not isinstance(parts, list) or not parts
+            or not all(_is_int(p) and p >= 0 for p in parts)):
+        errs.append(f"{title}: parts_{metric} must be a non-empty "
+                    f"list of ints >= 0, got {parts!r}"[:200])
+        return errs
+    scalar = st.get(f"{metric}_sum")
+    # congruence mod 2^32, not plain equality: each scalar series
+    # entry is a device-side uint32 (sum of its per-part row, which
+    # wraps past 2^32 edges/iteration) while the host part totals
+    # sum exactly — Σ(wrapped) ≡ Σ(exact) (mod 2^32) always holds
+    if _is_int(scalar) and (sum(parts) - scalar) % (1 << 32):
+        errs.append(
+            f"{title}: per-part {metric} sum {sum(parts)} != scalar "
+            f"{metric}_sum {scalar} (mod 2^32) — the imbalance "
+            f"table contradicts the counters it decomposes")
+    imb = st.get("imbalance")
+    if imb is not None and (not isinstance(imb, (int, float))
+                            or isinstance(imb, bool)):
+        errs.append(f"{title}: non-numeric imbalance {imb!r}")
+        imb = None
+    tot = sum(parts) or 1
+    print(f"  per-part {metric} (P={len(parts)}, imbalance "
+          f"{imb if imb is not None else 'n/a'} max/mean):", file=out)
+    for p, v in enumerate(parts):
+        print(f"    part {p}: {v:>12d} ({v / tot * 100:5.1f}%)",
+              file=out)
+    if imb is not None:
+        mean = sum(parts) / len(parts)
+        want = max(parts) / mean if mean else None
+        if want is not None and abs(imb - want) > 1e-3 * max(1, want):
+            errs.append(
+                f"{title}: imbalance {imb} contradicts its own "
+                f"per-part totals (max/mean = {want:.4f})")
+    return errs
+
+
 def render_run(run, out=sys.stdout) -> list[str]:
     """Print one run's table; returns audit errors."""
     errs = []
@@ -213,6 +276,7 @@ def render_run(run, out=sys.stdout) -> list[str]:
                   file=out)
         if st.get("truncated"):
             print("    WARNING: counter buffers truncated", file=out)
+        errs += render_parts_table(title, st, out)
 
     timed = by.get("timed_run", [])
     if timed:
@@ -296,6 +360,16 @@ def render_run(run, out=sys.stdout) -> list[str]:
         print(f"  straggler: peer(s) {sgl.get('peers')} "
               f"{sgl.get('behind_s')}s behind at boundary "
               f"{sgl.get('boundary')}", file=out)
+    hbs = by.get("heartbeat", [])
+    if hbs:
+        last = max((h.get("boundary", 0) for h in hbs), default=0)
+        print(f"  heartbeats: {len(hbs)} boundary sync(s), last "
+              f"boundary {last}", file=out)
+    for fd in by.get("flight_dump", []):
+        print(f"  FLIGHT RECORDER: {fd.get('events')} event(s) "
+              f"dumped to {fd.get('path')} "
+              f"[{fd.get('classification')}] {fd.get('reason')}",
+              file=out)
     for r in by.get("retry", []):
         print(f"  retry: attempt {r.get('attempt')} "
               f"{r.get('error')} [{r.get('classification')}] "
@@ -347,14 +421,84 @@ def render_run(run, out=sys.stdout) -> list[str]:
     return errs
 
 
+def render_flight(path: str, out=sys.stdout) -> list[str]:
+    """Render one crash-flight-recorder dump (lux_tpu/tracing.py
+    FLIGHT.json): reason, placement, last health word, and the tail
+    of the recent-event ring.  Audited like the event log: a dump
+    without its events ring, or with unparseable structure, fails."""
+    errs = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable flight dump ({e})"]
+    if not isinstance(doc, dict) or not isinstance(doc.get("events"),
+                                                  list):
+        return [f"{path}: not a flight-recorder dump (no events "
+                f"ring)"]
+    print(f"== FLIGHT {path} ==", file=out)
+    print(f"  session {doc.get('session')} pid {doc.get('pid')}",
+          file=out)
+    print(f"  reason: [{doc.get('classification')}] "
+          f"{doc.get('reason')}", file=out)
+    if doc.get("placement"):
+        pl = doc["placement"]
+        print("  placement: " + " ".join(f"{k}={v}" for k, v in
+                                         sorted(pl.items())),
+              file=out)
+    h = doc.get("health")
+    if h:
+        flags = h.get("flags")
+        print(f"  last health word: "
+              f"{'+'.join(flags) if flags else 'clean'} "
+              f"({h.get('engine')}, iteration "
+              f"{h.get('iteration', '-')}, part {h.get('part', '-')})",
+              file=out)
+    cal = doc.get("calibration")
+    if cal:
+        print(f"  calibration: {cal.get('platform')} "
+              f"grade={cal.get('grade')} "
+              f"deviation={cal.get('deviation')}", file=out)
+    evs = doc["events"]
+    counts = doc.get("counts") or {}
+    print(f"  ring: {len(evs)} event(s) "
+          f"({', '.join(f'{k} x{v}' for k, v in sorted(counts.items()))})",
+          file=out)
+    for ev in evs[-12:]:
+        if not isinstance(ev, dict) or "kind" not in ev:
+            errs.append(f"{path}: malformed ring event {ev!r}"[:160])
+            continue
+        extra = " ".join(
+            f"{k}={ev[k]}" for k in ("iteration", "part", "flags",
+                                     "error", "seconds", "boundary",
+                                     "attempt")
+            if k in ev)
+        print(f"    tm={ev.get('tm')} {ev['kind']} {extra}", file=out)
+    return errs
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="render a lux_tpu telemetry event JSONL "
                     "(-events FILE) into the reference-style table")
     ap.add_argument("files", nargs="+", metavar="FILE")
+    ap.add_argument("-flight", action="store_true",
+                    help="FILEs are crash-flight-recorder dumps "
+                         "(lux_tpu/tracing.py FLIGHT.json), not "
+                         "event JSONLs — render the postmortem view")
     args = ap.parse_args(argv)
 
     all_errs = []
+    if args.flight:
+        for path in args.files:
+            all_errs += render_flight(path)
+        for e in all_errs:
+            print(f"ERROR: {e}", file=sys.stderr)
+        if all_errs:
+            print(f"events_summary: {len(all_errs)} error(s)",
+                  file=sys.stderr)
+            return 1
+        return 0
     for path in args.files:
         try:
             events, errs = load_events(path)

@@ -22,6 +22,16 @@ be seen from a jaxpr (CLAUDE.md "Conventions"):
                 must cite the reference implementation (a
                 ``file:line`` pattern like ``pull_model.inl:423``) in
                 its module docstring, for parity auditing.
+  part-stats-oracle
+                Every engine ``*_stats``/``*_health`` loop variant
+                whose docstring cites per-part counters (round 13,
+                lux_tpu/tracing.py era) must be covered by a test
+                that exercises it against a per-part NumPy oracle:
+                some file under tests/ must reference BOTH the
+                variant name AND a ``per_part*`` oracle helper —
+                mirroring the app-module oracle-presence check, so a
+                new per-part counter variant cannot ship without its
+                sum-over-parts-bitwise proof.
 
 Suppression: an explicit ``# audit: allow(<check>)`` pragma on the
 flagged line, or in the contiguous comment block directly above it,
@@ -357,6 +367,62 @@ def check_citation(path, tree, lines):
 
 
 # ---------------------------------------------------------------------
+# check: per-part stats variants carry their per-part oracle test
+
+PART_STATS_DOC = "per-part"
+PART_ORACLE_TOKEN = re.compile(r"\bper_part\w*")
+_TESTS_CACHE: list[str] | None = None
+
+
+def _test_texts() -> list[str]:
+    """Cached source texts of every tests/*.py (coverage scan)."""
+    global _TESTS_CACHE
+    if _TESTS_CACHE is None:
+        texts = []
+        tdir = os.path.join(REPO, "tests")
+        if os.path.isdir(tdir):
+            for f in sorted(os.listdir(tdir)):
+                if f.endswith(".py"):
+                    try:
+                        with open(os.path.join(tdir, f)) as fh:
+                            texts.append(fh.read())
+                    except OSError:
+                        continue
+        _TESTS_CACHE = texts
+    return _TESTS_CACHE
+
+
+def check_part_stats_oracle(path, tree, lines):
+    """Engine loop variants citing per-part counters must carry a
+    per-part oracle test (see module docstring)."""
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if not (node.name.endswith("_stats")
+                or node.name.endswith("_health")):
+            continue
+        doc = ast.get_docstring(node) or ""
+        if PART_STATS_DOC not in doc.lower():
+            continue
+        if _suppressed(lines, node.lineno, "part-stats-oracle"):
+            continue
+        covered = any(node.name in txt
+                      and PART_ORACLE_TOKEN.search(txt)
+                      for txt in _test_texts())
+        if not covered:
+            findings.append(Finding(
+                path, node.lineno, "part-stats-oracle",
+                f"{node.name} cites per-part counters but no test "
+                f"under tests/ references it together with a "
+                f"per_part* NumPy oracle — per-part counter "
+                f"variants need their sum-over-parts-bitwise proof "
+                f"(CLAUDE.md: new device code gets an oracle test "
+                f"first)"))
+    return findings
+
+
+# ---------------------------------------------------------------------
 # driver
 
 
@@ -375,6 +441,8 @@ def lint_file(path: str):
         findings += check_oracle(path, tree, lines)
     if "/lux_tpu/engine/" in norm or "/lux_tpu/ops/" in norm:
         findings += check_citation(path, tree, lines)
+    if "/lux_tpu/engine/" in norm:
+        findings += check_part_stats_oracle(path, tree, lines)
     return findings
 
 

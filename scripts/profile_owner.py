@@ -15,19 +15,24 @@ rate.  Three formulations of the same total work (N indices against a
           (dynamic-slice of the stacked state) — serial over parts,
           but each gather's operand is genuinely small
 
-Methodology: profile_true.py rules — K iterations inside one jit,
-loop-dependent inputs, scalar output.
+Methodology: the trusted recipe as a library call
+(lux_tpu.timing.loop_bench, the PR-7/round-12 migration off the
+documented timing traps): K iterations inside one jit, loop-DEPENDENT
+carry, scalar output, host-fetch fence — big operands ride the carry
+as jit arguments, and the median over repeats absorbs tunnel jitter.
 
 Usage: PYTHONPATH=/root/repo:/root/.axon_site \
     python scripts/profile_owner.py [P logV]
 """
 
 import sys
-import time
+from statistics import median
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lux_tpu.timing import loop_bench
 
 K = 10
 P = int(sys.argv[1]) if len(sys.argv) > 1 else 4
@@ -44,22 +49,17 @@ idx_flat = (jnp.arange(P, dtype=jnp.int32)[:, None] * V +
             idx_local).reshape(-1)
 
 
-def bench(name, fn, *args):
-    def run(s0, *a):
-        def body(_, c):
-            acc, t = c
-            sv = fn(t, *a)
-            return (acc + sv, t + sv * 1e-30)
-        return jax.lax.fori_loop(0, K, body,
-                                 (jnp.float32(0), s0))[0]
+def bench(name, fn, idx):
+    def step(carry):
+        t, i = carry
+        sv = fn(t, i)
+        return sv, (t + sv * 1e-30, i)
 
-    r = jax.jit(run)
-    float(r(state, *args))
-    t0 = time.perf_counter()
-    float(r(state, *args))
-    dt = (time.perf_counter() - t0) / K
+    samples, _ = loop_bench(step, (state, idx), K, repeats=3)
+    dt = median(samples)
     print(f"{name:10s} {dt * 1e3:8.2f} ms  ({dt / N * 1e9:6.2f} "
-          f"ns/elem)", flush=True)
+          f"ns/elem)  [{' '.join(f'{s * 1e3:.2f}' for s in samples)}"
+          f" ms]", flush=True)
 
 
 def flat(t, i):
@@ -79,8 +79,9 @@ def scanned(t, i):
     return out
 
 
-print(f"P={P} V={V} ({V * 4 >> 20} MB/part, {P * V * 4 >> 20} MB "
-      f"total), N={N}")
-bench("flat", flat, idx_flat)
-bench("vmap", vmapped, idx_local)
-bench("scan", scanned, idx_local)
+if __name__ == "__main__":
+    print(f"P={P} V={V} ({V * 4 >> 20} MB/part, {P * V * 4 >> 20} MB "
+          f"total), N={N}")
+    bench("flat", flat, idx_flat)
+    bench("vmap", vmapped, idx_local)
+    bench("scan", scanned, idx_local)

@@ -10,8 +10,12 @@ For each scale: build the bench engine (relabel + pair) and time
   resid  a jit of ONLY the residual gather+tiled reduce
 plus the plan shape stats (coverage, R rows, inflation, chunks C).
 
-Methodology per PERF_NOTES: K iterations inside one jit, loop-carried
-inputs, scalar output, host fetch fence.
+Methodology per PERF_NOTES, through the trusted library recipe
+(lux_tpu.timing.loop_bench — the PR-7/round-12 migration of the
+profile scripts off the documented timing traps): K iterations inside
+one jit, loop-DEPENDENT carry, scalar output, host-fetch fence; big
+operands ride the carry as jit arguments and the reported number is
+the median over repeats.
 
 Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/profile_scale.py 21 22 23
 """
@@ -19,38 +23,31 @@ Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/profile_scale.py 21
 from __future__ import annotations
 
 import sys
-import time
+from statistics import median
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from lux_tpu.apps import pagerank
 from lux_tpu.convert import rmat_graph
 from lux_tpu.graph import degree_relabel
-from lux_tpu.timing import fetch
+from lux_tpu.timing import loop_bench
 
 K = 5
 
 
 def timed_scalar_loop(fn, state, *args):
-    """K loop-dependent iterations of fn inside one jit; returns s/iter."""
+    """K loop-dependent iterations of fn inside one jit
+    (timing.loop_bench); returns median s/iter over 3 repeats."""
 
-    @jax.jit
-    def run(state, *args):
-        def body(i, carry):
-            s, acc = carry
-            out = fn(s, *args)
-            acc = acc + jnp.sum(out[:8])
-            return out * (1.0 - 1e-30 * acc), acc
+    def step(carry):
+        s, rest = carry[0], carry[1:]
+        out = fn(s, *rest)
+        sv = jnp.sum(out.reshape(-1)[:8])
+        return sv, (out * (1.0 - 1e-30 * sv), *rest)
 
-        _, acc = jax.lax.fori_loop(0, K, body, (state, jnp.float32(0)))
-        return acc
-
-    fetch(run(state, *args))                     # compile + warm
-    t0 = time.perf_counter()
-    fetch(run(state, *args))
-    return (time.perf_counter() - t0) / K
+    samples, _ = loop_bench(step, (state, *args), K, repeats=3)
+    return median(samples)
 
 
 def main(scales):

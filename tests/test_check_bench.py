@@ -115,6 +115,54 @@ def test_bad_lines_fail(tmp_path, mutate, needle):
     assert needle in r.stderr
 
 
+def _with_imbalance(imb=None, **counter_over):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["telemetry"]["counters"].update(counter_over)
+    d["telemetry"]["imbalance"] = imb
+    return d
+
+
+def test_imbalance_digest_accepted(tmp_path):
+    """Round-13 telemetry.imbalance: a consistent digest passes,
+    null passes (iter-stats off), absent passes (older schema)."""
+    good = _with_imbalance({"kind": "pull", "index": 1.5,
+                            "parts": [180, 120, 60, 120]},
+                           changed_sum=480)
+    for line in (good, _with_imbalance(None), GOOD_LINE):
+        p = tmp_path / "bench.jsonl"
+        p.write_text(json.dumps(line) + "\n")
+        r = run_check(p)
+        assert r.returncode == 0, (line, r.stderr)
+
+
+@pytest.mark.parametrize("imb,counters,needle", [
+    # parts don't sum to the scalar counter — the health-digest
+    # contradiction pattern: per-part and scalar are the SAME
+    # device-side values, so disagreement is rejected
+    ({"kind": "pull", "index": 1.5, "parts": [180, 120, 60, 121]},
+     {"changed_sum": 480}, "contradicts the counter digest"),
+    # index contradicting its own parts
+    ({"kind": "pull", "index": 3.0, "parts": [180, 120, 60, 120]},
+     {"changed_sum": 480}, "contradicts its own parts"),
+    ({"kind": "pull", "index": 0.5, "parts": [180, 120, 60, 120]},
+     {"changed_sum": 480}, "must be a finite number >= 1"),
+    ({"kind": "sideways", "index": 1.5, "parts": [1, 2]},
+     {}, "not push|pull"),
+    ({"kind": "pull", "index": 1.0, "parts": []},
+     {}, "non-empty list"),
+    ({"kind": "pull", "index": 1.0, "parts": [1, -2]},
+     {}, "non-empty list of ints"),
+    ("not-a-dict", {}, "must be null or a dict"),
+])
+def test_bad_imbalance_digests_fail(tmp_path, imb, counters, needle):
+    d = _with_imbalance(imb, **counters)
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert needle in r.stderr
+
+
 def test_health_digest_accepted_and_typechecked(tmp_path):
     """Round-9 telemetry.health digest (bench.py -health): a clean
     digest passes, null passes (watchdog off), and malformed or

@@ -328,6 +328,103 @@ def test_heartbeat_protocol_shrink_renders(tmp_path):
     assert "survivors [0]" in r.stdout and "lost [0]" not in r.stdout
 
 
+# -- round-13 per-part attribution + flight recorder -------------------
+
+PARTS_STATS = {"t": 8.0, "kind": "iter_stats", "engine": "push",
+               "iters": 3, "truncated": False, "frontier_last": 1,
+               "frontier_max": 9, "frontier_sum": 14,
+               "edges_sum": 40, "parts": 2, "parts_edges": [30, 10],
+               "imbalance": 1.5}
+
+
+def test_per_part_table_renders(tmp_path):
+    events = [{"t": 7.9, "kind": "run_start", "app": "sssp"},
+              PARTS_STATS]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "per-part edges (P=2, imbalance 1.5" in r.stdout
+    assert "part 0:" in r.stdout and "75.0%" in r.stdout
+
+
+def test_per_part_sum_contradiction_fails(tmp_path):
+    """Per-part totals not summing to the scalar counter means the
+    imbalance table lies about the series it decomposes."""
+    bad = dict(PARTS_STATS, parts_edges=[30, 11])
+    p = tmp_path / "ev.jsonl"
+    write_log(p, [{"t": 7.9, "kind": "run_start", "app": "sssp"},
+                  bad])
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "contradicts the counters" in r.stderr
+
+
+def test_per_part_imbalance_contradiction_fails(tmp_path):
+    bad = dict(PARTS_STATS, imbalance=3.0)
+    p = tmp_path / "ev.jsonl"
+    write_log(p, [{"t": 7.9, "kind": "run_start", "app": "sssp"},
+                  bad])
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "max/mean" in r.stderr
+
+
+def test_heartbeat_and_flight_dump_render(tmp_path):
+    events = [
+        {"t": 8.0, "kind": "run_start", "app": "pagerank"},
+        {"t": 8.1, "kind": "heartbeat", "boundary": 0, "nproc": 2,
+         "waited_s": 0.05},
+        {"t": 8.2, "kind": "heartbeat", "boundary": 1, "nproc": 2,
+         "waited_s": 0.02},
+        {"t": 8.3, "kind": "flight_dump", "path": "FLIGHT.json",
+         "reason": "HealthError: tripped", "classification": "fatal",
+         "events": 64},
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, events)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "heartbeats: 2 boundary sync(s), last boundary 1" \
+        in r.stdout
+    assert "FLIGHT RECORDER: 64 event(s) dumped to FLIGHT.json" \
+        in r.stdout
+
+
+def test_flight_mode_renders_dump(tmp_path):
+    dump = {"schema": 1, "t": 1.0, "session": "aaaa11112222",
+            "pid": 7, "reason": "HealthError: watchdog tripped",
+            "classification": "fatal",
+            "placement": {"nv": 100, "ne": 700, "num_parts": 2,
+                          "ndev": 4},
+            "health": {"kind": "health_trip", "engine": "pull",
+                       "flags": ["nonfinite_state"], "iteration": 3,
+                       "part": 1, "tripped": True},
+            "calibration": None,
+            "counts": {"segment": 3, "health_trip": 1},
+            "events": [{"t": 0.9, "tm": 1.1, "kind": "segment",
+                        "seconds": 0.1},
+                       {"t": 1.0, "tm": 1.2, "kind": "health_trip",
+                        "flags": ["nonfinite_state"]}]}
+    p = tmp_path / "FLIGHT.json"
+    p.write_text(json.dumps(dump))
+    r = run_summary("-flight", p)
+    assert r.returncode == 0, r.stderr
+    assert "== FLIGHT" in r.stdout
+    assert "reason: [fatal] HealthError" in r.stdout
+    assert "last health word: nonfinite_state" in r.stdout
+    assert "num_parts=2" in r.stdout and "ring: 2 event(s)" \
+        in r.stdout
+
+
+def test_flight_mode_rejects_non_dump(tmp_path):
+    p = tmp_path / "notflight.json"
+    p.write_text(json.dumps({"kind": "segment"}))
+    r = run_summary("-flight", p)
+    assert r.returncode == 1
+    assert "not a flight-recorder dump" in r.stderr
+
+
 def test_topology_fault_without_error_fails(tmp_path):
     events = [
         {"t": 5.0, "kind": "run_start", "app": "pagerank"},

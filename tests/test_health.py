@@ -65,7 +65,7 @@ def test_run_until_health_raises_on_nan_seed():
     eng = pagerank.build_engine(g, num_parts=2)
     bad = np.array(jax.device_get(eng.init_state()))
     bad[1, 0] = np.nan
-    _s, it, _res, _rb, _cb, h = eng.run_until_health(
+    _s, it, _res, _rb, _cb, _rp, _cp, h = eng.run_until_health(
         eng.place(bad), 1e-3, max_iters=50)
     assert int(jax.device_get(it)) == 1      # exits AT the trip
     with pytest.raises(hw.HealthError) as ei:
@@ -84,7 +84,7 @@ def test_healthy_run_until_matches_plain():
     eng = pagerank.build_engine(g, num_parts=2)
     s1, it1, res1 = eng.run_until(eng.init_state(), 1e-7,
                                   max_iters=200)
-    s2, it2, res2, _rb, _cb, h = eng.run_until_health(
+    s2, it2, res2, _rb, _cb, _rp, _cp, h = eng.run_until_health(
         eng.init_state(), 1e-7, max_iters=200)
     assert not hw.ensure_ok(h, engine="pull")["tripped"]
     assert int(jax.device_get(it1)) == int(jax.device_get(it2))
@@ -100,13 +100,13 @@ def test_run_health_bitwise_matches_run(np_parts, mesh_n):
     mesh = make_mesh(mesh_n) if mesh_n else None
     eng = pagerank.build_engine(g, num_parts=np_parts, mesh=mesh)
     want = eng.unpad(eng.run(eng.init_state(), 10))
-    s, it, rb, cb, h = eng.run_health(eng.init_state(), 10)
+    s, it, rb, cb, rbp, cbp, h = eng.run_health(eng.init_state(), 10)
     d = hw.ensure_ok(h, engine="pull")
     assert d == {"engine": "pull", "tripped": False, "flags": []}
     assert int(jax.device_get(it)) == 10
     np.testing.assert_array_equal(eng.unpad(s), want)
     # counters identical to the stats variant's
-    s2, rb2, cb2 = eng.run_stats(eng.init_state(), 10)
+    s2, rb2, cb2, _rbp2, _cbp2 = eng.run_stats(eng.init_state(), 10)
     np.testing.assert_array_equal(np.asarray(jax.device_get(rb)),
                                   np.asarray(jax.device_get(rb2)))
     np.testing.assert_array_equal(np.asarray(jax.device_get(cb)),
@@ -121,7 +121,7 @@ def test_divergence_trips_after_window():
     sg = ShardedGraph.build(g, 2)
     eng = PullEngine(sg, synthetic_program(lambda old: old * 2,
                                            init_val=1e-3))
-    _s, it, _rb, _cb, h = eng.run_health(eng.init_state(), 100)
+    _s, it, _rb, _cb, _rp, _cp, h = eng.run_health(eng.init_state(), 100)
     assert int(jax.device_get(it)) == hw.WINDOW
     with pytest.raises(hw.HealthError) as ei:
         hw.ensure_ok(h, engine="pull", where="test")
@@ -141,7 +141,7 @@ def test_oscillation_trips_after_window():
     g = small_graph(nv=40, ne=200, seed=3)
     sg = ShardedGraph.build(g, 2)
     eng = PullEngine(sg, synthetic_program(cycle, init_val=0.0))
-    _s, it, _rb, _cb, h = eng.run_health(eng.init_state(), 100)
+    _s, it, _rb, _cb, _rp, _cp, h = eng.run_health(eng.init_state(), 100)
     assert int(jax.device_get(it)) == hw.WINDOW
     with pytest.raises(hw.HealthError) as ei:
         hw.ensure_ok(h, engine="pull", where="test")
@@ -153,7 +153,7 @@ def test_converging_run_never_false_positives():
     DECREASES) must stay clean far past the window."""
     g = small_graph()
     eng = pagerank.build_engine(g, num_parts=2, health=True)
-    s, it, _rb, _cb, h = eng.run_health(eng.init_state(),
+    s, it, _rb, _cb, _rp, _cp, h = eng.run_health(eng.init_state(),
                                         4 * hw.WINDOW)
     assert not hw.ensure_ok(h, engine="pull")["tripped"]
     assert int(jax.device_get(it)) == 4 * hw.WINDOW
@@ -168,13 +168,13 @@ def test_converge_health_matches_converge(np_parts, mesh_n):
     eng = sssp.build_engine(g, start_vertex=1, num_parts=np_parts,
                             mesh=mesh)
     l1, a1, it1 = eng.converge(*eng.init_state())
-    l2, a2, it2, fsz, fed, h = eng.converge_health(*eng.init_state())
+    l2, a2, it2, fsz, fed, _fp, _ep, h = eng.converge_health(*eng.init_state())
     assert not hw.ensure_ok(h, engine="push")["tripped"]
     assert int(jax.device_get(it1)) == int(jax.device_get(it2))
     np.testing.assert_array_equal(np.asarray(jax.device_get(l1)),
                                   np.asarray(jax.device_get(l2)))
     # counters identical to the stats variant's
-    _l, _a, _it, fsz2, fed2 = eng.converge_stats(*eng.init_state())
+    _l, _a, _it, fsz2, fed2, _fp2, _ep2 = eng.converge_stats(*eng.init_state())
     np.testing.assert_array_equal(np.asarray(jax.device_get(fsz)),
                                   np.asarray(jax.device_get(fsz2)))
     np.testing.assert_array_equal(np.asarray(jax.device_get(fed)),
@@ -190,7 +190,7 @@ def test_push_nan_labels_trip():
     lb = np.array(jax.device_get(label))
     lb[0, 0] = np.nan
     label, active = eng.place(lb, np.array(jax.device_get(active)))
-    _l, _a, _it, _f, _e, h = eng.converge_health(label, active)
+    _l, _a, _it, _f, _e, _fp, _ep, h = eng.converge_health(label, active)
     with pytest.raises(hw.HealthError) as ei:
         hw.ensure_ok(h, engine="push", where="test")
     assert ei.value.checks == ["nonfinite_state"]
@@ -205,7 +205,7 @@ def test_push_inf_sentinel_never_trips():
     g = Graph.from_edges(src, dst, 120, weights=w)
     eng = sssp.build_engine(g, start_vertex=0, num_parts=2,
                             weighted=True, health=True)
-    label, _a, _it, _f, _e, h = eng.converge_health(*eng.init_state())
+    label, _a, _it, _f, _e, _fp, _ep, h = eng.converge_health(*eng.init_state())
     assert not hw.ensure_ok(h, engine="push")["tripped"]
     assert np.isinf(np.asarray(jax.device_get(label))).any()
 
@@ -226,7 +226,7 @@ def test_frontier_stall_trips_and_exits_loop():
     l0, a0, it0 = eng.converge(*eng.init_state(), max_iters=60)
     assert int(jax.device_get(it0)) == 60          # livelocked
     assert int(jax.device_get(jnp.sum(a0))) > 0
-    _l, _a, it, _f, _e, h = eng.converge_health(label, active,
+    _l, _a, it, _f, _e, _fp, _ep, h = eng.converge_health(label, active,
                                                 max_iters=2000)
     assert int(jax.device_get(it)) < 60            # exited early
     with pytest.raises(hw.HealthError) as ei:

@@ -56,7 +56,7 @@ def test_push_classic_counters_match_stepwise(np_parts, mesh_n):
     fronts, edges = stepwise_push_series(eng)
 
     label, active = eng.init_state()
-    l2, a2, it, fsz, fed = eng.converge_stats(label, active)
+    l2, a2, it, fsz, fed, fszp, fedp = eng.converge_stats(label, active)
     it = int(jax.device_get(it))
     assert it == len(fronts)
     assert np.asarray(fsz)[:it].tolist() == fronts
@@ -77,7 +77,7 @@ def test_components_counters_match_stepwise(np_parts, mesh_n):
     eng = components.build_engine(g, num_parts=np_parts, mesh=mesh)
     fronts, edges = stepwise_push_series(eng)
     label, active = eng.init_state()
-    _l, _a, it, fsz, fed = eng.converge_stats(label, active)
+    _l, _a, it, fsz, fed, fszp, fedp = eng.converge_stats(label, active)
     it = int(jax.device_get(it))
     assert np.asarray(fsz)[:it].tolist() == fronts
     assert np.asarray(fed)[:it].tolist() == edges
@@ -91,7 +91,7 @@ def test_push_delta_counters_match_timed_phases():
     eng = sssp.build_engine(g, start_vertex=0, num_parts=1,
                             weighted=True, delta="auto")
     label, active = eng.init_state()
-    _l, _a, it, fsz, _fed = eng.converge_stats(label, active)
+    _l, _a, it, fsz, _fed, _fp, _ep = eng.converge_stats(label, active)
     it = int(jax.device_get(it))
     lab0, act0 = eng.init_state()
     _l2, _a2, report = eng.timed_phases(lab0, act0, iters=it)
@@ -115,7 +115,7 @@ def test_pull_counters_match_stepwise(np_parts, mesh_n):
         chg_oracle.append(int((d > 0).sum()))
         prev = cur
 
-    s2, rb, cb = eng.run_stats(eng.init_state(), 5)
+    s2, rb, cb, rbp, cbp = eng.run_stats(eng.init_state(), 5)
     np.testing.assert_allclose(np.asarray(rb)[:5], res_oracle,
                                rtol=1e-6)
     assert np.asarray(cb)[:5].tolist() == chg_oracle
@@ -127,7 +127,7 @@ def test_pull_run_until_stats_matches_run_until():
     eng = pagerank.build_engine(g, num_parts=2)
     s1, it1, res1 = eng.run_until(eng.init_state(), 1e-6,
                                   max_iters=50)
-    s2, it2, res2, rb, cb = eng.run_until_stats(
+    s2, it2, res2, rb, cb, rbp, cbp = eng.run_until_stats(
         eng.init_state(), 1e-6, max_iters=50)
     it1, it2 = int(jax.device_get(it1)), int(jax.device_get(it2))
     assert it1 == it2
@@ -158,7 +158,7 @@ def test_stats_cap_truncation():
     eng = sssp.build_engine(g, start_vertex=1, num_parts=1)
     eng.stats_cap = 2     # read lazily when converge_stats compiles
     label, active = eng.init_state()
-    _l, _a, it, fsz, fed = eng.converge_stats(label, active)
+    _l, _a, it, fsz, fed, fszp, fedp = eng.converge_stats(label, active)
     it = int(jax.device_get(it))
     assert it > 2 and fsz.shape == (2,)
     st = telemetry.IterStats()
@@ -175,7 +175,7 @@ def test_segmented_accumulation_matches_unsegmented():
     g = small_graph()
     eng = sssp.build_engine(g, start_vertex=1, num_parts=1)
     label, active = eng.init_state()
-    _l, _a, it, fsz, _fed = eng.converge_stats(label, active)
+    _l, _a, it, fsz, _fed, _fp, _ep = eng.converge_stats(label, active)
     it = int(jax.device_get(it))
 
     st = telemetry.IterStats()
@@ -191,7 +191,7 @@ def test_segmented_accumulation_matches_unsegmented():
     assert all(e["engine"] == "push" for e in segs)
 
     peng = pagerank.build_engine(g, num_parts=1)
-    _s, rb, cb = peng.run_stats(peng.init_state(), 6)
+    _s, rb, cb, _rbp, _cbp = peng.run_stats(peng.init_state(), 6)
     st2 = telemetry.IterStats()
     with telemetry.use(iter_stats=st2):
         run_segments(peng, peng.init_state(), 6, segment=4)
@@ -254,7 +254,7 @@ def test_counters_exact_through_crash_resume(tmp_path):
     g = small_graph()
     eng = sssp.build_engine(g, start_vertex=1, num_parts=1)
     label, active = eng.init_state()
-    _l, _a, it, fsz, _fed = eng.converge_stats(label, active)
+    _l, _a, it, fsz, _fed, _fp, _ep = eng.converge_stats(label, active)
     it = int(jax.device_get(it))
     ref = np.asarray(fsz)[:it].tolist()
 
@@ -268,6 +268,185 @@ def test_counters_exact_through_crash_resume(tmp_path):
     assert report.attempts > 1, "no injected crash fired"
     assert total == it
     assert st.frontier == ref
+
+
+# -- round 13: per-part counters vs NumPy per-part oracles -------------
+#    (sum-over-parts must BITWISE-equal the scalar counter series; the
+#    engines reduce the same device-side values part-first)
+
+def per_part_push_oracle(eng):
+    """NumPy per-part oracle: stepwise frontier size and entering
+    out-edges PER PART — the decomposition the fused per-part
+    buffers must reproduce exactly."""
+    deg = np.asarray(eng.sg.deg_padded)
+    label, active = eng.init_state()
+    fronts_p, edges_p = [], []
+    cnt = int(jax.device_get(np.sum(np.asarray(active))))
+    while cnt > 0:
+        act = np.asarray(jax.device_get(active))
+        edges_p.append([int(deg[p][act[p]].sum())
+                        for p in range(act.shape[0])])
+        label, active, c = eng.step(label, active)
+        cnt = int(jax.device_get(c))
+        act = np.asarray(jax.device_get(active))
+        fronts_p.append([int(act[p].sum())
+                         for p in range(act.shape[0])])
+    return fronts_p, edges_p
+
+
+def per_part_pull_oracle(eng, iters):
+    """NumPy per-part oracle: stepwise max-abs residual and
+    changed-vertex count per part."""
+    prev = np.asarray(jax.device_get(eng.init_state())).copy()
+    res_p, chg_p = [], []
+    s = eng.init_state()
+    for _ in range(iters):
+        s = eng.step(s)
+        cur = np.asarray(jax.device_get(s)).copy()
+        d = np.abs(cur.astype(np.float32) - prev.astype(np.float32))
+        dp = d.reshape(d.shape[0], -1)
+        res_p.append(dp.max(axis=1).tolist())
+        chg_p.append([int((row > 0).sum()) for row in dp])
+        prev = cur
+    return res_p, chg_p
+
+
+@pytest.mark.parametrize("np_parts,mesh_n", [(4, 0), (8, 8)])
+def test_push_per_part_counters_match_oracle(np_parts, mesh_n):
+    """converge_stats per-part buffers vs the NumPy per-part oracle,
+    on 1 device (mesh_n=0) and the full 8-virtual-device mesh; the
+    scalar series must be the bitwise sum of the per-part rows."""
+    g = small_graph()
+    mesh = make_mesh(mesh_n) if mesh_n else None
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=np_parts,
+                            mesh=mesh)
+    fronts_p, edges_p = per_part_push_oracle(eng)
+    label, active = eng.init_state()
+    _l, _a, it, fsz, fed, fszp, fedp = eng.converge_stats(label,
+                                                          active)
+    it = int(jax.device_get(it))
+    fszp = np.asarray(jax.device_get(fszp))
+    fedp = np.asarray(jax.device_get(fedp))
+    assert fszp.shape == (eng.stats_cap, np_parts)
+    assert fszp[:it].tolist() == fronts_p
+    assert fedp[:it].tolist() == edges_p
+    # sum-over-parts == the scalar series, BITWISE
+    np.testing.assert_array_equal(
+        fszp[:it].sum(axis=1, dtype=np.int64),
+        np.asarray(jax.device_get(fsz))[:it])
+    np.testing.assert_array_equal(
+        fedp[:it].astype(np.uint64).sum(axis=1).astype(np.uint32),
+        np.asarray(jax.device_get(fed))[:it])
+    assert not fszp[it:].any() and not fedp[it:].any()
+
+
+@pytest.mark.parametrize("np_parts,mesh_n", [(4, 0), (8, 8)])
+def test_pull_per_part_counters_match_oracle(np_parts, mesh_n):
+    g = small_graph(seed=11)
+    mesh = make_mesh(mesh_n) if mesh_n else None
+    eng = pagerank.build_engine(g, num_parts=np_parts, mesh=mesh)
+    res_p, chg_p = per_part_pull_oracle(eng, 5)
+    _s, rb, cb, rbp, cbp = eng.run_stats(eng.init_state(), 5)
+    rbp = np.asarray(jax.device_get(rbp))
+    cbp = np.asarray(jax.device_get(cbp))
+    np.testing.assert_array_equal(rbp[:5], np.asarray(res_p,
+                                                      np.float32))
+    assert cbp[:5].tolist() == chg_p
+    # max/sum over parts == the scalar series, BITWISE
+    np.testing.assert_array_equal(rbp[:5].max(axis=1),
+                                  np.asarray(jax.device_get(rb))[:5])
+    np.testing.assert_array_equal(
+        cbp[:5].astype(np.uint64).sum(axis=1).astype(np.uint32),
+        np.asarray(jax.device_get(cb))[:5])
+
+
+def test_per_part_counters_ride_health_variants():
+    """The *_health loop variants carry the same per-part counters
+    (bitwise-equal to the *_stats variants'): converge_health,
+    run_health and run_until_health vs their stats twins on the same
+    per_part oracle contract."""
+    g = small_graph()
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=4)
+    _l, _a, it, _f, _e, fszp, fedp = eng.converge_stats(
+        *eng.init_state())
+    _l2, _a2, _it2, _f2, _e2, fszp2, fedp2, h = eng.converge_health(
+        *eng.init_state())
+    np.testing.assert_array_equal(np.asarray(jax.device_get(fszp)),
+                                  np.asarray(jax.device_get(fszp2)))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(fedp)),
+                                  np.asarray(jax.device_get(fedp2)))
+
+    peng = pagerank.build_engine(g, num_parts=4)
+    _s, _rb, _cb, rbp, cbp = peng.run_stats(peng.init_state(), 6)
+    _s2, _it, _rb2, _cb2, rbp2, cbp2, _h = peng.run_health(
+        peng.init_state(), 6)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(rbp)),
+                                  np.asarray(jax.device_get(rbp2)))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(cbp)),
+                                  np.asarray(jax.device_get(cbp2)))
+
+    _s3, it3, _r3, rb3, cb3, rbp3, cbp3 = peng.run_until_stats(
+        peng.init_state(), 1e-6, max_iters=6)
+    _s4, it4, _r4, _rb4, _cb4, rbp4, cbp4, _h4 = \
+        peng.run_until_health(peng.init_state(), 1e-6, max_iters=6)
+    assert int(jax.device_get(it3)) == int(jax.device_get(it4))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(rbp3)),
+                                  np.asarray(jax.device_get(rbp4)))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(cbp3)),
+                                  np.asarray(jax.device_get(cbp4)))
+
+
+def test_iter_stats_imbalance_digest():
+    """IterStats per-part accumulation: part totals, the max/mean
+    imbalance index, the summary fields and the bench digest."""
+    st = telemetry.IterStats()
+    fsz = np.asarray([3, 2], np.int32)
+    fed = np.asarray([30, 10], np.uint32)
+    fszp = np.asarray([[2, 1], [1, 1]], np.int32)
+    fedp = np.asarray([[25, 5], [5, 5]], np.uint32)
+    st.extend_push(fsz, fed, 2, fszp, fedp)
+    assert st.num_parts() == 2
+    assert st.part_totals() == [30, 10]          # edges per part
+    assert st.imbalance() == pytest.approx(30 / 20)
+    s = st.summary()
+    assert s["parts"] == 2 and s["parts_edges"] == [30, 10]
+    assert s["imbalance"] == pytest.approx(1.5)
+    assert sum(s["parts_edges"]) == s["edges_sum"]    # bitwise
+    d = st.imbalance_digest()
+    assert d == {"kind": "push", "index": 1.5, "parts": [30, 10]}
+    lines = list(st.parts_lines())
+    assert "imbalance 1.500" in lines[0]
+    assert any("part 0: 30" in ln for ln in lines)
+    # per-part-free runs keep the legacy digest shape
+    st2 = telemetry.IterStats()
+    st2.extend_push(fsz, fed, 2)
+    assert st2.part_totals() is None
+    assert st2.imbalance_digest() is None
+    assert "parts" not in st2.summary()
+
+
+def test_segmented_per_part_accumulation_matches_unsegmented():
+    """Per-part series must be boundary-invisible exactly like the
+    scalar series (the supervised drivers fetch the part buffers once
+    per segment)."""
+    from lux_tpu.segmented import converge_segments
+
+    g = small_graph()
+    eng = sssp.build_engine(g, start_vertex=1, num_parts=4)
+    label, active = eng.init_state()
+    _l, _a, it, _f, _e, fszp, fedp = eng.converge_stats(label, active)
+    it = int(jax.device_get(it))
+    st = telemetry.IterStats()
+    with telemetry.use(iter_stats=st):
+        label, active = eng.init_state()
+        converge_segments(eng, label, active, segment=2)
+    assert st.frontier_parts == \
+        np.asarray(jax.device_get(fszp))[:it].tolist()
+    assert st.edges_parts == \
+        np.asarray(jax.device_get(fedp))[:it].tolist()
+    # and the digest's bitwise contract holds over the whole run
+    s = st.summary()
+    assert sum(s["parts_edges"]) == s["edges_sum"]
 
 
 def test_event_log_and_null_handle():

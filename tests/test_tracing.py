@@ -1,0 +1,288 @@
+"""lux_tpu/tracing.py: span timeline export, crash flight recorder,
+and the line-atomic multi-writer event log (round-13 tentpole).
+
+Acceptance bars under test:
+- trace-export round trip on a RECORDED elastic-drill event log:
+  spans nest, no orphans, the mesh-shrink instant marker is present
+  and post-shrink execution spans move to a new track;
+- two concurrent writer processes sharing one event file can never
+  interleave mid-line (EventLog's single-write O_APPEND contract),
+  and the merged log exports with one trace process per stream;
+- the flight recorder dumps a diagnosable FLIGHT.json on an injected
+  NaN fault (last health word + recent-event ring), atomically;
+- the ``python -m lux_tpu.tracing`` smoke exports a valid trace from
+  a CPU app run (the tier-1 smoke).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lux_tpu import telemetry, tracing
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_recorder():
+    yield
+    tracing.uninstall_flight_recorder()
+
+
+def _spans(trace, cat=None):
+    return [e for e in trace["traceEvents"] if e.get("ph") == "X"
+            and (cat is None or e.get("cat") == cat)]
+
+
+def _instants(trace, name=None):
+    return [e for e in trace["traceEvents"] if e.get("ph") == "i"
+            and (name is None or e["name"] == name)]
+
+
+# ---------------------------------------------------------------------
+# trace export: recorded elastic drill round trip
+
+@pytest.fixture(scope="module")
+def drill_events(tmp_path_factory):
+    """One recorded in-process elastic drill (DEVICE_LOSS at a
+    segment boundary, re-placement onto the surviving half-mesh) —
+    the round-trip source log."""
+    wd = tmp_path_factory.mktemp("drill")
+    path = str(wd / "events.jsonl")
+    tracing.run_loss_drill(str(wd), path)
+    events, errs = tracing.load_events(path)
+    assert not errs, errs
+    return events
+
+
+def test_trace_export_round_trip_elastic_drill(drill_events,
+                                               tmp_path):
+    out = str(tmp_path / "trace.json")
+    trace = tracing.trace_export(drill_events, out=out)
+    # the written artifact IS the returned trace
+    assert json.load(open(out)) == trace
+    # machine-validated: spans nest, no orphans
+    assert tracing.validate_trace(trace) == []
+    # the elastic story is on the timeline: a run span, >= 2 attempt
+    # spans (the topology fault forced a retry), the mesh-shrink
+    # instant marker, and execution spans on BOTH sides of the shrink
+    assert len(_spans(trace, "run")) == 1
+    assert len(_spans(trace, "attempt")) >= 2
+    assert len(_instants(trace, "mesh_shrink")) == 1
+    tids = {e["tid"] for e in _spans(trace, "exec")}
+    assert len(tids) >= 2, \
+        "post-shrink exec spans must move to a new track"
+    # every exec span has positive extent and numeric bounds
+    assert all(e["dur"] >= 0 and e["ts"] >= 0
+               for e in _spans(trace))
+    # the run span carries the per-part imbalance digest
+    run = _spans(trace, "run")[0]
+    ist = run.get("args", {}).get("iter_stats")
+    assert ist and "imbalance" in ist and "parts_changed" in ist
+    assert sum(ist["parts_changed"]) == ist["changed_sum"]
+
+
+def test_trace_export_merges_streams_onto_separate_tracks(
+        drill_events, tmp_path):
+    """A two-process log (same shape a heartbeat drill appends into
+    one shared file) exports with one trace process per (session,
+    pid) stream."""
+    second = []
+    for ev in drill_events:
+        ev2 = dict(ev)
+        ev2["session"] = "feedfacebeef"
+        ev2["pid"] = 424242
+        second.append(ev2)
+    merged = []
+    for a, b in zip(drill_events, second):   # fully interleaved
+        merged += [a, b]
+    trace = tracing.trace_export(merged)
+    assert tracing.validate_trace(trace) == []
+    assert trace["otherData"]["streams"] == 2
+    pids = {e.get("pid") for e in _spans(trace, "run")}
+    assert len(pids) == 2
+    names = {e["args"]["name"]
+             for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert any("feedfacebeef" in n for n in names)
+
+
+def test_validate_trace_catches_overlap_and_orphan():
+    base = dict(ph="X", cat="exec", pid=0, tid=1)
+    run = dict(ph="X", cat="run", name="run", ts=0.0, dur=100.0,
+               pid=0, tid=0)
+    # partial overlap on one track
+    bad = {"traceEvents": [
+        run, dict(base, name="a", ts=10.0, dur=50.0),
+        dict(base, name="b", ts=40.0, dur=50.0)]}
+    errs = tracing.validate_trace(bad)
+    assert any("must nest" in e for e in errs)
+    # orphan: outside every run span
+    bad2 = {"traceEvents": [
+        run, dict(base, name="late", ts=150.0, dur=10.0)]}
+    errs2 = tracing.validate_trace(bad2)
+    assert any("orphan" in e for e in errs2)
+    # the clean version of the same shapes validates
+    good = {"traceEvents": [
+        run, dict(base, name="a", ts=10.0, dur=30.0),
+        dict(base, name="b", ts=50.0, dur=30.0)]}
+    assert tracing.validate_trace(good) == []
+
+
+# ---------------------------------------------------------------------
+# EventLog: line-atomic appends under concurrent multi-process writers
+
+_WRITER = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from lux_tpu import telemetry
+ev = telemetry.EventLog({path!r})
+pad = "x" * 2000          # long lines provoke torn buffered writes
+for i in range(300):
+    ev.emit("writer_mark", i=i, who={who!r}, pad=pad)
+ev.close()
+print("WRITER_DONE")
+"""
+
+
+def test_event_log_concurrent_writers_line_atomic(tmp_path):
+    """Two processes appending 300 long events each into ONE file:
+    every line must parse (no mid-line interleaving — the O_APPEND
+    single-write contract) and each (session, pid) stream must be
+    complete and in order."""
+    path = str(tmp_path / "shared.jsonl")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c",
+         _WRITER.format(repo=str(REPO), path=path, who=f"w{i}")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    lines = open(path).read().splitlines()
+    assert len(lines) == 600
+    events = [json.loads(ln) for ln in lines]      # raises on a tear
+    by_pid = {}
+    for e in events:
+        assert e["kind"] == "writer_mark"
+        by_pid.setdefault((e["session"], e["pid"]), []).append(e)
+    assert len(by_pid) == 2
+    for evs in by_pid.values():
+        assert [e["i"] for e in evs] == list(range(300))
+        tms = [e["tm"] for e in evs]
+        assert tms == sorted(tms)
+
+
+# ---------------------------------------------------------------------
+# flight recorder
+
+def test_flight_dump_on_injected_nan_fault(tmp_path):
+    """An injected NaN fault under the health watchdog kills the
+    supervised run with a FATAL HealthError — and the flight recorder
+    leaves a FLIGHT.json carrying the health_trip word, placement
+    metadata and the recent-event ring."""
+    from lux_tpu import faults, health, resilience
+    from lux_tpu.apps import pagerank
+    from lux_tpu.convert import uniform_random_edges
+    from lux_tpu.graph import Graph
+
+    src, dst = uniform_random_edges(100, 700, seed=61)
+    g = Graph.from_edges(src, dst, 100)
+    eng = pagerank.build_engine(g, num_parts=2, health=True)
+    flight = str(tmp_path / "FLIGHT.json")
+    rec = tracing.install_flight_recorder(flight, capacity=64)
+    plan = faults.FaultPlan(schedule={1: faults.NAN})
+    ev = telemetry.EventLog()
+    with telemetry.use(events=ev):
+        ev.emit("header", schema=telemetry.SCHEMA, nv=g.nv, ne=g.ne,
+                num_parts=2)
+        with pytest.raises(health.HealthError):
+            resilience.supervised_run(
+                eng, 12, str(tmp_path / "ck.npz"), segment=3,
+                faults=plan, guard=False,
+                policy=resilience.RetryPolicy(retries=2,
+                                              sleep=lambda s: None))
+    assert rec.dumps == 1
+    assert os.path.exists(flight)
+    doc = tracing.load_flight(flight)
+    assert doc["classification"] == "fatal"
+    assert "HealthError" in doc["reason"]
+    assert doc["health"]["kind"] == "health_trip"
+    assert "nonfinite_state" in doc["health"]["flags"]
+    assert doc["placement"]["num_parts"] == 2
+    kinds = {e["kind"] for e in doc["events"]}
+    assert {"segment", "health_trip", "failure"} <= kinds
+    # the dump itself left its trail in the event log
+    assert ev.counts().get("flight_dump") == 1
+
+    # events_summary renders the postmortem
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "events_summary.py"),
+         "-flight", flight],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "nonfinite_state" in r.stdout
+    assert "FLIGHT" in r.stdout
+
+
+def test_flight_dump_is_atomic_and_bounded(tmp_path):
+    rec = tracing.install_flight_recorder(
+        str(tmp_path / "F.json"), capacity=8)
+    with telemetry.use(events=telemetry.EventLog()) as tel:
+        for i in range(40):
+            tel.emit("segment", engine="pull", n=1, done=i,
+                     seconds=0.01)
+    path = tracing.flight_dump(reason="test", classification="fatal")
+    doc = tracing.load_flight(path)
+    assert len(doc["events"]) == 8                # ring is bounded
+    assert doc["events"][-1]["done"] == 39        # ...keeping newest
+    assert not [f for f in os.listdir(tmp_path)
+                if f.endswith(".tmp")]            # atomic: no litter
+    # no recorder installed -> dump is a no-op None
+    tracing.uninstall_flight_recorder()
+    assert tracing.flight_dump() is None
+
+
+def test_observer_sees_events_without_a_sink():
+    """A flight recorder must capture the trail even when no -events
+    sink is configured (Telemetry.emit's observer-only path)."""
+    rec = tracing.install_flight_recorder("unused.json", capacity=4)
+    with telemetry.use():                      # no EventLog at all
+        telemetry.current().emit("retry", attempt=0, error="X")
+    assert [e["kind"] for e in rec.ring] == ["retry"]
+
+
+# ---------------------------------------------------------------------
+# CLI smoke (the tier-1 gate: python -m lux_tpu.tracing)
+
+def test_tracing_cli_smoke(tmp_path):
+    out = str(tmp_path / "trace.json")
+    rc = tracing.main(["-scale", "6", "-np", "2", "-apps", "sssp",
+                       "-o", out, "-workdir", str(tmp_path)])
+    assert rc == 0
+    trace = json.load(open(out))
+    assert tracing.validate_trace(trace) == []
+    assert len(_spans(trace, "run")) == 1
+    assert _spans(trace, "exec")          # the timed run span
+    # the events JSONL it recorded is events_summary-clean
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "events_summary.py"),
+         str(tmp_path / "events.jsonl")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "per-part" in r.stdout
+
+
+def test_tracing_cli_exports_existing_log(drill_events, tmp_path):
+    src = tmp_path / "ev.jsonl"
+    src.write_text("".join(json.dumps(e) + "\n"
+                           for e in drill_events))
+    out = str(tmp_path / "t.json")
+    rc = tracing.main([str(src), "-o", out])
+    assert rc == 0
+    trace = json.load(open(out))
+    assert len(_instants(trace, "mesh_shrink")) == 1
