@@ -110,7 +110,17 @@ PAIR_THRESHOLD = 16   # default; override with -pair
 DEFAULT_SHAPE = {"pagerank": (21, 16), "cc": (20, 16),
                  "sssp": (21, 16), "sssp-delta": (21, 16),
                  "colfilter": (16, 128), "pagerank-mp": (23, 16),
-                 "sssp-mp": (23, 16)}
+                 "sssp-mp": (23, 16),
+                 # query-batched engines (ROADMAP item 2): k-source
+                 # SSSP + personalized PageRank; `-config batch-sweep`
+                 # expands over -batch (default B in {1, 8, 64}) and
+                 # each line records batch + query_gteps = B x the
+                 # machine rate — one gather serving B queries, so
+                 # per-query delivered cost is 1/query_gteps ns/edge
+                 "ksssp-batch": (20, 16), "ppr-batch": (20, 16)}
+
+# the batch-sweep expansion (one metric line per B per app)
+BATCH_SWEEP_DEFAULT = "1,8,64"
 
 
 def build_graph(scale, ef, verbose, weighted=False):
@@ -212,6 +222,48 @@ def run_config(config, args):
     import numpy as np
 
     from lux_tpu.graph import pair_relabel
+
+    if config.startswith(("ksssp-batch", "ppr-batch")):
+        # query-batched configs (ROADMAP item 2): "<base>@B" names
+        # one sweep point — handled BEFORE the generic shape lookup
+        # (DEFAULT_SHAPE is keyed by the base name, not "@B").
+        # Sources are a fixed-seed draw so every sweep point (and
+        # every round) serves the same query set; pair delivery is
+        # scalar-state and stays off.
+        base, _, bstr = config.partition("@")
+        B = int(bstr) if bstr else 8
+        scale = args.scale or DEFAULT_SHAPE[base][0]
+        ef = args.ef or DEFAULT_SHAPE[base][1]
+        extra = {"np": args.np, "scale": scale, "ef": ef}
+        g = build_graph(scale, ef, args.verbose)
+        rng = np.random.default_rng(7)
+        sources = sorted(int(x) for x in
+                         rng.choice(g.nv, size=B, replace=False))
+        if base == "ksssp-batch":
+            from lux_tpu.apps import sssp
+            eng = sssp.build_engine(g, sources=sources,
+                                    num_parts=args.np,
+                                    health=args.health)
+            extra.update(batch=B, relabel=False, pair_threshold=None,
+                         exchange=eng.exchange)
+            _audit_build(eng, args, extra)
+            samples, rerun = bench_converge(eng, g.ne, args.verbose,
+                                            args.repeats)
+            name = f"ksssp_b{B}_rmat{scale}"
+        else:
+            from lux_tpu.apps import pagerank
+            eng = pagerank.build_engine(g, num_parts=args.np,
+                                        sources=sources,
+                                        health=args.health)
+            extra.update(batch=B, relabel=False, pair_threshold=None,
+                         exchange=eng.exchange)
+            _audit_build(eng, args, extra)
+            samples, rerun = bench_fused(eng, g.ne, args.ni,
+                                         args.verbose, args.repeats)
+            name = f"ppr_b{B}_rmat{scale}"
+        extra["ne"] = int(g.ne)
+        return (name, [s / 1e9 for s in samples], extra,
+                lambda: rerun() / 1e9)
 
     scale = args.scale or DEFAULT_SHAPE[config][0]
     ef = args.ef or DEFAULT_SHAPE[config][1]
@@ -331,11 +383,25 @@ def emit(name, samples, extra, attempts=None, discarded=(),
     is detected, not medianed).  scripts/check_bench.py validates
     all of it.  Returns the line dict (artifact/ledger writers)."""
     gteps = median(samples)
+    per_query = {}
+    if "batch" in extra:
+        # the machine rate serves every query of the batch at once:
+        # query_gteps = B x value is the delivered query-edge
+        # throughput, and 1/query_gteps the per-query ns/edge cost
+        # (the ~9/B amortization, PERF_NOTES "query batching");
+        # scripts/check_bench.py cross-checks it against batch*value
+        qg = round(gteps * extra["batch"], 4)
+        # derive the ns cost from the ROUNDED rate so the published
+        # pair is self-consistent to the digits it carries
+        per_query = {"query_gteps": qg,
+                     "per_query_edge_ns": (round(1.0 / qg, 4)
+                                           if qg > 0 else None)}
     result = {
         "metric": f"{name}_gteps_per_chip",
         "value": round(gteps, 4),
         "unit": "GTEPS",
         "vs_baseline": round(gteps / 1.0, 4),
+        **per_query,
         "samples": [round(s, 4) for s in samples],
         "attempts": len(samples) if attempts is None else attempts,
         "discarded": [round(d, 4) for d in discarded],
@@ -431,9 +497,15 @@ def config_telemetry(events, start_idx, iter_stats):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-config", default=None,
-                    choices=list(DEFAULT_SHAPE),
+                    choices=list(DEFAULT_SHAPE) + ["batch-sweep"],
                     help="run ONE config (default: all five, "
-                         "pagerank last)")
+                         "pagerank last); 'batch-sweep' expands "
+                         "ksssp-batch + ppr-batch over -batch "
+                         "(one metric line per B)")
+    ap.add_argument("-batch", default=BATCH_SWEEP_DEFAULT,
+                    help="comma list of query-batch widths B for the "
+                         "ksssp-batch/ppr-batch/batch-sweep configs "
+                         f"(default {BATCH_SWEEP_DEFAULT!r})")
     ap.add_argument("-all", action="store_true",
                     help="run every config (pagerank last; the "
                          "default when -config is not given)")
@@ -547,6 +619,25 @@ def main() -> int:
     configs = ([args.config] if args.config and not args.all
                else ["cc", "sssp", "sssp-delta", "colfilter",
                      "sssp-mp", "pagerank-mp", "pagerank"])
+    try:
+        batch_widths = [int(b) for b in
+                        str(args.batch).split(",") if b.strip()]
+    except ValueError:
+        ap.error(f"-batch must be a comma list of ints, got "
+                 f"{args.batch!r}")
+    if any(b < 1 for b in batch_widths) or not batch_widths:
+        ap.error("-batch widths must be >= 1")
+    # expand the batch configs into one sweep point per width
+    expanded = []
+    for c in configs:
+        if c == "batch-sweep":
+            expanded += [f"ksssp-batch@{b}" for b in batch_widths]
+            expanded += [f"ppr-batch@{b}" for b in batch_widths]
+        elif c in ("ksssp-batch", "ppr-batch"):
+            expanded += [f"{c}@{b}" for b in batch_widths]
+        else:
+            expanded.append(c)
+    configs = expanded
     failures = 0
     # one event log for the whole bench run (in-memory always — the
     # timed_run events are the per-config telemetry field; -events

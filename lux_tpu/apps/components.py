@@ -31,6 +31,41 @@ def make_program() -> PushProgram:
                        name="components")
 
 
+def make_batched_program(seeds) -> PushProgram:
+    """Batched SEEDED components: labels ``[vpad, B]`` with column q
+    the propagation from the single seed ``seeds[q]`` — label[v, q]
+    converges to ``seeds[q]`` where v is reachable from the seed and
+    stays -1 elsewhere (on a symmetrized graph: the membership
+    labeling of the seed's component).  One label gather per dense
+    iteration serves every query (ROADMAP item 2); columns retire
+    independently through their active masks.  Max fixed points are
+    unique, so each column is bitwise-equal to the single-seed run
+    (tests/test_batched.py)."""
+    seeds = [int(s) for s in seeds]
+    if not seeds:
+        raise ValueError("seeds must name at least one query")
+    B = len(seeds)
+
+    def relax(src_label, w):
+        return src_label
+
+    def init(sg: ShardedGraph):
+        for s in seeds:
+            if not 0 <= s < sg.nv:
+                raise ValueError(
+                    f"seed vertex {s} out of range [0, {sg.nv})")
+        labels = np.full((sg.nv, B), -1, dtype=np.int32)
+        active = np.zeros((sg.nv, B), dtype=bool)
+        for q, s in enumerate(seeds):
+            labels[s, q] = s
+            active[s, q] = True
+        return sg.to_padded(labels), sg.to_padded(active)
+
+    return PushProgram(reduce="max", relax=relax,
+                       identity=np.int32(-1), init=init,
+                       name="cc_seeded", batch=B)
+
+
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
@@ -40,6 +75,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
                  health: bool = False,
+                 sources=None,
                  audit: str | None = None) -> PushEngine:
     """pair_threshold enables pair-lane delivery on dense iterations
     (best after graph.pair_relabel, passing its ``starts`` through;
@@ -47,11 +83,18 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
     permutation).  enable_sparse=False drops the src-sorted frontier
     view — the big-scale fit lever (it re-doubles edge memory,
     ShardedGraph.memory_report(push_sparse=True)); every iteration
-    then runs dense."""
+    then runs dense.
+
+    sources=[a, b, ...] builds the QUERY-BATCHED seeded engine
+    (``make_batched_program``): column q labels the vertices
+    reachable from seed a with the seed's id (labels [vpad, B], one
+    gather serving every query); pair_threshold must be off then."""
     if sg is None:
         sg = ShardedGraph.build(g, num_parts, starts=starts,
                                 pair_threshold=pair_threshold)
-    return PushEngine(sg, make_program(), mesh=mesh,
+    program = (make_program() if sources is None
+               else make_batched_program(sources))
+    return PushEngine(sg, program, mesh=mesh,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill, exchange=exchange,
                       enable_sparse=enable_sparse, owner_tile_e=owner_tile_e,
@@ -79,6 +122,25 @@ def reference_components(g: Graph) -> np.ndarray:
     """NumPy oracle: iterate max-propagation to fixed point."""
     src, dst = g.edge_arrays()
     labels = np.arange(g.nv, dtype=np.int64)
+    while True:
+        new = labels.copy()
+        np.maximum.at(new, dst, labels[src])
+        if np.array_equal(new, labels):
+            return labels
+        labels = new
+
+
+def reference_components_batched(g: Graph, seeds) -> np.ndarray:
+    """NumPy seeded-propagation oracle -> ``[nv, B]`` labels: column q
+    is ``seeds[q]`` where the vertex is reachable from the seed, -1
+    elsewhere.  Column q is BITWISE-equal to running this oracle with
+    the single seed ``[seeds[q]]`` (max fixed points are unique;
+    tests/test_batched.py asserts the column equality)."""
+    src, dst = g.edge_arrays()
+    B = len(seeds)
+    labels = np.full((g.nv, B), -1, dtype=np.int64)
+    for q, s in enumerate(seeds):
+        labels[int(s), q] = int(s)
     while True:
         new = labels.copy()
         np.maximum.at(new, dst, labels[src])

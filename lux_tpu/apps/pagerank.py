@@ -47,6 +47,69 @@ def make_program(dtype=jnp.float32) -> PullProgram:
                        name="pagerank")
 
 
+def one_hot_resets(nv: int, sources) -> np.ndarray:
+    """[nv, B] reset matrix with column q the one-hot distribution of
+    ``sources[q]`` — the classic 'personalized to one vertex' case."""
+    sources = [int(s) for s in sources]
+    resets = np.zeros((nv, len(sources)), dtype=np.float32)
+    for q, s in enumerate(sources):
+        if not 0 <= s < nv:
+            raise ValueError(f"source vertex {s} out of range [0, {nv})")
+        resets[s, q] = 1.0
+    return resets
+
+
+def make_batched_program(resets, dtype=jnp.float32) -> PullProgram:
+    """Personalized PageRank over a query batch: state ``[vpad, B]``
+    degree-normalized ranks, one column per query, with per-query
+    reset vectors ``resets [nv, B]`` (each column a distribution over
+    vertices; the uniform column 1/nv recovers the classic program).
+    Update per column: ``pr = (1-ALPHA) * reset_q + ALPHA * sum``
+    (the reference's damping quirk, see module docstring), then the
+    same degree normalization.
+
+    The reset matrix rides ``PullProgram.extra_arrays`` — a jit
+    ARGUMENT the engine ships like any graph array (``ctx.extra
+    ['reset']``), so the no-closure convention holds and the serving
+    front-end can swap retired columns' resets in place
+    (PullEngine.update_program_arrays).  ONE state-table gather per
+    dense iteration serves all B queries (audit gather-budget);
+    ``state_bytes = 4B`` keeps the auto-exchange and ledger
+    estimates honest at B > 1."""
+    resets = np.asarray(resets, dtype=np.dtype(dtype))
+    if resets.ndim != 2:
+        raise ValueError(f"resets must be [nv, B], got {resets.shape}")
+    B = resets.shape[1]
+
+    def edge_value(src_val, dst_val, weight):
+        return src_val
+
+    def apply(old, red, ctx):
+        reset = ctx.extra["reset"]
+        pr = (1.0 - ALPHA) * reset + ALPHA * red
+        deg = ctx.deg.astype(pr.dtype)[:, None]
+        return jnp.where(ctx.deg[:, None] > 0,
+                         pr / jnp.maximum(deg, 1), pr)
+
+    def init(sg: ShardedGraph):
+        if resets.shape[0] != sg.nv:
+            raise ValueError(f"resets rows {resets.shape[0]} != nv "
+                             f"{sg.nv}")
+        deg = np.asarray(sg.deg_padded)[..., None]
+        r = sg.to_padded(resets)
+        return np.where(deg > 0, r / np.maximum(deg, 1),
+                        r).astype(np.dtype(dtype))
+
+    def extra_arrays(sg: ShardedGraph):
+        return {"reset": sg.to_padded(resets)}
+
+    return PullProgram(reduce="sum", edge_value=edge_value, apply=apply,
+                       init=init, needs_dst=False,
+                       state_bytes=np.dtype(dtype).itemsize * B,
+                       name="ppr", extra_arrays=extra_arrays,
+                       batch=B)
+
+
 def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  dtype=jnp.float32, sg: ShardedGraph | None = None,
                  pair_threshold: int | None = None,
@@ -55,6 +118,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  exchange: str = "auto",
                  owner_tile_e: int | None = None,
                  health: bool = False,
+                 sources=None, resets=None,
                  audit: str | None = None) -> PullEngine:
     """starts: partition cut points (e.g. from graph.pair_relabel for
     balanced multi-part pair delivery).  tile_e default: 128 with pair
@@ -64,13 +128,26 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
     once the state table outgrows ~64 MB.  health=True runs the
     device-side health watchdog loop variants (lux_tpu/health.py).
     audit='warn'|'error' statically audits every compiled program
-    variant at build time (lux_tpu/audit.py)."""
+    variant at build time (lux_tpu/audit.py).
+
+    sources=[a, b, ...] builds the QUERY-BATCHED personalized engine
+    with one-hot reset vectors (state [vpad, B] — one gather serves
+    every query); resets [nv, B] passes arbitrary per-query reset
+    distributions instead.  Batched engines reject pair_threshold
+    (pair delivery reads scalar state)."""
+    if sources is not None and resets is not None:
+        raise ValueError("pass sources=[...] OR resets=[nv, B], "
+                         "not both")
+    if sources is not None:
+        resets = one_hot_resets(g.nv, sources)
     if sg is None:
         sg = ShardedGraph.build(g, num_parts, starts=starts,
                                 pair_threshold=pair_threshold)
     if tile_e is None:
         tile_e = 128 if pair_threshold is not None else 512
-    return PullEngine(sg, make_program(dtype), mesh=mesh,
+    program = (make_program(dtype) if resets is None
+               else make_batched_program(resets, dtype))
+    return PullEngine(sg, program, mesh=mesh,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill, tile_e=tile_e,
                       exchange=exchange, owner_tile_e=owner_tile_e,
@@ -117,5 +194,28 @@ def reference_pagerank(g: Graph, num_iters: int) -> np.ndarray:
         acc = np.zeros(g.nv, dtype=np.float64)
         np.add.at(acc, dst, state[src])
         pr = (1.0 - ALPHA) / g.nv + ALPHA * acc
+        state = np.where(deg > 0, pr / np.maximum(deg, 1), pr)
+    return state
+
+
+def reference_pagerank_batched(g: Graph, resets,
+                               num_iters: int) -> np.ndarray:
+    """NumPy personalized-PageRank oracle -> ``[nv, B]``
+    degree-normalized ranks, one column per reset vector.
+
+    Column q is BITWISE-equal to running this oracle with the single
+    column ``resets[:, q:q+1]``: the vectorized ``np.add.at``
+    accumulates each column over the identical edge sequence, so the
+    per-column float-summation order is the single-query order
+    (tests/test_batched.py asserts it).  A uniform 1/nv column
+    reproduces ``reference_pagerank`` exactly."""
+    src, dst = g.edge_arrays()
+    resets = np.asarray(resets, dtype=np.float64)
+    deg = g.out_degrees.astype(np.float64)[:, None]
+    state = np.where(deg > 0, resets / np.maximum(deg, 1), resets)
+    for _ in range(num_iters):
+        acc = np.zeros_like(state)
+        np.add.at(acc, dst, state[src])
+        pr = (1.0 - ALPHA) * resets + ALPHA * acc
         state = np.where(deg > 0, pr / np.maximum(deg, 1), pr)
     return state

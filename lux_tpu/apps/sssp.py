@@ -56,6 +56,50 @@ def make_program(start_vertex: int, weighted: bool = False) -> PushProgram:
                        init=init, name="sssp")
 
 
+def make_batched_program(sources, weighted: bool = False) -> PushProgram:
+    """k-source SSSP: labels carry a query-batch axis ``[vpad, B]``
+    with column q the independent single-source run from
+    ``sources[q]`` (ROADMAP item 2: ONE label gather per dense
+    iteration serves all B queries; columns retire independently
+    through their per-query active masks).  Bitwise contract:
+    tests/test_batched.py proves each column equals the single-source
+    engine's run — min fixed points are unique, so the dense batched
+    schedule and the single-query sparse/dense schedule agree
+    exactly."""
+    sources = [int(s) for s in sources]
+    if not sources:
+        raise ValueError("sources must name at least one query")
+    B = len(sources)
+    if weighted:
+        def relax(src_label, w):
+            # weight [.., E] broadcasts over the trailing query axis
+            return src_label + w[..., None]
+        identity = np.float32(np.inf)
+        dtype = np.float32
+        inf = DIST_INF
+    else:
+        def relax(src_label, w):
+            return src_label + np.int32(1)
+        identity = HOP_INF
+        dtype = np.int32
+        inf = HOP_INF
+
+    def init(sg: ShardedGraph):
+        for s in sources:
+            if not 0 <= s < sg.nv:
+                raise ValueError(
+                    f"source vertex {s} out of range [0, {sg.nv})")
+        dist = np.full((sg.nv, B), inf, dtype=dtype)
+        active = np.zeros((sg.nv, B), dtype=bool)
+        for q, s in enumerate(sources):
+            dist[s, q] = 0
+            active[s, q] = True
+        return sg.to_padded(dist), sg.to_padded(active)
+
+    return PushProgram(reduce="min", relax=relax, identity=identity,
+                       init=init, name="ksssp", batch=B)
+
+
 def default_delta(g: Graph) -> float:
     """Bucket width heuristic: the smallest positive edge weight,
     floored at mean/16.
@@ -74,7 +118,8 @@ def default_delta(g: Graph) -> float:
     return float(max(pos.min(), np.mean(w) / 16.0))
 
 
-def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
+def build_engine(g: Graph, start_vertex: int | None = 0,
+                 num_parts: int = 1,
                  mesh=None, weighted: bool = False,
                  delta: float | str | None = None,
                  sg: ShardedGraph | None = None,
@@ -85,6 +130,7 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
                  health: bool = False,
+                 sources=None,
                  audit: str | None = None) -> PushEngine:
     """delta: bucket width for delta-stepping priority ordering
     (weighted runs); "auto" picks a heuristic; None disables (plain
@@ -94,15 +140,30 @@ def build_engine(g: Graph, start_vertex: int, num_parts: int = 1,
     enable_sparse=False drops the src-sorted frontier view — the
     big-scale fit lever (it re-doubles edge memory,
     ShardedGraph.memory_report(push_sparse=True)); every iteration
-    then runs dense."""
+    then runs dense.
+
+    sources=[a, b, c, ...] builds the QUERY-BATCHED k-source engine
+    instead (labels [vpad, B], one gather serving every query —
+    ``make_batched_program``); start_vertex is then ignored, and
+    delta/pair_threshold must be off (single-query machinery)."""
     if weighted and g.weights is None:
         raise ValueError("weighted SSSP needs a weighted graph")
-    if delta == "auto":
-        delta = default_delta(g) if weighted else 1.0
+    if sources is not None:
+        if delta is not None:
+            raise ValueError("delta-stepping is single-query; "
+                             "sources=[...] requires delta=None")
+        program = make_batched_program(sources, weighted)
+    else:
+        if start_vertex is None:
+            raise ValueError("single-query SSSP needs start_vertex "
+                             "(or pass sources=[...] for a batch)")
+        if delta == "auto":
+            delta = default_delta(g) if weighted else 1.0
+        program = make_program(start_vertex, weighted)
     if sg is None:
         sg = ShardedGraph.build(g, num_parts, starts=starts,
                                 pair_threshold=pair_threshold)
-    return PushEngine(sg, make_program(start_vertex, weighted), mesh=mesh,
+    return PushEngine(sg, program, mesh=mesh,
                       delta=delta, pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill,
                       exchange=exchange, enable_sparse=enable_sparse,
@@ -137,6 +198,36 @@ def reference_sssp(g: Graph, start_vertex: int = 0,
         w = np.ones(g.ne, dtype=np.int64)
         dist = np.full(g.nv, int(HOP_INF), dtype=np.int64)
     dist[start_vertex] = 0
+    while True:
+        cand = dist[src] + w
+        new = dist.copy()
+        np.minimum.at(new, dst, cand)
+        if np.array_equal(new, dist):
+            break
+        dist = new
+    return dist
+
+
+def reference_sssp_batched(g: Graph, sources,
+                           weighted: bool = False) -> np.ndarray:
+    """NumPy k-source Bellman-Ford oracle -> ``[nv, B]`` distances.
+
+    Column q is BITWISE-equal to ``reference_sssp(g, sources[q])``:
+    the vectorized relaxation applies the identical per-column
+    ``np.minimum.at`` updates in the identical edge order, and min
+    fixed points are unique (tests/test_batched.py asserts the
+    column-equality explicitly — the batched-oracle contract of
+    ROADMAP item 2)."""
+    src, dst = g.edge_arrays()
+    B = len(sources)
+    if weighted:
+        w = np.asarray(g.weights, dtype=np.float64)[:, None]
+        dist = np.full((g.nv, B), np.inf)
+    else:
+        w = np.ones((g.ne, 1), dtype=np.int64)
+        dist = np.full((g.nv, B), int(HOP_INF), dtype=np.int64)
+    for q, s in enumerate(sources):
+        dist[int(s), q] = 0
     while True:
         cand = dist[src] + w
         new = dist.copy()

@@ -896,6 +896,12 @@ def check_ledger(engine, tol: float = 0.5, where: str | None = None):
             kw["pair_kdim"] = _dot_kdim(engine.program)
     if is_push:
         kw["push_sparse"] = bool(engine.enable_sparse)
+        # query-batched labels [P, vpad, B]: the ledger must price
+        # the B-wide state + active mask or every batched build
+        # would read as drift (ROADMAP item 2; memory_report's
+        # query_batch pricing) — pull engines carry B through
+        # state_bytes instead (the correction below)
+        kw["query_batch"] = int(getattr(engine, "batch", None) or 1)
     ledger = engine.sg.memory_report(**kw)
     expected = int(ledger["total_bytes"])
     # the ledger prices scalar f32 state; K-vector programs carry
@@ -997,6 +1003,33 @@ def run_repo_audit(verbose: bool = False, ledger: bool = True):
                                       pair_threshold=8, starts=starts)
 
     configs.append(("colfilter_np2_pair_dot", _pair_dot_engine, False))
+    # query-batched engines (ROADMAP item 2): the gather budget must
+    # hold at B > 1 — ONE [P*vpad, B] table gather per dense pull/push
+    # iteration, ZERO in owner mode — and the owner collective
+    # schedule must be unchanged by the trailing query axis
+    QB = [0, 3, 7, 11]
+    configs.append(("ksssp_np2_batched",
+                    lambda: sssp.build_engine(g, num_parts=2,
+                                              sources=QB),
+                    False))
+    configs.append(("ksssp_np4_owner_batched",
+                    lambda: sssp.build_engine(g, num_parts=4,
+                                              sources=QB,
+                                              exchange="owner"),
+                    False))
+    configs.append(("ppr_np2_batched",
+                    lambda: pagerank.build_engine(g, num_parts=2,
+                                                  sources=QB),
+                    False))
+    configs.append(("ppr_np4_owner_batched",
+                    lambda: pagerank.build_engine(g, num_parts=4,
+                                                  sources=QB,
+                                                  exchange="owner"),
+                    False))
+    configs.append(("cc_np2_batched",
+                    lambda: components.build_engine(g, num_parts=2,
+                                                    sources=QB[:2]),
+                    False))
     if ledger:
         gd = graphs["dense"]
         gdw = graphs["dense_w"]
@@ -1006,6 +1039,18 @@ def run_repo_audit(verbose: bool = False, ledger: bool = True):
         configs.append(("sssp_np2_ledger",
                         lambda: sssp.build_engine(gdw, 0, num_parts=2,
                                                   weighted=True),
+                        True))
+        # the ledger-drift check must stay honest at B > 1: the
+        # priced [P*vpad, B] state table (memory_report query_batch /
+        # the pull state_bytes correction) vs the compiled step's
+        # argument bytes
+        configs.append(("ksssp_np2_batched_ledger",
+                        lambda: sssp.build_engine(
+                            gd, num_parts=2, sources=list(range(8))),
+                        True))
+        configs.append(("ppr_np2_batched_ledger",
+                        lambda: pagerank.build_engine(
+                            gd, num_parts=2, sources=list(range(8))),
                         True))
     if mesh is not None:
         configs.append(("pagerank_mesh2_gather",
@@ -1031,6 +1076,23 @@ def run_repo_audit(verbose: bool = False, ledger: bool = True):
         configs.append(("sssp_mesh2_sparse",
                         lambda: sssp.build_engine(g, 0, num_parts=2,
                                                   mesh=mesh),
+                        False))
+        # batched mesh configs: the single-gather hold AND the owner
+        # collective schedule (psum_scatter / all_to_all) at B > 1
+        configs.append(("ksssp_mesh2_batched",
+                        lambda: sssp.build_engine(g, num_parts=2,
+                                                  mesh=mesh,
+                                                  sources=QB),
+                        False))
+        configs.append(("ppr_mesh2_owner_batched",
+                        lambda: pagerank.build_engine(
+                            g, num_parts=2, mesh=mesh, sources=QB,
+                            exchange="owner"),
+                        False))
+        configs.append(("cc_mesh2_owner_batched",
+                        lambda: components.build_engine(
+                            g, num_parts=2, mesh=mesh,
+                            sources=QB[:2], exchange="owner"),
                         False))
     if ndev >= 4:
         from lux_tpu.parallel.mesh import make_mesh

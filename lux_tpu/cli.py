@@ -210,6 +210,22 @@ def _common(ap: argparse.ArgumentParser):
                          "faults — a dead run through the tunnel "
                          "stays diagnosable after the fact (render: "
                          "scripts/events_summary.py -flight FILE)")
+    ap.add_argument("-sources", default=None, metavar="A,B,C",
+                    help="comma list of query sources: runs the "
+                         "QUERY-BATCHED engine (ROADMAP item 2) — "
+                         "k-source SSSP / seeded components / "
+                         "personalized (one-hot reset) pagerank — "
+                         "with one state column per query, ONE "
+                         "gather serving all of them.  Composes "
+                         "with -retries/-seg-budget/-iter-stats/"
+                         "-health; -pair and sssp -delta are "
+                         "single-query machinery and must be off")
+    ap.add_argument("-batch", type=int, default=0, metavar="B",
+                    help="without -sources: build a B-query batch "
+                         "from evenly spaced source vertices; with "
+                         "-sources: must match the list length "
+                         "(sanity check).  The serving front-end is "
+                         "python -m lux_tpu.serve")
     ap.add_argument("-calibrate", action="store_true",
                     help="run the session-calibration probe "
                          "(lux_tpu/observe.py) before the run and "
@@ -273,6 +289,57 @@ def _print_phases(report, tel=None):
         tel.emit("phases", iters=len(report),
                  report=[{k: (v if k in META else round(v, 6))
                           for k, v in t.items()} for t in report])
+
+
+def _batched_sources(args, nv: int):
+    """None, or the resolved query-source list from -sources/-batch
+    (ROADMAP item 2 batched engines).  -batch without -sources draws
+    B evenly spaced vertices — deterministic, so batched CLI runs
+    are reproducible."""
+    srcs = getattr(args, "sources", None)
+    B = int(getattr(args, "batch", 0) or 0)
+    if srcs is None and not B:
+        return None
+    if getattr(args, "pair", None) is not None:
+        print("error: -pair is single-query machinery (pair delivery "
+              "reads scalar state); drop it for -sources/-batch runs",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if srcs is not None:
+        try:
+            out = [int(s) for s in srcs.split(",") if s.strip()]
+        except ValueError:
+            print(f"error: -sources must be a comma list of vertex "
+                  f"ids, got {srcs!r}", file=sys.stderr)
+            raise SystemExit(2)
+        if not out:
+            print("error: -sources named no vertices", file=sys.stderr)
+            raise SystemExit(2)
+        if B and B != len(out):
+            print(f"error: -batch {B} != len(-sources) = {len(out)}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+    else:
+        out = [int(x) for x in
+               np.linspace(0, nv - 1, B).round().astype(np.int64)]
+    for s in out:
+        if not 0 <= s < nv:
+            print(f"error: source vertex {s} out of range [0, {nv})",
+                  file=sys.stderr)
+            raise SystemExit(2)
+    return out
+
+
+def _print_batch(sources, ne, iters, elapsed):
+    """The batched runs' per-query delivered-rate line (the metric
+    bench.py's batch-sweep records as query_gteps)."""
+    B = len(sources)
+    if iters > 0 and elapsed > 0:
+        qg = ne * iters * B / elapsed / 1e9
+        print(f"BATCH = {B} queries; QUERY-GTEPS = {qg:.4f} "
+              f"({1.0 / qg:.1f} ns/edge/query delivered)")
+    else:
+        print(f"BATCH = {B} queries")
 
 
 def _maybe_calibrate(args):
@@ -502,18 +569,22 @@ def cmd_pagerank(argv):
     with _telemetry(args, "pagerank") as tel:
         g = _load(args, weighted=False)
         mesh, num_parts = _mesh_and_parts(args)
+        sources = _batched_sources(args, g.nv)
         g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
         sg = _build_sg(args, g_run, num_parts, starts)
         def make_eng(m):
             # the -elastic factory: same graph/config, new mesh —
             # engines compile per-mesh automatically (arrays are jit
             # arguments), and the rebuilt engine re-audits under the
-            # same -audit mode at the new device count
+            # same -audit mode at the new device count.  -sources
+            # builds the personalized (one-hot reset) batched engine
+            # (ROADMAP item 2).
             return pagerank.build_engine(g_run, num_parts, m, sg=sg,
                                          pair_threshold=args.pair,
                                          pair_min_fill=args.min_fill,
                                          exchange=args.exchange,
                                          health=args.health,
+                                         sources=sources,
                                          audit=args.audit)
 
         eng = make_eng(mesh)
@@ -528,6 +599,8 @@ def cmd_pagerank(argv):
             print(f"ELAPSED TIME = {elapsed:.7f} s ({iters} iterations, "
                   f"residual {res:.3e})")
             print(f"GTEPS = {g.ne * iters / elapsed / 1e9:.4f}")
+            if sources is not None:
+                _print_batch(sources, g.ne, iters, elapsed)
             _finish_run(tel, elapsed, iters)
         else:
             sup = _supervisor_opts(args, "pagerank")
@@ -544,11 +617,18 @@ def cmd_pagerank(argv):
                 print(f"GTEPS = {g.ne * ni / elapsed / 1e9:.4f}{mark}")
             else:
                 print("GTEPS = n/a (run already complete in checkpoint)")
+            if sources is not None:
+                _print_batch(sources, g.ne, ni, elapsed)
             _finish_run(tel, elapsed, total)
 
         if args.phases:
             _state, rep = eng.timed_phases(eng.init_state(), args.phases)
             _print_phases(rep, tel)
+        if sources is not None and args.check:
+            # per-column device_check rides the batch-sweep debt
+            print("note: -check does not support batched runs yet; "
+                  "skipped (oracle proofs: tests/test_batched.py)")
+            return 0
         if args.check:
             # On-device sharded audit over the resident edge arrays
             # (the reference's per-part GPU check tasks,
@@ -582,6 +662,7 @@ def _push_app(argv, prog_name):
     with _telemetry(args, prog_name) as tel:
         g = _load(args, weighted=weighted)
         mesh, num_parts = _mesh_and_parts(args)
+        sources = _batched_sources(args, g.nv)
         g_run, perm, starts = _relabel_for_pairs(args, g, num_parts)
         sg = _build_sg(args, g_run, num_parts, starts)
         start = args.start if prog_name == "sssp" else None
@@ -593,6 +674,10 @@ def _push_app(argv, prog_name):
             delta = args.delta
             if delta is not None and delta != "auto":
                 delta = float(delta)
+            if sources is not None and delta is not None:
+                print("error: -delta is single-query machinery; drop "
+                      "it for -sources/-batch runs", file=sys.stderr)
+                return 2
 
             def make_eng(m):
                 return sssp.build_engine(
@@ -602,6 +687,7 @@ def _push_app(argv, prog_name):
                     pair_min_fill=args.min_fill,
                     exchange=args.exchange,
                     enable_sparse=bool(args.sparse),
+                    sources=sources,
                     health=args.health, audit=args.audit)
         else:
             def make_eng(m):
@@ -611,6 +697,7 @@ def _push_app(argv, prog_name):
                     pair_min_fill=args.min_fill,
                     exchange=args.exchange,
                     enable_sparse=bool(args.sparse),
+                    sources=sources,
                     health=args.health, audit=args.audit)
         eng = make_eng(mesh)
         sup = _supervisor_opts(args, prog_name)
@@ -626,12 +713,22 @@ def _push_app(argv, prog_name):
             print(f"GTEPS = {g.ne * it_exec / elapsed / 1e9:.4f}{mark}")
         else:
             print("GTEPS = n/a (run already complete in checkpoint)")
+        if sources is not None:
+            _print_batch(sources, g.ne, it_exec, elapsed)
         _finish_run(tel, elapsed, iters)
 
         if args.phases:
             lab0, act0 = eng.init_state()
             _l, _a, rep = eng.timed_phases(lab0, act0, args.phases)
             _print_phases(rep, tel)
+        if sources is not None and args.check:
+            # per-column device_check needs the batched fixed-point
+            # audits (carried with the on-device batch sweep debt,
+            # lux_tpu/observe.py); the oracle proofs live in
+            # tests/test_batched.py
+            print("note: -check does not support batched runs yet; "
+                  "skipped")
+            return 0
         if args.check:
             # On-device per-part audits (reference sssp_gpu.cu:800-843,
             # components_gpu.cu:788); labels are in g_run order, which
@@ -666,6 +763,10 @@ def cmd_colfilter(argv):
     from lux_tpu.apps import colfilter
 
     _warn_exchange_ignored(args)
+    if getattr(args, "sources", None) or getattr(args, "batch", 0):
+        print("note: colfilter trains one shared factorization; "
+              "-sources/-batch apply to sssp/components/pagerank "
+              "(per-user top-N serving is future work); ignored")
     with _telemetry(args, "colfilter") as tel:
         g = _load(args, weighted=True)
         mesh, num_parts = _mesh_and_parts(args)

@@ -25,11 +25,17 @@ class PartCtx:
     vmask  bool  [vpad]   True for real (non-padding) vertex slots
     nv     int            global vertex count (static)
     ne     int            global edge count (static)
+    extra  dict | None    this part's rows of the program's
+                          ``extra_arrays`` (query-batch arrays like
+                          personalized-PageRank reset vectors) —
+                          device arrays [vpad, ...], threaded as jit
+                          ARGUMENTS by the engine, never closed over
     """
     deg: Any
     vmask: Any
     nv: int
     ne: int
+    extra: Any = None
 
 
 def vmask_of(g, vpad: int):
@@ -74,6 +80,22 @@ class PullProgram:
                 in ``jax.named_scope(f"lux_{name}")`` so profiler
                 captures (profiling.trace) attribute device ops to
                 the app instead of anonymous XLA fusions.
+    extra_arrays
+                optional (sharded_graph) -> {name: [num_parts, vpad,
+                ...] numpy} per-part constants the apply epilogue
+                needs beyond deg/vmask (e.g. personalized PageRank's
+                per-query reset vectors, the query-batch analogue of
+                graph arrays).  The engine ships them as jit
+                ARGUMENTS (key ``prog_<name>`` in its graph-array
+                dict — the no-closure convention holds at any size)
+                and exposes each part's row via ``ctx.extra[name]``;
+                ``PullEngine.update_program_arrays`` swaps them
+                in-place (same shapes, no recompile) — the serving
+                front-end's continuous-batching refill path.
+    batch       query-batch width B when the state carries a trailing
+                query axis ``[vpad, B]`` (None = single-query).  One
+                state-table gather then serves all B queries
+                (machine-checked: lux_tpu/audit.py gather-budget).
     """
     reduce: str
     edge_value: Callable
@@ -83,3 +105,5 @@ class PullProgram:
     edge_value_from_dot: Callable | None = None
     state_bytes: int | None = None
     name: str | None = None
+    extra_arrays: Callable | None = None
+    batch: int | None = None

@@ -271,6 +271,12 @@ class PullEngine(AuditableEngine):
                 program.needs_dst
                 or program.edge_value_from_dot is not None,
                 tile_w, tile_e, device=mesh is None)
+        if program.extra_arrays is not None:
+            # program-contributed per-part constants (e.g. per-query
+            # reset vectors): jit ARGUMENTS like every graph array —
+            # the no-closure convention holds for query state too
+            for k, v in program.extra_arrays(sg).items():
+                arrays[f"prog_{k}"] = dev(np.asarray(v))
         if self.pairs is not None:
             arrays["pair_rowbind"] = dev(self.pairs.rowbind)
             arrays["pair_rel"] = dev(self.pairs.rel_dst)
@@ -311,6 +317,12 @@ class PullEngine(AuditableEngine):
 
         if layout != "tiled":
             raise ValueError("pair_threshold requires the tiled layout")
+        if getattr(program, "batch", None) is not None:
+            raise ValueError(
+                "pair_threshold does not support query-batched "
+                "programs: pair delivery reads scalar vertex state "
+                "(ops/pairs.pair_partial); run batched engines "
+                "without pairs")
         if program.needs_dst and program.edge_value_from_dot is None:
             raise ValueError("pair_threshold supports programs whose "
                              "edge_value depends only on the source "
@@ -365,12 +377,48 @@ class PullEngine(AuditableEngine):
             leaves = [jnp.asarray(x) for x in leaves]
         return jax.tree.unflatten(treedef, leaves)
 
+    def update_program_arrays(self, **host_arrays):
+        """Swap program-contributed per-part arrays
+        (``PullProgram.extra_arrays``; key ``<name>`` here maps to
+        graph-array key ``prog_<name>``) with SAME-shape/dtype host
+        replacements — no recompile: every compiled variant reads
+        ``self.graph_args`` at call time, so the next step/run sees
+        the new arrays.  This is the serving front-end's
+        continuous-batching refill path (lux_tpu/serve.py): a retired
+        query column's reset vector is replaced without rebuilding
+        the engine."""
+        for k, v in host_arrays.items():
+            key = f"prog_{k}"
+            if key not in self.arrays:
+                raise KeyError(
+                    f"engine has no program array {k!r} "
+                    f"(program.extra_arrays supplies "
+                    f"{[x[5:] for x in self.arrays if x.startswith('prog_')]})")
+            cur = self.arrays[key]
+            arr = np.asarray(v)
+            if (arr.shape != tuple(cur.shape)
+                    or np.dtype(arr.dtype) != np.dtype(cur.dtype)):
+                raise ValueError(
+                    f"program array {k!r} must keep shape "
+                    f"{tuple(cur.shape)}/{np.dtype(cur.dtype)} "
+                    f"(got {arr.shape}/{arr.dtype}) — shapes are "
+                    f"compiled; rebuild the engine to change B")
+            if self.mesh is not None:
+                arr = shard_over_parts(self.mesh, [arr],
+                                       self.sg.num_parts)[0]
+            else:
+                arr = jnp.asarray(arr)
+            self.arrays[key] = arr
+        self.graph_args = tuple(self.arrays[k] for k in self._graph_keys)
+
     # -- one part's work ----------------------------------------------
 
     def _apply_epilogue(self, old_p, red, g):
         sg, prog = self.sg, self.program
         vm = vmask_of(g, sg.vpad)
-        ctx = PartCtx(deg=g["deg"], vmask=vm, nv=sg.nv, ne=sg.ne)
+        extra = {k[5:]: g[k] for k in g if k.startswith("prog_")}
+        ctx = PartCtx(deg=g["deg"], vmask=vm, nv=sg.nv, ne=sg.ne,
+                      extra=extra or None)
         new = prog.apply(old_p, red, ctx)
         keep = vm.reshape(vm.shape + (1,) * (new.ndim - 1))
         return jnp.where(keep, new, old_p)

@@ -74,12 +74,23 @@ class PushProgram:
     name      optional app label; engines scope their traced step in
               ``jax.named_scope(f"lux_{name}")`` so profiler captures
               (profiling.trace) attribute device ops to the app.
+    batch     query-batch width B when labels/active carry a trailing
+              query axis ``[vpad, B]`` (None = single-query).  Each
+              column is one independent query: its active mask is its
+              frontier, a retired (converged) column is all-inactive
+              and contributes the reduce identity through the same
+              pre-gather mask as any inactive source — ONE label
+              gather serves all B queries (audit gather-budget).
+              Batched engines run every iteration DENSE (per-query
+              sparse queues are not implemented) and reject
+              delta-stepping and pair-lane delivery.
     """
     reduce: str
     relax: Callable
     identity: Any
     init: Callable
     name: str | None = None
+    batch: int | None = None
 
     def better(self, cand, old):
         return cand < old if self.reduce == "min" else cand > old
@@ -114,7 +125,29 @@ class PushEngine(AuditableEngine):
                                          resolve_exchange,
                                          resolve_reduce_method)
         _check_local_parts(sg, mesh, pair_threshold)
-        exchange = resolve_exchange(exchange, sg, program)
+        # query-batched labels [vpad, B] (program.batch = B): dense
+        # masked iterations only — columns retire independently
+        # through their own active masks; sparse queues, delta
+        # buckets and pair rows are single-query machinery
+        self.batch = getattr(program, "batch", None)
+        if self.batch is not None:
+            if delta is not None:
+                raise ValueError(
+                    "delta-stepping is single-query (one scalar "
+                    "bucket bound); build batched engines with "
+                    "delta=None")
+            if pair_threshold is not None:
+                raise ValueError(
+                    "pair_threshold does not support query-batched "
+                    "programs: pair delivery reads scalar vertex "
+                    "state (ops/pairs.pair_partial)")
+            enable_sparse = False
+        # the auto-exchange table estimate is in BYTES of the whole
+        # label table — a B-wide batch is B tables
+        ident_dt = np.asarray(program.identity).dtype
+        exchange = resolve_exchange(
+            exchange, sg, program,
+            itemsize=ident_dt.itemsize * (self.batch or 1))
         self.exchange = exchange
         # fused (ring reduce-scatter) min/max owner exchange — opt-in,
         # see ops/owner.owner_exchange
@@ -278,9 +311,14 @@ class PushEngine(AuditableEngine):
         BEFORE the per-edge gather — one gather instead of two (the
         gather is ~90% of a dense iteration, PERF_NOTES.md), with
         identical semantics: relax(identity) stays absorbing for
-        min/max programs."""
+        min/max programs.  Batched labels [.., vpad, B] keep their
+        query axis: the flat table is [P*vpad, B] and the SAME single
+        gather fetches all B columns per edge (a retired column is
+        all-inactive, so it contributes the identity here exactly
+        like any masked source — the sentinel convention per query)."""
         ident_l = jnp.asarray(self.program.identity, full_label.dtype)
-        return jnp.where(full_active, full_label, ident_l).reshape(-1)
+        masked = jnp.where(full_active, full_label, ident_l)
+        return masked.reshape((-1,) + masked.shape[2:])
 
     def _dense_cand(self, flat_l, g):
         """Phase 2 (relax): per-edge source gather + candidates."""
@@ -342,9 +380,12 @@ class PushEngine(AuditableEngine):
             reduce_method=self.reduce_method)[:self.sg.vpad]
 
     def _dense_update(self, old, red, g):
-        """Phase 4 (update): keep improvements, flag the new frontier."""
-        improved = (self.program.better(red, old)
-                    & vmask_of(g, self.sg.vpad))
+        """Phase 4 (update): keep improvements, flag the new frontier
+        (per query on batched labels — the [vpad] vertex mask
+        broadcasts over the trailing query axis)."""
+        vm = vmask_of(g, self.sg.vpad)
+        vm = vm.reshape(vm.shape + (1,) * (red.ndim - 1))
+        improved = self.program.better(red, old) & vm
         return jnp.where(improved, red, old), improved
 
     _DENSE_KEYS = ("src_slot", "dst_local", "weight", "rel_dst",
@@ -657,6 +698,12 @@ class PushEngine(AuditableEngine):
                 # uint32: a full 2^31+-edge frontier must not wrap
                 # int32; the scalar counter is the SUM of this row,
                 # so sum-over-parts is bitwise-exact by construction.
+                # Batched labels: the dense iteration gathers each
+                # edge ONCE for all B queries, so the work counter is
+                # the out-edges of the UNION frontier over the query
+                # axis (any column active at the vertex).
+                if act.ndim > 2:
+                    act = jnp.any(act, axis=-1)
                 e = jnp.sum(jnp.where(act, deg_full, 0)
                             .astype(jnp.uint32), axis=1)
                 if on_mesh:
@@ -665,8 +712,11 @@ class PushEngine(AuditableEngine):
 
             def fcount_parts(act):
                 # active count per part [P] int32 (sums to the psum'd
-                # scalar frontier count exactly — integer addition)
-                c = jnp.sum(act.astype(jnp.int32), axis=1)
+                # scalar frontier count exactly — integer addition);
+                # batched: active (vertex, query) PAIRS, matching the
+                # scalar global_sum the convergence predicate uses
+                c = jnp.sum(act.astype(jnp.int32),
+                            axis=tuple(range(1, act.ndim)))
                 if on_mesh:
                     c = jax.lax.all_gather(c, PARTS_AXIS, tiled=True)
                 return c

@@ -672,7 +672,8 @@ class ShardedGraph:
                       owner_packed: bool | None = None,
                       push_sparse: bool = False,
                       pairs=None, pair_kdim: int = 1,
-                      pair_stream: bool | None = None) -> dict:
+                      pair_stream: bool | None = None,
+                      query_batch: int = 1) -> dict:
         """HBM bytes for the engine edge layouts per part — the
         analogue of the reference's startup memory advisor (reference
         pagerank.cc:60-85).  (The flat oracle layout ships int32
@@ -705,7 +706,24 @@ class ShardedGraph:
         that roughly DOUBLE edge memory and must be priced before any
         big-scale push run (round-4 VERDICT).  The source-index pad S
         uses the cached src-sort when available, else the min(nv-ish,
-        epad) upper bound."""
+        epad) upper bound.
+
+        query_batch prices the QUERY-BATCHED state table (ROADMAP
+        item 2, engine/program.py ``batch``): B > 1 makes the vertex
+        term ``vpad * (5 B + 4)`` — a 4-byte label/rank plus the
+        1-byte active mask per (vertex, query), plus the shared int32
+        degrees (at B = 1 the legacy ``vpad * 8`` pricing is kept so
+        historical reports stay comparable; pull engines carry no
+        mask, so the 5 B term over-prices them by B/(4B+4) — inside
+        the ledger-drift tolerance).  The owner exchange's per-
+        iteration contribution accumulator also widens to ``vpad * 4
+        * B`` per part — reported as ``owner_msg_bytes_per_part`` but
+        NOT folded into ``total_bytes``, which prices resident
+        ARGUMENT arrays (the quantity the ledger-drift audit check
+        compares against XLA memory_analysis)."""
+        if query_batch < 1:
+            raise ValueError(f"query_batch must be >= 1, got "
+                             f"{query_batch}")
         w = 4 if self.weighted else 0
         if exchange == "owner":
             slots = (self.epad if owner_slots_per_part is None
@@ -759,17 +777,25 @@ class ShardedGraph:
                 # monolithic: delivered f32 value rows + row partials
                 pair_temp = (PAIR_STREAM_BLOCK_BYTES if streamed
                              else 2 * Rp * _PW * 4)
-        # state f32 + deg int32 (vmask derives from a scalar on device)
-        vert_bytes = self.vpad * (4 + 4)
+        # state f32 + deg int32 (vmask derives from a scalar on
+        # device); batched: 4-byte state + 1-byte active per column
+        if query_batch == 1:
+            vert_bytes = self.vpad * (4 + 4)
+        else:
+            vert_bytes = self.vpad * (5 * query_batch + 4)
+        owner_msg = (self.vpad * 4 * query_batch
+                     if exchange == "owner" else 0)
         per_part = edge_bytes + sparse_bytes + pair_bytes \
             + pair_temp + vert_bytes
         return {
             "num_parts": self.num_parts,
+            "query_batch": query_batch,
             "edge_bytes_per_part": edge_bytes,
             "push_sparse_bytes_per_part": sparse_bytes,
             "pair_bytes_per_part": pair_bytes,
             "pair_temp_bytes_per_part": pair_temp,
             "vertex_bytes_per_part": vert_bytes,
+            "owner_msg_bytes_per_part": owner_msg,
             "total_bytes": self.num_parts * per_part,
         }
 

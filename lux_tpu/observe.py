@@ -607,6 +607,14 @@ DEBTS = (
          "era) on/off A/B through the tunnel — CPU A/B is within "
          "noise; the on-device all_gather cost is unmeasured",
          "PERF_NOTES round 13", min_ndev=2),
+    Debt("batch-sweep-on-device",
+         "bench.py -config batch-sweep (B in {1,8,64} k-source SSSP "
+         "+ personalized PageRank) on a live tunnel: the modeled "
+         "~9/B per-query amortization (scalemodel.per_query_edge_ns, "
+         "BATCH_LANE_NS wide-row lane rate) is CPU-A/B'd only; the "
+         "serve refill path's host column scatter also wants a "
+         "device-side scatter once measured",
+         "PERF_NOTES round 14 (query batching)"),
 )
 
 

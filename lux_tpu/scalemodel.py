@@ -92,6 +92,46 @@ def break_even_fill(kdim: int = 1,
     return max(1, math.ceil(pair_row_ns(kdim) / residual_ns))
 
 
+# Query batching (ROADMAP item 2, engine/program.py ``batch``): the
+# dense iteration's ONE table gather fetches a [B]-wide CONTIGUOUS
+# state row per edge instead of one element — the fetch is
+# latency-bound, so the extra lanes ride at roughly the wide-row rate
+# (modeled from the measured 150 ns / 128-lane pair-row fetch, NOT
+# yet swept on-device: observe.DEBTS "batch-sweep-on-device").
+BATCH_LANE_NS = PAIR_ROW_NS / 128.0      # ~1.17 ns per extra lane
+
+
+def batched_edge_ns(B: int, rate: float = GATHER_SMALL_NS) -> float:
+    """Modeled per-edge cost of ONE batched dense iteration serving B
+    queries: the scalar gather latency + (B-1) ride-along lanes."""
+    if B < 1:
+        raise ValueError(f"B must be >= 1, got {B}")
+    return rate + BATCH_LANE_NS * (B - 1)
+
+
+def per_query_edge_ns(B: int, rate: float = GATHER_SMALL_NS) -> float:
+    """Modeled DELIVERED cost per edge per query at batch width B —
+    the ~9/B amortization claim, priced honestly: exactly rate/B only
+    if extra lanes were free; the wide-row lane term floors it at
+    ~BATCH_LANE_NS (~1.2 ns) for large B.  The bench batch-sweep's
+    measured 1/query_gteps is the number this predicts."""
+    return batched_edge_ns(B, rate) / B
+
+
+def batch_sweep_table(widths=(1, 2, 4, 8, 16, 32, 64),
+                      rate: float = GATHER_SMALL_NS) -> str:
+    """Markdown modeled ~9/B table for PERF_NOTES."""
+    lines = ["| B | edge ns (batched iter) | ns/edge/query "
+             "| vs B=1 |",
+             "|---|---|---|---|"]
+    base = per_query_edge_ns(1, rate)
+    for b in widths:
+        pq = per_query_edge_ns(b, rate)
+        lines.append(f"| {b} | {batched_edge_ns(b, rate):.2f} | "
+                     f"{pq:.2f} | {base / pq:.1f}x |")
+    return "\n".join(lines)
+
+
 STATE_NS_PER_VERTEX = 6.0  # apply + epilogues, per padded vertex
                            # (the ~0.2 s/iter residual in the RMAT25
                            # np=4 decomposition)

@@ -1,0 +1,619 @@
+"""Multi-query serving front-end: request batching + continuous refill.
+
+ROADMAP item 2's front-end: production graph services answer BATCHES
+of queries (k-source shortest paths, personalized PageRank with
+per-user reset vectors, seeded reachability), and the engines now
+carry a query-batch axis ``[vpad, B]`` so ONE state-table gather
+serves every query per iteration (engine/program.py ``batch``;
+delivered cost ~9/B ns/edge/query, PERF_NOTES "query batching").
+This module is the continuous-batching layer on top — the LLM-serving
+idiom applied to graph queries, on the segmented/telemetry substrate
+PRs 1-8 built:
+
+- a **request queue** (``Server.submit`` / ``BatchCollector``): each
+  request is one query (a source vertex, or a reset distribution for
+  personalized PageRank); the collector takes up to B queries, or
+  whatever has arrived when the collection deadline expires.
+- a **BatchRunner** per query kind holding ONE batched engine with a
+  fixed column count B.  Queries occupy columns; free columns are
+  IDLE (push: all-inactive, contributing the reduce identity through
+  the ordinary pre-gather mask; pull: a converged fixed point whose
+  updates are no-ops) — the retired-column identity rule
+  (ARCHITECTURE.md "Query batching & serving").
+- segments run on the EXISTING drivers: push kinds converge through
+  ``segmented.converge_segments`` and pull kinds through
+  ``segmented.run_segments``, with the continuous-batching refill
+  implemented as the drivers' documented ``on_segment`` hook — so
+  duration budgeting, telemetry segment events, iter-stats counters
+  and the health watchdog all compose unchanged.
+- at each segment boundary the hook RETIRES converged columns (push:
+  the column's frontier is empty; pull: the column's residual fell
+  under ``tol``), scatters their answers into per-query
+  :class:`Response` objects, and REFILLS the freed columns from the
+  queue (pull refills also swap the column's reset vector in place
+  via ``PullEngine.update_program_arrays`` — no recompile).
+- per-query telemetry: ``query_enqueue`` / ``query_start`` /
+  ``query_done`` events (latency, wait, iterations, segments) plus a
+  ``serve_refill`` event per boundary — rendered and validated by
+  scripts/events_summary.py.
+
+Costs and debts: the refill path fetches the [nv, B] state at
+boundaries that retire or fill columns (host scatter + re-place) —
+O(state) per boundary, fine for the CPU mesh and small B; the
+device-side column scatter and the on-device batch sweep are carried
+debts (lux_tpu/observe.py DEBTS "batch-sweep-on-device").
+
+Smoke: ``python -m lux_tpu.serve`` builds a small random graph,
+enqueues 2B mixed queries (sssp + components + pagerank), drains them
+through continuous-batching refill, and verifies every per-query
+answer against the apps' batched NumPy oracles (exit 1 on any
+mismatch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as _queuemod
+import time
+
+import numpy as np
+
+DEFAULT_SEG_ITERS = 4
+KINDS = ("sssp", "components", "pagerank")
+
+
+@dataclasses.dataclass
+class Request:
+    """One query: ``source`` for sssp/components (and one-hot
+    pagerank); ``reset`` [nv] overrides it for personalized
+    pagerank."""
+    qid: int
+    kind: str
+    source: int | None = None
+    reset: np.ndarray | None = None
+    t_enqueue: float = 0.0
+
+
+@dataclasses.dataclass
+class Response:
+    qid: int
+    kind: str
+    source: int | None
+    answer: np.ndarray          # [nv] labels / distances / ranks
+    iters: int                  # engine iterations while resident
+    segments: int               # boundaries the query lived through
+    latency_s: float            # enqueue -> retire
+    wait_s: float               # enqueue -> column assignment
+    converged: bool = True      # False: retired on the segment cap
+
+
+class _Drained(Exception):
+    """Raised by the pull hook when the queue is empty and every
+    column is idle — the documented ``on_segment`` abort path of
+    ``segmented.run_segments``."""
+
+
+class BatchCollector:
+    """Thread-safe request queue + the collect-up-to-B-or-deadline
+    batching rule.  ``put`` is called by ``Server.submit`` (any
+    thread); ``collect(n, deadline_s)`` returns up to ``n`` requests,
+    waiting at most ``deadline_s`` for the FIRST one and then taking
+    only what has already arrived (a deadline of 0 never blocks)."""
+
+    def __init__(self):
+        self._q: _queuemod.Queue = _queuemod.Queue()
+
+    def put(self, req: Request) -> None:
+        self._q.put(req)
+
+    def __len__(self) -> int:
+        return self._q.qsize()
+
+    def collect(self, n: int, deadline_s: float = 0.0) -> list[Request]:
+        out: list[Request] = []
+        deadline = time.monotonic() + max(0.0, deadline_s)
+        while len(out) < n:
+            timeout = deadline - time.monotonic()
+            try:
+                if not out and timeout > 0:
+                    out.append(self._q.get(timeout=timeout))
+                else:
+                    out.append(self._q.get_nowait())
+            except _queuemod.Empty:
+                break
+        return out
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    t_start: float
+    iter_start: int
+    segments: int = 0
+
+
+def _emit(event: str, **fields):
+    from lux_tpu import telemetry
+    telemetry.current().emit(event, **fields)
+
+
+class _RunnerBase:
+    """Shared slot bookkeeping for one batched engine of width B."""
+
+    def __init__(self, kind: str, B: int, seg_iters: int,
+                 max_segments: int):
+        self.kind = kind
+        self.B = int(B)
+        self.seg_iters = int(seg_iters)
+        self.max_segments = int(max_segments)
+        self.slots: list[_Slot | None] = [None] * self.B
+        self.responses: list[Response] = []
+
+    def _free_cols(self):
+        return [c for c, s in enumerate(self.slots) if s is None]
+
+    def _occupied(self):
+        return [c for c, s in enumerate(self.slots) if s is not None]
+
+    def _start(self, col: int, req: Request, total_iters: int):
+        now = time.monotonic()
+        self.slots[col] = _Slot(req=req, t_start=now,
+                                iter_start=total_iters)
+        _emit("query_start", qid=req.qid, query_kind=self.kind,
+              col=col,
+              wait_s=round(now - req.t_enqueue, 6))
+
+    def _retire(self, col: int, answer: np.ndarray, total_iters: int,
+                converged: bool = True):
+        slot = self.slots[col]
+        self.slots[col] = None
+        now = time.monotonic()
+        resp = Response(
+            qid=slot.req.qid, kind=self.kind, source=slot.req.source,
+            answer=answer, iters=total_iters - slot.iter_start,
+            segments=slot.segments,
+            latency_s=now - slot.req.t_enqueue,
+            wait_s=slot.t_start - slot.req.t_enqueue,
+            converged=converged)
+        self.responses.append(resp)
+        _emit("query_done", qid=resp.qid, query_kind=self.kind,
+              col=col,
+              iters=resp.iters, segments=resp.segments,
+              latency_s=round(resp.latency_s, 6),
+              wait_s=round(resp.wait_s, 6), converged=converged)
+        return resp
+
+
+class PushBatchRunner(_RunnerBase):
+    """Continuous-batching runner for push kinds (sssp /
+    components): one batched PushEngine, columns retire when their
+    per-query frontier empties, refill rides
+    ``converge_segments``'s ``on_segment`` hook."""
+
+    def __init__(self, kind: str, g, B: int, *, num_parts: int = 1,
+                 mesh=None, exchange: str = "auto",
+                 health: bool = False, weighted: bool = False,
+                 seg_iters: int = DEFAULT_SEG_ITERS,
+                 max_segments: int = 10_000):
+        super().__init__(kind, B, seg_iters, max_segments)
+        self.g = g
+        self.weighted = bool(weighted and kind == "sssp")
+        placeholder = [0] * self.B
+        if kind == "sssp":
+            from lux_tpu.apps import sssp as app
+            self.eng = app.build_engine(
+                g, sources=placeholder, num_parts=num_parts,
+                mesh=mesh, weighted=self.weighted,
+                exchange=exchange, health=health)
+            self._inf = (app.DIST_INF if self.weighted
+                         else app.HOP_INF)
+            self._dtype = np.float32 if self.weighted else np.int32
+        elif kind == "components":
+            from lux_tpu.apps import components as app
+            self.eng = app.build_engine(
+                g, sources=placeholder, num_parts=num_parts,
+                mesh=mesh, exchange=exchange, health=health)
+            self._inf = np.int32(-1)
+            self._dtype = np.int32
+        else:
+            raise ValueError(f"unknown push kind {kind!r}")
+
+    def _col_init(self, req: Request):
+        """(label [nv], active [nv]) for a fresh query column."""
+        nv = self.g.nv
+        s = int(req.source)
+        if not 0 <= s < nv:
+            raise ValueError(f"query {req.qid}: source {s} out of "
+                             f"range [0, {nv})")
+        lab = np.full(nv, self._inf, dtype=self._dtype)
+        act = np.zeros(nv, dtype=bool)
+        lab[s] = s if self.kind == "components" else 0
+        act[s] = True
+        return lab, act
+
+    def drain(self, collector: BatchCollector,
+              deadline_s: float = 0.0) -> list[Response]:
+        """Serve until the collector is empty and every column is
+        idle; returns the responses retired during this drain."""
+        import jax
+        import jax.numpy as jnp
+
+        from lux_tpu.segmented import converge_segments
+
+        eng, sg = self.eng, self.eng.sg
+        nv, B = self.g.nv, self.B
+        n0 = len(self.responses)
+
+        lab_h = np.full((nv, B), self._inf, dtype=self._dtype)
+        act_h = np.zeros((nv, B), dtype=bool)
+        filled = self._fill(lab_h, act_h, collector, 0, deadline_s)
+        if not filled:
+            return []
+        label, active = eng.place(sg.to_padded(lab_h),
+                                  sg.to_padded(act_h))
+
+        def hook(label, active, total, cnt):
+            for s in self.slots:
+                if s is not None:
+                    s.segments += 1
+            counts = np.asarray(jax.device_get(
+                jnp.sum(active, axis=tuple(range(active.ndim - 1)))))
+            done = [c for c in self._occupied()
+                    if counts[c] == 0
+                    or self.slots[c].segments >= self.max_segments]
+            want_fill = len(collector) > 0 and (
+                done or self._free_cols())
+            if not done and not want_fill:
+                return None
+            lab_h = sg.from_padded(np.asarray(jax.device_get(label)))
+            act_h = sg.from_padded(np.asarray(jax.device_get(active)))
+            for c in done:
+                self._retire(c, lab_h[:, c].copy(), total,
+                             converged=bool(counts[c] == 0))
+                lab_h[:, c] = self._inf
+                act_h[:, c] = False
+            n_filled = self._fill(lab_h, act_h, collector, total,
+                                  deadline_s)
+            _emit("serve_refill", query_kind=self.kind,
+                  retired=len(done),
+                  filled=n_filled, occupied=len(self._occupied()),
+                  queued=len(collector))
+            return eng.place(sg.to_padded(lab_h), sg.to_padded(act_h))
+
+        converge_segments(eng, label, active, self.seg_iters,
+                          on_segment=hook)
+        return self.responses[n0:]
+
+    def _fill(self, lab_h, act_h, collector, total_iters,
+              deadline_s) -> int:
+        free = self._free_cols()
+        reqs = collector.collect(len(free), deadline_s)
+        for col, req in zip(free, reqs):
+            lab_h[:, col], act_h[:, col] = self._col_init(req)
+            self._start(col, req, total_iters)
+        return len(reqs)
+
+
+class PullBatchRunner(_RunnerBase):
+    """Continuous-batching runner for personalized PageRank: one
+    batched PullEngine; a column retires when its per-query residual
+    (max-abs state change over a segment's last iteration, computed
+    at the boundary) falls under ``tol``; refill swaps the column's
+    reset vector in place (``PullEngine.update_program_arrays``)."""
+
+    def __init__(self, kind: str, g, B: int, *, num_parts: int = 1,
+                 mesh=None, exchange: str = "auto",
+                 health: bool = False,
+                 seg_iters: int = DEFAULT_SEG_ITERS,
+                 tol: float = 1e-8, max_segments: int = 500):
+        super().__init__(kind, B, seg_iters, max_segments)
+        if kind != "pagerank":
+            raise ValueError(f"unknown pull kind {kind!r}")
+        from lux_tpu.apps import pagerank as app
+        self.g = g
+        self.app = app
+        self.tol = float(tol)
+        # idle columns carry the uniform reset's fixed-point-bound
+        # trajectory — cheap, and refilled before they matter
+        self.resets = np.full((g.nv, B), 1.0 / g.nv, dtype=np.float32)
+        self.eng = app.build_engine(
+            g, num_parts=num_parts, mesh=mesh, resets=self.resets,
+            exchange=exchange, health=health)
+
+    def _col_reset(self, req: Request) -> np.ndarray:
+        if req.reset is not None:
+            r = np.asarray(req.reset, np.float32)
+            if r.shape != (self.g.nv,):
+                raise ValueError(
+                    f"query {req.qid}: reset must be [nv], got "
+                    f"{r.shape}")
+            return r
+        return self.app.one_hot_resets(self.g.nv,
+                                       [int(req.source)])[:, 0]
+
+    def _col_init(self, reset: np.ndarray) -> np.ndarray:
+        deg = np.asarray(self.g.out_degrees, np.float32)
+        return np.where(deg > 0, reset / np.maximum(deg, 1),
+                        reset).astype(np.float32)
+
+    def drain(self, collector: BatchCollector,
+              deadline_s: float = 0.0) -> list[Response]:
+        import jax
+
+        from lux_tpu.segmented import run_segments
+
+        eng, sg = self.eng, self.eng.sg
+        B = self.B
+        n0 = len(self.responses)
+
+        state_h = sg.from_padded(np.asarray(
+            self.eng.program.init(sg)))          # [nv, B]
+        if not self._fill(state_h, collector, 0, deadline_s):
+            return []
+        self._push_resets()
+        prev = state_h.copy()
+        state = eng.place(sg.to_padded(state_h))
+
+        def hook(state, done_iters):
+            nonlocal prev
+            for s in self.slots:
+                if s is not None:
+                    s.segments += 1
+            new = sg.from_padded(np.asarray(jax.device_get(state)))
+            # per-query convergence: max-abs state change over the
+            # WHOLE segment <= tol (an upper bound on any single
+            # iteration's residual — strictly conservative)
+            res = np.max(np.abs(new - prev), axis=0)
+            done = [c for c in self._occupied()
+                    if res[c] <= self.tol
+                    or self.slots[c].segments >= self.max_segments]
+            for c in done:
+                self._retire(c, new[:, c].copy(), done_iters,
+                             converged=bool(res[c] <= self.tol))
+            n_filled = self._fill(new, collector, done_iters,
+                                  deadline_s)
+            if done or n_filled:
+                _emit("serve_refill", query_kind=self.kind,
+                      retired=len(done), filled=n_filled,
+                      occupied=len(self._occupied()),
+                      queued=len(collector))
+            if not self._occupied() and not len(collector):
+                raise _Drained()
+            prev = new
+            if n_filled:
+                self._push_resets()
+                return eng.place(sg.to_padded(new))
+            return None
+
+        try:
+            run_segments(eng, state, np.iinfo(np.int32).max,
+                         self.seg_iters, on_segment=hook)
+        except _Drained:
+            pass
+        return self.responses[n0:]
+
+    def _push_resets(self):
+        self.eng.update_program_arrays(
+            reset=self.eng.sg.to_padded(self.resets))
+
+    def _fill(self, state_h, collector, total_iters,
+              deadline_s) -> int:
+        free = self._free_cols()
+        reqs = collector.collect(len(free), deadline_s)
+        for col, req in zip(free, reqs):
+            reset = self._col_reset(req)
+            self.resets[:, col] = reset
+            state_h[:, col] = self._col_init(reset)
+            self._start(col, req, total_iters)
+        return len(reqs)
+
+
+class Server:
+    """Route queries by kind to per-kind BatchRunners and drain them.
+
+    One engine per kind is built lazily at the first query of that
+    kind (column count ``batch``); ``run()`` drains every kind's
+    queue through continuous-batching refill and returns the
+    responses in retirement order.  ``deadline_s`` is the batch
+    collector's wait-for-more budget (0 = serve whatever is queued —
+    the offline/smoke mode)."""
+
+    def __init__(self, g, batch: int = 4, *, num_parts: int = 1,
+                 mesh=None, exchange: str = "auto",
+                 health: bool = False, weighted: bool = False,
+                 seg_iters: int = DEFAULT_SEG_ITERS,
+                 tol: float = 1e-8, deadline_s: float = 0.0):
+        self.g = g
+        self.batch = int(batch)
+        self.opts = dict(num_parts=num_parts, mesh=mesh,
+                         exchange=exchange, health=health)
+        self.weighted = bool(weighted)
+        self.seg_iters = int(seg_iters)
+        self.tol = float(tol)
+        self.deadline_s = float(deadline_s)
+        self._collectors: dict[str, BatchCollector] = {}
+        self._runners: dict[str, _RunnerBase] = {}
+        self._next_qid = 0
+
+    def _collector(self, kind: str) -> BatchCollector:
+        if kind not in KINDS:
+            raise ValueError(f"unknown query kind {kind!r}; choose "
+                             f"from {KINDS}")
+        return self._collectors.setdefault(kind, BatchCollector())
+
+    def _runner(self, kind: str) -> _RunnerBase:
+        if kind not in self._runners:
+            if kind == "pagerank":
+                self._runners[kind] = PullBatchRunner(
+                    kind, self.g, self.batch,
+                    seg_iters=self.seg_iters, tol=self.tol,
+                    **self.opts)
+            else:
+                self._runners[kind] = PushBatchRunner(
+                    kind, self.g, self.batch,
+                    weighted=self.weighted,
+                    seg_iters=self.seg_iters, **self.opts)
+        return self._runners[kind]
+
+    def submit(self, kind: str, source: int | None = None,
+               reset=None) -> int:
+        qid = self._next_qid
+        self._next_qid += 1
+        req = Request(qid=qid, kind=kind,
+                      source=None if source is None else int(source),
+                      reset=(None if reset is None
+                             else np.asarray(reset, np.float32)),
+                      t_enqueue=time.monotonic())
+        self._collector(kind).put(req)
+        _emit("query_enqueue", qid=qid, query_kind=kind,
+              source=req.source, queued=len(self._collector(kind)))
+        return qid
+
+    def run(self) -> list[Response]:
+        """Drain every kind's queue; returns responses in retirement
+        order (continuous batching: later queries refill columns
+        freed by earlier retirements)."""
+        out: list[Response] = []
+        for kind, coll in self._collectors.items():
+            while len(coll):
+                out += self._runner(kind).drain(coll, self.deadline_s)
+        return out
+
+
+# ---------------------------------------------------------------------
+# smoke: python -m lux_tpu.serve
+
+def _smoke_graph(scale: int, ef: int, seed: int = 0):
+    from lux_tpu.graph import Graph
+    r = np.random.default_rng(seed)
+    nv = 1 << scale
+    ne = nv * ef
+    return Graph.from_edges(r.integers(0, nv, ne),
+                            r.integers(0, nv, ne), nv)
+
+
+def _check_answers(g, responses) -> int:
+    """Verify every response against the apps' batched NumPy oracles;
+    returns the mismatch count."""
+    from lux_tpu.apps import components, pagerank, sssp
+    bad = 0
+    for r in responses:
+        if r.kind == "sssp":
+            ref = sssp.reference_sssp_batched(g, [r.source])[:, 0]
+            ref = np.where(ref >= int(sssp.HOP_INF),
+                           int(sssp.HOP_INF), ref)
+            ok = np.array_equal(r.answer.astype(np.int64), ref)
+        elif r.kind == "components":
+            ref = components.reference_components_batched(
+                g, [r.source])[:, 0]
+            ok = np.array_equal(r.answer.astype(np.int64), ref)
+        else:
+            reset = pagerank.one_hot_resets(g.nv, [r.source])
+            ref = pagerank.reference_pagerank_batched(
+                g, reset, max(1, r.iters))[:, 0]
+            ok = bool(np.allclose(r.answer, ref, atol=5e-5))
+        if not ok:
+            bad += 1
+            print(f"MISMATCH qid={r.qid} kind={r.kind} "
+                  f"source={r.source}")
+    return bad
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m lux_tpu.serve",
+        description="continuous-batching serve smoke: 2B mixed "
+                    "queries drain through refill; answers are "
+                    "oracle-checked")
+    ap.add_argument("-scale", type=int, default=9,
+                    help="graph scale (nv = 2**scale; default 9)")
+    ap.add_argument("-ef", type=int, default=8)
+    ap.add_argument("-batch", type=int, default=4,
+                    help="engine column count B (default 4)")
+    ap.add_argument("-queries", type=int, default=0,
+                    help="total mixed queries (default 2B)")
+    ap.add_argument("-kinds", default="sssp,components,pagerank",
+                    help="comma list of query kinds to mix")
+    ap.add_argument("-np", type=int, default=2, dest="num_parts")
+    ap.add_argument("-seg-iters", type=int, default=2,
+                    dest="seg_iters",
+                    help="iterations per serve segment (the refill "
+                         "cadence)")
+    ap.add_argument("-seed", type=int, default=0)
+    ap.add_argument("-events", default=None, metavar="FILE",
+                    help="append the per-query telemetry trail as "
+                         "JSONL (render: scripts/events_summary.py)")
+    ap.add_argument("-no-check", action="store_true", dest="no_check",
+                    help="skip the oracle verification")
+    args = ap.parse_args(argv)
+
+    from lux_tpu import telemetry
+
+    kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
+    for k in kinds:
+        if k not in KINDS:
+            print(f"error: unknown kind {k!r}")
+            return 2
+    g = _smoke_graph(args.scale, args.ef, args.seed)
+    n_queries = args.queries or 2 * args.batch
+    rng = np.random.default_rng(args.seed + 1)
+
+    ev = telemetry.EventLog(args.events) if args.events else \
+        telemetry.EventLog()
+    with telemetry.use(events=ev):
+        ev.emit("run_start", schema=telemetry.SCHEMA, app="serve",
+                file=f"<rmat{args.scale}>", mesh=1,
+                np=args.num_parts)
+        srv = Server(g, batch=args.batch, num_parts=args.num_parts,
+                     seg_iters=args.seg_iters)
+        # mixed-kind queue of 2B queries, biased so the primary kind
+        # OVERSUBSCRIBES its B columns — later queries must wait for
+        # retirements and enter through continuous-batching refill
+        others = kinds[1:]
+        seq = [others[i - 1] if 0 < i <= len(others) else kinds[0]
+               for i in range(n_queries)]
+        for k in seq:
+            srv.submit(k, source=int(rng.integers(0, g.nv)))
+        t0 = time.perf_counter()
+        responses = srv.run()
+        elapsed = time.perf_counter() - t0
+        ev.emit("run_done", seconds=round(elapsed, 6),
+                iters=sum(r.iters for r in responses))
+    refills = sum(1 for e in ev.events
+                  if e["kind"] == "serve_refill"
+                  and e.get("retired", 0) and e.get("filled", 0))
+    ev.close()
+
+    lat = sorted(r.latency_s for r in responses)
+    p50 = lat[len(lat) // 2] if lat else 0.0
+    for r in responses:
+        print(f"query {r.qid} [{r.kind}] source={r.source}: "
+              f"{r.iters} iters over {r.segments} segment(s), "
+              f"latency {r.latency_s * 1e3:.1f} ms"
+              + ("" if r.converged else " (SEGMENT CAP)"))
+    print(f"# served {len(responses)}/{n_queries} queries "
+          f"(B={args.batch}, {len(kinds)} kind(s)) in {elapsed:.2f}s; "
+          f"p50 latency {p50 * 1e3:.1f} ms, max "
+          f"{(lat[-1] if lat else 0) * 1e3:.1f} ms; "
+          f"{refills} retire+refill boundary(ies)")
+    if len(responses) != n_queries:
+        print("error: queue did not drain")
+        return 1
+    if n_queries > args.batch and not refills:
+        print("error: oversubscribed queue drained without any "
+              "continuous-batching refill")
+        return 1
+    if not args.no_check:
+        bad = _check_answers(g, responses)
+        if bad:
+            print(f"error: {bad} answer(s) mismatched their oracle")
+            return 1
+        print("# all answers match their NumPy oracles")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -121,6 +121,13 @@ NETFLIX_METRIC = re.compile(
     r"^colfilter_netflix(\d+)m_np(\d+)_gteps_per_chip$")
 BIGSCALE_METRIC = re.compile(
     r"^(pagerank|cc|sssp|sssp-w)_rmat(\d+)_np(\d+)_gteps_per_chip$")
+# query-batched lines (bench.py ksssp-batch/ppr-batch, ROADMAP item
+# 2): the metric name carries the batch width B, the line carries
+# batch + query_gteps (= B x value, the delivered query-edge rate) —
+# cross-checked below so a published per-query claim can never
+# contradict the machine rate it was derived from
+BATCH_METRIC = re.compile(
+    r"^(ksssp|ppr)_b(\d+)_rmat(\d+)_gteps_per_chip$")
 
 
 def iter_metric_lines(path: str):
@@ -261,6 +268,10 @@ def check_line(obj: dict, *, legacy_ok: bool):
         m = BIGSCALE_METRIC.match(name)
         if m:
             errs += check_bigscale_fields(name, obj, int(m.group(2)))
+    m = BATCH_METRIC.match(name)
+    if m or "batch" in obj:
+        errs += check_batch_fields(name, obj,
+                                   int(m.group(2)) if m else None)
     return errs, warns
 
 
@@ -330,6 +341,49 @@ def check_bigscale_fields(name: str, obj: dict,
     if ne is not None and (not isinstance(ne, int) or ne < 1):
         errs.append(f"{name}: ne={ne!r} must be a positive int")
     return errs + _check_pair_cfg(name, obj)
+
+
+def check_batch_fields(name: str, obj: dict,
+                       name_b: int | None) -> list[str]:
+    """Query-batched lines (bench.py batch-sweep, ROADMAP item 2):
+    ``batch`` must be a positive int matching the metric name's _bN_,
+    and ``query_gteps`` — the delivered query-edge rate the per-query
+    amortization claim rests on — must equal batch x value (to
+    rounding): a per-query number that contradicts the machine rate
+    it was derived from is rejected, the same contradiction pattern
+    as the imbalance/health digests."""
+    errs = []
+    b = obj.get("batch")
+    if not isinstance(b, int) or isinstance(b, bool) or b < 1:
+        errs.append(f"{name}: batch={b!r} must be a positive int")
+        return errs
+    if name_b is not None and b != name_b:
+        errs.append(f"{name}: batch={b} contradicts the metric "
+                    f"name's _b{name_b}_")
+    qg = obj.get("query_gteps")
+    if qg is None:
+        errs.append(f"{name}: batched line missing query_gteps "
+                    f"(= batch x value, the per-query metric of "
+                    f"record)")
+    elif not _is_num(qg):
+        errs.append(f"{name}: query_gteps={qg!r} must be a finite "
+                    f"number")
+    elif _is_num(obj.get("value")):
+        want = b * obj["value"]
+        # value and query_gteps round independently to 4 decimals
+        if abs(qg - want) > 1e-4 * (b + 1):
+            errs.append(
+                f"{name}: query_gteps={qg} != batch x value "
+                f"({b} x {obj['value']} = {want:.4f}) — the "
+                f"per-query claim contradicts the machine rate")
+    pq = obj.get("per_query_edge_ns")
+    if pq is not None and _is_num(qg) and qg > 0:
+        if not _is_num(pq) or abs(pq - 1.0 / qg) > 2e-3 * max(
+                1.0, 1.0 / qg):
+            errs.append(
+                f"{name}: per_query_edge_ns={pq!r} contradicts "
+                f"1/query_gteps ({1.0 / qg:.4f})")
+    return errs
 
 
 def check_telemetry(name: str, obj: dict) -> list[str]:

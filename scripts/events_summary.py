@@ -66,7 +66,13 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "budget_reset", "outlier_discard", "outlier_rerun", "health",
          "health_trip", "topology_fault", "mesh_shrink", "replace",
          "straggler", "calibration", "phase_cost", "drift",
-         "debt_collected", "heartbeat", "flight_dump"}
+         "debt_collected", "heartbeat", "flight_dump",
+         "query_enqueue", "query_start", "query_done", "serve_refill"}
+
+# a query_done without these cannot account for the query's cost —
+# the serving front-end's per-query latency contract (lux_tpu/serve.py)
+QUERY_DONE_REQUIRED = ("qid", "query_kind", "iters", "segments",
+                       "latency_s")
 
 # a health_trip without these fields cannot be diagnosed — the whole
 # point of the watchdog is a NAMED check at a NAMED iteration
@@ -162,6 +168,11 @@ def _fmt_s(x: float) -> str:
 
 def _is_int(x) -> bool:
     return isinstance(x, int) and not isinstance(x, bool)
+
+
+def _is_num(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool) \
+        and x == x and abs(x) != float("inf")
 
 
 def render_parts_table(title, st, out) -> list[str]:
@@ -399,6 +410,54 @@ def render_run(run, out=sys.stdout) -> list[str]:
               f"({d.get('ratio')}x)", file=out)
     for d in by.get("debt_collected", []):
         print(f"  carried debt collected: {d.get('debt')}", file=out)
+
+    # serving front-end (round 14, lux_tpu/serve.py): per-query
+    # latency accounting.  AUDIT: every query_done carries its
+    # qid/kind/iters/segments/latency, latencies are finite and >=
+    # the query's wait (enqueue -> column), and every retired qid was
+    # enqueued — a served answer with no matching request means the
+    # per-query trail is lying.
+    qdone = by.get("query_done", [])
+    if qdone:
+        enq = {e.get("qid") for e in by.get("query_enqueue", [])}
+        lats = []
+        for q in qdone:
+            missing = [k for k in QUERY_DONE_REQUIRED if k not in q]
+            if missing:
+                errs.append(f"{title}: query_done missing {missing}: "
+                            f"{q!r}"[:200])
+                continue
+            lat, wait = q["latency_s"], q.get("wait_s", 0)
+            if not _is_num(lat) or lat < 0:
+                errs.append(f"{title}: query_done qid={q['qid']} "
+                            f"non-finite latency {lat!r}")
+                continue
+            if _is_num(wait) and lat + 1e-9 < wait:
+                errs.append(f"{title}: query_done qid={q['qid']} "
+                            f"latency {lat} < wait {wait} — the "
+                            f"per-query clock is inconsistent")
+            # no `if enq` guard: a trail with ZERO enqueue events is
+            # the maximally-broken case and must fail loudest
+            if q["qid"] not in enq:
+                errs.append(f"{title}: query_done qid={q['qid']} was "
+                            f"never enqueued")
+            lats.append(lat)
+        if lats:
+            lats.sort()
+            kinds = {}
+            for q in qdone:
+                k = q.get("query_kind", "?")
+                kinds[k] = kinds.get(k, 0) + 1
+            mix = ", ".join(f"{k} x{n}" for k, n in sorted(kinds.items()))
+            print(f"  queries served: {len(qdone)} ({mix})  latency "
+                  f"p50 {_fmt_s(lats[len(lats) // 2])} max "
+                  f"{_fmt_s(lats[-1])}", file=out)
+        refills = by.get("serve_refill", [])
+        live = sum(1 for r in refills
+                   if r.get("retired", 0) and r.get("filled", 0))
+        if refills:
+            print(f"  continuous batching: {len(refills)} refill "
+                  f"boundary(ies), {live} retire+refill", file=out)
 
     done = by.get("run_done", [])
     if done:

