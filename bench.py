@@ -117,7 +117,14 @@ DEFAULT_SHAPE = {"pagerank": (21, 16), "cc": (20, 16),
                  # each line records batch + query_gteps = B x the
                  # machine rate — one gather serving B queries, so
                  # per-query delivered cost is 1/query_gteps ns/edge
-                 "ksssp-batch": (20, 16), "ppr-batch": (20, 16)}
+                 "ksssp-batch": (20, 16), "ppr-batch": (20, 16),
+                 # paged-vs-flat gather A/B (round 15,
+                 # ops/pagegather.py): `-config gather-ab` runs
+                 # pagerank BOTH ways on one degree-sorted graph and
+                 # records the plan's measured unique-page ratio /
+                 # row fill on both lines (scripts/check_bench.py
+                 # validates the fields)
+                 "gather-ab": (21, 16)}
 
 # the batch-sweep expansion (one metric line per B per app)
 BATCH_SWEEP_DEFAULT = "1,8,64"
@@ -222,6 +229,45 @@ def run_config(config, args):
     import numpy as np
 
     from lux_tpu.graph import pair_relabel
+
+    if config.startswith("gather-ab"):
+        # paged-vs-flat A/B: "gather-ab@paged" / "gather-ab@flat"
+        # name one side each; both sides run the SAME degree-sorted
+        # graph and carry the same plan stats, so the pair is
+        # directly comparable
+        from lux_tpu.apps import pagerank
+        from lux_tpu.graph import ShardedGraph, degree_relabel
+        from lux_tpu.ops.pagegather import plan_paged_stats
+
+        _, _, mode = config.partition("@")
+        mode = mode or "paged"
+        scale = args.scale or DEFAULT_SHAPE["gather-ab"][0]
+        ef = args.ef or DEFAULT_SHAPE["gather-ab"][1]
+        g = build_graph(scale, ef, args.verbose)
+        # degree sort concentrates hubs into shared pages — the page
+        # locality the paged plan bins for (same preprocessing both
+        # sides, so the A/B isolates the delivery swap)
+        g2, _perm = degree_relabel(g)
+        sg = ShardedGraph.build(g2, args.np, vpad_align=128)
+        eng = pagerank.build_engine(g2, num_parts=args.np, sg=sg,
+                                    gather=mode, health=args.health)
+        stats = (eng.page_plan.stats if eng.page_plan is not None
+                 else plan_paged_stats(sg))
+        extra = {"np": args.np, "scale": scale, "ef": ef,
+                 "relabel": True, "pair_threshold": None,
+                 "gather": mode, "exchange": eng.exchange,
+                 "page_ratio": round(float(stats["page_ratio"]), 4),
+                 # the PADDED fill — live lanes per padded row, the
+                 # exact input gather="auto" and the phase model
+                 # consume (class-pad rows pay full machinery)
+                 "page_fill": round(float(stats["padded_fill"]), 2)}
+        _audit_build(eng, args, extra)
+        samples, rerun = bench_fused(eng, g.ne, args.ni, args.verbose,
+                                     args.repeats)
+        extra["ne"] = int(g.ne)
+        return (f"pagerank_{mode}_rmat{scale}",
+                [s / 1e9 for s in samples], extra,
+                lambda: rerun() / 1e9)
 
     if config.startswith(("ksssp-batch", "ppr-batch")):
         # query-batched configs (ROADMAP item 2): "<base>@B" names
@@ -635,6 +681,10 @@ def main() -> int:
             expanded += [f"ppr-batch@{b}" for b in batch_widths]
         elif c in ("ksssp-batch", "ppr-batch"):
             expanded += [f"{c}@{b}" for b in batch_widths]
+        elif c == "gather-ab":
+            # one line per side, paged first (the headline of the
+            # A/B); both carry the plan's page stats
+            expanded += ["gather-ab@paged", "gather-ab@flat"]
         else:
             expanded.append(c)
     configs = expanded

@@ -59,7 +59,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  pair_threshold: int | None = None,
                  pair_min_fill: int | str | None = None,
                  pair_stream: bool | None = None,
-                 starts=None, health: bool = False,
+                 starts=None, gather: str = "flat",
+                 health: bool = False,
                  audit: str | None = None) -> PullEngine:
     """pair_threshold routes dense tile pairs through the blocked-
     SDDMM pair path (ops/pairs.pair_partial_dot, streamed past the
@@ -75,14 +76,16 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
     if g.weights is None:
         raise ValueError("collaborative filtering needs a weighted graph")
     if sg is None:
-        sg = ShardedGraph.build(g, num_parts, starts=starts,
-                                pair_threshold=pair_threshold)
+        sg = ShardedGraph.build(
+            g, num_parts, starts=starts,
+            pair_threshold=pair_threshold,
+            vpad_align=128 if gather != "flat" else 8)
     tile_e = 128 if pair_threshold is not None else 512
     return PullEngine(sg, make_program(), mesh=mesh,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill,
                       pair_stream=pair_stream, tile_e=tile_e,
-                      health=health, audit=audit)
+                      gather=gather, health=health, audit=audit)
 
 
 def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):

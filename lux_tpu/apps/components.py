@@ -71,6 +71,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  pair_threshold: int | None = None,
                  pair_min_fill: int | None = None,
                  starts=None, exchange: str = "auto",
+                 gather: str = "flat",
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
@@ -90,14 +91,17 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
     reachable from seed a with the seed's id (labels [vpad, B], one
     gather serving every query); pair_threshold must be off then."""
     if sg is None:
-        sg = ShardedGraph.build(g, num_parts, starts=starts,
-                                pair_threshold=pair_threshold)
+        sg = ShardedGraph.build(
+            g, num_parts, starts=starts,
+            pair_threshold=pair_threshold,
+            vpad_align=128 if gather != "flat" else 8)
     program = (make_program() if sources is None
                else make_batched_program(sources))
     return PushEngine(sg, program, mesh=mesh,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill, exchange=exchange,
-                      enable_sparse=enable_sparse, owner_tile_e=owner_tile_e,
+                      gather=gather, enable_sparse=enable_sparse,
+                      owner_tile_e=owner_tile_e,
                       owner_minmax_fused=owner_minmax_fused,
                       health=health, audit=audit)
 

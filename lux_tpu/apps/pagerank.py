@@ -116,6 +116,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  pair_min_fill: int | None = None,
                  starts=None, tile_e: int | None = None,
                  exchange: str = "auto",
+                 gather: str = "flat",
                  owner_tile_e: int | None = None,
                  health: bool = False,
                  sources=None, resets=None,
@@ -141,8 +142,12 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
     if sources is not None:
         resets = one_hot_resets(g.nv, sources)
     if sg is None:
-        sg = ShardedGraph.build(g, num_parts, starts=starts,
-                                pair_threshold=pair_threshold)
+        # gather="paged"|"auto": the paged plan needs 128-aligned
+        # vertex padding, like pair delivery (ops/pagegather.py)
+        sg = ShardedGraph.build(
+            g, num_parts, starts=starts,
+            pair_threshold=pair_threshold,
+            vpad_align=128 if gather != "flat" else 8)
     if tile_e is None:
         tile_e = 128 if pair_threshold is not None else 512
     program = (make_program(dtype) if resets is None
@@ -150,7 +155,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
     return PullEngine(sg, program, mesh=mesh,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill, tile_e=tile_e,
-                      exchange=exchange, owner_tile_e=owner_tile_e,
+                      exchange=exchange, gather=gather,
+                      owner_tile_e=owner_tile_e,
                       health=health, audit=audit)
 
 

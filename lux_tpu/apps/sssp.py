@@ -126,6 +126,7 @@ def build_engine(g: Graph, start_vertex: int | None = 0,
                  pair_threshold: int | None = None,
                  pair_min_fill: int | None = None,
                  starts=None, exchange: str = "auto",
+                 gather: str = "flat",
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
@@ -161,12 +162,15 @@ def build_engine(g: Graph, start_vertex: int | None = 0,
             delta = default_delta(g) if weighted else 1.0
         program = make_program(start_vertex, weighted)
     if sg is None:
-        sg = ShardedGraph.build(g, num_parts, starts=starts,
-                                pair_threshold=pair_threshold)
+        sg = ShardedGraph.build(
+            g, num_parts, starts=starts,
+            pair_threshold=pair_threshold,
+            vpad_align=128 if gather != "flat" else 8)
     return PushEngine(sg, program, mesh=mesh,
                       delta=delta, pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill,
-                      exchange=exchange, enable_sparse=enable_sparse,
+                      exchange=exchange, gather=gather,
+                      enable_sparse=enable_sparse,
                       owner_tile_e=owner_tile_e,
                       owner_minmax_fused=owner_minmax_fused,
                       health=health, audit=audit)

@@ -212,6 +212,14 @@ class ProgramSpec:
     ring_size: int | None = None
     expect_reduce_scatter: bool = False
     expect_all_to_all: bool = False
+    # paged engines (ops/pagegather.py): the page row-fetch whose
+    # operand is the TILE-RESHAPED state table ([T, 128, ...] /
+    # [T, 128*K]) IS the iteration's one state-table access — these
+    # shapes count against the same gather budget, so a paged dense
+    # iteration stays machine-checked at exactly 1 with no pragma.
+    # (The plan pads its buffer/row dims to NEVER collide with these
+    # shapes, pagegather._pad8_distinct.)
+    paged_table_shapes: tuple = ()
 
 
 # ---------------------------------------------------------------------
@@ -359,18 +367,23 @@ def _count_prims(jaxpr, names) -> int:
 # ---------------------------------------------------------------------
 # check 1: gather budget
 
-def _table_gathers(jaxpr, table_shape):
+def _table_gathers(jaxpr, table_shape, paged_shapes=()):
     """Gather eqns whose operand aval IS the flat state table (exact
     shape match: per-part arrays are rank+1 batched [P_local, vpad,
     ...], shards are [vpad, ...], pair row fetches are tile-reshaped
     [n_tiles, 128*...] — none collide with [num_parts*vpad, ...]).
-    A gather carrying an explicit ``# audit: allow(gather-budget)``
-    source pragma does not count against the budget."""
+    ``paged_shapes`` (ops/pagegather.py engines) adds the tile-
+    reshaped table shapes of the page row-fetch, counted against the
+    SAME budget: the paged path's page fetch is THE state-table
+    access of a dense iteration.  A gather carrying an explicit
+    ``# audit: allow(gather-budget)`` source pragma does not count."""
+    shapes = {tuple(table_shape)}
+    shapes.update(tuple(s) for s in paged_shapes)
     n = 0
     for eqn, _, stack in _iter_eqns(jaxpr):
         if eqn.primitive.name == "gather":
             aval = eqn.invars[0].aval
-            if (tuple(aval.shape) == tuple(table_shape)
+            if (tuple(aval.shape) in shapes
                     and not _pragma_allows(eqn, "gather-budget",
                                            stack)):
                 n += 1
@@ -383,7 +396,8 @@ def check_gather_budget(closed, spec: ProgramSpec, where: str):
     findings = []
     bodies = _outer_loops(closed.jaxpr) or [("program", closed.jaxpr)]
     for desc, body in bodies:
-        n = _table_gathers(body, spec.table_shape)
+        n = _table_gathers(body, spec.table_shape,
+                           spec.paged_table_shapes)
         if n > spec.gather_budget:
             findings.append(Finding(
                 "gather-budget", "error", where,
@@ -782,9 +796,10 @@ def raise_findings(findings, where: str = "",
 def engine_spec(engine, state_aval) -> ProgramSpec:
     """The ProgramSpec an engine's own configuration implies."""
     sg = engine.sg
-    table_shape = ((sg.num_parts * sg.vpad,)
-                   + tuple(state_aval.shape[2:]))
+    trail = tuple(state_aval.shape[2:])
+    table_shape = (sg.num_parts * sg.vpad,) + trail
     owner = engine.exchange == "owner"
+    paged = getattr(engine, "page_plan", None) is not None
     ndev = 1 if engine.mesh is None else engine.mesh.devices.size
     # the owner generation scan runs per DEVICE (inside shard_map on
     # a mesh): its length is the device-local source-part count
@@ -792,17 +807,31 @@ def engine_spec(engine, state_aval) -> ProgramSpec:
     reduce_kind = getattr(engine.program, "reduce", "sum")
     fused = bool(getattr(engine, "owner_minmax_fused", False))
     on_mesh = engine.mesh is not None
+    # paged engines access the table through its tile-reshaped view:
+    # [T, 128, ...] (scalar/batched) or [T, 128*prod(trail)] (the
+    # SDDMM path's flattened [T, 128*K] rows) — the page fetch on
+    # either shape counts against the same budget
+    T = sg.num_parts * sg.vpad // 128
+    paged_shapes = ()
+    if paged and not owner:
+        paged_shapes = ((T, 128) + trail,)
+        if trail:
+            paged_shapes += ((T, 128 * int(np.prod(trail))),)
+    # the owner paged scan gathers from the PAGE-RESHAPED shard
+    shard_shape = (sg.vpad,) + trail
+    if paged:
+        shard_shape = (sg.vpad // 128, 128) + trail
     return ProgramSpec(
         table_shape=table_shape,
         # dense iterations mask into the value vector PRE-gather:
-        # one per-element table gather, zero in owner mode (per-shard
-        # gathers ride the scan; pair row fetches are tile-reshaped)
+        # one table access (the flat per-element gather, or the paged
+        # page row-fetch), zero in owner mode (per-shard gathers ride
+        # the scan; pair row fetches are tile-reshaped and exempt)
         gather_budget=0 if owner else 1,
+        paged_table_shapes=paged_shapes,
         state_itemsize=np.dtype(state_aval.dtype).itemsize,
         require_scan_len=rows if owner else None,
-        require_scan_shard_shape=(
-            (sg.vpad,) + tuple(state_aval.shape[2:]) if owner
-            else None),
+        require_scan_shard_shape=shard_shape if owner else None,
         ppermute_hops=(ndev - 1) if (owner and on_mesh and fused
                                      and reduce_kind in ("min", "max"))
         else None,
@@ -889,6 +918,14 @@ def check_ledger(engine, tol: float = 0.5, where: str | None = None):
     from lux_tpu.engine.push import PushEngine
     is_push = isinstance(engine, PushEngine)
     kw = dict(exchange=engine.exchange)
+    if getattr(engine, "page_plan", None) is not None:
+        # paged engines carry the plan arrays + page buffer instead
+        # of the tiled/owner edge layout (memory_report prices the
+        # actual plan array bytes)
+        kw["page_plan"] = engine.page_plan
+        if not is_push:
+            from lux_tpu.engine.pull import _dot_kdim
+            kw["pair_kdim"] = _dot_kdim(engine.program)
     if engine.pairs is not None:
         kw["pairs"] = engine.pairs
         if not is_push:
@@ -904,6 +941,14 @@ def check_ledger(engine, tol: float = 0.5, where: str | None = None):
         kw["query_batch"] = int(getattr(engine, "batch", None) or 1)
     ledger = engine.sg.memory_report(**kw)
     expected = int(ledger["total_bytes"])
+    # memory_analysis argument bytes cover resident ARGUMENT arrays
+    # only — subtract the advisor's per-iteration temporary terms
+    # (pair/paged delivery intermediates, the page buffer) so the
+    # drift comparison is apples to apples
+    for tk in ("pair_temp_bytes_per_part",
+               "page_buffer_bytes_per_part",
+               "page_temp_bytes_per_part"):
+        expected -= engine.sg.num_parts * int(ledger.get(tk, 0))
     # the ledger prices scalar f32 state; K-vector programs carry
     # state_bytes per vertex — correct the vertex term so colfilter's
     # [vpad, 20] table does not read as edge-ledger drift
@@ -1003,6 +1048,34 @@ def run_repo_audit(verbose: bool = False, ledger: bool = True):
                                       pair_threshold=8, starts=starts)
 
     configs.append(("colfilter_np2_pair_dot", _pair_dot_engine, False))
+    # paged two-level gather (ops/pagegather.py, round 15): the page
+    # row-fetch + Pallas lane shuffle must hold the SAME one-access
+    # budget as the flat gather (paged_table_shapes) with no pragma —
+    # dense pull, dense push, the SDDMM dot path, the owner-side
+    # generation scan (page-reshaped shard gathers), and a batched
+    # B > 1 build
+    configs.append(("pagerank_np2_paged",
+                    lambda: pagerank.build_engine(g, num_parts=2,
+                                                  gather="paged"),
+                    False))
+    configs.append(("sssp_np2_paged",
+                    lambda: sssp.build_engine(g, 0, num_parts=2,
+                                              gather="paged"),
+                    False))
+    configs.append(("pagerank_np4_owner_paged",
+                    lambda: pagerank.build_engine(g, num_parts=4,
+                                                  exchange="owner",
+                                                  gather="paged"),
+                    False))
+    configs.append(("colfilter_np2_paged_dot",
+                    lambda: colfilter.build_engine(gw, num_parts=2,
+                                                   gather="paged"),
+                    False))
+    configs.append(("ppr_np2_paged_batched",
+                    lambda: pagerank.build_engine(g, num_parts=2,
+                                                  sources=[0, 3, 7],
+                                                  gather="paged"),
+                    False))
     # query-batched engines (ROADMAP item 2): the gather budget must
     # hold at B > 1 — ONE [P*vpad, B] table gather per dense pull/push
     # iteration, ZERO in owner mode — and the owner collective
@@ -1051,6 +1124,12 @@ def run_repo_audit(verbose: bool = False, ledger: bool = True):
         configs.append(("ppr_np2_batched_ledger",
                         lambda: pagerank.build_engine(
                             gd, num_parts=2, sources=list(range(8))),
+                        True))
+        # paged ledger: the priced plan arrays + page buffer vs the
+        # compiled step's argument bytes
+        configs.append(("pagerank_np2_paged_ledger",
+                        lambda: pagerank.build_engine(
+                            gd, num_parts=2, gather="paged"),
                         True))
     if mesh is not None:
         configs.append(("pagerank_mesh2_gather",

@@ -130,6 +130,18 @@ def _common(ap: argparse.ArgumentParser):
                          "96 MB state table; the default).  "
                          "colfilter's dot path has its own dst-free "
                          "machinery and ignores this")
+    ap.add_argument("-gather", default="flat",
+                    choices=["flat", "paged", "auto"],
+                    help="state-table delivery for dense iterations: "
+                         "'paged' replaces the ~9 ns/edge per-edge "
+                         "gather with the page-binned row fetch + "
+                         "Pallas lane shuffle (ops/pagegather.py); "
+                         "'auto' resolves by the scalemodel "
+                         "break-even on the plan's measured "
+                         "unique-page ratio (best after a degree "
+                         "relabel, which concentrates hot pages).  "
+                         "Mutually exclusive with -pair (both are "
+                         "row-granular delivery layouts)")
     ap.add_argument("-min-fill", type=_min_fill_arg, default=None,
                     dest="min_fill", metavar="F",
                     help="with -pair: drop pair rows that would "
@@ -536,8 +548,12 @@ def _build_sg(args, g, num_parts, starts=None):
     reference pagerank.cc:60-85) under -verbose."""
     from lux_tpu.graph import ShardedGraph
 
+    # -gather paged|auto: the paged plan needs 128-aligned vertex
+    # padding, like pair delivery (ops/pagegather.py)
+    paged = getattr(args, "gather", "flat") != "flat"
     sg = ShardedGraph.build(g, num_parts, starts=starts,
-                            pair_threshold=getattr(args, "pair", None))
+                            pair_threshold=getattr(args, "pair", None),
+                            vpad_align=128 if paged else 8)
     from lux_tpu import telemetry
     telemetry.current().emit("header", schema=telemetry.SCHEMA,
                              **sg.telemetry_header())
@@ -583,6 +599,7 @@ def cmd_pagerank(argv):
                                          pair_threshold=args.pair,
                                          pair_min_fill=args.min_fill,
                                          exchange=args.exchange,
+                                         gather=args.gather,
                                          health=args.health,
                                          sources=sources,
                                          audit=args.audit)
@@ -686,6 +703,7 @@ def _push_app(argv, prog_name):
                     pair_threshold=args.pair,
                     pair_min_fill=args.min_fill,
                     exchange=args.exchange,
+                    gather=args.gather,
                     enable_sparse=bool(args.sparse),
                     sources=sources,
                     health=args.health, audit=args.audit)
@@ -696,6 +714,7 @@ def _push_app(argv, prog_name):
                     pair_threshold=args.pair,
                     pair_min_fill=args.min_fill,
                     exchange=args.exchange,
+                    gather=args.gather,
                     enable_sparse=bool(args.sparse),
                     sources=sources,
                     health=args.health, audit=args.audit)
@@ -776,6 +795,7 @@ def cmd_colfilter(argv):
             return colfilter.build_engine(g_run, num_parts, m, sg=sg,
                                           pair_threshold=args.pair,
                                           pair_min_fill=args.min_fill,
+                                          gather=args.gather,
                                           health=args.health,
                                           audit=args.audit)
 

@@ -181,6 +181,7 @@ class PullEngine(AuditableEngine):
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
+                 gather: str = "flat",
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
                  stats_cap: int | None = None,
@@ -205,6 +206,20 @@ class PullEngine(AuditableEngine):
         # real mesh
         self.owner_minmax_fused = bool(owner_minmax_fused)
         self.pairs = None
+        # paged two-level gather (ops/pagegather.py): replaces the
+        # per-edge state-table gather with a page-binned row fetch +
+        # Pallas lane shuffle; an alternative row-delivery layout to
+        # the pair plan, so the two never compose
+        self.page_plan = None
+        self.gather = "flat"
+        if gather != "flat":
+            if gather == "paged" and pair_threshold is not None:
+                raise ValueError(
+                    "gather='paged' subsumes pair delivery (both are "
+                    "row-granular layouts); build without "
+                    "pair_threshold")
+            if pair_threshold is None:
+                self._setup_paged(sg, gather, program, exchange)
         if pair_threshold is not None:
             sg = self._setup_pairs(sg, pair_threshold, mesh, layout,
                                    program, pair_min_fill)
@@ -248,7 +263,14 @@ class PullEngine(AuditableEngine):
         self.stats_cap = int(stats_cap or DEFAULT_STATS_CAP)
         self.reduce_method = resolve_reduce_method(reduce_method)
         dev = jnp.asarray if mesh is None else np.asarray
-        if exchange == "owner":
+        if self.page_plan is not None:
+            # the paged plan IS the edge layout: neither the tiled
+            # chunk arrays nor the owner chunk layout is built
+            self.owner = None
+            self.tiles = None
+            arrays = dict(common_graph_arrays(sg, dev),
+                          **self._paged_arrays(dev, program))
+        elif exchange == "owner":
             from lux_tpu.ops.owner import OwnerLayout
             self.owner = OwnerLayout.build(sg, E=owner_tile_e or 256)
             self.tiles = None
@@ -347,6 +369,67 @@ class PullEngine(AuditableEngine):
             lambda vals, w: prog.edge_value(vals, None, w),
             reduce_method=self.reduce_method)
         return red[:self.sg.vpad]
+
+    # -- paged two-level gather (ops/pagegather.py) --------------------
+
+    def _setup_paged(self, sg: ShardedGraph, gather: str, program,
+                     exchange: str):
+        """Build the page-binned delivery plan and resolve
+        ``gather="auto"`` by the scalemodel break-even on its MEASURED
+        unique-page ratio / row fill (scalemodel.page_gather_ns) —
+        ops/pagegather.engine_page_plan holds the shared rule."""
+        from lux_tpu.ops.pagegather import engine_page_plan
+
+        self.page_plan = engine_page_plan(sg, gather, program, exchange)
+        if self.page_plan is not None:
+            self.gather = "paged"
+
+    def _paged_arrays(self, dev, program):
+        """The paged plan's graph arrays
+        (ops/pagegather.plan_graph_arrays)."""
+        from lux_tpu.ops.pagegather import plan_graph_arrays
+        return plan_graph_arrays(
+            self.page_plan, dev, owner=self.exchange == "owner",
+            dot=getattr(program, "edge_value_from_dot", None)
+            is not None,
+            num_parts=self.sg.num_parts, vpad=self.sg.vpad)
+
+    def _paged_red(self, flat_state, g):
+        """Paged delivery + reduce for one part -> [vpad, ...] (total
+        coverage: the plan serves EVERY edge, no residual)."""
+        from lux_tpu.ops.pagegather import paged_partial
+
+        prog = self.program
+        red = paged_partial(
+            self.page_plan, flat_state, g["pg_ids"], g["pg_sl"],
+            g["pg_rel"], g.get("pg_w"), g["pg_tp"], prog.reduce,
+            lambda vals, w: prog.edge_value(vals, None, w),
+            reduce_method=self.reduce_method)
+        return red[:self.sg.vpad]
+
+    def _paged_dot_red(self, flat_state, g):
+        """Paged SDDMM delivery (ops/pagegather.paged_partial_dot) —
+        pair_partial_dot's MXU pipeline plus the one-hot lane-shuffle
+        contraction."""
+        from lux_tpu.ops.pagegather import paged_partial_dot
+
+        red = paged_partial_dot(
+            self.page_plan, flat_state, g["pg_ids"], g["pg_sl"],
+            g["pg_rel"], g["pg_w"], g["pg_rt"], g["pg_tp"],
+            g["pg_t0"][0], self.program.edge_value_from_dot)
+        return red[:self.sg.vpad]
+
+    def _part_step_paged(self, flat_state, old_p, g):
+        with jax.named_scope("lux_gather_reduce"):
+            red = self._paged_red(flat_state, g)
+        with jax.named_scope("lux_apply"):
+            return self._apply_epilogue(old_p, red, g)
+
+    def _part_step_paged_dot(self, flat_state, old_p, g):
+        with jax.named_scope("lux_dot_reduce"):
+            red = self._paged_dot_red(flat_state, g)
+        with jax.named_scope("lux_apply"):
+            return self._apply_epilogue(old_p, red, g)
 
     # -- state placement ----------------------------------------------
 
@@ -591,9 +674,14 @@ class PullEngine(AuditableEngine):
         sg = self.sg
         flat = full_state.reshape((sg.num_parts * sg.vpad,) +
                                   full_state.shape[2:])
-        use_dot = (self.program.edge_value_from_dot is not None
-                   and self.tiles is not None)
-        step = self._part_step_dot if use_dot else self._part_step
+        use_dot = self.program.edge_value_from_dot is not None
+        if self.page_plan is not None:
+            step = (self._part_step_paged_dot if use_dot
+                    else self._part_step_paged)
+        else:
+            step = (self._part_step_dot
+                    if use_dot and self.tiles is not None
+                    else self._part_step)
         return jax.vmap(lambda old, g: step(flat, old, g))(
             local_state, g_local)
 
@@ -610,10 +698,20 @@ class PullEngine(AuditableEngine):
             probe_s, probe_w).dtype
 
     def _owner_contribs(self, state_rows, g):
-        """Per-source-part contributions (ops/owner.owner_contribs)."""
+        """Per-source-part contributions (ops/owner.owner_contribs;
+        paged engines run the page-binned shard delivery under the
+        same generation scan, ops/pagegather.paged_owner_contribs)."""
+        prog = self.program
+        if self.page_plan is not None:
+            from lux_tpu.ops.pagegather import paged_owner_contribs
+            return paged_owner_contribs(
+                self.page_plan, state_rows, g, prog.reduce,
+                lambda vals, wt: prog.edge_value(vals, None, wt),
+                self._msg_dtype(state_rows), self.sg.num_parts,
+                self.reduce_method,
+                varying_axis=None if self.mesh is None else PARTS_AXIS)
         from lux_tpu.ops.owner import owner_contribs
 
-        prog = self.program
         return owner_contribs(
             self.owner, state_rows, g,
             prog.reduce,
@@ -1093,11 +1191,14 @@ class PullEngine(AuditableEngine):
         sg = self.sg
 
         if (self.program.edge_value_from_dot is not None
-                and self.tiles is not None):
+                and (self.tiles is not None
+                     or self.page_plan is not None)):
             # dot-path programs (colfilter): the src gather, MXU tile
             # dots and one-hot reduction are one lax.map pipeline by
             # design, so they time as ONE 'dot_reduce' phase — closing
             # the round-2 hole where this raised NotImplementedError
+            # (paged engines time their page-fetch + shuffle + SDDMM
+            # pipeline under the same phase name)
             def dot_exchange(state, *gargs):
                 full = state
                 if self.mesh is not None:
@@ -1109,9 +1210,14 @@ class PullEngine(AuditableEngine):
 
             def dot_reduce(flat, state, *gargs):
                 g = dict(zip(keys, gargs))
-                red = jax.vmap(
-                    lambda old, gp: self._part_dot_red(flat, old, gp))(
-                    state, g)
+                if self.page_plan is not None:
+                    red = jax.vmap(
+                        lambda old, gp: self._paged_dot_red(flat, gp))(
+                        state, g)
+                else:
+                    red = jax.vmap(
+                        lambda old, gp: self._part_dot_red(
+                            flat, old, gp))(state, g)
                 return red, cksum(red)
 
             def dot_apply(state, red, *gargs):
@@ -1190,10 +1296,14 @@ class PullEngine(AuditableEngine):
             # the streamed step fuses gather+message+reduce per chunk
             # block — instrument it as ONE phase so the report reflects
             # what the compiled step actually runs (and stays within
-            # the memory bound streaming exists for)
+            # the memory bound streaming exists for).  Paged engines
+            # fuse page-fetch + lane shuffle + reduce the same way.
             g = dict(zip(keys, gargs))
-            red = jax.vmap(
-                lambda gp: self._part_red_streamed(flat, gp))(g)
+            if self.page_plan is not None:
+                red = jax.vmap(lambda gp: self._paged_red(flat, gp))(g)
+            else:
+                red = jax.vmap(
+                    lambda gp: self._part_red_streamed(flat, gp))(g)
             return red, cksum(red)
 
         def apply(state, red, *gargs):
@@ -1201,7 +1311,7 @@ class PullEngine(AuditableEngine):
             new = jax.vmap(self._apply_epilogue)(state, red, g)
             return new, cksum(new)
 
-        if self._streams:
+        if self._streams or self.page_plan is not None:
             fns = dict(exchange=exchange, gather_reduce=gather_reduce,
                        apply=apply)
             specs = dict(exchange=((0,), 1), gather_reduce=((1, 0), 0),

@@ -111,6 +111,7 @@ class PushEngine(AuditableEngine):
                  pair_stream: bool | None = None,
                  stream_msgs: bool | None = None,
                  exchange: str = "auto",
+                 gather: str = "flat",
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
                  stats_cap: int | None = None,
@@ -175,6 +176,24 @@ class PushEngine(AuditableEngine):
         self.stats_cap = int(stats_cap or DEFAULT_STATS_CAP)
         self.sparse_threshold = sparse_threshold
         self.reduce_method = resolve_reduce_method(reduce_method)
+        # Paged two-level gather for the DENSE iterations
+        # (ops/pagegather.py): page-binned rows + the Pallas lane
+        # shuffle replace the per-edge masked-label gather; the
+        # SPARSE path keeps the src-sorted view, like pairs below.
+        self.page_plan = None
+        self.gather = "flat"
+        if gather != "flat":
+            if gather == "paged" and pair_threshold is not None:
+                raise ValueError(
+                    "gather='paged' subsumes pair delivery (both are "
+                    "row-granular layouts); build without "
+                    "pair_threshold")
+            if pair_threshold is None:
+                from lux_tpu.ops.pagegather import engine_page_plan
+                self.page_plan = engine_page_plan(sg, gather, program,
+                                                  exchange)
+                if self.page_plan is not None:
+                    self.gather = "paged"
         # Pair-lane delivery for the DENSE iterations (ops/pairs.py):
         # dense pair edges leave the per-edge gather path; the SPARSE
         # path below keeps the FULL graph's src-sorted view — frontier
@@ -199,7 +218,20 @@ class PushEngine(AuditableEngine):
                               if stream_msgs is None
                               else bool(stream_msgs))
         dev = jnp.asarray if mesh is None else np.asarray
-        if exchange == "owner":
+        if self.page_plan is not None:
+            # the paged plan IS the dense edge layout (sparse
+            # iterations keep the src-sorted view added below)
+            from lux_tpu.engine.pull import common_graph_arrays
+            from lux_tpu.ops.pagegather import plan_graph_arrays
+            self.owner = None
+            self.tiles = None
+            arrays = dict(
+                common_graph_arrays(dense_sg, dev),
+                **plan_graph_arrays(
+                    self.page_plan, dev,
+                    owner=exchange == "owner", dot=False,
+                    num_parts=sg.num_parts, vpad=sg.vpad))
+        elif exchange == "owner":
             # dense iterations run owner-side (ops/owner.py): per-
             # source-part small-shard gathers + reduce_scatter replace
             # the label all_gather + big-table gather; the sparse path
@@ -337,9 +369,18 @@ class PushEngine(AuditableEngine):
         (billion-edge memory mode; PERF_NOTES ledger)."""
         sg, prog, lay = self.sg, self.program, self.tiles
         # relax + mask masked-source candidates back to the identity
-        # (shared by the streamed, pair and owner deliveries)
+        # (shared by the streamed, pair, paged and owner deliveries)
         msg = self._owner_msg(flat_l.dtype)
 
+        if self.page_plan is not None:
+            # paged two-level delivery (ops/pagegather.py): the page
+            # fetch + lane shuffle + compare-reduce replace both the
+            # masked-label gather and the tiled reduce
+            from lux_tpu.ops.pagegather import paged_partial
+            return paged_partial(
+                self.page_plan, flat_l, g["pg_ids"], g["pg_sl"],
+                g["pg_rel"], g.get("pg_w"), g["pg_tp"], prog.reduce,
+                msg, reduce_method=self.reduce_method)[:sg.vpad]
         if cand is None:
             from lux_tpu.ops.tiled import (combine_partials,
                                            streamed_chunk_partials)
@@ -391,7 +432,8 @@ class PushEngine(AuditableEngine):
     _DENSE_KEYS = ("src_slot", "dst_local", "weight", "rel_dst",
                    "chunk_start", "last_chunk", "chunk_tile", "nvp",
                    "deg", "pair_rowbind", "pair_rel", "pair_weight",
-                   "pair_tile_pos")
+                   "pair_tile_pos", "pg_ids", "pg_sl", "pg_rel",
+                   "pg_w", "pg_tp")
 
     @property
     def _streams(self) -> bool:
@@ -400,7 +442,10 @@ class PushEngine(AuditableEngine):
     def _dense_parts(self, label, active, full_label, full_active, g):
         with jax.named_scope("lux_exchange"):
             flat_l = self._dense_flat(full_label, full_active)
-        stream = self._streams
+        # streamed and paged steps both fuse gather+relax+reduce into
+        # one delivery (the paged one: page fetch + lane shuffle +
+        # compare-reduce, ops/pagegather.py)
+        stream = self._streams or self.page_plan is not None
 
         def one(old, g):
             with jax.named_scope("lux_relax"):
@@ -445,13 +490,20 @@ class PushEngine(AuditableEngine):
         msg_dtype = jax.eval_shape(
             msg, jax.ShapeDtypeStruct((1, 1), label.dtype),
             (jax.ShapeDtypeStruct((1, 1), jnp.float32)
-             if "own_w" in g else None)).dtype
+             if ("own_w" in g or "own_pg_w" in g) else None)).dtype
         with jax.named_scope("lux_gen_exchange"):
-            acc = owner_contribs(
-                self.owner, masked, g,
-                prog.reduce, msg, msg_dtype, sg.num_parts,
-                self.reduce_method,
-                varying_axis=PARTS_AXIS if on_mesh else None)
+            if self.page_plan is not None:
+                from lux_tpu.ops.pagegather import paged_owner_contribs
+                acc = paged_owner_contribs(
+                    self.page_plan, masked, g, prog.reduce, msg,
+                    msg_dtype, sg.num_parts, self.reduce_method,
+                    varying_axis=PARTS_AXIS if on_mesh else None)
+            else:
+                acc = owner_contribs(
+                    self.owner, masked, g,
+                    prog.reduce, msg, msg_dtype, sg.num_parts,
+                    self.reduce_method,
+                    varying_axis=PARTS_AXIS if on_mesh else None)
             red = owner_exchange(
                 acc, prog.reduce,
                 axis=PARTS_AXIS if on_mesh else None,
@@ -1171,7 +1223,7 @@ class PushEngine(AuditableEngine):
                 cnt = jax.lax.psum(cnt, PARTS_AXIS)
             return (new, improved), cnt
 
-        streams = self._streams
+        streams = self._streams or self.page_plan is not None
         if streams:
             fns = dict(exchange=exchange, relax_reduce=relax_reduce,
                        update=update)

@@ -673,6 +673,7 @@ class ShardedGraph:
                       push_sparse: bool = False,
                       pairs=None, pair_kdim: int = 1,
                       pair_stream: bool | None = None,
+                      page_plan=None,
                       query_batch: int = 1) -> dict:
         """HBM bytes for the engine edge layouts per part — the
         analogue of the reference's startup memory advisor (reference
@@ -725,7 +726,34 @@ class ShardedGraph:
             raise ValueError(f"query_batch must be >= 1, got "
                              f"{query_batch}")
         w = 4 if self.weighted else 0
-        if exchange == "owner":
+        page_buf = page_temp = 0
+        if page_plan is not None:
+            # paged gather (ops/pagegather.py): the plan arrays
+            # REPLACE the tiled/owner edge layout entirely — price
+            # their actual bytes (slot_lane uint32 + rel int8 +
+            # weights + row_tile + tile_pos + page_ids), plus the
+            # per-iteration temporaries: the deduplicated page buffer
+            # [n_pages, 128 (, K, B)] f32 AND the delivered rows —
+            # vals + per-row partials, f32 [Rp, 128 (, K, B)] each
+            # (the same 2x-Rp-rows term the pair path prices as
+            # pair_temp; there is no streamed paged variant yet, so
+            # the monolithic bound is what a big build must fit).
+            # Both fold into the total like the pair temporaries; the
+            # ledger-drift audit compares ARGUMENT arrays only and
+            # subtracts the temp fields (audit.check_ledger).
+            pp = page_plan
+            resident = (pp.slot_lane.nbytes + pp.rel_dst.nbytes
+                        + pp.row_tile.nbytes + pp.tile_pos.nbytes
+                        + pp.page_ids.nbytes
+                        + (pp.weight.nbytes
+                           if pp.weight is not None else 0))
+            # plan arrays lead with the part (owner: src-part) count
+            plan_parts = max(1, pp.slot_lane.shape[0])
+            edge_bytes = resident // plan_parts
+            wide = max(1, pair_kdim) * query_batch
+            page_buf = pp.n_pages * 128 * 4 * wide
+            page_temp = 2 * pp.Rp * 128 * 4 * wide
+        elif exchange == "owner":
             slots = (self.epad if owner_slots_per_part is None
                      else int(owner_slots_per_part))
             if owner_packed is None:
@@ -786,7 +814,7 @@ class ShardedGraph:
         owner_msg = (self.vpad * 4 * query_batch
                      if exchange == "owner" else 0)
         per_part = edge_bytes + sparse_bytes + pair_bytes \
-            + pair_temp + vert_bytes
+            + pair_temp + vert_bytes + page_buf + page_temp
         return {
             "num_parts": self.num_parts,
             "query_batch": query_batch,
@@ -794,6 +822,8 @@ class ShardedGraph:
             "push_sparse_bytes_per_part": sparse_bytes,
             "pair_bytes_per_part": pair_bytes,
             "pair_temp_bytes_per_part": pair_temp,
+            "page_buffer_bytes_per_part": page_buf,
+            "page_temp_bytes_per_part": page_temp,
             "vertex_bytes_per_part": vert_bytes,
             "owner_msg_bytes_per_part": owner_msg,
             "total_bytes": self.num_parts * per_part,

@@ -78,6 +78,8 @@ PROBE_GATHER_LOGV = 18        # 1 MB f32 table — small-table regime
 PROBE_GATHER_N = 1 << 20      # 1M indices per step
 PROBE_DOT_ROWS = 256          # pair-dot rows per step
 PROBE_DOT_K = 20              # colfilter's K (the modeled 5.5 ns/K)
+PROBE_PAGE_ROWS = 2048        # paged-gather delivery rows per step
+PROBE_PAGE_TABLE = 256        # pages in the probe's page buffer
 PROBE_LOOP_K = 8              # steps inside the one jitted loop
 DEVIATION_BOUND = 3.0         # outside [1/3, 3]x of canon = degraded
 
@@ -90,6 +92,14 @@ DEVIATION_BOUND = 3.0         # outside [1/3, 3]x of canon = degraded
 CANONICAL = {
     "gather_small_ns": scalemodel.GATHER_SMALL_NS,
     "pair_dot_row_ns": scalemodel.PAIR_DOT_ROW_K_NS * PROBE_DOT_K,
+    # paged-gather delivery row (ops/pagegather.py): row fetch + the
+    # 128-lane shuffle + the compare-reduce, composed from MEASURED
+    # primitive figures (PERF_NOTES round 2: 24 ns/row static fetch,
+    # 0.38 ns/elem shuffle, the 150 ns pair-row machinery the paged
+    # row shares) — scalemodel.PAGED_ROW_NS.  A model until the
+    # on-device A/B lands (DEBTS "paged-gather-ab"); recorded for
+    # trajectory and the paged phase pricing, never grading.
+    "page_gather_row_ns": scalemodel.PAGED_ROW_NS,
 }
 
 
@@ -185,6 +195,49 @@ def _gather_probe_step(carry):
     return sv, (table + sv * 1e-30, idx)
 
 
+def _page_resolve_method() -> str:
+    """The paged resolution formulation this platform runs: the
+    Pallas lane-shuffle kernel on real TPUs, the plain XLA
+    take_along_axis everywhere else (matching the engines'
+    resolve_reduce_method split, engine/pull.py)."""
+    import jax
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _page_probe_carry():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(2)           # pinned seed: one program
+    table = jnp.asarray(
+        rng.random((PROBE_PAGE_TABLE, 128), np.float32))
+    slot = rng.integers(0, PROBE_PAGE_TABLE, PROBE_PAGE_ROWS)
+    lane = rng.integers(0, 128, (PROBE_PAGE_ROWS, 128))
+    sl = (slot[:, None].astype(np.uint32) << np.uint32(7)) \
+        | lane.astype(np.uint32)
+    rel = rng.integers(0, 128, (PROBE_PAGE_ROWS, 128)).astype(np.int8)
+    return table, jnp.asarray(sl), jnp.asarray(rel)
+
+
+def _page_probe_step(carry):
+    """One paged DELIVERY row pipeline per row: page-row fetch, lane
+    shuffle, compare-reduce — the full composed primitive the engines
+    run per row (ops/pagegather.paged_partial), so the session scale
+    this probe yields prices paged phases in THIS session's ns."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.ops.pagegather import lane_resolve
+    from lux_tpu.ops.tiled import chunk_partials
+    table, sl, rel = carry
+    row_slot = jax.lax.shift_right_logical(
+        sl[:, 0], jnp.uint32(7)).astype(jnp.int32)
+    rows = jnp.take(table, row_slot, axis=0)
+    vals = lane_resolve(rows, sl, _page_resolve_method())
+    vals = jax.lax.optimization_barrier(vals)
+    partials = chunk_partials(vals, rel, 128, "sum")
+    sv = jnp.sum(partials)
+    return sv, (table + sv * 1e-30, sl, rel)
+
+
 def _dot_probe_carry(kdim: int = PROBE_DOT_K):
     import jax.numpy as jnp
     rng = np.random.default_rng(1)
@@ -216,7 +269,8 @@ def _audit_probe_programs():
     findings = []
     for name, step, carry in (
             ("gather", _gather_probe_step, _gather_probe_carry()),
-            ("pair_dot", _dot_probe_step, _dot_probe_carry())):
+            ("pair_dot", _dot_probe_step, _dot_probe_carry()),
+            ("page_gather", _page_probe_step, _page_probe_carry())):
         def run(c0, _step=step):
             def body(_, c):
                 acc, cur = c
@@ -259,13 +313,18 @@ def calibrate(force: bool = False, clock=time.perf_counter,
                              PROBE_LOOP_K, repeats=repeats, clock=clock)
     dot_s, _ = loop_bench(_dot_probe_step, _dot_probe_carry(),
                           PROBE_LOOP_K, repeats=repeats, clock=clock)
+    page_s, _ = loop_bench(_page_probe_step, _page_probe_carry(),
+                           PROBE_LOOP_K, repeats=repeats, clock=clock)
     g_m, g_mad = median_mad(gather_s)
     d_m, d_mad = median_mad(dot_s)
+    p_m, p_mad = median_mad(page_s)
     probe = {
         "gather_small_ns": g_m / PROBE_GATHER_N * 1e9,
         "gather_small_mad_ns": g_mad / PROBE_GATHER_N * 1e9,
         "pair_dot_row_ns": d_m / PROBE_DOT_ROWS * 1e9,
         "pair_dot_row_mad_ns": d_mad / PROBE_DOT_ROWS * 1e9,
+        "page_gather_row_ns": p_m / PROBE_PAGE_ROWS * 1e9,
+        "page_gather_row_mad_ns": p_mad / PROBE_PAGE_ROWS * 1e9,
     }
     deviation = probe["gather_small_ns"] / CANONICAL["gather_small_ns"]
     platform = jax.devices()[0].platform
@@ -349,10 +408,12 @@ def _engine_kind(eng) -> str:
     return "push" if hasattr(eng, "converge") else "pull"
 
 
-def _engine_model(eng, scale: float) -> dict:
+def _engine_model(eng, scale: float,
+                  page_scale: float | None = None) -> dict:
     """scalemodel.phase_model priced from the engine's OWN layout
-    stats (pair coverage/inflation, owner chunk inflation, K-dim) —
-    the same stats the engines already report."""
+    stats (pair coverage/inflation, owner chunk inflation, K-dim,
+    the paged plan's page ratio/fill) — the same stats the engines
+    already report."""
     cov, row_infl = 0.0, 1.0
     if eng.pairs is not None:
         cov = float(eng.pairs.stats["coverage"])
@@ -364,12 +425,20 @@ def _engine_model(eng, scale: float) -> dict:
     state_bytes = getattr(eng.program, "state_bytes", None) or 4
     kdim = max(1, int(state_bytes) // 4)
     dot = getattr(eng.program, "edge_value_from_dot", None) is not None
+    pp = getattr(eng, "page_plan", None)
+    paged = pp is not None
     return scalemodel.phase_model(
         engine=_engine_kind(eng), exchange=eng.exchange,
         ne=int(eng.sg.ne), nv=int(eng.sg.nv), kdim=kdim,
         pair_coverage=cov, pair_row_inflation=row_infl,
         chunk_inflation=chunk_infl,
-        state_bytes_per_vertex=int(state_bytes), dot=dot, scale=scale)
+        state_bytes_per_vertex=int(state_bytes), dot=dot, scale=scale,
+        paged=paged,
+        page_ratio=float(pp.stats["page_ratio"]) if paged else 0.0,
+        page_fill=float(pp.stats.get("padded_fill",
+                                     pp.stats["fill"]))
+        if paged else 128.0,
+        page_scale=page_scale)
 
 
 def decompose(eng, app: str, iters: int = 3,
@@ -387,7 +456,11 @@ def decompose(eng, app: str, iters: int = 3,
     phase and a ``drift`` event per non-ok verdict."""
     fp = fingerprint or calibrate()
     scale = session_scale(fp)
-    model = _engine_model(eng, scale)
+    page_scale = None
+    if "page_gather_row_ns" in fp.probe:
+        page_scale = (fp.probe["page_gather_row_ns"]
+                      / fp.canonical["page_gather_row_ns"])
+    model = _engine_model(eng, scale, page_scale=page_scale)
     kind = _engine_kind(eng)
     tel = telemetry.current()
 
@@ -607,6 +680,15 @@ DEBTS = (
          "era) on/off A/B through the tunnel — CPU A/B is within "
          "noise; the on-device all_gather cost is unmeasured",
          "PERF_NOTES round 13", min_ndev=2),
+    Debt("paged-gather-ab",
+         "on-device paged-vs-flat delivered-rate A/B at the pinned "
+         "probe shapes (ops/pagegather.py): the modeled "
+         "~0.57-2 ns/edge paged rate vs the measured 8.96 flat "
+         "gather — the round-15 break-even model "
+         "(scalemodel.page_gather_ns) is primitive-derived, not yet "
+         "measured end-to-end on device",
+         "PERF_NOTES round 15 (paged gather)",
+         auto="_debt_paged_gather_ab"),
     Debt("batch-sweep-on-device",
          "bench.py -config batch-sweep (B in {1,8,64} k-source SSSP "
          "+ personalized PageRank) on a live tunnel: the modeled "
@@ -646,6 +728,53 @@ def _debt_pair_dot_sweep(fp: Fingerprint, clock=time.perf_counter):
             "sweep": sweep}
 
 
+def _debt_paged_gather_ab(fp: Fingerprint, clock=time.perf_counter):
+    """Paged-vs-flat A/B at the pinned probe shapes: the same
+    PROBE_PAGE_ROWS x 128 delivered edges served by (a) the flat
+    per-edge gather and (b) the paged row-fetch + lane shuffle —
+    ns/edge for both plus the speedup, the number the round-15
+    break-even model owes from a live device."""
+    import jax.numpy as jnp
+
+    import jax
+
+    from lux_tpu.ops.tiled import chunk_partials
+
+    edges = PROBE_PAGE_ROWS * 128
+    rng = np.random.default_rng(3)
+    flat_table = jnp.asarray(
+        rng.random(PROBE_PAGE_TABLE * 128, np.float32))
+    idx = jnp.asarray(rng.integers(
+        0, PROBE_PAGE_TABLE * 128,
+        (PROBE_PAGE_ROWS, 128)).astype(np.int32))
+    rel = jnp.asarray(rng.integers(
+        0, 128, (PROBE_PAGE_ROWS, 128)).astype(np.int8))
+
+    def flat_step(carry):
+        # the flat side runs the SAME downstream compare-reduce, so
+        # the A/B isolates exactly the delivery-stage swap
+        t, i, r = carry
+        vals = jax.lax.optimization_barrier(jnp.take(t, i, axis=0))
+        sv = jnp.sum(chunk_partials(vals, r, 128, "sum"))
+        return sv, (t + sv * 1e-30, i, r)
+
+    flat_s, _ = loop_bench(flat_step, (flat_table, idx, rel),
+                           PROBE_LOOP_K, repeats=3, clock=clock)
+    page_s, _ = loop_bench(_page_probe_step, _page_probe_carry(),
+                           PROBE_LOOP_K, repeats=3, clock=clock)
+    f_m, f_mad = median_mad(flat_s)
+    p_m, p_mad = median_mad(page_s)
+    flat_ns = f_m / edges * 1e9
+    paged_ns = p_m / edges * 1e9
+    return {"debt": "paged-gather-ab", "edges": edges,
+            "flat_ns_per_edge": round(flat_ns, 4),
+            "flat_mad_ns": round(f_mad / edges * 1e9, 4),
+            "paged_ns_per_edge": round(paged_ns, 4),
+            "paged_mad_ns": round(p_mad / edges * 1e9, 4),
+            "speedup": round(flat_ns / max(paged_ns, 1e-12), 3),
+            "method": _page_resolve_method()}
+
+
 def collect_debts(fp: Fingerprint, ledger: PerfLedger | None,
                   only=None, clock=time.perf_counter):
     """Run every matched debt with an implemented probe, appending a
@@ -675,7 +804,8 @@ APPS = ("pagerank", "cc", "sssp", "colfilter")
 
 
 def _build_app_engine(app: str, scale: int, ef: int, num_parts: int,
-                      pair_threshold: int | None):
+                      pair_threshold: int | None,
+                      gather: str = "flat"):
     from lux_tpu.convert import rmat_graph
 
     g = rmat_graph(scale=scale, edge_factor=ef, seed=0)
@@ -698,7 +828,7 @@ def _build_app_engine(app: str, scale: int, ef: int, num_parts: int,
     else:
         starts = None
     kw = dict(num_parts=num_parts, pair_threshold=pair_threshold,
-              starts=starts)
+              starts=starts, gather=gather)
     if app == "pagerank":
         from lux_tpu.apps import pagerank
         return pagerank.build_engine(g, **kw)
@@ -729,6 +859,13 @@ def main(argv=None) -> int:
     ap.add_argument("-np", type=int, default=1, help="partitions")
     ap.add_argument("-pair", type=int, default=None, metavar="T",
                     help="pair-lane threshold (with degree relabel)")
+    ap.add_argument("-gather", default="flat",
+                    choices=["flat", "paged", "auto"],
+                    help="state-table delivery: 'paged' runs the "
+                         "page-binned two-level gather "
+                         "(ops/pagegather.py), 'auto' resolves by "
+                         "the scalemodel break-even on the plan's "
+                         "measured unique-page ratio")
     ap.add_argument("-iters", type=int, default=3,
                     help="measured iterations per phase (median + "
                          "MAD)")
@@ -788,7 +925,7 @@ def main(argv=None) -> int:
         decomps = []
         for app in args.apps:
             eng = _build_app_engine(app, args.scale, args.ef, args.np,
-                                    args.pair)
+                                    args.pair, gather=args.gather)
             d = decompose(eng, app, iters=args.iters, fingerprint=fp)
             decomps.append(d)
             if ledger is not None:

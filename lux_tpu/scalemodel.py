@@ -92,6 +92,94 @@ def break_even_fill(kdim: int = 1,
     return max(1, math.ceil(pair_row_ns(kdim) / residual_ns))
 
 
+# Paged two-level gather (ops/pagegather.py, round 15): the measured
+# primitive costs of its stages (PERF_NOTES round 2).  Static row
+# movement is cheap — `jnp.take` of [*, 128] rows = 24 ns/row — and
+# the Pallas lane shuffle (`take_along_axis` axis=1 ->
+# tpu.dynamic_gather dim 1) is the one fast dynamic primitive.
+PAGE_ROW_FETCH_NS = 24.0       # one [*, 128] row fetch (0.19 ns/elem)
+LANE_SHUFFLE_NS = 0.38         # per element, 128-wide lane shuffle
+# Modeled cost of ONE paged delivery row: the pair row's measured
+# 150 ns fetch + compare-reduce machinery (same row shape, same
+# combine) PLUS the 128-lane shuffle the paged row adds.  MODELED
+# from measured primitive costs, not yet measured end-to-end on
+# device — the owed A/B is observe.DEBTS "paged-gather-ab".
+PAGED_ROW_NS = PAIR_ROW_NS + 128 * LANE_SHUFFLE_NS     # = 198.64
+# K-dim (SDDMM) paged rows run THREE 128x128xK MXU contractions
+# (one-hot lane shuffle + D = S @ T^T + the gradient matmul) where
+# pair rows run two — 1.5x the pair per-K term.
+PAGED_DOT_ROW_K_NS = 1.5 * PAIR_DOT_ROW_K_NS
+
+
+def paged_row_ns(kdim: int = 1) -> float:
+    """Modeled cost of one delivered 128-lane paged row."""
+    if kdim <= 1:
+        return PAGED_ROW_NS
+    return PAGED_ROW_NS + PAGED_DOT_ROW_K_NS * kdim
+
+
+def flat_gather_ns(table_bytes: float) -> float:
+    """The flat per-edge gather rate for a state table of this size:
+    the measured small-table 8.96 ns/elem, stepping to 14.6 past the
+    ~96 MB emitter cliff (PERF_NOTES rounds 2-3)."""
+    return GATHER_BIG_NS if table_bytes > BIG_TABLE_BYTES \
+        else GATHER_SMALL_NS
+
+
+def page_gather_ns(page_ratio: float, fill: float,
+                   kdim: int = 1) -> float:
+    """Modeled delivered ns/edge of the paged two-level gather
+    (ops/pagegather.py) from the plan's MEASURED stats:
+
+      page_ratio  unique fetched page elements per edge
+                  (unique_pages * 128 / ne — the dedup'd page fetch's
+                  share, at the 0.19 ns/elem static row-fetch rate)
+      fill        average live lanes per delivery row (ne / rows —
+                  the per-row machinery amortizes over this)
+
+    Both are graph-structure dependent (R-MAT tails vs real-graph
+    clustering), which is why ``gather="auto"`` resolves from the
+    plan's recorded stats rather than a fixed constant."""
+    if fill <= 0:
+        raise ValueError(f"fill must be > 0, got {fill}")
+    if page_ratio < 0:
+        raise ValueError(f"page_ratio must be >= 0, got {page_ratio}")
+    fetch = page_ratio * (PAGE_ROW_FETCH_NS / 128.0) * max(1, kdim)
+    return fetch + paged_row_ns(kdim) / fill
+
+
+def page_break_even_fill(page_ratio: float = 1.0,
+                         table_bytes: float = 0.0,
+                         kdim: int = 1) -> int:
+    """Row fill above which the paged path beats the flat gather (at
+    a given unique-page ratio): rows under this live-lane count pay
+    more in row machinery than the 9/14.6 ns flat rate.  The modeled
+    small-table scalar threshold — fill >= 23 at page_ratio 1 — is
+    the recorded break-even of round 15 (pinned in
+    tests/test_pagegather.py)."""
+    import math
+    rate = flat_gather_ns(table_bytes)
+    if kdim > 1:
+        rate = residual_edge_ns(kdim)
+    margin = rate - page_ratio * (PAGE_ROW_FETCH_NS / 128.0) \
+        * max(1, kdim)
+    if margin <= 0:
+        return 1 << 30          # flat always wins at this page ratio
+    return max(1, math.ceil(paged_row_ns(kdim) / margin))
+
+
+def page_break_even_ratio(fill: float, table_bytes: float = 0.0,
+                          kdim: int = 1) -> float:
+    """Largest unique-page ratio at which the paged path still beats
+    the flat gather for rows of the given fill (negative = paged can
+    never win at this fill)."""
+    rate = flat_gather_ns(table_bytes)
+    if kdim > 1:
+        rate = residual_edge_ns(kdim)
+    return (rate - paged_row_ns(kdim) / fill) \
+        / ((PAGE_ROW_FETCH_NS / 128.0) * max(1, kdim))
+
+
 # Query batching (ROADMAP item 2, engine/program.py ``batch``): the
 # dense iteration's ONE table gather fetches a [B]-wide CONTIGUOUS
 # state row per edge instead of one element — the fetch is
@@ -227,7 +315,10 @@ def phase_model(*, engine: str, exchange: str, ne: int, nv: int,
                 pair_row_inflation: float = 1.0,
                 chunk_inflation: float = 1.2,
                 state_bytes_per_vertex: int = 4,
-                dot: bool = False, scale: float = 1.0) -> dict:
+                dot: bool = False, scale: float = 1.0,
+                paged: bool = False, page_ratio: float = 0.0,
+                page_fill: float = 128.0,
+                page_scale: float | None = None) -> dict:
     """Per-PHASE predicted nanoseconds for ONE engine iteration — the
     model side of the observatory's measured-vs-model drift check
     (lux_tpu/observe.py).  Keys match the engines' ``timed_phases``
@@ -265,7 +356,17 @@ def phase_model(*, engine: str, exchange: str, ne: int, nv: int,
     residual_ne = ne * (1.0 - cov)
     state_bytes = nv * state_bytes_per_vertex
 
-    if exchange == "owner":
+    if paged:
+        # paged two-level delivery (ops/pagegather.py): priced from
+        # the plan's recorded unique-page ratio and row fill — total
+        # coverage, so no pair/residual split.  ``page_scale`` is the
+        # session's measured page-row probe over its canon (the
+        # observe.calibrate page_gather probe) — the paged pipeline's
+        # platform factor differs from the flat gather's, so it gets
+        # its own scale when the caller has one.
+        deliver = ne * page_gather_ns(page_ratio, page_fill, kdim) \
+            * (scale if page_scale is None else page_scale)
+    elif exchange == "owner":
         deliver = residual_ne * chunk_inflation * OWNER_SLOT_NS * scale
     else:
         rate = (GATHER_BIG_NS if state_bytes > BIG_TABLE_BYTES
@@ -274,6 +375,8 @@ def phase_model(*, engine: str, exchange: str, ne: int, nv: int,
             rate = residual_edge_ns(kdim)
         deliver = residual_ne * rate * scale
     apply_ns = nv * STATE_NS_PER_VERTEX * scale
+    if paged:
+        pair_ns = 0.0
 
     model: dict[str, float | None] = {}
     if exchange == "owner":

@@ -128,6 +128,12 @@ BIGSCALE_METRIC = re.compile(
 # contradict the machine rate it was derived from
 BATCH_METRIC = re.compile(
     r"^(ksssp|ppr)_b(\d+)_rmat(\d+)_gteps_per_chip$")
+# paged-vs-flat A/B lines (bench.py -config gather-ab, round 15,
+# ops/pagegather.py): the metric name carries the delivery mode, the
+# line carries gather + the plan's measured page stats — the ratio
+# the break-even claim rests on must be on the record, both sides
+GATHER_AB_METRIC = re.compile(
+    r"^pagerank_(paged|flat)_rmat(\d+)_gteps_per_chip$")
 
 
 def iter_metric_lines(path: str):
@@ -272,6 +278,10 @@ def check_line(obj: dict, *, legacy_ok: bool):
     if m or "batch" in obj:
         errs += check_batch_fields(name, obj,
                                    int(m.group(2)) if m else None)
+    m = GATHER_AB_METRIC.match(name)
+    if m or "gather" in obj:
+        errs += check_gather_fields(name, obj,
+                                    m.group(1) if m else None)
     return errs, warns
 
 
@@ -383,6 +393,39 @@ def check_batch_fields(name: str, obj: dict,
             errs.append(
                 f"{name}: per_query_edge_ns={pq!r} contradicts "
                 f"1/query_gteps ({1.0 / qg:.4f})")
+    return errs
+
+
+def check_gather_fields(name: str, obj: dict,
+                        name_mode: str | None) -> list[str]:
+    """Gather A/B lines (bench.py -config gather-ab, round 15): the
+    ``gather`` mode must be paged|flat and match the metric name, and
+    BOTH sides must record the plan's measured page stats —
+    ``page_ratio`` (unique page elements per edge, finite > 0) and
+    ``page_fill`` (live lanes per PADDED delivery row, (0, 128] —
+    the exact padded_fill gather="auto" and the phase model consume,
+    not the live-rows-only figure): the modeled break-even
+    (scalemodel.page_gather_ns) is resolved FROM these numbers, so a
+    published A/B without them cannot be audited."""
+    errs = []
+    mode = obj.get("gather")
+    if mode not in ("paged", "flat"):
+        errs.append(f"{name}: gather={mode!r} must be 'paged' or "
+                    f"'flat'")
+        return errs
+    if name_mode is not None and mode != name_mode:
+        errs.append(f"{name}: gather={mode!r} contradicts the metric "
+                    f"name's _{name_mode}_")
+    pr = obj.get("page_ratio")
+    if not _is_num(pr) or pr <= 0:
+        errs.append(f"{name}: page_ratio={pr!r} must be a finite "
+                    f"number > 0 (the plan's measured unique-page "
+                    f"ratio, the break-even model's input)")
+    pf = obj.get("page_fill")
+    if not _is_num(pf) or not 0.0 < pf <= 128.0:
+        errs.append(f"{name}: page_fill={pf!r} must be a finite "
+                    f"number in (0, 128] (live lanes per padded "
+                    f"128-lane delivery row)")
     return errs
 
 

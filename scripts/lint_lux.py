@@ -32,6 +32,14 @@ be seen from a jaxpr (CLAUDE.md "Conventions"):
                 mirroring the app-module oracle-presence check, so a
                 new per-part counter variant cannot ship without its
                 sum-over-parts-bitwise proof.
+  bench-fence   (scripts/ only) No ``block_until_ready`` fencing in
+                benchmark scripts: it can return early through the
+                axon tunnel AND lets XLA hoist loop-invariant work,
+                the two measurement traps PERF_NOTES documents — the
+                trusted recipe is ``lux_tpu.timing.loop_bench``
+                (loop-dependent carry, scalar output, one jit, fetch
+                fence), which rounds 12/15 ported every profile
+                script onto.
 
 Suppression: an explicit ``# audit: allow(<check>)`` pragma on the
 flagged line, or in the contiguous comment block directly above it,
@@ -447,6 +455,37 @@ def check_part_stats_oracle(path, tree, lines):
 
 
 # ---------------------------------------------------------------------
+# check: no block_until_ready fencing in benchmark scripts
+
+
+def check_bench_fence(path, tree, lines):
+    """scripts/ may not fence timed regions with block_until_ready
+    (see module docstring): flag any call or attribute reference."""
+    findings = []
+    for node in ast.walk(tree):
+        name = None
+        if isinstance(node, ast.Attribute) \
+                and node.attr == "block_until_ready":
+            name = node.attr
+        elif isinstance(node, ast.Name) \
+                and node.id == "block_until_ready":
+            name = node.id
+        if name is None:
+            continue
+        line = getattr(node, "lineno", 1)
+        if _suppressed(lines, line, "bench-fence"):
+            continue
+        findings.append(Finding(
+            path, line, "bench-fence",
+            "block_until_ready fencing in a benchmark script — it "
+            "returns early through the tunnel and lets XLA hoist "
+            "loop-invariant work (PERF_NOTES traps); use "
+            "lux_tpu.timing.loop_bench (loop-dependent carry, "
+            "scalar output, one jit, fetch fence)"))
+    return findings
+
+
+# ---------------------------------------------------------------------
 # driver
 
 
@@ -459,8 +498,13 @@ def lint_file(path: str):
     except SyntaxError as e:
         return [Finding(path, e.lineno or 1, "parse",
                         f"syntax error: {e.msg}")]
-    findings = check_jit_closures(path, tree, lines)
     norm = path.replace(os.sep, "/")
+    if "/scripts/" in norm:
+        # benchmark scripts get ONLY the fencing gate — they are
+        # exploratory by design and exempt from the library-tree
+        # conventions (jit closures, oracles, citations)
+        return check_bench_fence(path, tree, lines)
+    findings = check_jit_closures(path, tree, lines)
     if "/lux_tpu/apps/" in norm:
         findings += check_oracle(path, tree, lines)
     if "/lux_tpu/engine/" in norm or "/lux_tpu/ops/" in norm:
@@ -493,9 +537,11 @@ def lint_paths(paths):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="AST convention linter (jit closures, app "
-                    "oracles, reference citations)")
+                    "oracles, reference citations, script bench "
+                    "fencing)")
     ap.add_argument("paths", nargs="*",
-                    default=[os.path.join(REPO, "lux_tpu")])
+                    default=[os.path.join(REPO, "lux_tpu"),
+                             os.path.join(REPO, "scripts")])
     ap.add_argument("-q", action="store_true", dest="quiet")
     args = ap.parse_args(argv)
 

@@ -25,16 +25,22 @@ gargs = eng.graph_args
 print(f"nv={sg.nv} ne={sg.ne} vpad={sg.vpad} C={lay.n_chunks} E={lay.E}")
 
 
-def timeit(name, fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    dt = (time.perf_counter() - t0) / REPS
+def timeit(name, fn, x0, *rest):
+    """Round 15: observatory recipe (lux_tpu.timing.loop_bench) —
+    loop-dependent x carry, scalar output, one jit; block_until_ready
+    fencing is grep-gated out of scripts/ (lint_lux bench-fence)."""
+    from lux_tpu.observe import median_mad
+    from lux_tpu.timing import loop_bench
+
+    def step(c):
+        x, extra = c
+        out = fn(x, *extra)
+        sv = jnp.sum(jax.tree.leaves(out)[0].ravel()[:1]).astype(
+            jnp.float32)
+        return sv, (x + (sv * 1e-30).astype(x.dtype), extra)
+
+    samples, _ = loop_bench(step, (x0, tuple(rest)), REPS, repeats=3)
+    dt, _mad = median_mad(samples)
     print(f"{name:46s} {dt * 1e3:8.2f} ms")
     return dt
 

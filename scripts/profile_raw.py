@@ -1,27 +1,35 @@
-"""Separate compute speed from data-movement speed on the axon TPU."""
+"""Separate compute speed from data-movement speed on the axon TPU.
+
+Round 15: ported onto the observatory recipe (lux_tpu.timing
+.loop_bench — loop-dependent carry, scalar output, one jit, fetch
+fence); the old block_until_ready pattern is the PERF_NOTES trap and
+is now grep-gated out of scripts/ (lint_lux bench-fence).
+"""
 
 from __future__ import annotations
-
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lux_tpu.observe import median_mad
+from lux_tpu.timing import loop_bench
+
 REPS = 5
 rng = np.random.default_rng(0)
 
 
-def timeit(name, fn, *args, work=0, bytes_=0):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    dt = (time.perf_counter() - t0) / REPS
+def timeit(name, fn, x0, work=0, bytes_=0):
+    """fn(x) -> array; the x carry is loop-dependent so XLA cannot
+    hoist the op out of the timed loop."""
+    def step(c):
+        (x,) = c
+        out = fn(x)
+        sv = jnp.sum(out.ravel()[:1]).astype(jnp.float32)
+        return sv, (x + (sv * 1e-30).astype(x.dtype),)
+
+    samples, _ = loop_bench(step, (x0,), REPS, repeats=3)
+    dt, _mad = median_mad(samples)
     extra = []
     if work:
         extra.append(f"{work / dt / 1e12:7.2f} TFLOP/s")

@@ -3,12 +3,16 @@
 a) axis=1: per-row 128-lane shuffle on [R, 128]
 b) axis=0: per-lane sublane gather on [M, 128] for varying M
 c) transpose cost for comparison
+
+Round 15: ported onto the observatory recipe (lux_tpu.timing
+.loop_bench — loop-dependent carry, scalar output, one jit, fetch
+fence); the old block_until_ready pattern is the PERF_NOTES trap and
+is now grep-gated out of scripts/ (lint_lux bench-fence).
 """
 
 from __future__ import annotations
 
 import functools
-import time
 
 import jax
 import jax.numpy as jnp
@@ -16,22 +20,26 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from lux_tpu.observe import median_mad
+from lux_tpu.timing import loop_bench
+
 REPS = 10
 rng = np.random.default_rng(0)
 
 
-def timeit(name, fn, *args, n_elems=None):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    dt = (time.perf_counter() - t0) / REPS
+def timeit(name, fn, x0, idx0, n_elems=None):
+    """fn(x, idx) -> array; timed with a loop-dependent x carry so
+    the kernel can neither hoist nor dead-code."""
+    def step(c):
+        x, i = c
+        out = fn(x, i)
+        sv = jnp.sum(out[..., :1])
+        return sv, (x + sv * 1e-30, i)
+
+    samples, _ = loop_bench(step, (x0, idx0), REPS, repeats=3)
+    dt, mad = median_mad(samples)
     r = f"  ({n_elems / dt / 1e9:7.2f} G/s)" if n_elems else ""
-    print(f"{name:44s} {dt * 1e3:8.2f} ms{r}")
+    print(f"{name:44s} {dt * 1e3:8.2f} ms{r}  mad {mad * 1e3:.2f} ms")
     return dt
 
 
@@ -94,5 +102,5 @@ for M in (8, 64, 512, 4096):
 
 # ---- c) transpose -------------------------------------------------------
 xt = jnp.asarray(rng.random((16384, 2048), np.float32))
-timeit("xla transpose [16384,2048]", jax.jit(lambda a: a.T.copy()), xt,
-       n_elems=16384 * 2048)
+timeit("xla transpose [16384,2048]", lambda a, _i: a.T.copy(), xt,
+       jnp.zeros((1,), jnp.int32), n_elems=16384 * 2048)

@@ -5,36 +5,44 @@ Times, for one PageRank iteration at the bench shape (rmat scale S):
   - src gather alone (jnp.take of flat state by src_slot)
   - pallas chunk partial reduce alone
   - combine_chunks alone
+
+Round 15: ported onto the observatory recipe (lux_tpu.timing
+.loop_bench — loop-dependent carry, scalar output, one jit, fetch
+fence); the old block_until_ready pattern is the PERF_NOTES trap and
+is now grep-gated out of scripts/ (lint_lux bench-fence).
 """
 
 from __future__ import annotations
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from lux_tpu.apps import pagerank
 from lux_tpu.convert import rmat_edges
 from lux_tpu.graph import Graph
+from lux_tpu.observe import median_mad
+from lux_tpu.timing import loop_bench
 
 SCALE = int(sys.argv[1]) if len(sys.argv) > 1 else 21
 EF = 16
 REPS = 10
 
 
-def timeit(name, fn, *args):
-    out = fn(*args)  # compile
-    jax.block_until_ready(out)
-    _ = np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    _ = np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[:1]
-    dt = (time.perf_counter() - t0) / REPS
+def timeit(name, fn, x0, *rest):
+    """fn(x, *rest) -> array; x rides a loop-dependent carry, the
+    other operands stay constant in the carry (jit arguments, not
+    baked constants)."""
+    def step(c):
+        x, extra = c
+        out = fn(x, *extra)
+        sv = jnp.sum(jax.tree.leaves(out)[0].ravel()[:1]).astype(
+            jnp.float32)
+        return sv, (x + (sv * 1e-30).astype(x.dtype), extra)
+
+    samples, _ = loop_bench(step, (x0, tuple(rest)), REPS, repeats=3)
+    dt, _mad = median_mad(samples)
     print(f"{name:32s} {dt * 1e3:9.2f} ms")
     return dt
 
@@ -48,30 +56,31 @@ def main():
     state = eng.init_state()
     gd = eng.arrays
 
-    step = jax.jit(eng._step_core)
-    dt = timeit("full step", step, state, *eng.graph_args)
+    dt = timeit("full step", eng._step_core, state, *eng.graph_args)
     print(f"  -> {g.ne / dt / 1e9:.3f} GTEPS")
 
     flat = state.reshape((-1,) + state.shape[2:])
     src_slot = gd["src_slot"][0]
-    gather = jax.jit(lambda f, s: jnp.take(f, s, axis=0))
+
+    def gather(f, s):
+        return jnp.take(f, s, axis=0)
+
     timeit("src gather (take)", gather, flat, src_slot)
 
     vals = gather(flat, src_slot)
-    jax.block_until_ready(vals)
     rel = gd["rel_dst"][0]
 
     from lux_tpu.ops.pallas_reduce import chunk_partials_pallas
-    pr = jax.jit(lambda v, r: chunk_partials_pallas(v, r, lay.W, "sum"))
-    timeit("pallas chunk partials", pr, vals, rel)
+    timeit("pallas chunk partials",
+           lambda v, r: chunk_partials_pallas(v, r, lay.W, "sum"),
+           vals, rel)
 
-    partials = pr(vals, rel)
-    jax.block_until_ready(partials)
+    partials = chunk_partials_pallas(vals, rel, lay.W, "sum")
 
     from lux_tpu.ops.tiled import combine_chunks
-    cc = jax.jit(lambda p, s, l: combine_chunks(p, lay, s, l, "sum"))
-    timeit("combine_chunks", cc, partials, gd["chunk_start"][0],
-           gd["last_chunk"][0])
+    timeit("combine_chunks",
+           lambda p, s, l: combine_chunks(p, lay, s, l, "sum"),
+           partials, gd["chunk_start"][0], gd["last_chunk"][0])
 
     # gather variants
     timeit("gather bf16", gather, flat.astype(jnp.bfloat16), src_slot)

@@ -290,15 +290,22 @@ def test_collect_debts(tmp_path, monkeypatch):
     """Matched debts with an implemented probe are collected into the
     ledger; manual ones are skipped with their PERF_NOTES pointer."""
     monkeypatch.setattr(observe, "PROBE_DOT_ROWS", 8)
+    monkeypatch.setattr(observe, "PROBE_PAGE_ROWS", 16)
+    monkeypatch.setattr(observe, "PROBE_PAGE_TABLE", 8)
     monkeypatch.setattr(observe, "PROBE_LOOP_K", 2)
     path = str(tmp_path / "led.jsonl")
     fp = synthetic_fp(platform="tpu", ndev=4)
     collected, skipped = observe.collect_debts(
         fp, observe.PerfLedger(path))
-    assert [c["debt"] for c in collected] == ["pair-dot-row-k-sweep"]
+    assert [c["debt"] for c in collected] == ["pair-dot-row-k-sweep",
+                                              "paged-gather-ab"]
     sweep = collected[0]["sweep"]
     assert set(sweep) == {"1", "4", "8", "16", "20", "32"}
     assert all(v["row_ns"] >= 0 for v in sweep.values())
+    ab = collected[1]
+    assert ab["flat_ns_per_edge"] > 0 and ab["paged_ns_per_edge"] > 0
+    assert ab["speedup"] == pytest.approx(
+        ab["flat_ns_per_edge"] / ab["paged_ns_per_edge"], rel=1e-2)
     assert observe.validate_ledger(path) == []
     skipped_ids = {i for i, _r in skipped}
     assert "netflix-pair-run" in skipped_ids
