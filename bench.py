@@ -231,31 +231,63 @@ def run_config(config, args):
     from lux_tpu.graph import pair_relabel
 
     if config.startswith("gather-ab"):
-        # paged-vs-flat A/B: "gather-ab@paged" / "gather-ab@flat"
-        # name one side each; both sides run the SAME degree-sorted
-        # graph and carry the same plan stats, so the pair is
-        # directly comparable
+        # paged-vs-flat A/B: "gather-ab@paged[:reorder]" names one
+        # side + preprocessing each; all sides run the SAME base
+        # graph, so the pairs are directly comparable.  The reorder
+        # token (round 16, lux_tpu/reorder.py) swaps the degree sort
+        # for the page-aware pass and records it in the line's
+        # ``reorder`` field (scripts/check_bench.py validates
+        # mode-vs-name AND fill-not-decreased vs the paired none
+        # line).
         from lux_tpu.apps import pagerank
         from lux_tpu.graph import ShardedGraph, degree_relabel
         from lux_tpu.ops.pagegather import plan_paged_stats
 
-        _, _, mode = config.partition("@")
-        mode = mode or "paged"
+        _, _, spec = config.partition("@")
+        mode, _, reorder = (spec or "paged").partition(":")
+        reorder = reorder or "none"
         scale = args.scale or DEFAULT_SHAPE["gather-ab"][0]
         ef = args.ef or DEFAULT_SHAPE["gather-ab"][1]
-        g = build_graph(scale, ef, args.verbose)
-        # degree sort concentrates hubs into shared pages — the page
-        # locality the paged plan bins for (same preprocessing both
-        # sides, so the A/B isolates the delivery swap)
-        g2, _perm = degree_relabel(g)
+        shape = getattr(args, "shape", "rmat")
+        if shape == "community":
+            from lux_tpu.convert import community_graph
+            t0 = time.perf_counter()
+            g = community_graph(scale=scale, edge_factor=ef)
+            if args.verbose:
+                print(f"# community graph built: nv={g.nv} ne={g.ne}"
+                      f" ({time.perf_counter() - t0:.1f}s)",
+                      file=sys.stderr)
+        else:
+            g = build_graph(scale, ef, args.verbose)
+        if reorder == "none":
+            # degree sort concentrates hubs into shared pages — the
+            # round-15 baseline preprocessing, kept for the paired
+            # none lines so reorder gains are measured against it
+            g2, _perm = degree_relabel(g)
+        else:
+            from lux_tpu.reorder import page_reorder
+            g2, _perm, rep = page_reorder(g, method=reorder,
+                                          num_parts=args.np,
+                                          verbose=args.verbose)
+            if args.verbose:
+                print(f"# reorder {reorder}: padded_fill "
+                      f"{rep['baseline_fill']} -> "
+                      f"{rep['chosen_fill']}", file=sys.stderr)
         sg = ShardedGraph.build(g2, args.np, vpad_align=128)
         eng = pagerank.build_engine(g2, num_parts=args.np, sg=sg,
                                     gather=mode, health=args.health)
-        stats = (eng.page_plan.stats if eng.page_plan is not None
-                 else plan_paged_stats(sg))
+        # the recorded page stats come from the SAME counting pass
+        # for every side (dense paged shape) — the exact objective
+        # the reorder pass maximizes — so paired lines compare one
+        # quantity regardless of delivery mode or the engine's
+        # resolved exchange (a pagemajor plan's virtual fill or an
+        # owner-shaped fill would break check_bench's
+        # fill-not-decreased pairing rule on a correct run)
+        stats = plan_paged_stats(sg)
         extra = {"np": args.np, "scale": scale, "ef": ef,
                  "relabel": True, "pair_threshold": None,
                  "gather": mode, "exchange": eng.exchange,
+                 "reorder": reorder, "shape": shape,
                  "page_ratio": round(float(stats["page_ratio"]), 4),
                  # the PADDED fill — live lanes per padded row, the
                  # exact input gather="auto" and the phase model
@@ -265,7 +297,9 @@ def run_config(config, args):
         samples, rerun = bench_fused(eng, g.ne, args.ni, args.verbose,
                                      args.repeats)
         extra["ne"] = int(g.ne)
-        return (f"pagerank_{mode}_rmat{scale}",
+        tag = "comm" if shape == "community" else "rmat"
+        rtok = "" if reorder == "none" else f"{reorder}_"
+        return (f"pagerank_{mode}_{rtok}{tag}{scale}",
                 [s / 1e9 for s in samples], extra,
                 lambda: rerun() / 1e9)
 
@@ -555,6 +589,26 @@ def main() -> int:
     ap.add_argument("-all", action="store_true",
                     help="run every config (pagerank last; the "
                          "default when -config is not given)")
+    ap.add_argument("-reorder", default="none",
+                    choices=["none", "native", "hillclimb"],
+                    help="page-aware vertex reorder for the "
+                         "gather-ab config (lux_tpu/reorder.py): "
+                         "'native' = the clustering BFS pass "
+                         "(native/reorder.cc), 'hillclimb' = "
+                         "candidates + dominant-tile refinement "
+                         "scored against the plan's measured "
+                         "page_fill.  Non-none expands gather-ab to "
+                         "FOUR lines (reordered pair + its paired "
+                         "none baseline) so scripts/check_bench.py "
+                         "can enforce fill-must-not-decrease")
+    ap.add_argument("-shape", default="rmat",
+                    choices=["rmat", "community"],
+                    help="gather-ab graph family: 'rmat' (the bench "
+                         "default — honest negative: little page "
+                         "locality to harvest) or 'community' (the "
+                         "scrambled planted-partition synthetic, "
+                         "convert.community_edges — the locality-"
+                         "rich case the reorder pass recovers)")
     ap.add_argument("-scale", type=int, default=0,
                     help="RMAT scale (nv = 2**scale; 0 = per-config "
                          "default)")
@@ -683,8 +737,14 @@ def main() -> int:
             expanded += [f"{c}@{b}" for b in batch_widths]
         elif c == "gather-ab":
             # one line per side, paged first (the headline of the
-            # A/B); both carry the plan's page stats
+            # A/B); both carry the plan's page stats.  A reorder run
+            # ALSO emits the none-reorder pair, so every reordered
+            # line has its paired baseline in the same artifact
+            # (check_bench enforces fill-must-not-decrease on pairs)
             expanded += ["gather-ab@paged", "gather-ab@flat"]
+            if args.reorder != "none":
+                expanded += [f"gather-ab@paged:{args.reorder}",
+                             f"gather-ab@flat:{args.reorder}"]
         else:
             expanded.append(c)
     configs = expanded

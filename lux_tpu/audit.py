@@ -800,6 +800,11 @@ def engine_spec(engine, state_aval) -> ProgramSpec:
     table_shape = (sg.num_parts * sg.vpad,) + trail
     owner = engine.exchange == "owner"
     paged = getattr(engine, "page_plan", None) is not None
+    # page-major owner (round 16): the generation scan still gathers
+    # page-reshaped shards, but the exchange is the ROUTING hop — one
+    # all_to_all of complete message rows for EVERY reduce kind, and
+    # never a psum_scatter (there are no pre-reduced partials to sum)
+    pagemajor = paged and engine.page_plan.mode == "pagemajor"
     ndev = 1 if engine.mesh is None else engine.mesh.devices.size
     # the owner generation scan runs per DEVICE (inside shard_map on
     # a mesh): its length is the device-local source-part count
@@ -833,13 +838,18 @@ def engine_spec(engine, state_aval) -> ProgramSpec:
         require_scan_len=rows if owner else None,
         require_scan_shard_shape=shard_shape if owner else None,
         ppermute_hops=(ndev - 1) if (owner and on_mesh and fused
+                                     and not pagemajor
                                      and reduce_kind in ("min", "max"))
         else None,
-        ring_size=ndev if (owner and on_mesh and fused) else None,
-        expect_reduce_scatter=(owner and on_mesh
+        ring_size=ndev if (owner and on_mesh and fused
+                           and not pagemajor) else None,
+        expect_reduce_scatter=(owner and on_mesh and not pagemajor
                                and reduce_kind == "sum"),
-        expect_all_to_all=(owner and on_mesh and not fused
-                           and reduce_kind in ("min", "max")),
+        expect_all_to_all=(owner and on_mesh
+                           and (pagemajor
+                                or (not fused
+                                    and reduce_kind in ("min",
+                                                        "max")))),
     )
 
 
@@ -1075,6 +1085,27 @@ def run_repo_audit(verbose: bool = False, ledger: bool = True):
                     lambda: pagerank.build_engine(g, num_parts=2,
                                                   sources=[0, 3, 7],
                                                   gather="paged"),
+                    False))
+    # page-major layout (round 16, ops/pagegather.py): the full-fill
+    # gather rows + virtual-row takes must hold the same one-access
+    # budget (the virtual take's operand is the [Rg, 128] value
+    # buffer, shape-distinct from the table by _pad8_distinct); the
+    # OWNER page-major routing must keep the generation scan AND
+    # lower its exchange through all_to_all — for sum too (engine_
+    # spec: no psum_scatter, there are no pre-reduced partials)
+    configs.append(("pagerank_np2_pagemajor",
+                    lambda: pagerank.build_engine(
+                        g, num_parts=2, gather="pagemajor"),
+                    False))
+    configs.append(("cc_np2_pagemajor",
+                    lambda: components.build_engine(
+                        g, num_parts=2, enable_sparse=False,
+                        gather="pagemajor"),
+                    False))
+    configs.append(("pagerank_np4_owner_pagemajor",
+                    lambda: pagerank.build_engine(
+                        g, num_parts=4, exchange="owner",
+                        gather="pagemajor"),
                     False))
     # query-batched engines (ROADMAP item 2): the gather budget must
     # hold at B > 1 — ONE [P*vpad, B] table gather per dense pull/push

@@ -131,15 +131,20 @@ def _common(ap: argparse.ArgumentParser):
                          "colfilter's dot path has its own dst-free "
                          "machinery and ignores this")
     ap.add_argument("-gather", default="flat",
-                    choices=["flat", "paged", "auto"],
+                    choices=["flat", "paged", "pagemajor", "auto"],
                     help="state-table delivery for dense iterations: "
                          "'paged' replaces the ~9 ns/edge per-edge "
                          "gather with the page-binned row fetch + "
                          "Pallas lane shuffle (ops/pagegather.py); "
-                         "'auto' resolves by the scalemodel "
+                         "'pagemajor' binds delivery rows to source "
+                         "pages first (full 128-lane rows) and "
+                         "routes completed rows to their destination "
+                         "tiles second (owner engines: an all_to_all "
+                         "routing hop); 'auto' arbitrates flat vs "
+                         "paged vs page-major by the scalemodel "
                          "break-even on the plan's measured "
-                         "unique-page ratio (best after a degree "
-                         "relabel, which concentrates hot pages).  "
+                         "unique-page ratio / fills (best after a "
+                         "page-aware reorder, lux_tpu/reorder.py).  "
                          "Mutually exclusive with -pair (both are "
                          "row-granular delivery layouts)")
     ap.add_argument("-min-fill", type=_min_fill_arg, default=None,

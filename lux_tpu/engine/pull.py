@@ -213,11 +213,12 @@ class PullEngine(AuditableEngine):
         self.page_plan = None
         self.gather = "flat"
         if gather != "flat":
-            if gather == "paged" and pair_threshold is not None:
+            if gather in ("paged", "pagemajor") \
+                    and pair_threshold is not None:
                 raise ValueError(
-                    "gather='paged' subsumes pair delivery (both are "
-                    "row-granular layouts); build without "
-                    "pair_threshold")
+                    f"gather={gather!r} subsumes pair delivery (both "
+                    f"are row-granular layouts); build without "
+                    f"pair_threshold")
             if pair_threshold is None:
                 self._setup_paged(sg, gather, program, exchange)
         if pair_threshold is not None:
@@ -382,7 +383,7 @@ class PullEngine(AuditableEngine):
 
         self.page_plan = engine_page_plan(sg, gather, program, exchange)
         if self.page_plan is not None:
-            self.gather = "paged"
+            self.gather = self.page_plan.mode
 
     def _paged_arrays(self, dev, program):
         """The paged plan's graph arrays
@@ -396,7 +397,10 @@ class PullEngine(AuditableEngine):
 
     def _paged_red(self, flat_state, g):
         """Paged delivery + reduce for one part -> [vpad, ...] (total
-        coverage: the plan serves EVERY edge, no residual)."""
+        coverage: the plan serves EVERY edge, no residual).  The
+        page-major plan rides the same call: ``pg_vrs`` binds each
+        virtual reduce row to its full-fill gather row
+        (ops/pagegather.PagedPlan mode="pagemajor")."""
         from lux_tpu.ops.pagegather import paged_partial
 
         prog = self.program
@@ -404,7 +408,8 @@ class PullEngine(AuditableEngine):
             self.page_plan, flat_state, g["pg_ids"], g["pg_sl"],
             g["pg_rel"], g.get("pg_w"), g["pg_tp"], prog.reduce,
             lambda vals, w: prog.edge_value(vals, None, w),
-            reduce_method=self.reduce_method)
+            reduce_method=self.reduce_method,
+            vrow_src=g.get("pg_vrs"))
         return red[:self.sg.vpad]
 
     def _paged_dot_red(self, flat_state, g):
@@ -748,6 +753,24 @@ class PullEngine(AuditableEngine):
         (single device: all parts; under shard_map: this device's)."""
         sg = self.sg
         with jax.named_scope("lux_gen_exchange"):
+            if (self.page_plan is not None
+                    and self.page_plan.mode == "pagemajor"):
+                # page-major routing: full message rows all_to_all to
+                # their destination parts, reduced receiver-side — no
+                # per-tile partials, no separate owner exchange
+                # (ops/pagegather.pagemajor_owner_deliver)
+                from lux_tpu.ops.pagegather import \
+                    pagemajor_owner_deliver
+                prog = self.program
+                red = pagemajor_owner_deliver(
+                    self.page_plan, state, g, prog.reduce,
+                    lambda vals, wt: prog.edge_value(vals, None, wt),
+                    self._msg_dtype(state), sg.num_parts,
+                    self.reduce_method,
+                    axis=None if self.mesh is None else PARTS_AXIS,
+                    varying_axis=(None if self.mesh is None
+                                  else PARTS_AXIS))[:, :sg.vpad]
+                return self._owner_apply(state, red, None, g)
             acc = self._owner_contribs(state, g)
             red = self._owner_exchange(acc)[:, :sg.vpad]
         flat = None

@@ -183,17 +183,18 @@ class PushEngine(AuditableEngine):
         self.page_plan = None
         self.gather = "flat"
         if gather != "flat":
-            if gather == "paged" and pair_threshold is not None:
+            if gather in ("paged", "pagemajor") \
+                    and pair_threshold is not None:
                 raise ValueError(
-                    "gather='paged' subsumes pair delivery (both are "
-                    "row-granular layouts); build without "
-                    "pair_threshold")
+                    f"gather={gather!r} subsumes pair delivery (both "
+                    f"are row-granular layouts); build without "
+                    f"pair_threshold")
             if pair_threshold is None:
                 from lux_tpu.ops.pagegather import engine_page_plan
                 self.page_plan = engine_page_plan(sg, gather, program,
                                                   exchange)
                 if self.page_plan is not None:
-                    self.gather = "paged"
+                    self.gather = self.page_plan.mode
         # Pair-lane delivery for the DENSE iterations (ops/pairs.py):
         # dense pair edges leave the per-edge gather path; the SPARSE
         # path below keeps the FULL graph's src-sorted view — frontier
@@ -375,12 +376,14 @@ class PushEngine(AuditableEngine):
         if self.page_plan is not None:
             # paged two-level delivery (ops/pagegather.py): the page
             # fetch + lane shuffle + compare-reduce replace both the
-            # masked-label gather and the tiled reduce
+            # masked-label gather and the tiled reduce (pg_vrs: the
+            # page-major plan's virtual-row binding)
             from lux_tpu.ops.pagegather import paged_partial
             return paged_partial(
                 self.page_plan, flat_l, g["pg_ids"], g["pg_sl"],
                 g["pg_rel"], g.get("pg_w"), g["pg_tp"], prog.reduce,
-                msg, reduce_method=self.reduce_method)[:sg.vpad]
+                msg, reduce_method=self.reduce_method,
+                vrow_src=g.get("pg_vrs"))[:sg.vpad]
         if cand is None:
             from lux_tpu.ops.tiled import (combine_partials,
                                            streamed_chunk_partials)
@@ -433,7 +436,7 @@ class PushEngine(AuditableEngine):
                    "chunk_start", "last_chunk", "chunk_tile", "nvp",
                    "deg", "pair_rowbind", "pair_rel", "pair_weight",
                    "pair_tile_pos", "pg_ids", "pg_sl", "pg_rel",
-                   "pg_w", "pg_tp")
+                   "pg_w", "pg_tp", "pg_vrs")
 
     @property
     def _streams(self) -> bool:
@@ -490,25 +493,41 @@ class PushEngine(AuditableEngine):
         msg_dtype = jax.eval_shape(
             msg, jax.ShapeDtypeStruct((1, 1), label.dtype),
             (jax.ShapeDtypeStruct((1, 1), jnp.float32)
-             if ("own_w" in g or "own_pg_w" in g) else None)).dtype
+             if ("own_w" in g or "own_pg_w" in g or "own_pm_w" in g)
+             else None)).dtype
         with jax.named_scope("lux_gen_exchange"):
-            if self.page_plan is not None:
-                from lux_tpu.ops.pagegather import paged_owner_contribs
-                acc = paged_owner_contribs(
+            if (self.page_plan is not None
+                    and self.page_plan.mode == "pagemajor"):
+                # page-major routing: complete message rows all_to_all
+                # to their destination parts, reduced receiver-side
+                # (ops/pagegather.pagemajor_owner_deliver) — the
+                # routing hop REPLACES the owner exchange
+                from lux_tpu.ops.pagegather import \
+                    pagemajor_owner_deliver
+                red = pagemajor_owner_deliver(
                     self.page_plan, masked, g, prog.reduce, msg,
                     msg_dtype, sg.num_parts, self.reduce_method,
+                    axis=PARTS_AXIS if on_mesh else None,
                     varying_axis=PARTS_AXIS if on_mesh else None)
             else:
-                acc = owner_contribs(
-                    self.owner, masked, g,
-                    prog.reduce, msg, msg_dtype, sg.num_parts,
-                    self.reduce_method,
-                    varying_axis=PARTS_AXIS if on_mesh else None)
-            red = owner_exchange(
-                acc, prog.reduce,
-                axis=PARTS_AXIS if on_mesh else None,
-                ndev=1 if not on_mesh else self.mesh.devices.size,
-                minmax_fused=self.owner_minmax_fused)
+                if self.page_plan is not None:
+                    from lux_tpu.ops.pagegather import \
+                        paged_owner_contribs
+                    acc = paged_owner_contribs(
+                        self.page_plan, masked, g, prog.reduce, msg,
+                        msg_dtype, sg.num_parts, self.reduce_method,
+                        varying_axis=PARTS_AXIS if on_mesh else None)
+                else:
+                    acc = owner_contribs(
+                        self.owner, masked, g,
+                        prog.reduce, msg, msg_dtype, sg.num_parts,
+                        self.reduce_method,
+                        varying_axis=PARTS_AXIS if on_mesh else None)
+                red = owner_exchange(
+                    acc, prog.reduce,
+                    axis=PARTS_AXIS if on_mesh else None,
+                    ndev=1 if not on_mesh else self.mesh.devices.size,
+                    minmax_fused=self.owner_minmax_fused)
         red = red[:, :sg.vpad]
         if self.pairs is not None:
             # pair rows fetch from the FULL masked table (row-granular

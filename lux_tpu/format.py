@@ -54,7 +54,7 @@ class GraphFormatError(ValueError):
     header, section_size, weighted_mismatch, ambiguous_layout,
     row_ptrs_monotone, row_ptrs_total, col_idx_range,
     degrees_length, degrees_consistent, partition_starts,
-    partition_edges)."""
+    partition_edges, perm_header, perm_length, perm_bijection)."""
 
     def __init__(self, path: str, check: str, detail: str):
         super().__init__(f"{path}: invalid graph [{check}] — {detail}")
@@ -236,6 +236,99 @@ def read_lux(path: str, weighted: bool | None = None, weight_dtype=np.int32,
         validate_graph(hdr.nv, hdr.ne, row_ptrs, col_idx,
                        degrees=degrees, path=path)
     return hdr, row_ptrs, col_idx, weights, degrees
+
+
+# ---------------------------------------------------------------------
+# permutation sidecar (round 16, page-aware reordering)
+#
+# The page-aware reorderer (lux_tpu/reorder.py, native/reorder.cc)
+# persists its vertex permutation BESIDE the .lux file rather than
+# rewriting multi-GB edge sections: ``<file>.lux.perm`` holds a tiny
+# header (magic "LUXP" + uint32 nv) and uint32[nv] ``perm`` with
+# perm[new] = old.  ``Graph.from_file(reorder=...)`` applies it at
+# load; scripts/fsck_lux.py validates sidecars at rest.  Validation
+# is the same crash-don't-corrupt conversion as validate_graph: a
+# truncated or non-bijective sidecar raises a typed GraphFormatError
+# instead of silently relabeling into a wrong-answer run.
+
+PERM_MAGIC = b"LUXP"
+PERM_SUFFIX = ".perm"
+
+
+def perm_sidecar_path(lux_path: str) -> str:
+    return lux_path + PERM_SUFFIX
+
+
+def validate_perm(perm, nv: int, path: str = "<perm>") -> None:
+    """The sidecar's structural invariants: length nv and a BIJECTION
+    of [0, nv) — each violation a typed :class:`GraphFormatError`
+    (checks ``perm_length`` / ``perm_bijection``)."""
+    perm = np.asarray(perm)
+    if perm.ndim != 1 or perm.shape[0] != nv:
+        raise GraphFormatError(
+            path, "perm_length",
+            f"{perm.shape} permutation for nv={nv}")
+    if nv:
+        p64 = perm.astype(np.int64, copy=False)
+        seen = np.zeros(nv, bool)
+        bad = (p64 < 0) | (p64 >= nv)
+        if bad.any():
+            at = int(np.argmax(bad))
+            raise GraphFormatError(
+                path, "perm_bijection",
+                f"perm[{at}]={int(p64[at])} outside [0, {nv})")
+        seen[p64] = True
+        if not seen.all():
+            at = int(np.argmax(~seen))
+            raise GraphFormatError(
+                path, "perm_bijection",
+                f"vertex {at} never appears (duplicate entries "
+                f"elsewhere) — not a bijection of [0, {nv})")
+
+
+def write_perm_sidecar(lux_path: str, perm,
+                       path: str | None = None) -> str:
+    """Write ``perm`` (perm[new] = old) beside ``lux_path``; the
+    permutation is validated against its own length before writing
+    (a corrupt sidecar must never be produced, only detected)."""
+    perm = np.ascontiguousarray(perm, dtype=V_DTYPE)
+    out = path or perm_sidecar_path(lux_path)
+    validate_perm(perm, perm.shape[0], out)
+    with open(out, "wb") as f:
+        f.write(PERM_MAGIC)
+        f.write(np.array([perm.shape[0]], V_DTYPE).tobytes())
+        f.write(perm.tobytes())
+    return out
+
+
+def read_perm_sidecar(lux_path: str, nv: int | None = None,
+                      path: str | None = None) -> np.ndarray:
+    """Read and VALIDATE the permutation sidecar next to
+    ``lux_path``.  ``nv`` (when given, normally the .lux header's
+    vertex count) must match the sidecar's — a sidecar copied from a
+    different graph raises instead of silently relabeling."""
+    p = path or perm_sidecar_path(lux_path)
+    with open(p, "rb") as f:
+        head = f.read(8)
+        if len(head) != 8 or head[:4] != PERM_MAGIC:
+            raise GraphFormatError(
+                p, "perm_header",
+                f"bad magic {head[:4]!r} (a .perm sidecar starts "
+                f"with {PERM_MAGIC!r})")
+        n = int(np.frombuffer(head, V_DTYPE, count=1, offset=4)[0])
+        perm = np.frombuffer(f.read(), V_DTYPE)
+    if perm.shape[0] != n:
+        raise GraphFormatError(
+            p, "perm_length",
+            f"header says nv={n} but payload holds {perm.shape[0]} "
+            f"entries — truncated or torn sidecar?")
+    if nv is not None and n != nv:
+        raise GraphFormatError(
+            p, "perm_length",
+            f"sidecar nv={n} does not match the graph's nv={nv} — "
+            f"sidecar from a different graph?")
+    validate_perm(perm, n, p)
+    return perm
 
 
 def write_lux(path: str, row_ptrs, col_idx, weights=None, degrees=None):

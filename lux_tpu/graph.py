@@ -79,7 +79,8 @@ class Graph:
     @classmethod
     def from_file(cls, path: str, weighted: bool | None = None,
                   weight_dtype=np.int32, use_native: bool = False,
-                  validate: bool = False) -> "Graph":
+                  validate: bool = False,
+                  reorder: bool | str = False) -> "Graph":
         """Load a .lux file.  use_native=True routes the bulk reads
         through the C++ pthread-pread loader (lux_tpu.native), the
         analogue of the reference's native per-partition load tasks
@@ -90,7 +91,18 @@ class Graph:
         (both load paths) — a malformed file raises a typed
         format.GraphFormatError instead of producing wrong results
         through XLA's clamping gathers (the apps' -validate flag and
-        scripts/fsck_lux.py surface this)."""
+        scripts/fsck_lux.py surface this).
+
+        reorder: apply the page-aware ``.perm`` sidecar written by
+        the reorder pass (lux_tpu/reorder.py; format.py sidecar
+        section) at load — True requires the sidecar (typed
+        GraphFormatError when absent), "auto" applies it only when
+        present.  The sidecar is validated (length, bijection) either
+        way; the returned graph is relabeled with perm[new] = old."""
+        if reorder not in (False, True, "auto"):
+            raise ValueError(f"reorder={reorder!r} must be False, "
+                             f"True or 'auto'")
+        g = None
         if use_native:
             from lux_tpu import native
             if native.available():
@@ -105,17 +117,37 @@ class Graph:
                                           col_idx, path=path)
                 degrees = np.bincount(col_idx,
                                       minlength=hdr.nv).astype(np.uint32)
-                return cls(nv=hdr.nv, ne=hdr.ne, row_ptrs=row_ptrs,
-                           col_idx=col_idx, weights=weights,
-                           out_degrees=degrees)
-        hdr, row_ptrs, col_idx, weights, degrees = luxfmt.read_lux(
-            path, weighted, weight_dtype, validate=validate)
-        if degrees is None:
-            # The reference recomputes out-degrees at load time anyway
-            # (PullScanTask, reference pull_model.inl:322-345).
-            degrees = np.bincount(col_idx, minlength=hdr.nv).astype(np.uint32)
-        return cls(nv=hdr.nv, ne=hdr.ne, row_ptrs=row_ptrs, col_idx=col_idx,
-                   weights=weights, out_degrees=degrees)
+                g = cls(nv=hdr.nv, ne=hdr.ne, row_ptrs=row_ptrs,
+                        col_idx=col_idx, weights=weights,
+                        out_degrees=degrees)
+        if g is None:
+            hdr, row_ptrs, col_idx, weights, degrees = luxfmt.read_lux(
+                path, weighted, weight_dtype, validate=validate)
+            if degrees is None:
+                # The reference recomputes out-degrees at load time
+                # anyway (PullScanTask, reference
+                # pull_model.inl:322-345).
+                degrees = np.bincount(
+                    col_idx, minlength=hdr.nv).astype(np.uint32)
+            g = cls(nv=hdr.nv, ne=hdr.ne, row_ptrs=row_ptrs,
+                    col_idx=col_idx, weights=weights,
+                    out_degrees=degrees)
+        if reorder:
+            import os as _os
+            sidecar = luxfmt.perm_sidecar_path(path)
+            if not _os.path.exists(sidecar):
+                if reorder == "auto":
+                    return g
+                raise luxfmt.GraphFormatError(
+                    sidecar, "perm_header",
+                    "reorder=True but no .perm sidecar exists "
+                    "(write one with lux_tpu.reorder / "
+                    "format.write_perm_sidecar, or pass "
+                    "reorder='auto')")
+            perm = luxfmt.read_perm_sidecar(path, nv=g.nv)
+            from lux_tpu.reorder import apply_perm
+            return apply_perm(g, perm)
+        return g
 
     @classmethod
     def from_edges(cls, src, dst, nv: int, weights=None) -> "Graph":
@@ -746,13 +778,20 @@ class ShardedGraph:
                         + pp.row_tile.nbytes + pp.tile_pos.nbytes
                         + pp.page_ids.nbytes
                         + (pp.weight.nbytes
-                           if pp.weight is not None else 0))
+                           if pp.weight is not None else 0)
+                        + (pp.vrow_src.nbytes
+                           if getattr(pp, "vrow_src", None)
+                           is not None else 0))
             # plan arrays lead with the part (owner: src-part) count
             plan_parts = max(1, pp.slot_lane.shape[0])
             edge_bytes = resident // plan_parts
             wide = max(1, pair_kdim) * query_batch
             page_buf = pp.n_pages * 128 * 4 * wide
-            page_temp = 2 * pp.Rp * 128 * 4 * wide
+            # page-major plans additionally hold the delivered
+            # gather-row value buffer [Rg, 128] the virtual rows
+            # take from (mode="pagemajor"; Rg = 0 on paged plans)
+            page_temp = (2 * pp.Rp + getattr(pp, "Rg", 0)) \
+                * 128 * 4 * wide
         elif exchange == "owner":
             slots = (self.epad if owner_slots_per_part is None
                      else int(owner_slots_per_part))

@@ -30,6 +30,9 @@ int lux_sort_kv_u64(uint64_t* keys, uint64_t* key_tmp, int64_t n,
                     void** pay_tmp, const int32_t* pay_size);
 int lux_argsort_u64(const uint64_t* keys, int64_t n, int threads,
                     int64_t* perm_out);
+int lux_reorder_cluster(uint32_t nv, uint64_t ne, const uint32_t* src,
+                        const uint32_t* dst, int hubs_first,
+                        uint32_t* perm_out);
 }
 
 #define CHECK(cond)                                                \
@@ -112,6 +115,66 @@ static int smoke_sort() {
   return 0;
 }
 
+static int smoke_reorder() {
+  // end-to-end contract of the clustering reorder (reorder.cc): the
+  // output is a BIJECTION of [0, nv) and relabeling preserves the
+  // degree histogram exactly — checked on the 3-edge smoke graph and
+  // on a 2-community R-MAT-free synthetic with an isolated vertex
+  // (singleton clusters must still be emitted)
+  {
+    const uint32_t src3[3] = {2, 0, 1}, dst3[3] = {0, 1, 2};
+    uint32_t perm[3];
+    for (int hubs = 0; hubs <= 1; hubs++) {
+      CHECK(lux_reorder_cluster(3, 3, src3, dst3, hubs, perm) == 0);
+      uint32_t seen = 0;
+      for (int i = 0; i < 3; i++) {
+        CHECK(perm[i] < 3);
+        seen |= 1u << perm[i];
+      }
+      CHECK(seen == 7);
+    }
+  }
+  const uint32_t nv = 9;  // two triangles + a bridge + isolated v8
+  const uint32_t src9[7] = {0, 1, 2, 4, 5, 6, 2};
+  const uint32_t dst9[7] = {1, 2, 0, 5, 6, 4, 4};
+  uint32_t perm[nv];
+  // every mode (CM, hub-first, LPA communities) emits a bijection
+  for (int mode = 0; mode <= 2; mode++) {
+    CHECK(lux_reorder_cluster(nv, 7, src9, dst9, mode, perm) == 0);
+    std::vector<uint32_t> mh(nv, 0);
+    for (uint32_t i = 0; i < nv; i++) {
+      CHECK(perm[i] < nv);
+      mh[perm[i]]++;
+    }
+    for (uint32_t v = 0; v < nv; v++) CHECK(mh[v] == 1);
+  }
+  CHECK(lux_reorder_cluster(nv, 7, src9, dst9, 1, perm) == 0);
+  std::vector<uint32_t> hits(nv, 0);
+  for (uint32_t i = 0; i < nv; i++) {
+    CHECK(perm[i] < nv);
+    hits[perm[i]]++;
+  }
+  for (uint32_t v = 0; v < nv; v++) CHECK(hits[v] == 1);  // bijection
+  // degree histogram preserved under the relabel: deg_new[i] must be
+  // deg_old[perm[i]] for every slot, so the multiset is invariant
+  std::vector<uint32_t> deg_old(nv, 0), deg_new(nv, 0), rank(nv);
+  for (uint32_t i = 0; i < nv; i++) rank[perm[i]] = i;
+  for (int e = 0; e < 7; e++) {
+    deg_old[src9[e]]++;
+    deg_old[dst9[e]]++;
+    deg_new[rank[src9[e]]]++;
+    deg_new[rank[dst9[e]]]++;
+  }
+  for (uint32_t i = 0; i < nv; i++)
+    CHECK(deg_new[i] == deg_old[perm[i]]);
+  // out-of-range edge, missing output, unknown mode: typed refusals
+  const uint32_t bad_src[1] = {99}, bad_dst[1] = {0};
+  CHECK(lux_reorder_cluster(nv, 1, bad_src, bad_dst, 0, perm) == -2);
+  CHECK(lux_reorder_cluster(nv, 7, src9, dst9, 0, nullptr) == -1);
+  CHECK(lux_reorder_cluster(nv, 7, src9, dst9, 3, perm) == -4);
+  return 0;
+}
+
 int main(int argc, char** argv) {
   if (argc != 2) {
     std::fprintf(stderr, "usage: sanitize_driver SMOKE.lux\n");
@@ -120,6 +183,7 @@ int main(int argc, char** argv) {
   if (smoke_loader(argv[1])) return 1;
   if (smoke_rmat()) return 1;
   if (smoke_sort()) return 1;
+  if (smoke_reorder()) return 1;
   std::printf("sanitize_driver OK\n");
   return 0;
 }

@@ -438,7 +438,10 @@ def _engine_model(eng, scale: float,
         page_fill=float(pp.stats.get("padded_fill",
                                      pp.stats["fill"]))
         if paged else 128.0,
-        page_scale=page_scale)
+        page_scale=page_scale,
+        page_mode=pp.mode if paged else "paged",
+        page_g_fill=float(pp.stats.get("padded_g_fill", 128.0))
+        if paged else 128.0)
 
 
 def decompose(eng, app: str, iters: int = 3,
@@ -689,6 +692,25 @@ DEBTS = (
          "measured end-to-end on device",
          "PERF_NOTES round 15 (paged gather)",
          auto="_debt_paged_gather_ab"),
+    Debt("reorder-fill-ab",
+         "page-aware reorder fill A/B (round 16, lux_tpu/reorder.py "
+         "+ native/reorder.cc): measured page_fill none vs "
+         "native/hillclimb on the locality-rich community shape plus "
+         "the modeled delivered ns/edge both ways — the fill side is "
+         "HOST-measured (the probe runs anywhere); the on-device "
+         "delivered-GTEPS confirmation rides `bench.py -config "
+         "gather-ab -shape community -reorder hillclimb` on a live "
+         "tunnel", "PERF_NOTES round 16 (locality harvest)",
+         platform="any", auto="_debt_reorder_fill_ab"),
+    Debt("pagemajor-route-ab",
+         "page-major routed delivery A/B on a real mesh (round 16, "
+         "ops/pagegather.pagemajor_owner_deliver): the modeled "
+         "full-fill gather rows + all_to_all row routing + "
+         "virtual-row reduce (scalemodel.pagemajor_gather_ns / "
+         "pagemajor_route_ns) vs the owner scan and the plain paged "
+         "path — the split constants (VROW_REDUCE_NS, the ICI row "
+         "rate) are primitive-derived, not yet measured end-to-end",
+         "PERF_NOTES round 16 (page-major routing)", min_ndev=2),
     Debt("batch-sweep-on-device",
          "bench.py -config batch-sweep (B in {1,8,64} k-source SSSP "
          "+ personalized PageRank) on a live tunnel: the modeled "
@@ -773,6 +795,41 @@ def _debt_paged_gather_ab(fp: Fingerprint, clock=time.perf_counter):
             "paged_mad_ns": round(p_mad / edges * 1e9, 4),
             "speedup": round(flat_ns / max(paged_ns, 1e-12), 3),
             "method": _page_resolve_method()}
+
+
+def _debt_reorder_fill_ab(fp: Fingerprint, clock=time.perf_counter):
+    """The locality-harvest fill A/B (round 16): build the scrambled
+    community shape, measure the plan builder's page_fill under
+    none / native / hillclimb reorders (HOST numpy — the objective
+    is device-free by construction) and record the modeled delivered
+    ns/edge each implies (scalemodel.page_gather_ns), plus what
+    ``gather="auto"`` resolves to.  The on-device GTEPS confirmation
+    is the gather-ab bench family; this probe pins the fill trail a
+    session can always collect."""
+    from lux_tpu.convert import community_graph
+    from lux_tpu.graph import ShardedGraph
+    from lux_tpu.ops.pagegather import plan_paged_stats, resolve_gather
+    from lux_tpu.reorder import page_reorder
+    from lux_tpu.scalemodel import page_gather_ns
+
+    g = community_graph(scale=14, edge_factor=8, community_scale=8,
+                        seed=0)
+    out = {"debt": "reorder-fill-ab", "shape": "community14x8",
+           "ne": int(g.ne), "orders": {}}
+    for method in ("none", "native", "hillclimb"):
+        t0 = clock()
+        g2, _perm, rep = page_reorder(g, method=method)
+        sg = ShardedGraph.build(g2, 1, vpad_align=128)
+        st = plan_paged_stats(sg)
+        out["orders"][method] = {
+            "page_fill": round(float(st["padded_fill"]), 3),
+            "page_ratio": round(float(st["page_ratio"]), 4),
+            "modeled_ns_per_edge": round(page_gather_ns(
+                st["page_ratio"], st["padded_fill"]), 3),
+            "auto_resolves": resolve_gather(
+                "auto", st, 4 * sg.num_parts * sg.vpad),
+            "reorder_s": round(clock() - t0, 2)}
+    return out
 
 
 def collect_debts(fp: Fingerprint, ledger: PerfLedger | None,
@@ -860,12 +917,14 @@ def main(argv=None) -> int:
     ap.add_argument("-pair", type=int, default=None, metavar="T",
                     help="pair-lane threshold (with degree relabel)")
     ap.add_argument("-gather", default="flat",
-                    choices=["flat", "paged", "auto"],
+                    choices=["flat", "paged", "pagemajor", "auto"],
                     help="state-table delivery: 'paged' runs the "
                          "page-binned two-level gather "
-                         "(ops/pagegather.py), 'auto' resolves by "
-                         "the scalemodel break-even on the plan's "
-                         "measured unique-page ratio")
+                         "(ops/pagegather.py), 'pagemajor' the "
+                         "full-row page-major layout (round 16), "
+                         "'auto' arbitrates by the scalemodel "
+                         "break-even on the plan's measured "
+                         "unique-page ratio / fills")
     ap.add_argument("-iters", type=int, default=3,
                     help="measured iterations per phase (median + "
                          "MAD)")
@@ -924,6 +983,12 @@ def main(argv=None) -> int:
 
         decomps = []
         for app in args.apps:
+            if args.gather == "pagemajor" and app == "colfilter":
+                # typed engine refusal (K-dim programs keep 'paged');
+                # skip loudly instead of failing the whole report
+                print(f"# skipping {app}: gather='pagemajor' does "
+                      f"not serve K-dim (SDDMM) programs")
+                continue
             eng = _build_app_engine(app, args.scale, args.ef, args.np,
                                     args.pair, gather=args.gather)
             d = decompose(eng, app, iters=args.iters, fingerprint=fp)

@@ -1,0 +1,189 @@
+"""Page-aware vertex reordering: the locality harvest's host half.
+
+The paged gather (ops/pagegather.py) prices a delivered edge at
+~1.6 ns ONLY when edges sharing a (dst tile, src page) cluster; the
+plan builder measures exactly that objective (``plan_paged_stats``:
+``padded_fill``/``page_ratio``, the inputs of ``gather="auto"``'s
+break-even).  This module turns the objective around: candidate
+vertex orders are generated (degree sort; the native clustering BFS,
+lux_tpu/native/reorder.cc, both seed polarities) and SCORED directly
+against the plan builder's measured fill — no device, pure host — and
+the winner is refined by a hill-climb whose move is the
+dominant-destination-tile regroup (re-pack source pages so vertices
+feeding the same destination tile share pages), each pass accepted
+only if the measured ``padded_fill`` improves.
+
+Reference anchor: Lux chooses edge layouts matched to its memory
+hierarchy at load time (reference README.md:33-38 scaling discussion;
+Jia et al., PVLDB 2017); the microbenchmark-driven objective is the
+IPU-dissection method (PAPERS.md).  The permutation is persisted as a
+``.perm`` sidecar beside the .lux file (lux_tpu/format.py), applied
+at load by ``Graph.from_file(reorder=...)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lux_tpu.graph import Graph, ShardedGraph
+
+W = 128
+
+METHODS = ("none", "degree", "native", "hillclimb")
+
+
+def apply_perm(g: Graph, perm: np.ndarray) -> Graph:
+    """Relabel ``g`` by ``perm`` (``perm[new] = old``, the
+    degree_relabel direction).  Edge weights ride along."""
+    perm = np.asarray(perm, np.int64)
+    if perm.shape != (g.nv,) or not np.array_equal(
+            np.sort(perm), np.arange(g.nv)):
+        raise ValueError(f"perm must be a bijection of [0, {g.nv})")
+    rank = np.empty(g.nv, np.int64)
+    rank[perm] = np.arange(g.nv)
+    src, dst = g.edge_arrays()
+    return Graph.from_edges(rank[src], rank[dst], g.nv,
+                            weights=g.weights)
+
+
+def page_fill_stats(g: Graph, num_parts: int = 1,
+                    exchange: str = "gather",
+                    pagemajor: bool = False) -> dict:
+    """The plan builder's measured objective for ``g`` under the
+    CURRENT vertex order: build the 128-aligned sharded layout and run
+    the counting pass only (ops/pagegather.plan_paged_stats — none of
+    the [P, Rp, 128] plan assembly), returning its stats dict.  This
+    is what the hill-climb maximizes (``padded_fill``) and what
+    ``gather="auto"`` resolves from."""
+    from lux_tpu.ops.pagegather import plan_paged_stats
+
+    sg = ShardedGraph.build(g, num_parts, vpad_align=128)
+    return plan_paged_stats(sg, exchange=exchange, pagemajor=pagemajor)
+
+
+def _dominant_tile_regroup(g: Graph) -> np.ndarray:
+    """One hill-climb move, as a relative permutation of the CURRENT
+    order: key every vertex by the destination tile receiving most of
+    its out-edges (ties to the smaller tile; sinks keep their
+    position-derived key) and stable-sort — sources feeding the same
+    tile become page-mates, which is the quantity the (tile, page)
+    bins measure.  O(ne log ne) host numpy + one fused radix sort."""
+    from lux_tpu import native
+
+    src, dst = g.edge_arrays()
+    n_tiles = -(-g.nv // W)
+    key = src * np.int64(n_tiles) + dst // W
+    native.sort_kv(key, ())
+    newg = np.ones(len(key), bool)
+    if len(key):
+        newg[1:] = key[1:] != key[:-1]
+    b = np.nonzero(newg)[0]
+    cnt = np.diff(np.concatenate((b, [len(key)])))
+    uk = key[b]
+    u_src = uk // np.int64(n_tiles)
+    u_tile = uk % np.int64(n_tiles)
+    # per source, the tile with the max count (stable ties -> smaller
+    # tile): sort groups by (src, -cnt, tile) and keep each first
+    order = np.lexsort((u_tile, -cnt, u_src))
+    first = np.ones(len(order), bool)
+    if len(order):
+        first[1:] = u_src[order][1:] != u_src[order][:-1]
+    dom = np.full(g.nv, -1, np.int64)
+    dom[u_src[order][first]] = u_tile[order][first]
+    # sinks (no out-edges) keep their current tile as the key, so the
+    # regroup never scatters an already-placed page of sinks
+    no_out = dom < 0
+    dom[no_out] = np.nonzero(no_out)[0] // W
+    return np.argsort(dom, kind="stable")
+
+
+def page_reorder(g: Graph, method: str = "hillclimb",
+                 num_parts: int = 1, exchange: str = "gather",
+                 passes: int = 8, verbose: bool = False):
+    """Reorder ``g``'s vertices for page locality.
+
+    method:
+      none       identity (the report still measures the baseline)
+      degree     descending total-degree sort (graph.degree_relabel's
+                 order — the round-15 bench preprocessing)
+      native     the native clustering passes (native/reorder.cc:
+                 label-propagation communities + hub-first BFS), the
+                 best BY MEASURED FILL
+      hillclimb  all of the above as candidates, then
+                 dominant-tile-regroup refinement passes, each
+                 accepted only if the measured ``padded_fill`` rises
+
+    Returns ``(g2, perm, report)`` with ``perm[new] = old`` mapping
+    the returned graph's ids back to ``g``'s, and ``report`` the
+    per-candidate measured stats (JSON-serializable: the inspection
+    trail scripts/pair_fill_hist.py renders).
+    """
+    from lux_tpu import native
+
+    if method not in METHODS:
+        raise ValueError(f"unknown reorder method {method!r} "
+                         f"(one of {', '.join(METHODS)})")
+
+    def score(g2):
+        return page_fill_stats(g2, num_parts, exchange)
+
+    base = score(g)
+    report = {"method": method, "num_parts": num_parts,
+              "exchange": exchange,
+              "candidates": {"none": _digest(base)}}
+    identity = np.arange(g.nv, dtype=np.int64)
+    if method == "none":
+        return g, identity, report
+
+    cands: list[tuple[str, np.ndarray]] = []
+    deg = (np.bincount(g.col_idx, minlength=g.nv).astype(np.int64)
+           + g.in_degrees())
+    cands.append(("degree", np.argsort(-deg, kind="stable")))
+    if method in ("native", "hillclimb"):
+        src, dst = g.edge_arrays()
+        for tag, m in (("native-communities", "communities"),
+                       ("native-hubs", "hubs")):
+            cands.append((tag, native.reorder_cluster(
+                src, dst, g.nv, mode=m).astype(np.int64)))
+    if method == "degree":
+        cands = cands[:1]
+
+    best = (g, identity, base)
+    for tag, perm in cands:
+        g2 = apply_perm(g, perm)
+        st = score(g2)
+        report["candidates"][tag] = _digest(st)
+        if verbose:
+            print(f"# reorder {tag}: padded_fill "
+                  f"{st['padded_fill']:.2f}", flush=True)
+        if st["padded_fill"] > best[2]["padded_fill"]:
+            best = (g2, perm, st)
+
+    if method == "hillclimb":
+        g2, perm, st = best
+        for i in range(passes):
+            rel = _dominant_tile_regroup(g2)
+            cand_perm = perm[rel]
+            g3 = apply_perm(g, cand_perm)
+            st3 = score(g3)
+            report["candidates"][f"regroup{i}"] = _digest(st3)
+            if verbose:
+                print(f"# reorder regroup{i}: padded_fill "
+                      f"{st3['padded_fill']:.2f}", flush=True)
+            if st3["padded_fill"] <= st["padded_fill"]:
+                break                       # hill-climb: accept only up
+            g2, perm, st = g3, cand_perm, st3
+        best = (g2, perm, st)
+
+    g2, perm, st = best
+    report["chosen_fill"] = round(float(st["padded_fill"]), 3)
+    report["baseline_fill"] = round(float(base["padded_fill"]), 3)
+    report["chosen"] = _digest(st)
+    return g2, perm, report
+
+
+def _digest(stats: dict) -> dict:
+    return {"fill": round(float(stats["fill"]), 3),
+            "padded_fill": round(float(stats["padded_fill"]), 3),
+            "page_ratio": round(float(stats["page_ratio"]), 4),
+            "rows": int(stats["rows"])}

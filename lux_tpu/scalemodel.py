@@ -180,6 +180,81 @@ def page_break_even_ratio(fill: float, table_bytes: float = 0.0,
         / ((PAGE_ROW_FETCH_NS / 128.0) * max(1, kdim))
 
 
+# Page-major split (round 16, ops/pagegather.py mode="pagemajor"):
+# the PAIR_ROW_NS = 150 per-row machinery decomposes as one 24 ns
+# static row fetch + the compare-reduce/class-combine remainder; the
+# page-major layout pays fetch+shuffle per FULL gather row and the
+# remainder per (low-fill) virtual row, plus one extra 24 ns take
+# binding each virtual row to its gather row's delivered values.
+# MODELED from the measured primitive costs like PAGED_ROW_NS —
+# the owed on-device split is observe.DEBTS "pagemajor-route-ab".
+VROW_REDUCE_NS = PAIR_ROW_NS - PAGE_ROW_FETCH_NS       # = 126.0
+
+
+def pagemajor_gather_ns(page_ratio: float, g_fill: float,
+                        v_fill: float, kdim: int = 1,
+                        routed: bool = False,
+                        itemsize: int = 4) -> float:
+    """Modeled delivered ns/edge of the PAGE-MAJOR two-level layout
+    from the plan's measured stats: the dedup'd page fetch
+    (``page_ratio``) + row fetch and lane shuffle amortized over the
+    near-full GATHER rows (``g_fill``) + the compare-reduce machinery
+    amortized over the VIRTUAL rows (``v_fill`` — the same joint
+    (tile, page) density the plain paged fill measures) + the routing
+    hop when the rows cross the mesh (``routed``, the owner plan's
+    all_to_all — priced per shipped lane over ICI,
+    ``pagemajor_route_ns``).  K-dim (SDDMM) programs are not served
+    by this mode (typed refusal, matching ops/pagegather)."""
+    if kdim > 1:
+        raise ValueError("page-major does not serve K-dim (SDDMM) "
+                         "programs; use page_gather_ns")
+    if g_fill <= 0 or v_fill <= 0:
+        raise ValueError(f"fills must be > 0, got g_fill={g_fill} "
+                         f"v_fill={v_fill}")
+    if page_ratio < 0:
+        raise ValueError(f"page_ratio must be >= 0, got {page_ratio}")
+    fetch = page_ratio * (PAGE_ROW_FETCH_NS / 128.0)
+    gather = (PAGE_ROW_FETCH_NS + 128 * LANE_SHUFFLE_NS) / g_fill
+    reduce = (PAGE_ROW_FETCH_NS + VROW_REDUCE_NS) / v_fill
+    route = pagemajor_route_ns(g_fill, itemsize) if routed else 0.0
+    return fetch + gather + reduce + route
+
+
+def pagemajor_route_ns(g_fill: float, itemsize: int = 4) -> float:
+    """The routing hop's per-edge price: every (padded) lane of a
+    routed 128-lane row ships ``itemsize`` bytes over ICI once, so an
+    edge pays itemsize * 128 / g_fill bytes at the link rate — ~0.1
+    ns/edge at full rows, which is why trading the hop for full rows
+    can pay (the comm-is-permille-of-compute relation the mesh model
+    rests on, ICI_BYTES_PER_S)."""
+    if g_fill <= 0:
+        raise ValueError(f"g_fill must be > 0, got {g_fill}")
+    return itemsize * (128.0 / g_fill) / (ICI_BYTES_PER_S * 1e-9)
+
+
+def pagemajor_break_even_vfill(page_ratio: float = 1.0,
+                               g_fill: float = 128.0,
+                               table_bytes: float = 0.0,
+                               routed: bool = False,
+                               itemsize: int = 4) -> int:
+    """Virtual-row fill above which page-major beats the flat gather
+    (at a given page ratio and gather fill) — the page-major
+    counterpart of ``page_break_even_fill``.  The modeled small-table
+    threshold at full gather rows — v_fill >= 19 — undercuts the
+    plain paged break-even of 23 because the shuffle rides the full
+    rows (pinned in tests/test_pagegather.py)."""
+    import math
+    rate = flat_gather_ns(table_bytes)
+    margin = rate - page_ratio * (PAGE_ROW_FETCH_NS / 128.0) \
+        - (PAGE_ROW_FETCH_NS + 128 * LANE_SHUFFLE_NS) / g_fill
+    if routed:
+        margin -= pagemajor_route_ns(g_fill, itemsize)
+    if margin <= 0:
+        return 1 << 30
+    return max(1, math.ceil((PAGE_ROW_FETCH_NS + VROW_REDUCE_NS)
+                            / margin))
+
+
 # Query batching (ROADMAP item 2, engine/program.py ``batch``): the
 # dense iteration's ONE table gather fetches a [B]-wide CONTIGUOUS
 # state row per edge instead of one element — the fetch is
@@ -318,7 +393,9 @@ def phase_model(*, engine: str, exchange: str, ne: int, nv: int,
                 dot: bool = False, scale: float = 1.0,
                 paged: bool = False, page_ratio: float = 0.0,
                 page_fill: float = 128.0,
-                page_scale: float | None = None) -> dict:
+                page_scale: float | None = None,
+                page_mode: str = "paged",
+                page_g_fill: float = 128.0) -> dict:
     """Per-PHASE predicted nanoseconds for ONE engine iteration — the
     model side of the observatory's measured-vs-model drift check
     (lux_tpu/observe.py).  Keys match the engines' ``timed_phases``
@@ -363,8 +440,16 @@ def phase_model(*, engine: str, exchange: str, ne: int, nv: int,
         # session's measured page-row probe over its canon (the
         # observe.calibrate page_gather probe) — the paged pipeline's
         # platform factor differs from the flat gather's, so it gets
-        # its own scale when the caller has one.
-        deliver = ne * page_gather_ns(page_ratio, page_fill, kdim) \
+        # its own scale when the caller has one.  The PAGE-MAJOR mode
+        # prices its split gather/virtual rates + the routing hop
+        # instead (pagemajor_gather_ns).
+        if page_mode == "pagemajor":
+            per_edge = pagemajor_gather_ns(
+                page_ratio, page_g_fill, page_fill,
+                routed=exchange == "owner")
+        else:
+            per_edge = page_gather_ns(page_ratio, page_fill, kdim)
+        deliver = ne * per_edge \
             * (scale if page_scale is None else page_scale)
     elif exchange == "owner":
         deliver = residual_ne * chunk_inflation * OWNER_SLOT_NS * scale

@@ -131,9 +131,17 @@ BATCH_METRIC = re.compile(
 # paged-vs-flat A/B lines (bench.py -config gather-ab, round 15,
 # ops/pagegather.py): the metric name carries the delivery mode, the
 # line carries gather + the plan's measured page stats — the ratio
-# the break-even claim rests on must be on the record, both sides
+# the break-even claim rests on must be on the record, both sides.
+# Round 16 grows the reorder token (none|native|hillclimb,
+# lux_tpu/reorder.py — absent in the name means none), the pagemajor
+# mode and the community shape; a reordered line is additionally
+# cross-checked against its paired none line (check_reorder_pairs:
+# the fill must not DECREASE under a reorder, or the published gain
+# is a contradiction)
 GATHER_AB_METRIC = re.compile(
-    r"^pagerank_(paged|flat)_rmat(\d+)_gteps_per_chip$")
+    r"^pagerank_(paged|flat|pagemajor)_(?:(native|hillclimb)_)?"
+    r"(rmat|comm)(\d+)_gteps_per_chip$")
+REORDER_METHODS = ("none", "native", "hillclimb")
 
 
 def iter_metric_lines(path: str):
@@ -281,7 +289,9 @@ def check_line(obj: dict, *, legacy_ok: bool):
     m = GATHER_AB_METRIC.match(name)
     if m or "gather" in obj:
         errs += check_gather_fields(name, obj,
-                                    m.group(1) if m else None)
+                                    m.group(1) if m else None,
+                                    (m.group(2) or "none") if m
+                                    else None)
     return errs, warns
 
 
@@ -397,25 +407,37 @@ def check_batch_fields(name: str, obj: dict,
 
 
 def check_gather_fields(name: str, obj: dict,
-                        name_mode: str | None) -> list[str]:
+                        name_mode: str | None,
+                        name_reorder: str | None = None) -> list[str]:
     """Gather A/B lines (bench.py -config gather-ab, round 15): the
-    ``gather`` mode must be paged|flat and match the metric name, and
-    BOTH sides must record the plan's measured page stats —
+    ``gather`` mode must be paged|flat|pagemajor and match the metric
+    name, and BOTH sides must record the plan's measured page stats —
     ``page_ratio`` (unique page elements per edge, finite > 0) and
     ``page_fill`` (live lanes per PADDED delivery row, (0, 128] —
     the exact padded_fill gather="auto" and the phase model consume,
     not the live-rows-only figure): the modeled break-even
     (scalemodel.page_gather_ns) is resolved FROM these numbers, so a
-    published A/B without them cannot be audited."""
+    published A/B without them cannot be audited.  Round 16: the
+    ``reorder`` field (none|native|hillclimb, lux_tpu/reorder.py)
+    must match the metric name's reorder token — a line claiming a
+    reordered fill under an unreordered name (or vice versa) is the
+    same contradiction class as mode-vs-name."""
     errs = []
     mode = obj.get("gather")
-    if mode not in ("paged", "flat"):
-        errs.append(f"{name}: gather={mode!r} must be 'paged' or "
-                    f"'flat'")
+    if mode not in ("paged", "flat", "pagemajor"):
+        errs.append(f"{name}: gather={mode!r} must be 'paged', "
+                    f"'flat' or 'pagemajor'")
         return errs
     if name_mode is not None and mode != name_mode:
         errs.append(f"{name}: gather={mode!r} contradicts the metric "
                     f"name's _{name_mode}_")
+    ro = obj.get("reorder")
+    if ro is not None and ro not in REORDER_METHODS:
+        errs.append(f"{name}: reorder={ro!r} must be one of "
+                    f"{'|'.join(REORDER_METHODS)}")
+    elif name_reorder is not None and (ro or "none") != name_reorder:
+        errs.append(f"{name}: reorder={ro!r} contradicts the metric "
+                    f"name's reorder token {name_reorder!r}")
     pr = obj.get("page_ratio")
     if not _is_num(pr) or pr <= 0:
         errs.append(f"{name}: page_ratio={pr!r} must be a finite "
@@ -818,6 +840,47 @@ def check_event_lines(path: str, events):
     return errs
 
 
+def check_reorder_pairs(lines) -> list[str]:
+    """Cross-line audit of the round-16 reorder A/B (bench.py
+    -reorder emits each reordered gather-ab line TOGETHER with its
+    paired none baseline): for every reordered line whose paired
+    none line (same gather mode, shape and scale) is in the same
+    artifact, the measured ``page_fill`` must not DECREASE under the
+    reorder — the reorder pass hill-climbs exactly this objective
+    (lux_tpu/reorder.py), so a published pair where it fell is
+    either a mislabeled line or a broken reorderer, both rejected."""
+    errs = []
+    by_key = {}
+    for where, obj in lines:
+        name = obj.get("metric", "")
+        m = GATHER_AB_METRIC.match(name)
+        if not m or not _is_num(obj.get("page_fill")):
+            continue
+        mode, ro, tag, scale = (m.group(1), m.group(2) or "none",
+                                m.group(3), m.group(4))
+        # num_parts is part of the pairing identity: padded fill
+        # legitimately shifts with the common depth profile across
+        # parts, so a cross-np comparison would reject correct data.
+        # Keep EVERY line per key (repeated sessions all check).
+        key = (mode, tag, scale, obj.get("np"))
+        by_key.setdefault(key, {}).setdefault(ro, []).append(
+            (where, name, obj["page_fill"]))
+    for key, by_ro in by_key.items():
+        for ro, entries in by_ro.items():
+            if ro == "none":
+                continue
+            for where, name, pf in entries:
+                for _bw, bname, bpf in by_ro.get("none", []):
+                    if pf < bpf - 1e-9:
+                        errs.append(
+                            f"({where}): {name}: page_fill={pf} "
+                            f"DECREASED vs its paired none line "
+                            f"{bname} ({bpf}) — the reorder "
+                            f"hill-climbs fill, a drop contradicts "
+                            f"the published pair")
+    return errs
+
+
 def check_file(path: str, *, legacy_ok: bool):
     errs, warns, n = [], [], 0
     try:
@@ -834,6 +897,7 @@ def check_file(path: str, *, legacy_ok: bool):
         e, w = check_line(obj, legacy_ok=legacy_ok)
         errs += [f"{path} ({where}): {m}" for m in e]
         warns += [f"{path} ({where}): {m}" for m in w]
+    errs += [f"{path} {m}" for m in check_reorder_pairs(lines)]
     return errs, warns, n
 
 

@@ -45,9 +45,24 @@ def fsck(path: str, weighted: bool | None) -> str | None:
         return f"[{e.check}] {e.detail}"
     except (OSError, ValueError) as e:
         return f"[unreadable] {type(e).__name__}: {e}"
+    # the page-aware reorder's .perm sidecar (round 16,
+    # lux_tpu/reorder.py): validated whenever present — length nv,
+    # bijection of [0, nv) — so a torn or mismatched sidecar fails
+    # at rest, not as a silent wrong-answer relabel at load
+    perm_state = "no"
+    sidecar = luxfmt.perm_sidecar_path(path)
+    if os.path.exists(sidecar):
+        try:
+            luxfmt.read_perm_sidecar(path, nv=hdr.nv)
+            perm_state = "yes"
+        except luxfmt.GraphFormatError as e:
+            return f"[{e.check}] {e.detail}"
+        except (OSError, ValueError) as e:
+            return f"[perm unreadable] {type(e).__name__}: {e}"
     print(f"{path}: OK nv={hdr.nv} ne={hdr.ne} "
           f"weights={'yes' if hdr.has_weights else 'no'} "
-          f"degrees={'yes' if hdr.has_degrees else 'no'}")
+          f"degrees={'yes' if hdr.has_degrees else 'no'} "
+          f"perm={perm_state}")
     return None
 
 

@@ -17,13 +17,23 @@ scalar ~16 (~22 at colfilter's K=20).  No device work — pure numpy.
 
 Usage:
   PYTHONPATH=/root/repo python scripts/pair_fill_hist.py \
-      [shape=rmat|netflix] [scale=21] [ratings=100000000] [np=1] \
-      [pair=16] [kdim=1] [residual_ns=0]
+      [mode=pair|page] [shape=rmat|netflix|community] [scale=21] \
+      [ratings=100000000] [np=1] [pair=16] [kdim=1] [residual_ns=0] \
+      [reorder=none|degree|native|hillclimb] [exchange=gather|owner]
 
 residual_ns=0 uses the modeled K-aware default
 (scalemodel.residual_edge_ns).  shape=netflix builds the bench shape
 (scripts/bench_netflix.py, convert.netflix_like_edges) and defaults
 kdim to colfilter's K=20.
+
+mode=page (round 16): the PAGED delivery's per-(dst tile, src page)
+fill histogram instead of the pair one — the objective the reorder
+pass maximizes (lux_tpu/reorder.py; ``reorder=`` applies it first)
+— plus the modeled break-even VERDICT: the plan's measured
+padded_fill / page_ratio against scalemodel.page_break_even_fill and
+what ``gather="auto"`` would resolve.  shape=community builds the
+scrambled locality-rich synthetic (convert.community_edges).  All
+host numpy — reorder gains are inspectable without a device.
 """
 
 from __future__ import annotations
@@ -35,16 +45,104 @@ import time
 import numpy as np
 
 
+def _build_graph(cfg):
+    t0 = time.time()
+    if cfg["shape"] == "netflix":
+        from lux_tpu.convert import netflix_like_edges
+        from lux_tpu.graph import Graph
+        src, dst, w, nv = netflix_like_edges(n_ratings=cfg["ratings"])
+        g = Graph.from_edges(src, dst, nv, weights=w)
+    elif cfg["shape"] == "community":
+        from lux_tpu.convert import community_graph
+        g = community_graph(scale=cfg["scale"], edge_factor=16)
+    else:
+        from lux_tpu.convert import rmat_graph
+        g = rmat_graph(scale=cfg["scale"], edge_factor=16, seed=0)
+    print(f"# graph built in {time.time() - t0:.0f}s",
+          file=sys.stderr)
+    return g
+
+
+def page_fill_main(cfg):
+    """mode=page: per-(tile, page) fill histogram + break-even
+    verdict for the given graph/order."""
+    from lux_tpu.graph import ShardedGraph
+    from lux_tpu.ops.pagegather import (plan_paged_gather,
+                                        plan_owner_paged,
+                                        plan_paged_stats,
+                                        resolve_gather)
+    from lux_tpu.reorder import page_reorder
+    from lux_tpu.scalemodel import (page_break_even_fill,
+                                    page_gather_ns)
+
+    g = _build_graph(cfg)
+    t0 = time.time()
+    g2, _perm, report = page_reorder(g, method=cfg["reorder"],
+                                     num_parts=cfg["np"],
+                                     exchange=cfg["exchange"])
+    print(f"# reorder {cfg['reorder']} in {time.time() - t0:.0f}s",
+          file=sys.stderr)
+    sg = ShardedGraph.build(g2, cfg["np"], vpad_align=128)
+    owner = cfg["exchange"] == "owner"
+    pp = plan_owner_paged(sg) if owner else plan_paged_gather(sg)
+    stats = plan_paged_stats(sg, exchange=cfg["exchange"],
+                             pagemajor=True)
+    table_bytes = sg.num_parts * sg.vpad * 4
+    be = page_break_even_fill(stats["page_ratio"], table_bytes)
+    resolved = resolve_gather("auto", stats, table_bytes,
+                              exchange=cfg["exchange"])
+    verdict = dict(
+        shape=cfg["shape"], reorder=cfg["reorder"],
+        np=cfg["np"], exchange=cfg["exchange"], ne=int(sg.ne),
+        page_fill=round(float(stats["padded_fill"]), 2),
+        live_fill=round(float(stats["fill"]), 2),
+        page_ratio=round(float(stats["page_ratio"]), 4),
+        pm_g_fill=round(float(stats["pm_g_fill"]), 2),
+        pm_vfill=round(float(stats["pm_padded_vfill"]), 2),
+        break_even=be,
+        modeled_ns_per_edge=round(page_gather_ns(
+            stats["page_ratio"], stats["padded_fill"]), 2),
+        auto_resolves=resolved,
+        paged_pays=bool(stats["padded_fill"] >= be),
+        reorder_trail=report["candidates"])
+    print(json.dumps(verdict))
+    # per-(tile, page) fill histogram over LIVE delivery rows (the
+    # plan's row fill; class-ladder pad rows excluded here — the
+    # padded economics are the verdict line's padded_fill)
+    W = 128
+    fills = np.zeros(W + 1, np.int64)
+    for p in range(pp.slot_lane.shape[0]):
+        live = (pp.rel_dst[p] != -1).sum(axis=1)
+        fills += np.bincount(np.minimum(live, W), minlength=W + 1)
+    fills[0] = 0                       # dead (pad) rows
+    print("| fill | rows | edges |")
+    print("|---|---|---|")
+    edges = fills * np.arange(W + 1)
+    bands = [(1, 8), (8, 16), (16, 23), (23, 32), (32, 64),
+             (64, 128), (128, 129)]
+    for lo, hi in bands:
+        r = int(fills[lo:hi].sum())
+        e = int(edges[lo:hi].sum())
+        label = f"{lo}-{hi - 1}" if hi - lo > 1 else f"{lo}"
+        print(f"| {label} | {r} | {e} |")
+
+
 def main():
-    cfg = dict(shape="rmat", scale=21, ratings=100_000_000, np=1,
-               pair=16, kdim=0, residual_ns=0.0)
+    cfg = dict(mode="pair", shape="rmat", scale=21,
+               ratings=100_000_000, np=1, pair=16, kdim=0,
+               residual_ns=0.0, reorder="none", exchange="gather")
     for a in sys.argv[1:]:
         k, v = a.split("=", 1)
         if k not in cfg:
             raise SystemExit(f"unknown arg {k!r} (known: "
                              f"{', '.join(cfg)})")
-        cfg[k] = (v if k == "shape"
+        cfg[k] = (v if k in ("shape", "mode", "reorder", "exchange")
                   else float(v) if k == "residual_ns" else int(v))
+    if cfg["mode"] == "page":
+        return page_fill_main(cfg)
+    if cfg["mode"] != "pair":
+        raise SystemExit(f"unknown mode {cfg['mode']!r} "
+                         f"(pair or page)")
 
     from lux_tpu.graph import ShardedGraph, pair_relabel
     from lux_tpu.ops.pairs import W, analyze_pairs, fill_histogram

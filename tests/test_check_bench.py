@@ -515,3 +515,85 @@ def test_bad_batched_lines_fail(tmp_path, mutate, needle):
     r = _audit_one(tmp_path, obj)
     assert r.returncode == 1, "audit passed a bad batched line"
     assert needle in r.stderr, r.stderr
+
+
+# ---------------------------------------------------------------------
+# round 16: gather-ab reorder field + pairing rule
+
+
+def _gather_line(mode="paged", reorder=None, fill=9.5, tag="rmat21"):
+    d = json.loads(json.dumps(GOOD_LINE))
+    rtok = "" if reorder in (None, "none") else f"{reorder}_"
+    d["metric"] = f"pagerank_{mode}_{rtok}{tag}_gteps_per_chip"
+    d["gather"] = mode
+    d["page_ratio"] = 0.61
+    d["page_fill"] = fill
+    if reorder is not None:
+        d["reorder"] = reorder
+    return d
+
+
+def test_gather_reorder_lines_accepted(tmp_path):
+    """A reordered pair whose fill ROSE passes, including the
+    pagemajor mode and the community shape tag."""
+    lines = [_gather_line("paged", "none", 8.2),
+             _gather_line("paged", "hillclimb", 31.0),
+             _gather_line("flat", "none", 8.2),
+             _gather_line("flat", "native", 24.0),
+             _gather_line("pagemajor", "none", 9.0, tag="comm14")]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    r = run_check(p)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("line,needle", [
+    (_gather_line("paged", "sorted"), "reorder="),
+    # reorder field contradicting the metric name's token
+    ({**_gather_line("paged", "hillclimb"), "reorder": "none"},
+     "contradicts the metric name's reorder"),
+    ({**_gather_line("paged"), "reorder": "native"},
+     "contradicts the metric name's reorder"),
+])
+def test_bad_reorder_fields_fail(tmp_path, line, needle):
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(line) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert needle in r.stderr
+
+
+def test_reorder_pair_fill_decrease_rejected(tmp_path):
+    """The cross-line rule: a reordered line published WITH its
+    paired none line must not show a fill drop — the reorder
+    hill-climbs fill, so a drop is a mislabeled pair or a broken
+    reorderer."""
+    lines = [_gather_line("paged", "none", 9.5),
+             _gather_line("paged", "hillclimb", 7.0)]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    r = run_check(p)
+    assert r.returncode == 1
+    assert "DECREASED" in r.stderr
+    # without the paired none line the (possibly historical) single
+    # line stands on its own
+    p.write_text(json.dumps(lines[1]) + "\n")
+    assert run_check(p).returncode == 0
+
+
+def test_reorder_pair_cross_np_not_compared(tmp_path):
+    """num_parts is part of the pairing identity: padded fill shifts
+    legitimately with the parts' common depth profile, so a
+    reordered np=4 line never pairs against a none np=1 baseline."""
+    none1 = _gather_line("paged", "none", 20.0)
+    none1["np"] = 1
+    ro4 = _gather_line("paged", "hillclimb", 12.0)
+    ro4["np"] = 4
+    p = tmp_path / "bench.jsonl"
+    p.write_text("".join(json.dumps(d) + "\n" for d in [none1, ro4]))
+    assert run_check(p).returncode == 0
+    # same np: the drop IS a contradiction
+    ro4["np"] = 1
+    p.write_text("".join(json.dumps(d) + "\n" for d in [none1, ro4]))
+    r = run_check(p)
+    assert r.returncode == 1 and "DECREASED" in r.stderr

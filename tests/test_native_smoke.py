@@ -70,6 +70,21 @@ def test_make_smoke_and_bindings():
     np.testing.assert_array_equal(keys, np.sort(
         np.array([5, 1, 4, 1, 3])))
 
+    # reorder round-trip on the same 3-edge graph (round 16,
+    # reorder.cc): every mode emits a bijection, and relabeling
+    # through it preserves the degree histogram exactly
+    src, dst = g.edge_arrays()
+    deg = (np.bincount(src, minlength=3)
+           + np.bincount(dst, minlength=3))
+    for mode in ("cm", "hubs", "communities"):
+        perm = native.reorder_cluster(src, dst, 3, mode=mode)
+        assert sorted(perm.tolist()) == [0, 1, 2]
+        rank = np.empty(3, np.int64)
+        rank[perm] = np.arange(3)
+        deg2 = (np.bincount(rank[src], minlength=3)
+                + np.bincount(rank[dst], minlength=3))
+        np.testing.assert_array_equal(deg2, deg[perm])
+
 
 @pytest.mark.slow
 def test_make_sanitize():

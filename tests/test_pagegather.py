@@ -561,3 +561,212 @@ def test_lint_gates_bench_fencing(tmp_path):
         [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
          str(REPO / "scripts")], capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
+
+
+# ---------------------------------------------------------------------
+# page-major layout (round 16): gather rows bind to pages first
+
+
+def test_pagemajor_plan_resolves_every_edge():
+    """Every edge decodes back through its virtual row's gather row —
+    multiset equality per part — and the gather rows are near-full by
+    construction (that is the mode's whole point)."""
+    from lux_tpu.ops.pagegather import plan_pagemajor
+
+    g = _skewed_graph(11, 4 * W, 7000)
+    for P in (1, 3):
+        sg = ShardedGraph.build(g, P, vpad_align=128)
+        pp = plan_pagemajor(sg)
+        assert pp.mode == "pagemajor"
+        assert pp.stats["g_fill"] > pp.stats["fill"]
+        for p in range(P):
+            nep = int(sg.ne_part[p])
+            src, dst = decode_plan(pp, p)
+            assert len(src) == nep
+            want = sorted(zip(sg.src_slot[p, :nep].tolist(),
+                              sg.dst_local[p, :nep].tolist()))
+            assert sorted(zip(src.tolist(), dst.tolist())) == want
+
+
+def test_pagemajor_oracle_reduce_matches_flat():
+    """paged_reduce_numpy through the virtual-row indirection equals
+    the plain flat reduce (padding contributes the identity)."""
+    from lux_tpu.ops.pagegather import plan_pagemajor
+
+    g = _skewed_graph(12, 3 * W, 5000)
+    sg = ShardedGraph.build(g, 2, vpad_align=128)
+    pp = plan_pagemajor(sg)
+    state = np.random.default_rng(1).random(sg.num_parts * sg.vpad)
+    for p in range(2):
+        nep = int(sg.ne_part[p])
+        out = paged_reduce_numpy(pp, p, state, "sum")
+        ref = np.zeros(sg.vpad)
+        np.add.at(ref, sg.dst_local[p, :nep],
+                  state[sg.src_slot[p, :nep]])
+        assert np.allclose(out[:sg.vpad], ref)
+
+
+def test_pagemajor_owner_plan_decodes():
+    """The owner page-major plan's routed layout decodes back to the
+    full edge multiset: every (src part, src local, global dst)
+    appears exactly once across the destination parts' receive
+    plans."""
+    from lux_tpu.ops.pagegather import (decode_pagemajor_owner,
+                                        plan_owner_pagemajor)
+
+    g = _skewed_graph(13, 4 * W, 6000)
+    P = 4
+    sg = ShardedGraph.build(g, P, vpad_align=128)
+    po = plan_owner_pagemajor(sg)
+    assert po.route >= 8 and po.route % 8 == 0
+    got = []
+    for d in range(P):
+        s, srcl, dstl = decode_pagemajor_owner(po, d)
+        got += list(zip(s.tolist(), srcl.tolist(),
+                        (d * sg.vpad + dstl).tolist()))
+    want = []
+    for r in range(P):
+        nep = int(sg.ne_part[r])
+        slot = sg.src_slot[r, :nep].astype(np.int64)
+        sp = slot // sg.vpad
+        want += list(zip(sp.tolist(),
+                         (slot - sp * sg.vpad).tolist(),
+                         (r * sg.vpad
+                          + sg.dst_local[r, :nep]).tolist()))
+    assert sorted(got) == sorted(want)
+
+
+def test_pagemajor_engines_match_flat():
+    """gather='pagemajor' engines reproduce the flat engines: bitwise
+    for the min/max push apps (order-independent), on one device, the
+    8-device mesh, the OWNER routing exchange, and a batched build;
+    integer-exact for a sum pull step."""
+    from lux_tpu.apps import sssp
+    from lux_tpu.engine.program import PullProgram
+    from lux_tpu.engine.pull import PullEngine
+    from lux_tpu.engine.push import PushEngine
+    from lux_tpu.parallel.mesh import make_mesh
+
+    g = _skewed_graph(14, 4 * W, 6000)
+    sg = ShardedGraph.build(g, 2, vpad_align=128)
+    flat = _converge(PushEngine(sg, sssp.make_program(0)))
+    pm = _converge(PushEngine(sg, sssp.make_program(0),
+                              gather="pagemajor"))
+    assert np.array_equal(flat, pm)
+    pmo = _converge(PushEngine(sg, sssp.make_program(0),
+                               exchange="owner",
+                               gather="pagemajor"))
+    assert np.array_equal(flat, pmo)
+
+    mesh = make_mesh(8)
+    sg8 = ShardedGraph.build(g, 8, vpad_align=128)
+    pm8 = _converge(PushEngine(sg8, sssp.make_program(0), mesh=mesh,
+                               gather="pagemajor"))
+    assert np.array_equal(flat, pm8)
+    pm8o = _converge(PushEngine(sg8, sssp.make_program(0), mesh=mesh,
+                                exchange="owner",
+                                gather="pagemajor"))
+    assert np.array_equal(flat, pm8o)
+
+    # batched (k-source) labels ride the trailing query axis
+    ks_flat = _converge(PushEngine(sg, sssp.make_batched_program(
+        [0, 5, 9])))
+    ks_pm = _converge(PushEngine(sg, sssp.make_batched_program(
+        [0, 5, 9]), gather="pagemajor"))
+    assert np.array_equal(ks_flat, ks_pm)
+
+    # integer-exact sum pull step (the established f32-exactness
+    # trick): flat vs pagemajor vs pagemajor+owner
+    vals = np.random.default_rng(2).integers(0, 8, g.nv).astype(
+        np.float32)
+
+    def mk():
+        return PullProgram(
+            reduce="sum",
+            edge_value=lambda s, d, w: s,
+            apply=lambda o, r, c: r,
+            init=lambda sgx: sgx.to_padded(vals))
+
+    a = PullEngine(sg, mk())
+    b = PullEngine(sg, mk(), gather="pagemajor")
+    c = PullEngine(sg, mk(), gather="pagemajor", exchange="owner")
+    ra = a.unpad(a.step(a.init_state()))
+    assert np.array_equal(ra, b.unpad(b.step(b.init_state())))
+    assert np.array_equal(ra, c.unpad(c.step(c.init_state())))
+
+
+def test_pagemajor_break_even_pinned():
+    from lux_tpu import scalemodel as sm
+
+    # the 150 ns pair-row machinery splits: 24 ns static row fetch +
+    # the compare-reduce/combine remainder
+    assert sm.VROW_REDUCE_NS == pytest.approx(150.0 - 24.0)
+    # full gather rows pay fetch+shuffle once; the virtual-row
+    # break-even undercuts the plain paged 23
+    assert sm.pagemajor_break_even_vfill() == 19
+    assert sm.pagemajor_break_even_vfill() < sm.page_break_even_fill()
+    # the routing hop is ~0.1 ns/edge at full rows — priced, small
+    assert 0.0 < sm.pagemajor_route_ns(128.0) < 0.2
+    assert sm.pagemajor_break_even_vfill(routed=True) >= \
+        sm.pagemajor_break_even_vfill()
+    with pytest.raises(ValueError, match="K-dim"):
+        sm.pagemajor_gather_ns(1.0, 128.0, 30.0, kdim=20)
+
+
+def test_resolve_gather_three_way():
+    """auto arbitration with the pm counting present: page-major wins
+    exactly when its modeled split rate undercuts both flat and
+    paged; without pm keys the old two-way behavior is unchanged."""
+    from lux_tpu import scalemodel as sm
+
+    # virtual fill below the paged break-even but above the
+    # page-major one, gather rows full -> pagemajor
+    st = dict(page_ratio=0.3, fill=20.0, padded_fill=20.0,
+              pm_padded_vfill=20.0, pm_g_padded_fill=120.0)
+    assert sm.pagemajor_gather_ns(0.3, 120.0, 20.0) \
+        < sm.GATHER_SMALL_NS < sm.page_gather_ns(0.3, 20.0)
+    assert resolve_gather("auto", st, 1 << 20) == "pagemajor"
+    # high fill: paged's single-level pipeline models cheaper than
+    # pm's extra virtual take whenever vfill ~ gfill
+    dense = dict(page_ratio=0.3, fill=120.0, padded_fill=120.0,
+                 pm_padded_vfill=120.0, pm_g_padded_fill=120.0)
+    assert resolve_gather("auto", dense, 1 << 20) == "paged"
+    # hopeless fills stay flat even with pm keys
+    sparse = dict(page_ratio=3.0, fill=2.0, padded_fill=2.0,
+                  pm_padded_vfill=2.0, pm_g_padded_fill=10.0)
+    assert resolve_gather("auto", sparse, 1 << 20) == "flat"
+    assert resolve_gather("pagemajor", sparse, 1 << 20) == "pagemajor"
+
+
+def test_pagemajor_guards():
+    """Typed refusals: K-dim (SDDMM) programs cannot take
+    gather='pagemajor'; pair_threshold conflicts like paged."""
+    from lux_tpu.apps import colfilter, pagerank
+
+    gw = _skewed_graph(15, 3 * W, 4000, weighted=True)
+    with pytest.raises(ValueError, match="K-dim|SDDMM"):
+        colfilter.build_engine(gw, num_parts=1, gather="pagemajor")
+    g = _skewed_graph(15, 3 * W, 4000)
+    with pytest.raises(ValueError, match="pair"):
+        pagerank.build_engine(g, num_parts=1, gather="pagemajor",
+                              pair_threshold=8)
+
+
+def test_pagemajor_ledger_prices_clean():
+    """memory_report(page_plan=pm plan) prices the plan arrays + the
+    gather-row buffer; the audit ledger check stays clean on a dense
+    pagemajor build."""
+    from lux_tpu import audit
+    from lux_tpu.apps import pagerank
+
+    r = np.random.default_rng(4)
+    g = Graph.from_edges(r.integers(0, 2048, 32768),
+                         r.integers(0, 2048, 32768), 2048)
+    eng = pagerank.build_engine(g, num_parts=2, gather="pagemajor")
+    assert eng.gather == "pagemajor"
+    rep = eng.sg.memory_report(page_plan=eng.page_plan)
+    assert rep["page_temp_bytes_per_part"] > 0
+    assert rep["edge_bytes_per_part"] > 0
+    findings = audit.audit_engine(eng, mode=None, ledger=True)
+    assert not [f for f in findings if f.severity == "error"], \
+        [str(f) for f in findings]
