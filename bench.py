@@ -124,7 +124,17 @@ DEFAULT_SHAPE = {"pagerank": (21, 16), "cc": (20, 16),
                  # records the plan's measured unique-page ratio /
                  # row fill on both lines (scripts/check_bench.py
                  # validates the fields)
-                 "gather-ab": (21, 16)}
+                 "gather-ab": (21, 16),
+                 # serving-tier SLO lines (round 17, lux_tpu/serve.py
+                 # + scripts/loadgen.py): `-config serve-slo` expands
+                 # over -rates into one open-loop load step per
+                 # offered rate; each line carries offered/achieved
+                 # qps, snapshot p50/p99 and the SLO good fraction
+                 # (scripts/check_bench.py rejects the contradictions:
+                 # p99 < p50, achieved > offered, fraction outside
+                 # [0, 1]).  The on-device run is carried as debt
+                 # serve-slo-on-device (lux_tpu/observe.py).
+                 "serve-slo": (12, 8)}
 
 # the batch-sweep expansion (one metric line per B per app)
 BATCH_SWEEP_DEFAULT = "1,8,64"
@@ -222,6 +232,98 @@ def bench_converge(eng, ne, verbose, repeats):
     return [ne * iters / e for e in elapsed], rerun
 
 
+def _rate_token(rate: float) -> str:
+    return f"{rate:g}".replace(".", "p").replace("-", "m")
+
+
+def run_serve_slo(config, args):
+    """One serve-slo line: an open-loop Poisson load step
+    (scripts/loadgen.py) at the offered rate named by
+    "serve-slo@RATE" against a mixed-kind continuous-batching Server
+    with per-kind latency SLOs.  The line's value/samples are the
+    MEASURED achieved qps; offered/achieved, the snapshot p50/p99 and
+    the SLO good fraction ride the line for scripts/check_bench.py's
+    contradiction rejects (p99 < p50, achieved > offered, fraction
+    outside [0, 1])."""
+    import itertools
+    import os
+
+    import numpy as np
+
+    sdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "scripts")
+    if sdir not in sys.path:
+        sys.path.insert(0, sdir)
+    import loadgen
+
+    from lux_tpu import serve, telemetry
+
+    _, _, rstr = config.partition("@")
+    rate = float(rstr) if rstr else 20.0
+    if not rate > 0:
+        # the bare-config expansion validates -rates; the @-form must
+        # reject too, or a zero rate hangs the submitter forever
+        raise ValueError(f"serve-slo offered rate must be > 0 qps, "
+                         f"got {rate}")
+    scale = args.scale or DEFAULT_SHAPE["serve-slo"][0]
+    ef = args.ef or DEFAULT_SHAPE["serve-slo"][1]
+    kinds = [k.strip() for k in args.serve_kinds.split(",")
+             if k.strip()]
+    slo = loadgen._parse_slo(args.slo_ms)
+    g = build_graph(scale, ef, args.verbose)
+    srv = serve.Server(g, batch=args.serve_batch, num_parts=args.np,
+                       seg_iters=2, slo_ms=slo, health=args.health)
+    extra = {"np": args.np, "scale": scale, "ef": ef,
+             "serve_batch": args.serve_batch, "kinds": kinds,
+             "queries": args.serve_queries, "unit": "qps"}
+    if args.audit != "off":
+        from lux_tpu import audit
+        findings = []
+        for k in kinds:
+            findings += audit.audit_engine(srv._runner(k).eng,
+                                           mode=None)
+        d = audit.digest(findings, mode=args.audit)
+        extra["audit"] = d
+        if d["errors"] and args.audit == "error":
+            audit.raise_findings(findings, where="serve-slo")
+        for f in findings:
+            print(f"# audit: {f}", file=sys.stderr)
+    loadgen.warm(srv, kinds)         # compile outside the load
+    rng = np.random.default_rng(7)   # fixed seed: one query schedule
+    steps = itertools.count()
+
+    def one_step():
+        step = next(steps)
+        rep = loadgen.run_step(srv, rate, args.serve_queries, kinds,
+                               rng, step=step)
+        telemetry.current().emit("timed_run", repeat=step,
+                                 iters=rep.served,
+                                 seconds=round(rep.elapsed_s, 6))
+        if not rep.drained:
+            raise RuntimeError(
+                f"serve-slo load step {step} did not drain "
+                f"({rep.served}/{rep.submitted})")
+        if rep.slo_good_fraction is None or rep.p50_ms is None:
+            raise RuntimeError(
+                f"serve-slo load step {step} produced no SLO "
+                f"accounting (slo_ms={slo!r})")
+        return rep
+
+    rep = one_step()
+    if args.verbose:
+        loadgen.render_table([rep], out=sys.stderr)
+    extra.update(offered_qps=round(rep.offered_qps, 4),
+                 achieved_qps=round(rep.achieved_qps, 4),
+                 p50_ms=round(rep.p50_ms, 4),
+                 p99_ms=round(rep.p99_ms, 4),
+                 slo_target_ms=slo,
+                 slo_good_fraction=round(rep.slo_good_fraction, 4),
+                 served=rep.served, submitted=rep.submitted)
+    name = f"serve_slo_q{_rate_token(rate)}_rmat{scale}"
+    return (name, [rep.achieved_qps], extra,
+            lambda: one_step().achieved_qps)
+
+
 def run_config(config, args):
     """Returns (name, gteps samples list, extra json fields,
     rerun() -> one more gteps sample)."""
@@ -229,6 +331,9 @@ def run_config(config, args):
     import numpy as np
 
     from lux_tpu.graph import pair_relabel
+
+    if config.startswith("serve-slo"):
+        return run_serve_slo(config, args)
 
     if config.startswith("gather-ab"):
         # paged-vs-flat A/B: "gather-ab@paged[:reorder]" names one
@@ -463,6 +568,9 @@ def emit(name, samples, extra, attempts=None, discarded=(),
     is detected, not medianed).  scripts/check_bench.py validates
     all of it.  Returns the line dict (artifact/ledger writers)."""
     gteps = median(samples)
+    # serve-slo lines are qps, not GTEPS — the unit names the metric
+    # suffix so the two families can never be conflated by name
+    unit = extra.get("unit", "GTEPS")
     per_query = {}
     if "batch" in extra:
         # the machine rate serves every query of the batch at once:
@@ -477,9 +585,9 @@ def emit(name, samples, extra, attempts=None, discarded=(),
                      "per_query_edge_ns": (round(1.0 / qg, 4)
                                            if qg > 0 else None)}
     result = {
-        "metric": f"{name}_gteps_per_chip",
+        "metric": f"{name}_{unit.lower()}_per_chip",
         "value": round(gteps, 4),
-        "unit": "GTEPS",
+        "unit": unit,
         "vs_baseline": round(gteps / 1.0, 4),
         **per_query,
         "samples": [round(s, 4) for s in samples],
@@ -589,6 +697,25 @@ def main() -> int:
     ap.add_argument("-all", action="store_true",
                     help="run every config (pagerank last; the "
                          "default when -config is not given)")
+    ap.add_argument("-rates", default="15,45",
+                    help="comma list of offered qps for the "
+                         "serve-slo config (one open-loop load step "
+                         "and one metric line per rate)")
+    ap.add_argument("-serve-queries", type=int, default=36,
+                    dest="serve_queries",
+                    help="queries per serve-slo load step")
+    ap.add_argument("-serve-batch", type=int, default=4,
+                    dest="serve_batch",
+                    help="serving engine column count B for "
+                         "serve-slo")
+    ap.add_argument("-serve-kinds",
+                    default="sssp,components,pagerank",
+                    dest="serve_kinds",
+                    help="mixed query kinds for the serve-slo load")
+    ap.add_argument("-slo-ms", dest="slo_ms",
+                    default="sssp=250,components=250,pagerank=1000",
+                    help="per-kind latency SLO targets for "
+                         "serve-slo, kind=ms comma list")
     ap.add_argument("-reorder", default="none",
                     choices=["none", "native", "hillclimb"],
                     help="page-aware vertex reorder for the "
@@ -735,6 +862,16 @@ def main() -> int:
             expanded += [f"ppr-batch@{b}" for b in batch_widths]
         elif c in ("ksssp-batch", "ppr-batch"):
             expanded += [f"{c}@{b}" for b in batch_widths]
+        elif c == "serve-slo":
+            try:
+                rates = [float(r) for r in args.rates.split(",")
+                         if r.strip()]
+            except ValueError:
+                ap.error(f"-rates must be a comma list of numbers, "
+                         f"got {args.rates!r}")
+            if not rates or any(r <= 0 for r in rates):
+                ap.error("-rates must be positive offered qps")
+            expanded += [f"serve-slo@{r:g}" for r in rates]
         elif c == "gather-ab":
             # one line per side, paged first (the headline of the
             # A/B); both carry the plan's page stats.  A reorder run

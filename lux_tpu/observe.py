@@ -711,6 +711,14 @@ DEBTS = (
          "path — the split constants (VROW_REDUCE_NS, the ICI row "
          "rate) are primitive-derived, not yet measured end-to-end",
          "PERF_NOTES round 16 (page-major routing)", min_ndev=2),
+    Debt("serve-slo-on-device",
+         "bench.py -config serve-slo (open-loop Poisson load vs the "
+         "continuous-batching Server, scripts/loadgen.py) on a live "
+         "tunnel: the latency-vs-offered-rate curve, the saturation "
+         "knee and the SLO good fraction are CPU-mesh-measured only; "
+         "on-device per-query latency (and the knee's position vs "
+         "the ~9/B ns/edge amortization) is unmeasured",
+         "PERF_NOTES round 17 (serving observability)"),
     Debt("batch-sweep-on-device",
          "bench.py -config batch-sweep (B in {1,8,64} k-source SSSP "
          "+ personalized PageRank) on a live tunnel: the modeled "

@@ -36,6 +36,21 @@ PRs 1-8 built:
   ``query_done`` events (latency, wait, iterations, segments) plus a
   ``serve_refill`` event per boundary — rendered and validated by
   scripts/events_summary.py.
+- streaming SLO metrics (round 17, lux_tpu/metrics.py): every Server
+  owns a metrics Registry (``metrics=`` to share or ``metrics=False``
+  to disable — the overhead-A/B switch) fed HOST-side at segment
+  boundaries only (the hot-path-metrics lint contract): queue depth
+  and collect wait-time on ``BatchCollector.collect``, batch
+  occupancy / refill and segment counters per ``BatchRunner``
+  boundary, per-kind latency histograms at retire, and — with
+  ``Server(slo_ms={kind: target_ms})`` — per-kind SLO accounting:
+  ``serve_slo_good_total`` / ``serve_slo_violation_total`` counters
+  plus a rolling burn-rate gauge (violating fraction over the last
+  ``SLO_WINDOW`` retirements; ARCHITECTURE.md "Serving metrics &
+  SLOs" has the series catalogue).  ``run()`` publishes a
+  ``metrics_snapshot`` telemetry event per drain; scripts/loadgen.py
+  reads the snapshots back and scripts/events_summary.py cross-audits
+  them against the raw ``query_done`` stream.
 
 Costs and debts: the refill path fetches the [nv, B] state at
 boundaries that retire or fill columns (host scatter + re-place) —
@@ -60,6 +75,12 @@ import numpy as np
 
 DEFAULT_SEG_ITERS = 4
 KINDS = ("sssp", "components", "pagerank")
+
+# rolling SLO burn-rate window: the violating fraction over the last
+# SLO_WINDOW retirements per kind (a short multi-batch horizon — long
+# enough to smooth one batch's retirements, short enough that a burn
+# shows within a few boundaries)
+SLO_WINDOW = 64
 
 
 @dataclasses.dataclass
@@ -98,13 +119,27 @@ class BatchCollector:
     batching rule.  ``put`` is called by ``Server.submit`` (any
     thread); ``collect(n, deadline_s)`` returns up to ``n`` requests,
     waiting at most ``deadline_s`` for the FIRST one and then taking
-    only what has already arrived (a deadline of 0 never blocks)."""
+    only what has already arrived (a deadline of 0 never blocks).
 
-    def __init__(self):
+    With ``metrics``/``kind`` set (Server wires them), ``put`` and
+    ``collect`` keep the ``serve_queue_depth`` gauge current and
+    ``collect`` observes each request's queue wait (enqueue ->
+    collection) into ``serve_wait_seconds`` — host-side, boundary-
+    cadence calls only."""
+
+    def __init__(self, metrics=None, kind: str | None = None):
         self._q: _queuemod.Queue = _queuemod.Queue()
+        self.metrics = metrics
+        self.kind = kind
+
+    def _depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("serve_queue_depth",
+                               kind=self.kind).set(self._q.qsize())
 
     def put(self, req: Request) -> None:
         self._q.put(req)
+        self._depth()
 
     def __len__(self) -> int:
         return self._q.qsize()
@@ -121,6 +156,13 @@ class BatchCollector:
                     out.append(self._q.get_nowait())
             except _queuemod.Empty:
                 break
+        if self.metrics is not None:
+            self._depth()
+            now = time.monotonic()
+            wait = self.metrics.histogram("serve_wait_seconds",
+                                          kind=self.kind)
+            for req in out:
+                wait.observe(max(0.0, now - req.t_enqueue))
         return out
 
 
@@ -141,13 +183,19 @@ class _RunnerBase:
     """Shared slot bookkeeping for one batched engine of width B."""
 
     def __init__(self, kind: str, B: int, seg_iters: int,
-                 max_segments: int):
+                 max_segments: int, metrics=None,
+                 slo_ms: float | None = None):
         self.kind = kind
         self.B = int(B)
         self.seg_iters = int(seg_iters)
         self.max_segments = int(max_segments)
         self.slots: list[_Slot | None] = [None] * self.B
         self.responses: list[Response] = []
+        self.metrics = metrics
+        self.slo_ms = None if slo_ms is None else float(slo_ms)
+        # rolling SLO window: True per retirement = violation
+        import collections
+        self._slo_window = collections.deque(maxlen=SLO_WINDOW)
 
     def _free_cols(self):
         return [c for c, s in enumerate(self.slots) if s is None]
@@ -176,12 +224,50 @@ class _RunnerBase:
             wait_s=slot.t_start - slot.req.t_enqueue,
             converged=converged)
         self.responses.append(resp)
+        slo = {}
+        if self.slo_ms is not None:
+            slo_ok = resp.latency_s * 1e3 <= self.slo_ms
+            slo = {"slo_ms": self.slo_ms, "slo_ok": slo_ok}
+            self._slo_window.append(not slo_ok)
+        if self.metrics is not None:
+            m = self.metrics
+            m.histogram("serve_latency_seconds",
+                        kind=self.kind).observe(resp.latency_s)
+            m.counter("serve_retired_total", kind=self.kind).inc()
+            if not converged:
+                m.counter("serve_segment_cap_total",
+                          kind=self.kind).inc()
+            if self.slo_ms is not None:
+                m.counter("serve_slo_good_total" if slo["slo_ok"]
+                          else "serve_slo_violation_total",
+                          kind=self.kind).inc()
+                burn = (sum(self._slo_window)
+                        / max(1, len(self._slo_window)))
+                m.gauge("serve_slo_burn_rate",
+                        kind=self.kind).set(burn)
         _emit("query_done", qid=resp.qid, query_kind=self.kind,
               col=col,
               iters=resp.iters, segments=resp.segments,
               latency_s=round(resp.latency_s, 6),
-              wait_s=round(resp.wait_s, 6), converged=converged)
+              wait_s=round(resp.wait_s, 6), converged=converged,
+              **slo)
         return resp
+
+    def _boundary_metrics(self, retired: int, filled: int,
+                          queued: int) -> None:
+        """Per-segment-boundary series (host-side by construction —
+        the drivers' on_segment hooks are the only callers): batch
+        occupancy, segment count, retire/refill rates."""
+        if self.metrics is None:
+            return
+        m = self.metrics
+        m.counter("serve_segments_total", kind=self.kind).inc()
+        m.gauge("serve_batch_occupancy",
+                kind=self.kind).set(len(self._occupied()))
+        m.gauge("serve_queue_depth", kind=self.kind).set(queued)
+        if filled:
+            m.counter("serve_refilled_total",
+                      kind=self.kind).inc(filled)
 
 
 class PushBatchRunner(_RunnerBase):
@@ -194,8 +280,10 @@ class PushBatchRunner(_RunnerBase):
                  mesh=None, exchange: str = "auto",
                  health: bool = False, weighted: bool = False,
                  seg_iters: int = DEFAULT_SEG_ITERS,
-                 max_segments: int = 10_000):
-        super().__init__(kind, B, seg_iters, max_segments)
+                 max_segments: int = 10_000, metrics=None,
+                 slo_ms: float | None = None):
+        super().__init__(kind, B, seg_iters, max_segments,
+                         metrics=metrics, slo_ms=slo_ms)
         self.g = g
         self.weighted = bool(weighted and kind == "sssp")
         placeholder = [0] * self.B
@@ -264,6 +352,7 @@ class PushBatchRunner(_RunnerBase):
             want_fill = len(collector) > 0 and (
                 done or self._free_cols())
             if not done and not want_fill:
+                self._boundary_metrics(0, 0, len(collector))
                 return None
             lab_h = sg.from_padded(np.asarray(jax.device_get(label)))
             act_h = sg.from_padded(np.asarray(jax.device_get(active)))
@@ -278,6 +367,8 @@ class PushBatchRunner(_RunnerBase):
                   retired=len(done),
                   filled=n_filled, occupied=len(self._occupied()),
                   queued=len(collector))
+            self._boundary_metrics(len(done), n_filled,
+                                   len(collector))
             return eng.place(sg.to_padded(lab_h), sg.to_padded(act_h))
 
         converge_segments(eng, label, active, self.seg_iters,
@@ -305,8 +396,10 @@ class PullBatchRunner(_RunnerBase):
                  mesh=None, exchange: str = "auto",
                  health: bool = False,
                  seg_iters: int = DEFAULT_SEG_ITERS,
-                 tol: float = 1e-8, max_segments: int = 500):
-        super().__init__(kind, B, seg_iters, max_segments)
+                 tol: float = 1e-8, max_segments: int = 500,
+                 metrics=None, slo_ms: float | None = None):
+        super().__init__(kind, B, seg_iters, max_segments,
+                         metrics=metrics, slo_ms=slo_ms)
         if kind != "pagerank":
             raise ValueError(f"unknown pull kind {kind!r}")
         from lux_tpu.apps import pagerank as app
@@ -377,6 +470,8 @@ class PullBatchRunner(_RunnerBase):
                       retired=len(done), filled=n_filled,
                       occupied=len(self._occupied()),
                       queued=len(collector))
+            self._boundary_metrics(len(done), n_filled,
+                                   len(collector))
             if not self._occupied() and not len(collector):
                 raise _Drained()
             prev = new
@@ -416,13 +511,21 @@ class Server:
     queue through continuous-batching refill and returns the
     responses in retirement order.  ``deadline_s`` is the batch
     collector's wait-for-more budget (0 = serve whatever is queued —
-    the offline/smoke mode)."""
+    the offline/smoke mode).
+
+    ``slo_ms`` maps query kinds to per-kind latency targets in
+    milliseconds (SLO good/violation counters + the rolling burn-rate
+    gauge); ``metrics`` is a lux_tpu.metrics.Registry to share, None
+    for a fresh private one, or False to disable metrics entirely
+    (the overhead-A/B switch, PERF_NOTES round 17)."""
 
     def __init__(self, g, batch: int = 4, *, num_parts: int = 1,
                  mesh=None, exchange: str = "auto",
                  health: bool = False, weighted: bool = False,
                  seg_iters: int = DEFAULT_SEG_ITERS,
-                 tol: float = 1e-8, deadline_s: float = 0.0):
+                 tol: float = 1e-8, deadline_s: float = 0.0,
+                 slo_ms: dict | None = None, metrics=None,
+                 snapshot_every_s: float = 1.0):
         self.g = g
         self.batch = int(batch)
         self.opts = dict(num_parts=num_parts, mesh=mesh,
@@ -431,6 +534,20 @@ class Server:
         self.seg_iters = int(seg_iters)
         self.tol = float(tol)
         self.deadline_s = float(deadline_s)
+        self.slo_ms = dict(slo_ms or {})
+        for k in self.slo_ms:
+            if k not in KINDS:
+                raise ValueError(f"slo_ms names unknown kind {k!r}; "
+                                 f"choose from {KINDS}")
+        if metrics is False:
+            self.metrics = None
+        elif metrics is None:
+            from lux_tpu import metrics as metrics_mod
+            self.metrics = metrics_mod.Registry()
+        else:
+            self.metrics = metrics
+        self.snapshot_every_s = float(snapshot_every_s)
+        self._last_snapshot = 0.0
         self._collectors: dict[str, BatchCollector] = {}
         self._runners: dict[str, _RunnerBase] = {}
         self._next_qid = 0
@@ -439,21 +556,44 @@ class Server:
         if kind not in KINDS:
             raise ValueError(f"unknown query kind {kind!r}; choose "
                              f"from {KINDS}")
-        return self._collectors.setdefault(kind, BatchCollector())
+        return self._collectors.setdefault(
+            kind, BatchCollector(metrics=self.metrics, kind=kind))
 
     def _runner(self, kind: str) -> _RunnerBase:
         if kind not in self._runners:
+            mkw = dict(metrics=self.metrics,
+                       slo_ms=self.slo_ms.get(kind))
             if kind == "pagerank":
                 self._runners[kind] = PullBatchRunner(
                     kind, self.g, self.batch,
                     seg_iters=self.seg_iters, tol=self.tol,
-                    **self.opts)
+                    **mkw, **self.opts)
             else:
                 self._runners[kind] = PushBatchRunner(
                     kind, self.g, self.batch,
                     weighted=self.weighted,
-                    seg_iters=self.seg_iters, **self.opts)
+                    seg_iters=self.seg_iters, **mkw, **self.opts)
         return self._runners[kind]
+
+    def set_metrics(self, registry) -> None:
+        """Re-point every collector and runner at ``registry`` (or
+        None to disable).  The load harness uses this to give each
+        ramp step a FRESH registry without rebuilding the engines —
+        series are fetched from the registry at use time, so the swap
+        is complete at the next boundary."""
+        self.metrics = registry
+        for coll in self._collectors.values():
+            coll.metrics = registry
+        for runner in self._runners.values():
+            runner.metrics = registry
+
+    def emit_metrics_snapshot(self, **extra):
+        """Publish a ``metrics_snapshot`` telemetry event for this
+        server's registry (None when metrics are disabled or no
+        event sink is active)."""
+        if self.metrics is None:
+            return None
+        return self.metrics.emit_snapshot(**extra)
 
     def submit(self, kind: str, source: int | None = None,
                reset=None) -> int:
@@ -464,6 +604,9 @@ class Server:
                       reset=(None if reset is None
                              else np.asarray(reset, np.float32)),
                       t_enqueue=time.monotonic())
+        if self.metrics is not None:
+            self.metrics.counter("serve_queries_total",
+                                 kind=kind).inc()
         self._collector(kind).put(req)
         _emit("query_enqueue", qid=qid, query_kind=kind,
               source=req.source, queued=len(self._collector(kind)))
@@ -472,11 +615,21 @@ class Server:
     def run(self) -> list[Response]:
         """Drain every kind's queue; returns responses in retirement
         order (continuous batching: later queries refill columns
-        freed by earlier retirements)."""
+        freed by earlier retirements).  Publishes a periodic
+        ``metrics_snapshot`` event (at most one per
+        ``snapshot_every_s`` of non-empty drains — the cadence a
+        long-lived serving loop rides; ``emit_metrics_snapshot()``
+        snapshots on demand)."""
         out: list[Response] = []
-        for kind, coll in self._collectors.items():
+        # list(): submit() may add a NEW kind's collector from a
+        # submitter thread while an open-loop drain iterates
+        for kind, coll in list(self._collectors.items()):
             while len(coll):
                 out += self._runner(kind).drain(coll, self.deadline_s)
+        now = time.monotonic()
+        if out and now - self._last_snapshot >= self.snapshot_every_s:
+            self._last_snapshot = now
+            self.emit_metrics_snapshot()
         return out
 
 

@@ -145,16 +145,143 @@ class EventLog:
     goes down as ONE ``os.write`` of one serialized buffer, so two
     processes' lines can never interleave mid-line (POSIX appends are
     atomic per write; buffered ``file.write`` may split a line across
-    syscalls)."""
+    syscalls).
 
-    def __init__(self, path: str | None = None):
+    ``rotate_bytes`` (round 17) bounds the on-disk JSONL for
+    long-lived serving processes: when an append would push the live
+    file past the threshold it is renamed to ``.1`` (existing
+    generations shift ``.1 -> .2``, the oldest beyond ``generations``
+    drops) and a fresh live file opens, stamped with a ``log_rotate``
+    event.  The line-atomic contract survives concurrency: rotation
+    runs under an flock'd ``<path>.lock`` sidecar, a writer that
+    lost the race just follows the rename (its fd still points at a
+    complete, un-torn generation; the path/inode check re-opens the
+    new live file on its next emit), and every write remains ONE
+    O_APPEND ``os.write`` to whichever generation the fd holds.
+    ``rotated_paths`` lists the generation set oldest-first —
+    scripts/events_summary.py and lux_tpu/tracing.py consume the
+    whole set as one stream.  Rotation also bounds the IN-MEMORY
+    ``self.events`` (trimmed to the newest ``MEM_KEEP`` at each
+    rotation — a log big enough to rotate is too big to keep whole
+    in RAM); index-stable ``self.events`` slicing is therefore
+    guaranteed only for non-rotating logs (bench.py's
+    ``config_telemetry`` relies on it and never rotates)."""
+
+    # in-memory events kept across a rotation (rotation cadence keeps
+    # RSS bounded at ~max(events-per-rotate_bytes, MEM_KEEP))
+    MEM_KEEP = 4096
+
+    def __init__(self, path: str | None = None,
+                 rotate_bytes: int | None = None,
+                 generations: int = 2):
+        if rotate_bytes is not None and rotate_bytes <= 0:
+            raise ValueError(f"rotate_bytes must be > 0, got "
+                             f"{rotate_bytes}")
+        if generations < 1:
+            raise ValueError(f"generations must be >= 1, got "
+                             f"{generations}")
         self.path = path
+        self.rotate_bytes = rotate_bytes
+        self.generations = int(generations)
+        self.rotations = 0
         self.events: list[dict] = []
-        self._fd = (os.open(path, os.O_WRONLY | os.O_CREAT
-                            | os.O_APPEND, 0o644)
-                    if path else None)
+        self._closed = False
+        self._fd = self._open() if path else None
+
+    def _open(self) -> int:
+        return os.open(self.path, os.O_WRONLY | os.O_CREAT
+                       | os.O_APPEND, 0o644)
+
+    def _swap_fd(self) -> None:
+        """Close the held fd and reopen the live path, keeping
+        ``self._fd`` VALID-OR-NONE at every step: a failed reopen
+        must leave None (the next emit retries the open), never a
+        stale closed descriptor that a later write would hit with
+        EBADF — or worse, that a reused descriptor number would turn
+        into silent writes to an unrelated file."""
+        fd, self._fd = self._fd, None
+        if fd is not None:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._fd = self._open()
+
+    def _maybe_rotate(self) -> None:
+        """Size-triggered rotation check run BEFORE the next event is
+        built (so the ``log_rotate`` stamp's monotonic ``tm`` stays
+        ordered before it; the live file may overshoot the threshold
+        by one line).  Three jobs: recover a sink lost to an earlier
+        failed reopen, follow a rotation another process performed
+        (path no longer names our inode -> reopen the new live
+        file), and rotate ourselves when the live file has crossed
+        ``rotate_bytes`` — shift generations, reopen, stamp the new
+        file with a ``log_rotate`` event."""
+        import fcntl
+        try:
+            if self._fd is None:
+                if not self._closed:
+                    self._fd = self._open()   # recover a lost sink
+                return
+            mine = os.fstat(self._fd)
+            try:
+                cur = os.stat(self.path)
+            except FileNotFoundError:
+                cur = None
+            if cur is None or (cur.st_dev, cur.st_ino) != \
+                    (mine.st_dev, mine.st_ino):
+                # someone else rotated: follow to the new live file
+                self._swap_fd()
+                return
+            if mine.st_size <= self.rotate_bytes:
+                return
+            lfd = os.open(self.path + ".lock",
+                          os.O_WRONLY | os.O_CREAT, 0o644)
+            try:
+                fcntl.flock(lfd, fcntl.LOCK_EX)
+                # re-check under the lock: a racing writer may have
+                # rotated while we waited
+                mine = os.fstat(self._fd)
+                try:
+                    cur = os.stat(self.path)
+                except FileNotFoundError:
+                    cur = None
+                rotated = False
+                if cur is not None \
+                        and (cur.st_dev, cur.st_ino) == \
+                            (mine.st_dev, mine.st_ino) \
+                        and mine.st_size > self.rotate_bytes:
+                    for g in range(self.generations - 1, 0, -1):
+                        src = f"{self.path}.{g}"
+                        if os.path.exists(src):
+                            os.replace(src, f"{self.path}.{g + 1}")
+                    os.replace(self.path, f"{self.path}.1")
+                    rotated = True
+                self._swap_fd()
+            finally:
+                fcntl.flock(lfd, fcntl.LOCK_UN)
+                os.close(lfd)
+            if rotated:
+                self.rotations += 1
+                if len(self.events) > self.MEM_KEEP:
+                    self.events = self.events[-self.MEM_KEEP:]
+                rot = make_event("log_rotate", {
+                    "path": self.path, "rotation": self.rotations,
+                    "rotate_bytes": self.rotate_bytes,
+                    "generations": self.generations})
+                self.events.append(rot)
+                os.write(self._fd, (json.dumps(rot) + "\n").encode())
+                _notify(rot)
+        except OSError:
+            # rotation is best-effort: a filesystem hiccup must never
+            # fail the emit (events always land in memory; _swap_fd
+            # guarantees the sink is valid-or-None for the write
+            # guard below)
+            pass
 
     def emit(self, kind: str, **fields) -> dict:
+        if self.path is not None and self.rotate_bytes is not None:
+            self._maybe_rotate()
         ev = make_event(kind, fields)
         self.events.append(ev)
         if self._fd is not None:
@@ -171,6 +298,7 @@ class EventLog:
         return out
 
     def close(self) -> None:
+        self._closed = True
         if self._fd is not None:
             os.close(self._fd)
             self._fd = None
@@ -180,6 +308,18 @@ class EventLog:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def rotated_paths(path: str) -> list[str]:
+    """The on-disk generation set of a (possibly rotated) event log,
+    OLDEST FIRST: [path.N, ..., path.1, path] for whichever
+    generations exist — concatenating them in this order reproduces
+    one stream whose per-process monotonic ``tm`` ordering holds.
+    A never-rotated log returns [path]."""
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    return [f"{path}.{g}" for g in range(n - 1, 0, -1)] + [path]
 
 
 class IterStats:

@@ -86,6 +86,12 @@ PROCESS_INSTANTS = {"mesh_shrink", "topology_fault", "replace",
 # timed_phases report keys that are counters, not phase seconds
 META_KEYS = ("frontier", "bucket", "advances")
 
+# per-query serving spans (round 17): query tracks start here, one
+# LANE per set of non-overlapping queries (greedy interval packing —
+# an oversubscribed load renders as stacked lanes whose depth IS the
+# concurrency), leaving tid 1..99 to the execution epochs
+QUERY_TID_BASE = 100
+
 
 def _num(x) -> bool:
     return isinstance(x, (int, float)) and not isinstance(x, bool) \
@@ -298,6 +304,101 @@ def _run_spans(run, us, trk: _Track, te: list):
             trk.shrink_labels[trk.epoch] = (
                 f"exec (after shrink #{trk.epoch}"
                 + (f", ndev={to}" if _num(to) else "") + ")")
+    _query_spans(run, times, trk, te, rstart, rend)
+
+
+def _merge_windows(windows):
+    """Sorted, overlap-merged [(s, e)] — sibling spans on one track
+    must be disjoint for the nesting validator."""
+    out = []
+    for s, e in sorted(windows):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _query_spans(run, times, trk: _Track, te: list, rstart, rend):
+    """Per-query serving spans (round 17, lux_tpu/serve.py events):
+    each retired query becomes a ``query`` span from its enqueue to
+    its retirement, with ``query_phase`` children splitting the life
+    into the queue WAIT (enqueue -> column assignment) and the
+    engine segments that carried it — so a query's wait-vs-compute
+    renders visibly in Perfetto.  Queries pack greedily onto
+    ``queries.N`` lanes (tid QUERY_TID_BASE+N, one lane per set of
+    non-overlapping queries); everything is clamped into the run
+    extent so the run-nesting invariant holds by construction, and
+    ``validate_trace`` machine-checks the query/query_phase nesting
+    rule."""
+    enq, start, done = {}, {}, {}
+    segs = []
+    for ev, ts in zip(run, times):
+        kind = ev["kind"]
+        qid = ev.get("qid")
+        if kind == "query_enqueue":
+            enq.setdefault(qid, ts)
+        elif kind == "query_start":
+            start[qid] = ts
+        elif kind == "query_done":
+            done[qid] = (ts, ev)
+        elif kind == "segment" and _num(ev.get("seconds")):
+            d = ev["seconds"] * 1e6
+            segs.append((ts - d, ts))
+    if not done:
+        return
+    segs = _merge_windows(segs)
+    qs = []
+    for qid, (tend, ev) in done.items():
+        t0 = enq.get(qid)
+        if t0 is None and _num(ev.get("latency_s")):
+            t0 = tend - ev["latency_s"] * 1e6
+        t1 = start.get(qid)
+        if t1 is None and t0 is not None and _num(ev.get("wait_s")):
+            t1 = t0 + ev["wait_s"] * 1e6
+        t0 = tend if t0 is None else t0
+        t1 = t0 if t1 is None else t1
+        t0 = min(max(t0, rstart), rend)          # clamp + order
+        t1 = min(max(t1, t0), rend)
+        tend = min(max(tend, t1), rend)
+        qs.append((t0, t1, tend, qid, ev))
+    qs.sort(key=lambda x: (x[0], x[2]))
+    lane_ends: list = []
+    for t0, t1, tend, qid, ev in qs:
+        lane = next((i for i, e in enumerate(lane_ends)
+                     if e <= t0), None)
+        if lane is None:
+            lane = len(lane_ends)
+            lane_ends.append(tend)
+            te.append(_meta("thread_name", trk.pid,
+                            f"queries.{lane}",
+                            tid=QUERY_TID_BASE + lane))
+        else:
+            lane_ends[lane] = tend
+        tid = QUERY_TID_BASE + lane
+        args = {k: v for k, v in ev.items()
+                if k in ("qid", "query_kind", "col", "iters",
+                         "segments", "latency_s", "wait_s",
+                         "converged", "slo_ms", "slo_ok")}
+        te.append(_span(f"q{qid} [{ev.get('query_kind', '?')}]",
+                        "query", t0, tend - t0, trk.pid, tid,
+                        args=args))
+        if t1 > t0:
+            te.append(_span("wait", "query_phase", t0, t1 - t0,
+                            trk.pid, tid))
+        resident = False
+        for s0, s1 in segs:
+            a, b = max(s0, t1), min(s1, tend)
+            if b > a:
+                te.append(_span("seg", "query_phase", a, b - a,
+                                trk.pid, tid))
+                resident = True
+        if not resident and tend > t1:
+            # no overlapping segment events (sparse log): one
+            # undifferentiated residency child keeps wait-vs-compute
+            # readable
+            te.append(_span("resident", "query_phase", t1,
+                            tend - t1, trk.pid, tid))
 
 
 def trace_export(events, out: str | None = None) -> dict:
@@ -363,15 +464,20 @@ _EPS_US = 2.0
 def validate_trace(trace, eps_us: float = _EPS_US) -> list[str]:
     """Machine-check a trace: known phases only, numeric
     ts/dur, PROPER NESTING per (pid, tid) track (two spans either
-    disjoint or one contains the other), and no orphan spans (every
-    non-run span lies inside some run span of its process).  Returns
-    error strings; empty = valid."""
+    disjoint or one contains the other), no orphan spans (every
+    non-run span lies inside some run span of its process), and —
+    round 17 — the per-query nesting rule: every ``query`` span
+    carries its qid, and every ``query_phase`` span (wait / seg /
+    resident) lies inside some ``query`` span of its own track.
+    Returns error strings; empty = valid."""
     errs: list[str] = []
     evs = trace.get("traceEvents") if isinstance(trace, dict) else None
     if not isinstance(evs, list) or not evs:
         return ["traceEvents missing or empty"]
     spans: dict = {}
     runs: dict = {}
+    qspans: dict = {}
+    qphases: dict = {}
     for i, e in enumerate(evs):
         if not isinstance(e, dict):
             errs.append(f"traceEvents[{i}]: not an object")
@@ -397,6 +503,18 @@ def validate_trace(trace, eps_us: float = _EPS_US) -> list[str]:
             if e.get("cat") == "run":
                 runs.setdefault(e.get("pid"), []).append(
                     (e["ts"], e["ts"] + e["dur"]))
+            elif e.get("cat") == "query":
+                if not isinstance((e.get("args") or {}).get("qid"),
+                                  int):
+                    errs.append(f"traceEvents[{i}] {e.get('name')!r}:"
+                                f" query span without an integer "
+                                f"args.qid")
+                qspans.setdefault((e.get("pid"), e.get("tid")),
+                                  []).append(
+                    (e["ts"], e["ts"] + e["dur"]))
+            elif e.get("cat") == "query_phase":
+                qphases.setdefault((e.get("pid"), e.get("tid")),
+                                   []).append(e)
     for (pid, tid), sp in spans.items():
         sp.sort(key=lambda e: (e["ts"], -e["dur"]))
         stack: list[float] = []
@@ -423,6 +541,20 @@ def validate_trace(trace, eps_us: float = _EPS_US) -> list[str]:
                        for rs, re in rl):
                 errs.append(f"orphan span {e['name']!r} (pid {pid}): "
                             f"[{s}, {end}] lies in no run span")
+    # round 17: a query phase (wait/seg/resident) outside every query
+    # span of its track is an orphan — the wait-vs-compute split
+    # would be attributed to no query
+    for key, phases in qphases.items():
+        ql = qspans.get(key, [])
+        for e in phases:
+            s, end = e["ts"], e["ts"] + e["dur"]
+            if not any(qs - eps_us <= s and end <= qe + eps_us
+                       for qs, qe in ql):
+                errs.append(
+                    f"track (pid {key[0]}, tid {key[1]}): "
+                    f"query_phase span {e['name']!r} [{s}, {end}] "
+                    f"lies in no query span — per-query phases must "
+                    f"nest inside their query")
     return errs
 
 
@@ -868,7 +1000,11 @@ def main(argv=None) -> int:
     workdir = args.workdir or tempfile.mkdtemp(prefix="lux_trace_")
     os.makedirs(workdir, exist_ok=True)
     if args.files:
-        paths = list(args.files)
+        # a rotated EventLog (rotate_bytes) leaves a .1/.2 generation
+        # set beside the live file: consume the whole set, oldest
+        # first, as one stream (telemetry.rotated_paths)
+        paths = [g for p in args.files
+                 for g in telemetry.rotated_paths(p)]
     elif args.drill:
         path = run_kill_drill(workdir)
         if path is None:

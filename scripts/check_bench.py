@@ -92,6 +92,15 @@ Checked per metric line:
   and labeled at the source, and its numbers never enter the
   trajectory silently.
 
+- serve-slo lines (round 17, bench.py -config serve-slo +
+  scripts/loadgen.py): the value is the measured achieved qps of one
+  open-loop Poisson load step; the line must carry offered_qps /
+  achieved_qps / p50_ms / p99_ms / slo_target_ms / slo_good_fraction
+  and is rejected on the contradictions an honest open-loop run
+  cannot produce: p99 < p50, achieved > offered, a good fraction
+  outside [0, 1], or a headline value disagreeing with the recorded
+  achieved rate.
+
 - telemetry.health (round 9, bench.py -health): the device-side
   watchdog digest — optional and null when off; present it must be a
   clean bill ({engine, tripped=false, flags=[], iters >= 0}; known
@@ -142,6 +151,17 @@ GATHER_AB_METRIC = re.compile(
     r"^pagerank_(paged|flat|pagemajor)_(?:(native|hillclimb)_)?"
     r"(rmat|comm)(\d+)_gteps_per_chip$")
 REORDER_METHODS = ("none", "native", "hillclimb")
+# round-17 serving SLO lines (bench.py -config serve-slo +
+# scripts/loadgen.py): one open-loop Poisson load step per line, the
+# value is the MEASURED achieved qps.  The line must carry the whole
+# latency-vs-offered-rate record (offered/achieved qps, snapshot
+# p50/p99 ms, the per-kind SLO targets and the good fraction), and
+# three contradictions reject outright: p99 < p50 (a percentile pair
+# no real distribution produces), achieved > offered (the open-loop
+# harness measures both from the same load-start clock, so service
+# cannot outrun arrivals), and an SLO good fraction outside [0, 1].
+SERVE_SLO_METRIC = re.compile(
+    r"^serve_slo_q([0-9pm]+)_rmat(\d+)_qps_per_chip$")
 
 
 def iter_metric_lines(path: str):
@@ -292,6 +312,8 @@ def check_line(obj: dict, *, legacy_ok: bool):
                                     m.group(1) if m else None,
                                     (m.group(2) or "none") if m
                                     else None)
+    if SERVE_SLO_METRIC.match(name) or "offered_qps" in obj:
+        errs += check_serve_slo_fields(name, obj)
     return errs, warns
 
 
@@ -448,6 +470,70 @@ def check_gather_fields(name: str, obj: dict,
         errs.append(f"{name}: page_fill={pf!r} must be a finite "
                     f"number in (0, 128] (live lanes per padded "
                     f"128-lane delivery row)")
+    return errs
+
+
+def check_serve_slo_fields(name: str, obj: dict) -> list[str]:
+    """Round-17 serving SLO lines (see SERVE_SLO_METRIC): the full
+    latency-vs-offered-rate record must be present, self-consistent
+    (value == achieved qps), and free of the three contradictions an
+    honest open-loop run cannot produce — p99 < p50, achieved >
+    offered, SLO good fraction outside [0, 1]."""
+    errs = []
+    missing = [k for k in ("offered_qps", "achieved_qps", "p50_ms",
+                           "p99_ms", "slo_target_ms",
+                           "slo_good_fraction") if k not in obj]
+    if missing:
+        errs.append(f"{name}: serve-slo line missing {missing}")
+    off, ach = obj.get("offered_qps"), obj.get("achieved_qps")
+    if off is not None and (not _is_num(off) or off <= 0):
+        errs.append(f"{name}: offered_qps={off!r} must be a finite "
+                    f"number > 0")
+        off = None
+    if ach is not None and (not _is_num(ach) or ach < 0):
+        errs.append(f"{name}: achieved_qps={ach!r} must be a finite "
+                    f"number >= 0")
+        ach = None
+    if off is not None and ach is not None \
+            and ach > off + 3e-4 * max(1.0, off):
+        errs.append(
+            f"{name}: achieved_qps={ach} > offered_qps={off} — the "
+            f"open-loop harness measures both from the load-start "
+            f"clock, so service cannot outrun arrivals; the line "
+            f"contradicts its own schedule")
+    if ach is not None and _is_num(obj.get("value")) \
+            and abs(obj["value"] - ach) > 2e-4 * max(1.0, ach):
+        errs.append(f"{name}: value={obj['value']} is not the "
+                    f"recorded achieved_qps ({ach}) — the headline "
+                    f"and the SLO record disagree")
+    p50, p99 = obj.get("p50_ms"), obj.get("p99_ms")
+    for k, v in (("p50_ms", p50), ("p99_ms", p99)):
+        if v is not None and (not _is_num(v) or v < 0):
+            errs.append(f"{name}: {k}={v!r} must be a finite "
+                        f"number >= 0")
+    if _is_num(p50) and _is_num(p99) \
+            and p99 < p50 - 2e-4 * max(1.0, p50):
+        errs.append(
+            f"{name}: p99_ms={p99} < p50_ms={p50} — no latency "
+            f"distribution has a 99th percentile under its median; "
+            f"the published percentile pair is a contradiction")
+    frac = obj.get("slo_good_fraction")
+    if frac is not None and (not _is_num(frac)
+                             or not 0.0 <= frac <= 1.0):
+        errs.append(f"{name}: slo_good_fraction={frac!r} must be a "
+                    f"finite number in [0, 1]")
+    tgt = obj.get("slo_target_ms")
+    if tgt is not None:
+        if _is_num(tgt):
+            ok = tgt > 0
+        elif isinstance(tgt, dict) and tgt:
+            ok = all(_is_num(v) and v > 0 for v in tgt.values())
+        else:
+            ok = False
+        if not ok:
+            errs.append(f"{name}: slo_target_ms={tgt!r} must be a "
+                        f"positive number or a non-empty "
+                        f"{{kind: positive ms}} dict")
     return errs
 
 

@@ -36,6 +36,20 @@ human shape — and audits it while doing so:
   means the merge key is lying).  ``calibration`` fingerprints and
   ``drift``/``phase_cost`` attribution events render.
 
+- round 17 (serving observability, lux_tpu/metrics.py + serve.py):
+  ``metrics_snapshot`` events render the per-kind latency table
+  (count / p50 / p99 from the snapshot's log-linear histograms),
+  queue depths and the SLO burn record — and are CROSS-AUDITED
+  against the raw ``query_done`` stream: a snapshot whose
+  ``serve_latency_seconds`` histogram claims MORE retired queries of
+  a kind than ``query_done`` events exist in the run FAILS (the
+  established contradiction-check pattern), as does a histogram
+  whose ``count`` disagrees with the sum of its own bucket cells or
+  whose p99 lies under its p50.  ``log_rotate`` markers render, and
+  every FILE argument is expanded to its rotated ``.2/.1/live``
+  generation set (telemetry.EventLog(rotate_bytes=...)) and
+  consumed, oldest first, as ONE stream.
+
 - round 13 (tracing & imbalance attribution, lux_tpu/tracing.py):
   ``iter_stats`` digests carrying per-part counters render a
   per-part table with the imbalance index, and the AUDIT checks that
@@ -57,6 +71,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 KNOWN = {"run_start", "config_start", "header", "timed_run",
@@ -67,7 +82,8 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "health_trip", "topology_fault", "mesh_shrink", "replace",
          "straggler", "calibration", "phase_cost", "drift",
          "debt_collected", "heartbeat", "flight_dump",
-         "query_enqueue", "query_start", "query_done", "serve_refill"}
+         "query_enqueue", "query_start", "query_done", "serve_refill",
+         "metrics_snapshot", "log_rotate"}
 
 # a query_done without these cannot account for the query's cost —
 # the serving front-end's per-query latency contract (lux_tpu/serve.py)
@@ -89,6 +105,17 @@ def _shrink_pair(ev):
                 and isinstance(t, int) and not isinstance(t, bool)):
             return f, t
     return None
+
+
+def rotated_set(path: str) -> list[str]:
+    """[path.N, ..., path.1, path] — the oldest-first generation set
+    a size-rotated EventLog leaves behind (mirrors
+    lux_tpu.telemetry.rotated_paths; re-implemented so this script
+    stays stdlib-only)."""
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    return [f"{path}.{g}" for g in range(n - 1, 0, -1)] + [path]
 
 
 def load_events(path: str):
@@ -220,6 +247,108 @@ def render_parts_table(title, st, out) -> list[str]:
             errs.append(
                 f"{title}: imbalance {imb} contradicts its own "
                 f"per-part totals (max/mean = {want:.4f})")
+    return errs
+
+
+def render_metrics_snapshot(title, snap, qdone_by_kind, out,
+                            render: bool = True,
+                            truncated: bool = False) -> list[str]:
+    """Round-17 serving snapshot (lux_tpu/metrics.py): render the
+    per-kind latency table, queue depths and SLO burn — and audit it
+    against the raw query_done stream: a snapshot claiming MORE
+    retired queries of a kind than query_done events exist is lying
+    about the stream it aggregates (the contradiction-check
+    pattern), as is a histogram whose count disagrees with its own
+    bucket cells or whose p99 undercuts its p50.  ``truncated``
+    disarms the overcount check ONLY: when rotation dropped
+    generations (more rotations than kept generations), the raw
+    stream is known-incomplete and a cumulative registry count
+    legitimately exceeds the surviving query_done events."""
+    errs = []
+    step = f" (step {snap['step']})" if "step" in snap else ""
+    hists = snap.get("histograms")
+    gauges = snap.get("gauges") or []
+    counters = snap.get("counters") or []
+    if not isinstance(hists, list):
+        return [f"{title}: metrics_snapshot without a histograms "
+                f"list: {snap!r}"[:200]]
+    lat = [h for h in hists
+           if h.get("name") == "serve_latency_seconds"]
+    if lat and render:
+        print(f"  metrics snapshot{step} — per-kind latency:",
+              file=out)
+    for h in lat:
+        kind = (h.get("labels") or {}).get("kind", "?")
+        count, buckets = h.get("count"), h.get("buckets")
+        if not _is_int(count) or count < 0:
+            errs.append(f"{title}: snapshot latency histogram "
+                        f"[{kind}] non-int count {count!r}")
+            continue
+        if isinstance(buckets, dict):
+            cells = sum(int(v) for v in buckets.values())
+            if cells != count:
+                errs.append(
+                    f"{title}: snapshot latency histogram [{kind}] "
+                    f"count {count} != sum of its bucket cells "
+                    f"{cells} — the histogram contradicts itself")
+        seen = qdone_by_kind.get(kind, 0)
+        if count > seen and not truncated:
+            errs.append(
+                f"{title}: metrics snapshot claims {count} retired "
+                f"{kind!r} queries but only {seen} query_done "
+                f"event(s) exist — the snapshot contradicts the raw "
+                f"per-query stream")
+        p50, p99 = h.get("p50"), h.get("p99")
+        if _is_num(p50) and _is_num(p99) and p99 < p50:
+            errs.append(f"{title}: snapshot latency histogram "
+                        f"[{kind}] p99 {p99} < p50 {p50}")
+        if render:
+            p50s = "-" if not _is_num(p50) else f"{p50 * 1e3:8.1f}ms"
+            p99s = "-" if not _is_num(p99) else f"{p99 * 1e3:8.1f}ms"
+            print(f"    {kind:12s} count {count:>5d}  "
+                  f"p50 {p50s:>10s}  p99 {p99s:>10s}", file=out)
+    def _gval(g, what):
+        """Numeric gauge/counter value or an audit error (a
+        malformed trail must FAIL the render, never crash it)."""
+        v = g.get("value")
+        if _is_num(v):
+            return v
+        errs.append(f"{title}: snapshot {what} "
+                    f"[{(g.get('labels') or {}).get('kind', '?')}] "
+                    f"non-numeric value {v!r}")
+        return None
+
+    depths = [g for g in gauges
+              if g.get("name") == "serve_queue_depth"]
+    dvals = [(g, _gval(g, "queue-depth gauge")) for g in depths]
+    if depths and render:
+        cells = "  ".join(
+            f"{(g.get('labels') or {}).get('kind', '?')}="
+            f"{'?' if v is None else f'{v:g}'}" for g, v in dvals)
+        print(f"    queue depth: {cells}", file=out)
+    burn = [g for g in gauges
+            if g.get("name") == "serve_slo_burn_rate"]
+    slo_counts = {}
+    for c in counters:
+        if c.get("name") in ("serve_slo_good_total",
+                             "serve_slo_violation_total"):
+            kind = (c.get("labels") or {}).get("kind", "?")
+            key = "good" if c["name"].endswith("good_total") \
+                else "bad"
+            slo_counts.setdefault(kind, {})[key] = c.get("value")
+    bvals = [(g, _gval(g, "burn-rate gauge")) for g in burn]
+    if (burn or slo_counts) and render:
+        def num(v):
+            return f"{v:g}" if _is_num(v) else "?"
+
+        cells = []
+        for g, v in bvals:
+            kind = (g.get("labels") or {}).get("kind", "?")
+            gb = slo_counts.get(kind, {})
+            cells.append(f"{kind}: burn {num(v)} "
+                         f"(good {num(gb.get('good', 0))} / viol "
+                         f"{num(gb.get('bad', 0))})")
+        print(f"    SLO burn: {'; '.join(cells)}", file=out)
     return errs
 
 
@@ -459,6 +588,34 @@ def render_run(run, out=sys.stdout) -> list[str]:
             print(f"  continuous batching: {len(refills)} refill "
                   f"boundary(ies), {live} retire+refill", file=out)
 
+    # round 17: serving metrics snapshots, cross-audited against the
+    # raw query_done stream they claim to aggregate
+    qdone_by_kind = {}
+    for q in by.get("query_done", []):
+        k = q.get("query_kind", "?")
+        qdone_by_kind[k] = qdone_by_kind.get(k, 0) + 1
+    # the live file's newest log_rotate carries the cumulative
+    # rotation count: more rotations than kept generations means the
+    # oldest query_done events were dropped with their generation, so
+    # the overcount audit would indict an honest long-lived trail
+    truncated = any(
+        _is_int(lr.get("rotation")) and _is_int(lr.get("generations"))
+        and lr["rotation"] > lr["generations"]
+        for lr in by.get("log_rotate", []))
+    snaps = by.get("metrics_snapshot", [])
+    for i, snap in enumerate(snaps):
+        # audit EVERY snapshot; render only the newest (the periodic
+        # cadence otherwise floods the table)
+        errs += render_metrics_snapshot(title, snap, qdone_by_kind,
+                                        out,
+                                        render=i == len(snaps) - 1,
+                                        truncated=truncated)
+    for lr in by.get("log_rotate", []):
+        print(f"  log rotated (#{lr.get('rotation')}): "
+              f"{lr.get('path')} -> .1 at {lr.get('rotate_bytes')} "
+              f"bytes, {lr.get('generations')} generation(s) kept",
+              file=out)
+
     done = by.get("run_done", [])
     if done:
         total = sum(seconds_of("run_done"))
@@ -559,8 +716,18 @@ def main(argv=None) -> int:
             return 1
         return 0
     for path in args.files:
+        # a rotated EventLog (telemetry rotate_bytes) leaves .1/.2
+        # generations beside the live file: consume the whole set,
+        # oldest first, as ONE stream — runs spanning a rotation must
+        # not split at the file boundary
+        gens = rotated_set(path)
+        events, errs = [], []
         try:
-            events, errs = load_events(path)
+            for gen in gens:
+                evs, es = load_events(gen)
+                events += evs
+                errs += [e if len(gens) == 1 else f"{gen}: {e}"
+                         for e in es]
         except OSError as e:
             all_errs.append(f"{path}: unreadable ({e})")
             continue

@@ -32,6 +32,20 @@ be seen from a jaxpr (CLAUDE.md "Conventions"):
                 mirroring the app-module oracle-presence check, so a
                 new per-part counter variant cannot ship without its
                 sum-over-parts-bitwise proof.
+  hot-path-metrics
+                No metrics call (``metrics.counter(...)``,
+                ``self.metrics.histogram(...).observe(...)``, any
+                call whose target chain references a ``metrics``
+                name or attribute — lux_tpu/metrics.py) may appear
+                inside engine device code (lux_tpu/engine/,
+                lux_tpu/ops/) or inside a fused-loop body (a
+                function handed to ``fori_loop``/``while_loop``/
+                ``scan``) anywhere in the tree.  Metrics are
+                HOST-side, segment-boundary-only by contract — the
+                same rationale as the audited callback-in-loop ban:
+                a metrics call in a traced loop body either bakes a
+                host callback into the fused program or silently
+                records nothing per iteration.
   bench-fence   (scripts/ only) No ``block_until_ready`` fencing in
                 benchmark scripts: it can return early through the
                 axon tunnel AND lets XLA hoist loop-invariant work,
@@ -455,6 +469,86 @@ def check_part_stats_oracle(path, tree, lines):
 
 
 # ---------------------------------------------------------------------
+# check: no metrics calls in engine device code / fused-loop bodies
+
+# callable POSITIONAL slots per loop primitive (fori_loop(lo, hi,
+# body, init): only arg 2 is traced code — treating bounds/init
+# Names as body functions would scan unrelated same-named helpers)
+LOOP_BODY_ARGS = {"fori_loop": (2,), "while_loop": (0, 1),
+                  "scan": (0,)}
+LOOP_BODY_KEYWORDS = {"body_fun", "cond_fun", "f", "body"}
+
+
+def _references_metrics(expr) -> bool:
+    """Does this call-target expression reach through a ``metrics``
+    name or attribute (``metrics.counter(...)``,
+    ``self.metrics.histogram(...).observe(...)``)?"""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id == "metrics":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "metrics":
+            return True
+    return False
+
+
+def _loop_body_targets(tree):
+    """AST nodes whose bodies trace into fused loops: functions
+    passed by name — and lambdas passed inline — in the CALLABLE
+    slots of fori_loop/while_loop/scan calls (positional body/cond
+    slots + the body_fun/cond_fun/f keywords; bounds and init-state
+    arguments are data, never loop bodies)."""
+    body_names, lambdas = set(), []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        fname = f.attr if isinstance(f, ast.Attribute) \
+            else getattr(f, "id", None)
+        if fname not in LOOP_BODY_ARGS:
+            continue
+        slots = [n.args[i] for i in LOOP_BODY_ARGS[fname]
+                 if i < len(n.args)]
+        slots += [kw.value for kw in n.keywords
+                  if kw.arg in LOOP_BODY_KEYWORDS]
+        for a in slots:
+            if isinstance(a, ast.Name):
+                body_names.add(a.id)
+            elif isinstance(a, ast.Lambda):
+                lambdas.append(a)
+    return lambdas + [n for n in ast.walk(tree)
+                      if isinstance(n, ast.FunctionDef)
+                      and n.name in body_names]
+
+
+def check_hot_path_metrics(path, tree, lines, whole_file: bool):
+    """Flag metrics calls in device code (see module docstring):
+    the WHOLE file for engine/ops modules, fused-loop bodies
+    everywhere else in the library tree."""
+    findings = []
+    targets = [tree] if whole_file else _loop_body_targets(tree)
+    seen = set()
+    for t in targets:
+        for n in ast.walk(t):
+            if not (isinstance(n, ast.Call)
+                    and _references_metrics(n.func)):
+                continue
+            line = getattr(n, "lineno", 1)
+            if line in seen or _suppressed(lines, line,
+                                           "hot-path-metrics"):
+                continue
+            seen.add(line)
+            where = ("engine device code" if whole_file
+                     else "a fused-loop body")
+            findings.append(Finding(
+                path, line, "hot-path-metrics",
+                f"metrics call inside {where} — metrics are "
+                f"host-side, segment-boundary only "
+                f"(lux_tpu/metrics.py contract; the audited "
+                f"callback-in-loop ban's source-level twin)"))
+    return findings
+
+
+# ---------------------------------------------------------------------
 # check: no block_until_ready fencing in benchmark scripts
 
 
@@ -505,6 +599,10 @@ def lint_file(path: str):
         # conventions (jit closures, oracles, citations)
         return check_bench_fence(path, tree, lines)
     findings = check_jit_closures(path, tree, lines)
+    findings += check_hot_path_metrics(
+        path, tree, lines,
+        whole_file=("/lux_tpu/engine/" in norm
+                    or "/lux_tpu/ops/" in norm))
     if "/lux_tpu/apps/" in norm:
         findings += check_oracle(path, tree, lines)
     if "/lux_tpu/engine/" in norm or "/lux_tpu/ops/" in norm:

@@ -400,6 +400,62 @@ def test_lint_detects_and_suppresses(tmp_path):
     assert r.returncode == 0, r.stderr
 
 
+def test_lint_hot_path_metrics(tmp_path):
+    """Round-17 hot-path-metrics check: a metrics call inside engine
+    device code or a fused-loop body is flagged (metrics are
+    host-side, segment-boundary only); host-side calls outside loop
+    bodies pass, and the pragma suppresses per convention."""
+    eng = tmp_path / "lux_tpu" / "engine"
+    eng.mkdir(parents=True)
+    bad_eng = eng / "bad.py"
+    bad_eng.write_text(
+        '"""Demo engine. reference pull_model.inl:423"""\n\n\n'
+        "def build(metrics):\n"
+        "    metrics.counter('x').inc()\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(bad_eng)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "hot-path-metrics" in r.stderr
+
+    loopy = tmp_path / "lux_tpu" / "loopy.py"
+    loopy.write_text(
+        "import jax\n\n\n"
+        "def run(self):\n"
+        "    def body(i, c):\n"
+        "        self.metrics.gauge('g').set(i)\n"
+        "        return c\n"
+        "    return jax.lax.fori_loop(0, 3, body, 0)\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(loopy)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "fused-loop body" in r.stderr
+
+    # host-side (boundary) calls outside loop bodies are the contract
+    fine = tmp_path / "lux_tpu" / "fine.py"
+    fine.write_text(
+        "def boundary(self, queued):\n"
+        "    self.metrics.gauge('serve_queue_depth').set(queued)\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(fine)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+    loopy.write_text(
+        "import jax\n\n\n"
+        "def run(self):\n"
+        "    def body(i, c):\n"
+        "        # audit: allow(hot-path-metrics) test fixture\n"
+        "        self.metrics.gauge('g').set(i)\n"
+        "        return c\n"
+        "    return jax.lax.fori_loop(0, 3, body, 0)\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(loopy)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+
 def test_lint_batched_oracle_coverage(tmp_path):
     """An app module shipping a batched builder without its batched
     oracle is flagged (ROADMAP item 2 oracle-first contract); adding

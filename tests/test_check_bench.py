@@ -517,6 +517,58 @@ def test_bad_batched_lines_fail(tmp_path, mutate, needle):
     assert needle in r.stderr, r.stderr
 
 
+# -- round-17 serving SLO lines (bench.py -config serve-slo) -----------
+
+SERVE_SLO_LINE = {
+    "metric": "serve_slo_q45_rmat12_qps_per_chip",
+    "value": 41.2, "unit": "qps", "vs_baseline": 41.2,
+    "samples": [41.2], "attempts": 1, "discarded": [],
+    "np": 2, "scale": 12, "ef": 8, "serve_batch": 4,
+    "kinds": ["sssp", "components", "pagerank"], "queries": 36,
+    "offered_qps": 44.8, "achieved_qps": 41.2,
+    "p50_ms": 18.4, "p99_ms": 61.0,
+    "slo_target_ms": {"sssp": 250.0, "components": 250.0,
+                      "pagerank": 1000.0},
+    "slo_good_fraction": 0.972,
+    "served": 35, "submitted": 36,
+    "telemetry": {"runs": [{"repeat": 0, "iters": 35,
+                            "seconds": 0.85}],
+                  "counters": None},
+    "calibration": GOOD_CAL,
+}
+
+
+def test_serve_slo_line_passes_strict(tmp_path):
+    r = _audit_one(tmp_path, SERVE_SLO_LINE)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    # the three contradiction rejects of the round-17 schema
+    (lambda o: o.update(p99_ms=9.0), "p99_ms=9.0 < p50_ms"),
+    (lambda o: o.update(achieved_qps=50.0, value=50.0,
+                        samples=[50.0]), "outrun arrivals"),
+    (lambda o: o.update(slo_good_fraction=1.2), "slo_good_fraction"),
+    (lambda o: o.update(slo_good_fraction=-0.1),
+     "slo_good_fraction"),
+    # record completeness + self-consistency
+    (lambda o: o.pop("offered_qps"), "serve-slo line missing"),
+    (lambda o: o.pop("slo_target_ms"), "serve-slo line missing"),
+    (lambda o: o.update(value=12.0, samples=[12.0]),
+     "achieved_qps"),
+    (lambda o: o.update(offered_qps=-3.0), "offered_qps"),
+    (lambda o: o.update(slo_target_ms={}), "slo_target_ms"),
+    (lambda o: o.update(slo_target_ms={"sssp": 0}), "slo_target_ms"),
+    (lambda o: o.update(p50_ms="fast"), "p50_ms"),
+])
+def test_bad_serve_slo_lines_fail(tmp_path, mutate, needle):
+    obj = json.loads(json.dumps(SERVE_SLO_LINE))
+    mutate(obj)
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 1, "audit passed a bad serve-slo line"
+    assert needle in r.stderr, r.stderr
+
+
 # ---------------------------------------------------------------------
 # round 16: gather-ab reorder field + pairing rule
 

@@ -461,3 +461,151 @@ def test_replace_without_mesh_pair_fails(tmp_path):
     r = run_summary(p)
     assert r.returncode == 1
     assert "replace" in r.stderr
+
+
+# -- round-17 serving observability: metrics_snapshot cross-audit ------
+
+def _snapshot_event(count=2, buckets=None, p50=0.01, p99=0.02,
+                    kind="sssp"):
+    return {
+        "t": 1.5, "kind": "metrics_snapshot", "schema": 1,
+        "counters": [
+            {"name": "serve_slo_good_total",
+             "labels": {"kind": kind}, "value": count},
+            {"name": "serve_slo_violation_total",
+             "labels": {"kind": kind}, "value": 0},
+        ],
+        "gauges": [
+            {"name": "serve_queue_depth", "labels": {"kind": kind},
+             "value": 0},
+            {"name": "serve_slo_burn_rate", "labels": {"kind": kind},
+             "value": 0.0},
+        ],
+        "histograms": [
+            {"name": "serve_latency_seconds",
+             "labels": {"kind": kind}, "count": count, "sum": 0.03,
+             "min": 0.01, "max": 0.02, "p50": p50, "p90": p99,
+             "p99": p99,
+             "buckets": {"800": count} if buckets is None
+             else buckets},
+        ],
+    }
+
+
+def _qdone(qid, kind="sssp"):
+    return {"t": 1.2 + qid * 0.01, "kind": "query_done", "qid": qid,
+            "query_kind": kind, "iters": 3, "segments": 1,
+            "latency_s": 0.015, "wait_s": 0.001}
+
+
+def _serve_run(snapshot, n_done=2):
+    evs = [{"t": 1.0, "kind": "run_start", "app": "serve"}]
+    evs += [{"t": 1.1 + q * 0.01, "kind": "query_enqueue", "qid": q,
+             "query_kind": "sssp"} for q in range(n_done)]
+    evs += [_qdone(q) for q in range(n_done)]
+    evs.append(snapshot)
+    return evs
+
+
+def test_metrics_snapshot_renders(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _serve_run(_snapshot_event()))
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "metrics snapshot" in r.stdout
+    assert "per-kind latency" in r.stdout
+    assert "queue depth: sssp=0" in r.stdout
+    assert "SLO burn: sssp" in r.stdout
+
+
+def test_snapshot_overcount_contradiction_fails(tmp_path):
+    """THE round-17 contradiction: a snapshot claiming MORE retired
+    queries than query_done events exist is lying about the stream
+    it aggregates."""
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _serve_run(_snapshot_event(count=5,
+                                            buckets={"800": 5})))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "contradicts the raw per-query stream" in r.stderr
+
+
+def test_snapshot_bucket_count_mismatch_fails(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _serve_run(_snapshot_event(count=2,
+                                            buckets={"800": 3})))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "bucket cells" in r.stderr
+
+
+def test_snapshot_percentile_inversion_fails(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _serve_run(_snapshot_event(p50=0.05, p99=0.01)))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "p99" in r.stderr
+
+
+def test_rotated_file_set_consumed_as_one_stream(tmp_path):
+    """A size-rotated EventLog's .1 + live generations render as ONE
+    run: the snapshot in the live file audits against query_done
+    events that rotated into the older generation."""
+    evs = _serve_run(_snapshot_event())
+    split = len(evs) - 1
+    p = tmp_path / "ev.jsonl"
+    write_log(Path(str(p) + ".1"), evs[:split])
+    write_log(p, [{"t": 1.45, "kind": "log_rotate",
+                   "path": str(p), "rotation": 1,
+                   "rotate_bytes": 1000, "generations": 2}]
+              + evs[split:])
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "log rotated" in r.stdout
+    assert "metrics snapshot" in r.stdout
+    # the set is what saves it: the live generation's content ALONE
+    # (no .1 sibling) overcounts — the snapshot's retirements rotated
+    # into the older file — and the audit fails it
+    alone = tmp_path / "alone.jsonl"
+    alone.write_text(p.read_text())
+    r_alone = run_summary(alone)
+    assert r_alone.returncode == 1
+    assert "contradicts the raw per-query stream" in r_alone.stderr
+
+
+def test_snapshot_malformed_gauge_fails_not_crashes(tmp_path):
+    """A gauge missing its value must produce a NAMED audit error,
+    never a TypeError traceback (the malformed-health-event rule
+    applied to snapshots)."""
+    snap = _snapshot_event()
+    snap["gauges"][0].pop("value")
+    snap["gauges"][1]["value"] = "hot"
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _serve_run(snap))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "non-numeric value" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+def test_snapshot_overcount_disarmed_by_rotation_truncation(
+        tmp_path):
+    """A long-lived trail whose oldest generations were DROPPED by
+    rotation (rotation count > kept generations) legitimately shows
+    fewer query_done events than the cumulative registry count — the
+    overcount audit must stand down, while the self-consistency
+    checks (bucket cells, p99 >= p50) stay armed."""
+    evs = _serve_run(_snapshot_event(count=5, buckets={"800": 5}))
+    evs.insert(1, {"t": 1.05, "kind": "log_rotate",
+                   "path": "ev.jsonl", "rotation": 3,
+                   "rotate_bytes": 1000, "generations": 2})
+    p = tmp_path / "ev.jsonl"
+    write_log(p, evs)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    # rotations within the kept window keep the audit armed
+    evs[1]["rotation"] = 2
+    write_log(p, evs)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "contradicts the raw per-query stream" in r.stderr

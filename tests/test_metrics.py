@@ -1,0 +1,267 @@
+"""lux_tpu/metrics.py: the streaming SLO metrics subsystem.
+
+Acceptance bars under test:
+- histogram quantiles agree with a NumPy nearest-rank oracle within
+  the PINNED error bound (metrics.QUANTILE_REL_ERR — the log-linear
+  bucket geometry's published guarantee);
+- merge is lossless and associative (bucket-wise add), so per-kind /
+  per-replica series combine into one distribution exactly;
+- labels isolate series; type punning a name is a hard error;
+- the Prometheus text exposition round-trips (cumulative le buckets
+  reparse to the exact per-bucket counts and _sum/_count);
+- the metrics_snapshot event schema is JSON-ready, self-consistent
+  (count == sum of bucket cells) and rebuilds into a mergeable
+  histogram (Histogram.from_snapshot);
+- the stdlib-http /metrics endpoint serves the exposition.
+"""
+
+import json
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lux_tpu import metrics, telemetry
+
+
+def fill(values):
+    h = metrics.Histogram()
+    for v in values:
+        h.observe(float(v))
+    return h
+
+
+# ---------------------------------------------------------------------
+# quantile accuracy vs the NumPy oracle, at the pinned bound
+
+@pytest.mark.parametrize("dist,seed", [
+    ("lognormal", 0), ("lognormal", 1), ("exponential", 2),
+    ("uniform", 3)])
+def test_quantile_accuracy_within_pinned_bound(dist, seed):
+    """Histogram quantiles vs NumPy's nearest-rank (inverted_cdf)
+    oracle: relative error must stay under the PINNED
+    QUANTILE_REL_ERR for every standard quantile — this is the bound
+    the serving SLO numbers inherit."""
+    rng = np.random.default_rng(seed)
+    if dist == "lognormal":
+        xs = rng.lognormal(mean=-3.0, sigma=1.5, size=5000)
+    elif dist == "exponential":
+        xs = rng.exponential(scale=0.05, size=5000)
+    else:
+        xs = rng.uniform(1e-4, 10.0, size=5000)
+    h = fill(xs)
+    for q in (0.5, 0.9, 0.99, 0.999):
+        oracle = float(np.quantile(xs, q, method="inverted_cdf"))
+        got = h.quantile(q)
+        assert abs(got - oracle) / oracle <= metrics.QUANTILE_REL_ERR, \
+            (dist, q, got, oracle)
+
+
+def test_quantile_edges_and_exact_scalars():
+    xs = [0.001, 0.002, 0.004, 0.008, 0.016]
+    h = fill(xs)
+    assert h.count == 5
+    assert h.sum == pytest.approx(sum(xs))
+    assert h.min == 0.001 and h.max == 0.016
+    # q=0 -> first value's bucket, q=1 -> last value's bucket
+    assert abs(h.quantile(0.0) - 0.001) / 0.001 \
+        <= metrics.QUANTILE_REL_ERR
+    assert abs(h.quantile(1.0) - 0.016) / 0.016 \
+        <= metrics.QUANTILE_REL_ERR
+    assert metrics.Histogram().quantile(0.5) is None
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_bucket_geometry_is_consistent():
+    """Every in-range value lands in a bucket whose [lo, hi) contains
+    it — the invariant the error bound rests on."""
+    rng = np.random.default_rng(7)
+    for v in rng.lognormal(mean=0.0, sigma=4.0, size=2000):
+        v = float(v)
+        if not (2.0 ** metrics.HIST_EXP_MIN < v
+                < 2.0 ** metrics.HIST_EXP_MAX):
+            continue
+        idx = metrics.bucket_index(v)
+        assert metrics.bucket_lo(idx) <= v <= metrics.bucket_hi(idx)
+
+
+# ---------------------------------------------------------------------
+# merge: lossless, associative
+
+def test_merge_is_lossless_and_associative():
+    rng = np.random.default_rng(11)
+    xs = rng.lognormal(mean=-2.0, sigma=1.0, size=900)
+    a, b, c = fill(xs[:300]), fill(xs[300:600]), fill(xs[600:])
+    whole = fill(xs)
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    for m in (left, right):
+        assert m.buckets == whole.buckets        # bucket-wise exact
+        assert m.count == whole.count
+        assert m.sum == pytest.approx(whole.sum)
+        assert m.min == whole.min and m.max == whole.max
+        for q in (0.5, 0.9, 0.99):
+            assert m.quantile(q) == whole.quantile(q)
+
+
+def test_merge_with_empty_is_identity():
+    h = fill([0.01, 0.02])
+    e = metrics.Histogram()
+    assert h.merge(e).buckets == h.buckets
+    assert e.merge(h).min == h.min and e.merge(h).max == h.max
+
+
+# ---------------------------------------------------------------------
+# registry: labels isolate, types pin
+
+def test_label_isolation_and_identity():
+    reg = metrics.Registry()
+    a = reg.counter("queries_total", kind="sssp")
+    b = reg.counter("queries_total", kind="pagerank")
+    a.inc(3)
+    b.inc()
+    assert a is not b
+    assert reg.counter("queries_total", kind="sssp") is a
+    assert a.value == 3 and b.value == 1
+    h1 = reg.histogram("lat", kind="a", tenant="t0")
+    h2 = reg.histogram("lat", tenant="t0", kind="a")   # order-free
+    assert h1 is h2
+
+
+def test_type_conflict_is_an_error():
+    reg = metrics.Registry()
+    reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.gauge("x")
+    with pytest.raises(ValueError):
+        reg.counter("x").inc(-1)
+
+
+# ---------------------------------------------------------------------
+# Prometheus exposition round-trip
+
+PROM_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+
+
+def parse_prometheus(text):
+    """{(name, frozen labels): float value} over all sample lines."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = PROM_LINE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = {}
+        for tok in (m.group("labels") or "").split(","):
+            if not tok:
+                continue
+            k, _, v = tok.partition("=")
+            labels[k] = v.strip('"')
+        out[(m.group("name"), tuple(sorted(labels.items())))] = \
+            float(m.group("value"))
+    return out
+
+
+def test_prometheus_round_trip():
+    reg = metrics.Registry()
+    reg.counter("served_total", kind="sssp").inc(7)
+    reg.gauge("queue_depth", kind="sssp").set(3)
+    xs = [0.001, 0.001, 0.004, 0.02, 0.02, 0.02, 5.0]
+    h = reg.histogram("lat_seconds", kind="sssp")
+    for v in xs:
+        h.observe(v)
+    parsed = parse_prometheus(reg.prometheus_text())
+    assert parsed[("served_total", (("kind", "sssp"),))] == 7
+    assert parsed[("queue_depth", (("kind", "sssp"),))] == 3
+    assert parsed[("lat_seconds_count", (("kind", "sssp"),))] == 7
+    assert parsed[("lat_seconds_sum", (("kind", "sssp"),))] == \
+        pytest.approx(sum(xs))
+    # cumulative le buckets re-derive the exact per-bucket counts
+    les = {k: v for k, v in parsed.items()
+           if k[0] == "lat_seconds_bucket"}
+    inf_key = ("lat_seconds_bucket",
+               (("kind", "sssp"), ("le", "+Inf")))
+    assert les.pop(inf_key) == 7
+    bounds = sorted((float(dict(k[1])["le"]), v)
+                    for k, v in les.items())
+    cums = [v for _le, v in bounds]
+    assert cums == sorted(cums) and cums[-1] == 7
+    per_bucket = [c - p for c, p in zip(cums, [0] + cums[:-1])]
+    assert sorted(h.buckets.values()) == sorted(per_bucket)
+    # every observation is under its claimed upper bound
+    for (le, cum), n in zip(bounds, per_bucket):
+        assert n >= 0 and le > 0
+
+
+# ---------------------------------------------------------------------
+# snapshot event schema + rebuild
+
+def test_snapshot_event_schema_and_rebuild():
+    reg = metrics.Registry()
+    reg.counter("served_total", kind="sssp").inc(4)
+    reg.gauge("occupancy", kind="sssp").set(2)
+    xs = [0.003, 0.005, 0.009, 0.2]
+    h = reg.histogram("serve_latency_seconds", kind="sssp")
+    for v in xs:
+        h.observe(v)
+    ev = telemetry.EventLog()
+    with telemetry.use(events=ev):
+        out = reg.emit_snapshot(step=3)
+    assert out["kind"] == "metrics_snapshot"
+    assert out["schema"] == metrics.SCHEMA and out["step"] == 3
+    # JSON-ready: the wire line round-trips
+    assert json.loads(json.dumps(out)) == out
+    (hs,) = out["histograms"]
+    assert hs["name"] == "serve_latency_seconds"
+    assert hs["labels"] == {"kind": "sssp"}
+    assert hs["count"] == 4 == sum(hs["buckets"].values())
+    assert hs["min"] == 0.003 and hs["max"] == 0.2
+    assert hs["p50"] is not None and hs["p99"] is not None
+    assert hs["p50"] <= hs["p99"]
+    rebuilt = metrics.Histogram.from_snapshot(hs)
+    assert rebuilt.buckets == h.buckets
+    assert rebuilt.quantile(0.5) == h.quantile(0.5)
+    (c,) = out["counters"]
+    assert c == {"name": "served_total", "labels": {"kind": "sssp"},
+                 "value": 4.0}
+    # null telemetry handle: emit_snapshot is a no-op None
+    assert reg.emit_snapshot() is None
+
+
+# ---------------------------------------------------------------------
+# the stdlib-http endpoint
+
+def test_http_metrics_endpoint():
+    reg = metrics.Registry()
+    reg.counter("served_total", kind="sssp").inc(9)
+    srv = metrics.serve_http(reg, 0)            # ephemeral port
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert 'served_total{kind="sssp"} 9' in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        th.join(timeout=10)
+
+
+def test_cli_prints_exposition(capsys):
+    rc = metrics.main(["-demo"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "# TYPE serve_latency_seconds histogram" in out
+    assert "serve_queries_total" in out

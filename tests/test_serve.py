@@ -3,6 +3,16 @@
 Oracle-checked drains through refill (push + pull runners), refill
 determinism, the batch collector's deadline rule, and the per-query
 telemetry round-trip through scripts/events_summary.py.
+
+Round 17 (serving observability) acceptance bars:
+- SLO good/violation counters and the rolling burn-rate gauge match
+  a NumPy oracle over the responses' own latencies;
+- scripts/loadgen.py against an OVERSUBSCRIBED mixed-kind Server on
+  the 8-virtual-device CPU mesh: the metrics snapshot's per-kind
+  p50/p99 agree with a NumPy quantile oracle over the raw query_done
+  events within the histogram's pinned error bound, the Perfetto
+  export carries per-query spans that pass validate_trace, and the
+  bench.py serve-slo line is accepted by scripts/check_bench.py.
 """
 
 import json
@@ -13,6 +23,7 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+from lux_tpu import metrics as metrics_mod
 from lux_tpu import serve, telemetry
 from lux_tpu.apps import components, pagerank, sssp
 from lux_tpu.convert import uniform_random_edges
@@ -20,6 +31,9 @@ from lux_tpu.graph import Graph
 
 REPO = Path(__file__).resolve().parent.parent
 SUMMARY = REPO / "scripts" / "events_summary.py"
+CHECK_BENCH = REPO / "scripts" / "check_bench.py"
+sys.path.insert(0, str(REPO / "scripts"))
+sys.path.insert(0, str(REPO))
 
 NV, NE = 256, 2048
 
@@ -205,6 +219,239 @@ class TestTelemetryRoundTrip:
                            capture_output=True, text=True)
         assert r.returncode == 1
         assert "never enqueued" in r.stderr
+
+
+class TestServingMetricsAndSLO:
+    def test_slo_accounting_matches_oracle(self, g):
+        """The SLO counters, burn-rate gauge and per-event slo_ok
+        flags must all re-derive from the responses' OWN latencies —
+        the accounting can never disagree with the stream it
+        aggregates."""
+        slo = 40.0
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            srv = serve.Server(g, batch=2, num_parts=2, seg_iters=2,
+                               slo_ms={"sssp": slo})
+            for s in (3, 17, 40, 99, 200):
+                srv.submit("sssp", source=s)
+            responses = srv.run()
+        assert len(responses) == 5
+        want_good = sum(r.latency_s * 1e3 <= slo for r in responses)
+        want_bad = 5 - want_good
+        reg = srv.metrics
+
+        def counter(name):
+            c = reg.counter(name, kind="sssp")
+            return c.value
+
+        assert counter("serve_slo_good_total") == want_good
+        assert counter("serve_slo_violation_total") == want_bad
+        burn = reg.gauge("serve_slo_burn_rate", kind="sssp").value
+        assert burn == pytest.approx(want_bad / 5)
+        # the per-event record carries the same verdicts
+        done = [e for e in ev.events if e["kind"] == "query_done"]
+        assert len(done) == 5
+        by_qid = {r.qid: r for r in responses}
+        for e in done:
+            assert e["slo_ms"] == slo
+            assert e["slo_ok"] == \
+                (by_qid[e["qid"]].latency_s * 1e3 <= slo)
+        # latency histogram count equals retirements; queue drained
+        h = reg.histogram("serve_latency_seconds", kind="sssp")
+        assert h.count == 5
+        assert reg.gauge("serve_queue_depth", kind="sssp").value == 0
+        # the drain published a snapshot event
+        assert any(e["kind"] == "metrics_snapshot"
+                   for e in ev.events)
+        # events_summary cross-audit accepts the consistent trail
+        # (snapshot counts vs query_done events) — in-process render
+        import io
+
+        import events_summary as es
+        out = io.StringIO()
+        errs = []
+        streams, serrs = es.split_streams(ev.events)
+        for _key, stream in streams:
+            for run in es.split_runs(stream):
+                errs += es.render_run(run, out=out)
+        assert serrs == [] and errs == []
+        assert "metrics snapshot" in out.getvalue()
+
+    def test_metrics_false_disables_cleanly(self, g):
+        srv = serve.Server(g, batch=2, num_parts=2, seg_iters=2,
+                           metrics=False)
+        assert srv.metrics is None
+        srv.submit("sssp", source=3)
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            (r,) = srv.run()
+        assert r.converged
+        assert not any(e["kind"] == "metrics_snapshot"
+                       for e in ev.events)
+        assert srv.emit_metrics_snapshot() is None
+
+    def test_unknown_slo_kind_rejected(self, g):
+        with pytest.raises(ValueError):
+            serve.Server(g, slo_ms={"bogus": 10.0})
+
+    def test_loadgen_acceptance_oversubscribed_mesh(self, g,
+                                                    tmp_path):
+        """THE round-17 acceptance: an open-loop oversubscribed
+        mixed-kind load on the 8-virtual-device mesh — snapshot
+        percentiles against the NumPy oracle at the pinned bound,
+        per-query spans through validate_trace, and the rendered
+        events_summary audit."""
+        import loadgen
+
+        from lux_tpu import tracing
+        from lux_tpu.parallel.mesh import make_mesh
+
+        kinds = ["sssp", "components", "pagerank"]
+        path = tmp_path / "serve_ev.jsonl"
+        ev = telemetry.EventLog(str(path))
+        with telemetry.use(events=ev):
+            ev.emit("run_start", schema=telemetry.SCHEMA,
+                    app="serve", file="<test>", mesh=8)
+            srv = serve.Server(g, batch=2, num_parts=8,
+                               mesh=make_mesh(8), seg_iters=2,
+                               slo_ms={"sssp": 250.0,
+                                       "components": 250.0,
+                                       "pagerank": 1000.0})
+            import time as _time
+            t0 = _time.perf_counter()
+            loadgen.warm(srv, kinds)
+            idx0 = len(ev.events)
+            rng = np.random.default_rng(3)
+            # rate far past the CPU mesh's service rate: every query
+            # arrives up front, so the B=2 columns OVERSUBSCRIBE and
+            # later queries enter through retire+refill
+            rep = loadgen.run_step(srv, rate=500.0, n=12,
+                                   kinds=kinds, rng=rng, step=0)
+            ev.emit("run_done",
+                    seconds=round(_time.perf_counter() - t0, 6),
+                    iters=rep.served)
+        ev.close()
+        assert rep.drained and rep.served == 12
+        assert rep.achieved_qps <= rep.offered_qps * (1 + 1e-9)
+        assert rep.p50_ms is not None and rep.p99_ms is not None
+        assert rep.p50_ms <= rep.p99_ms
+        assert rep.slo_good_fraction is not None
+        # oversubscription really exercised continuous batching
+        refills = [e for e in ev.events[idx0:]
+                   if e["kind"] == "serve_refill"
+                   and e.get("retired") and e.get("filled")]
+        assert refills, "oversubscribed load drained without refill"
+
+        # (a) snapshot percentiles vs the NumPy oracle over the raw
+        # query_done stream, within the histogram's PINNED bound
+        snaps = [e for e in ev.events
+                 if e["kind"] == "metrics_snapshot"
+                 and e.get("step") == 0]
+        assert snaps
+        done = [e for e in ev.events[idx0:]
+                if e["kind"] == "query_done"]
+        assert len(done) == 12
+        checked = 0
+        for h in snaps[-1]["histograms"]:
+            if h["name"] != "serve_latency_seconds":
+                continue
+            kind = h["labels"]["kind"]
+            lats = [e["latency_s"] for e in done
+                    if e["query_kind"] == kind]
+            assert h["count"] == len(lats)
+            for q, key in ((0.5, "p50"), (0.99, "p99")):
+                oracle = float(np.quantile(lats, q,
+                                           method="inverted_cdf"))
+                # + 1e-3: the event stream rounds latency_s to 1e-6
+                assert abs(h[key] - oracle) / oracle <= \
+                    metrics_mod.QUANTILE_REL_ERR + 1e-3, (kind, key)
+            checked += 1
+        assert checked == len(kinds)
+
+        # (b) per-query spans through validate_trace
+        trace = tracing.trace_export(ev.events,
+                                     out=str(tmp_path / "t.json"))
+        assert tracing.validate_trace(trace) == []
+        qspans = [e for e in trace["traceEvents"]
+                  if e.get("cat") == "query"]
+        phases = [e for e in trace["traceEvents"]
+                  if e.get("cat") == "query_phase"]
+        assert len(qspans) >= 12          # warm queries also render
+        assert {e["name"] for e in phases} >= {"wait"}
+        waits = {}
+        for e in trace["traceEvents"]:
+            if e.get("cat") == "query" and "slo_ok" in e.get("args",
+                                                            {}):
+                waits[e["args"]["qid"]] = e["args"]["wait_s"]
+        assert waits                      # spans carry the SLO verdict
+
+        # events_summary renders + audits the full trail
+        r = subprocess.run([sys.executable, str(SUMMARY), str(path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "metrics snapshot" in r.stdout
+
+    def test_serve_slo_bench_line_through_check_bench(self, tmp_path):
+        """(c) of the acceptance: bench.py -config serve-slo produces
+        a metric line scripts/check_bench.py ACCEPTS, and the
+        contradiction mutations are rejected."""
+        import argparse
+
+        import bench
+
+        args = argparse.Namespace(
+            scale=8, ef=8, ni=20, np=2, pair=0, min_fill=None,
+            min_fill_dot=None, repeats=1, verbose=False,
+            health=False, audit="warn", serve_queries=10,
+            serve_batch=2, serve_kinds="sssp,components,pagerank",
+            slo_ms="sssp=250,components=250,pagerank=1000",
+            rates="60", batch="1", shape="rmat", reorder="none")
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            idx0 = len(ev.events)
+            name, samples, extra, _rerun = bench.run_config(
+                "serve-slo@60", args)
+            tel = bench.config_telemetry(ev, idx0, None)
+        assert name == "serve_slo_q60_rmat8"
+        assert extra["unit"] == "qps"
+        assert extra["audit"]["errors"] == 0
+        value = round(float(np.median(samples)), 4)
+        line = {"metric": f"{name}_qps_per_chip", "value": value,
+                "unit": "qps", "vs_baseline": value,
+                "samples": [round(s, 4) for s in samples],
+                "attempts": len(samples), "discarded": [],
+                "telemetry": tel, **extra}
+        p = tmp_path / "bench.jsonl"
+        p.write_text(json.dumps(line) + "\n")
+        r = subprocess.run([sys.executable, str(CHECK_BENCH),
+                            "-legacy-ok", str(p)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+
+        def rejects(mutate, needle):
+            bad = json.loads(json.dumps(line))
+            mutate(bad)
+            p.write_text(json.dumps(bad) + "\n")
+            rr = subprocess.run([sys.executable, str(CHECK_BENCH),
+                                 "-legacy-ok", str(p)],
+                                capture_output=True, text=True)
+            assert rr.returncode == 1 and needle in rr.stderr, \
+                (needle, rr.stderr)
+
+        rejects(lambda d: d.update(p99_ms=d["p50_ms"] / 2),
+                "p99_ms")
+        rejects(lambda d: d.update(
+            achieved_qps=d["offered_qps"] * 2,
+            value=round(d["offered_qps"] * 2, 4),
+            samples=[round(d["offered_qps"] * 2, 4)]),
+            "outrun arrivals")
+        rejects(lambda d: d.update(slo_good_fraction=1.2),
+                "slo_good_fraction")
+        rejects(lambda d: d.pop("offered_qps"),
+                "serve-slo line missing")
+        rejects(lambda d: d.update(value=d["value"] + 1,
+                                   samples=[d["value"] + 1]),
+                "achieved_qps")
 
 
 class TestServeSmoke:

@@ -461,3 +461,48 @@ def test_event_log_and_null_handle():
     with telemetry.use(events=ev) as tel:
         assert telemetry.current() is tel
     assert telemetry.current().events is None
+
+
+def test_event_log_rotation_single_process(tmp_path):
+    """Round-17 bounded EventLog: size-triggered rotation shifts
+    generations (.1 -> .2, live -> .1), stamps each fresh live file
+    with a log_rotate event, drops generations past the window, and
+    telemetry.rotated_paths lists the surviving set oldest-first
+    with per-stream tm still monotone across the concatenation."""
+    import os
+
+    path = str(tmp_path / "ev.jsonl")
+    ev = telemetry.EventLog(path, rotate_bytes=4000)
+    pad = "x" * 200
+    for i in range(60):
+        ev.emit("mark", i=i, pad=pad)
+    ev.close()
+    assert ev.rotations >= 2
+    paths = telemetry.rotated_paths(path)
+    assert paths == [f"{path}.2", f"{path}.1", path]
+    assert all(os.path.exists(p) for p in paths)
+    events = []
+    for p in paths:
+        events += [json.loads(ln)
+                   for ln in open(p).read().splitlines()]
+    marks = [e["i"] for e in events if e["kind"] == "mark"]
+    # oldest generations beyond the window dropped; the kept tail is
+    # contiguous and ends at the newest event
+    assert marks == list(range(marks[0], 60))
+    rots = [e for e in events if e["kind"] == "log_rotate"]
+    assert rots and all(r["path"] == path for r in rots)
+    tms = [e["tm"] for e in events]
+    assert tms == sorted(tms)
+    # in-memory view complete while under the MEM_KEEP bound (a
+    # rotation only trims once the list outgrows it)
+    assert len(ev.events) < ev.MEM_KEEP
+    assert [e["i"] for e in ev.events
+            if e["kind"] == "mark"] == list(range(60))
+
+    with pytest.raises(ValueError):
+        telemetry.EventLog(path, rotate_bytes=0)
+    with pytest.raises(ValueError):
+        telemetry.EventLog(path, rotate_bytes=100, generations=0)
+    # a plain (never-rotated) path is its own one-element set
+    lone = str(tmp_path / "lone.jsonl")
+    assert telemetry.rotated_paths(lone) == [lone]

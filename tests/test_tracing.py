@@ -141,7 +141,7 @@ _WRITER = r"""
 import sys
 sys.path.insert(0, {repo!r})
 from lux_tpu import telemetry
-ev = telemetry.EventLog({path!r})
+ev = telemetry.EventLog({path!r}, rotate_bytes={rotate!r})
 pad = "x" * 2000          # long lines provoke torn buffered writes
 for i in range(300):
     ev.emit("writer_mark", i=i, who={who!r}, pad=pad)
@@ -158,7 +158,8 @@ def test_event_log_concurrent_writers_line_atomic(tmp_path):
     path = str(tmp_path / "shared.jsonl")
     procs = [subprocess.Popen(
         [sys.executable, "-c",
-         _WRITER.format(repo=str(REPO), path=path, who=f"w{i}")],
+         _WRITER.format(repo=str(REPO), path=path, who=f"w{i}",
+                        rotate=None)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)]
     outs = [p.communicate(timeout=120)[0] for p in procs]
@@ -175,6 +176,56 @@ def test_event_log_concurrent_writers_line_atomic(tmp_path):
         assert [e["i"] for e in evs] == list(range(300))
         tms = [e["tm"] for e in evs]
         assert tms == sorted(tms)
+
+
+def test_event_log_rotation_concurrent_writers_line_atomic(tmp_path):
+    """Round-17 regression beside the atomicity test: two processes
+    appending through SIZE-TRIGGERED ROTATION (rotate_bytes) into one
+    shared path.  The whole .2/.1/live generation set must hold every
+    line un-torn, each writer's stream complete and tm-ordered across
+    the generation concatenation, and the set must export as one
+    valid trace (the rotated-file-set acceptance of trace_export)."""
+    path = str(tmp_path / "shared.jsonl")
+    # ~1.23 MB total at a 700 KB threshold -> exactly one or two
+    # rotations: the 2-generation window retains every line
+    procs = [subprocess.Popen(
+        [sys.executable, "-c",
+         _WRITER.format(repo=str(REPO), path=path, who=f"w{i}",
+                        rotate=700_000)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    gens = telemetry.rotated_paths(path)
+    assert len(gens) >= 2, "rotation never fired"
+    events = []
+    for gen in gens:
+        for ln in open(gen).read().splitlines():
+            events.append(json.loads(ln))          # raises on a tear
+    rotations = [e for e in events if e["kind"] == "log_rotate"]
+    assert rotations, "no log_rotate stamp in the generation set"
+    by_pid = {}
+    for e in events:
+        if e["kind"] != "writer_mark":
+            continue
+        by_pid.setdefault((e["session"], e["pid"]), []).append(e)
+    assert len(by_pid) == 2
+    for evs in by_pid.values():
+        # complete and in order ACROSS the generation boundary
+        assert [e["i"] for e in evs] == list(range(300))
+        tms = [e["tm"] for e in evs]
+        assert tms == sorted(tms)
+    # the rotated set exports as one multi-stream trace
+    trace = tracing.trace_export(events)
+    assert tracing.validate_trace(trace) == []
+    assert trace["otherData"]["streams"] == 2
+    # events_summary consumes the SET from the live path alone
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "events_summary.py"),
+         path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "log rotated" in r.stdout
 
 
 # ---------------------------------------------------------------------
