@@ -134,7 +134,19 @@ DEFAULT_SHAPE = {"pagerank": (21, 16), "cc": (20, 16),
                  # p99 < p50, achieved > offered, fraction outside
                  # [0, 1]).  The on-device run is carried as debt
                  # serve-slo-on-device (lux_tpu/observe.py).
-                 "serve-slo": (12, 8)}
+                 "serve-slo": (12, 8),
+                 # serving-tier chaos lines (round 18,
+                 # lux_tpu/fleet.py): `-config serve-chaos` runs the
+                 # serve-slo open-loop load against a FleetServer of
+                 # -serve-replicas replicas with a ReplicaKillPlan
+                 # armed post-warm; each line extends the serve-slo
+                 # record with replicas/failovers/shed/shed_fraction
+                 # (scripts/check_bench.py rejects the
+                 # contradictions: shed_fraction outside [0,1],
+                 # failovers with replicas=1, SLO accounting over
+                 # shed queries).  The real-TPU drill is debt
+                 # serve-chaos-on-device.
+                 "serve-chaos": (12, 8)}
 
 # the batch-sweep expansion (one metric line per B per app)
 BATCH_SWEEP_DEFAULT = "1,8,64"
@@ -236,15 +248,24 @@ def _rate_token(rate: float) -> str:
     return f"{rate:g}".replace(".", "p").replace("-", "m")
 
 
-def run_serve_slo(config, args):
-    """One serve-slo line: an open-loop Poisson load step
-    (scripts/loadgen.py) at the offered rate named by
-    "serve-slo@RATE" against a mixed-kind continuous-batching Server
-    with per-kind latency SLOs.  The line's value/samples are the
-    MEASURED achieved qps; offered/achieved, the snapshot p50/p99 and
-    the SLO good fraction ride the line for scripts/check_bench.py's
-    contradiction rejects (p99 < p50, achieved > offered, fraction
-    outside [0, 1])."""
+def run_serve_load(config, args, *, chaos: bool):
+    """Shared body of the serve-slo and serve-chaos configs: one
+    open-loop Poisson load step (scripts/loadgen.py) at the offered
+    rate named by "<config>@RATE" against a mixed-kind
+    continuous-batching server with per-kind latency SLOs.  The
+    line's value/samples are the MEASURED achieved qps; offered/
+    achieved, snapshot p50/p99, SLO targets and good fraction ride
+    the line for scripts/check_bench.py's contradiction rejects
+    (p99 < p50, achieved > offered, fraction outside [0, 1]).
+
+    ``chaos`` (round 18, lux_tpu/fleet.py) swaps the single Server
+    for a FleetServer of ``-serve-replicas`` replicas with a
+    faults.ReplicaKillPlan armed AFTER the engine-compile warmup
+    (the last replica dies at its ``-kill-boundary``-th loaded
+    boundary), extends the line with replicas/failovers/shed/
+    shed_fraction/slo_accounted, and FAILS unless the kill actually
+    fired and at least one query failed over — a chaos line measured
+    without chaos is a lie."""
     import itertools
     import os
 
@@ -258,37 +279,66 @@ def run_serve_slo(config, args):
 
     from lux_tpu import serve, telemetry
 
+    family = "serve-chaos" if chaos else "serve-slo"
     _, _, rstr = config.partition("@")
-    rate = float(rstr) if rstr else 20.0
+    rate = float(rstr) if rstr else (60.0 if chaos else 20.0)
     if not rate > 0:
         # the bare-config expansion validates -rates; the @-form must
         # reject too, or a zero rate hangs the submitter forever
-        raise ValueError(f"serve-slo offered rate must be > 0 qps, "
+        raise ValueError(f"{family} offered rate must be > 0 qps, "
                          f"got {rate}")
-    scale = args.scale or DEFAULT_SHAPE["serve-slo"][0]
-    ef = args.ef or DEFAULT_SHAPE["serve-slo"][1]
+    scale = args.scale or DEFAULT_SHAPE[family][0]
+    ef = args.ef or DEFAULT_SHAPE[family][1]
     kinds = [k.strip() for k in args.serve_kinds.split(",")
              if k.strip()]
     slo = loadgen._parse_slo(args.slo_ms)
     g = build_graph(scale, ef, args.verbose)
-    srv = serve.Server(g, batch=args.serve_batch, num_parts=args.np,
-                       seg_iters=2, slo_ms=slo, health=args.health)
     extra = {"np": args.np, "scale": scale, "ef": ef,
              "serve_batch": args.serve_batch, "kinds": kinds,
              "queries": args.serve_queries, "unit": "qps"}
+    if chaos:
+        from lux_tpu import faults, fleet, resilience
+        if args.serve_replicas < 2:
+            raise ValueError(
+                "serve-chaos needs -serve-replicas >= 2: there is "
+                "no surviving replica to fail over to with one")
+        srv = fleet.FleetServer(
+            g, replicas=args.serve_replicas, batch=args.serve_batch,
+            num_parts=args.np, seg_iters=2, slo_ms=slo,
+            health=args.health,
+            retry=resilience.RetryPolicy(retries=3, backoff_s=0.01,
+                                         max_backoff_s=0.1,
+                                         jitter_seed=0))
+        runner_of = srv._replicas[0].runner
+        extra["replicas"] = args.serve_replicas
+    else:
+        srv = serve.Server(g, batch=args.serve_batch,
+                           num_parts=args.np, seg_iters=2,
+                           slo_ms=slo, health=args.health)
+        runner_of = srv._runner
     if args.audit != "off":
         from lux_tpu import audit
         findings = []
         for k in kinds:
-            findings += audit.audit_engine(srv._runner(k).eng,
+            findings += audit.audit_engine(runner_of(k).eng,
                                            mode=None)
         d = audit.digest(findings, mode=args.audit)
         extra["audit"] = d
         if d["errors"] and args.audit == "error":
-            audit.raise_findings(findings, where="serve-slo")
+            audit.raise_findings(findings, where=family)
         for f in findings:
             print(f"# audit: {f}", file=sys.stderr)
-    loadgen.warm(srv, kinds)         # compile outside the load
+    # compile outside the load — the fleet warms EVERY (replica,
+    # kind) engine (routing-spread warm would leave cold runners
+    # whose first measured query pays XLA compilation)
+    if chaos:
+        srv.warm(kinds)
+        # arm the kill AFTER warm so its boundary counter sees only
+        # loaded traffic: the LAST replica dies mid-load
+        srv.set_fault(faults.ReplicaKillPlan(
+            {srv.replica_names[-1]: args.kill_boundary}))
+    else:
+        loadgen.warm(srv, kinds)
     rng = np.random.default_rng(7)   # fixed seed: one query schedule
     steps = itertools.count()
 
@@ -301,15 +351,20 @@ def run_serve_slo(config, args):
                                  seconds=round(rep.elapsed_s, 6))
         if not rep.drained:
             raise RuntimeError(
-                f"serve-slo load step {step} did not drain "
-                f"({rep.served}/{rep.submitted})")
+                f"{family} load step {step} did not drain "
+                f"({rep.served}+{rep.shed}/{rep.submitted})")
         if rep.slo_good_fraction is None or rep.p50_ms is None:
             raise RuntimeError(
-                f"serve-slo load step {step} produced no SLO "
+                f"{family} load step {step} produced no SLO "
                 f"accounting (slo_ms={slo!r})")
         return rep
 
     rep = one_step()
+    if chaos and (not srv.fault.fired or srv.failovers < 1):
+        raise RuntimeError(
+            "serve-chaos kill plan never fired (or nothing failed "
+            "over) — the chaos line would be measuring a fault-free "
+            "run")
     if args.verbose:
         loadgen.render_table([rep], out=sys.stderr)
     extra.update(offered_qps=round(rep.offered_qps, 4),
@@ -319,7 +374,14 @@ def run_serve_slo(config, args):
                  slo_target_ms=slo,
                  slo_good_fraction=round(rep.slo_good_fraction, 4),
                  served=rep.served, submitted=rep.submitted)
-    name = f"serve_slo_q{_rate_token(rate)}_rmat{scale}"
+    if chaos:
+        extra.update(failovers=int(srv.failovers),
+                     shed=int(rep.shed),
+                     shed_fraction=round(rep.shed
+                                         / max(1, rep.submitted), 4),
+                     slo_accounted=rep.slo_accounted)
+    prefix = "serve_chaos" if chaos else "serve_slo"
+    name = f"{prefix}_q{_rate_token(rate)}_rmat{scale}"
     return (name, [rep.achieved_qps], extra,
             lambda: one_step().achieved_qps)
 
@@ -333,7 +395,10 @@ def run_config(config, args):
     from lux_tpu.graph import pair_relabel
 
     if config.startswith("serve-slo"):
-        return run_serve_slo(config, args)
+        return run_serve_load(config, args, chaos=False)
+
+    if config.startswith("serve-chaos"):
+        return run_serve_load(config, args, chaos=True)
 
     if config.startswith("gather-ab"):
         # paged-vs-flat A/B: "gather-ab@paged[:reorder]" names one
@@ -712,6 +777,15 @@ def main() -> int:
                     default="sssp,components,pagerank",
                     dest="serve_kinds",
                     help="mixed query kinds for the serve-slo load")
+    ap.add_argument("-serve-replicas", type=int, default=2,
+                    dest="serve_replicas",
+                    help="replica count for the serve-chaos config "
+                         "(lux_tpu/fleet.py; needs >= 2 — one dies)")
+    ap.add_argument("-kill-boundary", type=int, default=1,
+                    dest="kill_boundary",
+                    help="segment boundary (post-warm) of the last "
+                         "replica at which the serve-chaos kill plan "
+                         "fires")
     ap.add_argument("-slo-ms", dest="slo_ms",
                     default="sssp=250,components=250,pagerank=1000",
                     help="per-kind latency SLO targets for "
@@ -862,7 +936,7 @@ def main() -> int:
             expanded += [f"ppr-batch@{b}" for b in batch_widths]
         elif c in ("ksssp-batch", "ppr-batch"):
             expanded += [f"{c}@{b}" for b in batch_widths]
-        elif c == "serve-slo":
+        elif c in ("serve-slo", "serve-chaos"):
             try:
                 rates = [float(r) for r in args.rates.split(",")
                          if r.strip()]
@@ -871,7 +945,7 @@ def main() -> int:
                          f"got {args.rates!r}")
             if not rates or any(r <= 0 for r in rates):
                 ap.error("-rates must be positive offered qps")
-            expanded += [f"serve-slo@{r:g}" for r in rates]
+            expanded += [f"{c}@{r:g}" for r in rates]
         elif c == "gather-ab":
             # one line per side, paged first (the headline of the
             # A/B); both carry the plan's page stats.  A reorder run

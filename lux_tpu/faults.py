@@ -217,6 +217,66 @@ class FaultPlan:
         raise ValueError(f"unknown fault action {action!r}")
 
 
+@dataclasses.dataclass
+class ReplicaKillPlan:
+    """Replica-scoped kill schedule for the serving fleet
+    (lux_tpu/fleet.py, round 18): ``schedule`` maps a replica NAME to
+    the replica's segment-boundary index at which it dies.  The
+    fleet's per-replica boundary hook calls ``fire(name)`` at every
+    segment boundary of every runner the replica owns (one shared
+    counter per replica, all query kinds), so the kill lands
+    MID-DRAIN with queries resident in the runner's columns — exactly
+    the in-flight state the failover path must re-dispatch.
+
+    ``action`` is WORKER_KILL (default: InjectedWorkerKill, or with
+    ``hard_kill=True`` a REAL ``os._exit(HARD_KILL_CODE)`` for
+    subprocess replica workers — the genuine death only the replica
+    board's beat staleness can detect) or DEVICE_LOSS
+    (InjectedDeviceLoss).  A fired entry never re-fires (the
+    boundary counter advances past it), so a drained fleet always
+    terminates; ``fired`` records what happened, for assertions."""
+
+    schedule: dict
+    action: str = WORKER_KILL
+    hard_kill: bool = False
+    boundaries: dict = dataclasses.field(default_factory=dict,
+                                         init=False)
+    fired: list = dataclasses.field(default_factory=list, init=False)
+
+    def __post_init__(self):
+        # validate at CONSTRUCTION: a typo'd action discovered at
+        # the scheduled boundary would crash the run mid-measurement
+        # instead of failing the plan before anything was spent
+        if self.action not in (WORKER_KILL, DEVICE_LOSS):
+            raise ValueError(
+                f"ReplicaKillPlan action must be WORKER_KILL or "
+                f"DEVICE_LOSS, got {self.action!r}")
+
+    def fire(self, replica: str) -> None:
+        import os
+
+        i = int(self.boundaries.get(replica, 0))
+        self.boundaries[replica] = i + 1
+        due = self.schedule.get(replica)
+        if due is None or i != int(due):
+            return
+        self.fired.append((replica, i, self.action))
+        if self.action == DEVICE_LOSS:
+            raise InjectedDeviceLoss(
+                f"injected device loss on serving replica "
+                f"{replica!r} at its boundary {i}: devices "
+                f"unavailable", ())
+        if self.hard_kill:
+            # a REAL death, mid-drain: no exception, no cleanup —
+            # the parent fleet can only see it through the replica
+            # board's beat going stale (lux_tpu/heartbeat.py)
+            os._exit(HARD_KILL_CODE)
+        raise InjectedWorkerKill(
+            f"injected worker death on serving replica {replica!r} "
+            f"at its boundary {i}: coordination service heartbeat "
+            f"to the replica timed out", ())
+
+
 def nan_corrupt(state, count: int = 1):
     """Host copy of ``state`` with NaN poked into the first ``count``
     cells of its first floating leaf (what a corrupted segment output

@@ -67,6 +67,67 @@ class WorkerLostError(RuntimeError):
 
 
 @dataclasses.dataclass
+class ReplicaBoard:
+    """Name-keyed replica heartbeat board for the serving fleet
+    (lux_tpu/fleet.py, round 18) — the same shared-dir,
+    atomic-rename discipline as :class:`Heartbeat`, but keyed by
+    replica NAME with free-form status fields and NO boundary
+    barrier: the fleet dispatcher reads beat AGES (per-replica health
+    gauges, and the only death detector a hard-killed subprocess
+    replica leaves behind) instead of syncing at boundaries.  A
+    replica whose newest beat is older than ``deadline_s`` is
+    presumed dead; the dispatcher then fails its in-flight queries
+    over to the survivors."""
+
+    path: str
+    deadline_s: float = 3.0
+    now: Callable[[], float] = time.time
+
+    def __post_init__(self):
+        os.makedirs(self.path, exist_ok=True)
+
+    def _file(self, name: str) -> str:
+        return os.path.join(self.path, f"rb_{name}.json")
+
+    def beat(self, name: str, **fields) -> None:
+        """Record a replica's sign of life (atomic rename: a reader
+        never sees a torn beat).  Extra fields (boundary, served,
+        status) ride along for the board's diagnostics."""
+        fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".rb.tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump({"replica": str(name), "t": self.now(),
+                       **fields}, f)
+        os.replace(tmp, self._file(name))
+
+    def read(self, name: str) -> dict | None:
+        try:
+            with open(self._file(name)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def replicas(self) -> list[str]:
+        """Names with a beat on the board, sorted."""
+        out = []
+        for f in os.listdir(self.path):
+            if f.startswith("rb_") and f.endswith(".json"):
+                out.append(f[3:-5])
+        return sorted(out)
+
+    def age(self, name: str) -> float | None:
+        """Seconds since the replica's newest beat (None before its
+        first one — the caller owns the launch grace)."""
+        r = self.read(name)
+        if r is None or not isinstance(r.get("t"), (int, float)):
+            return None
+        return max(0.0, self.now() - r["t"])
+
+    def alive(self, name: str) -> bool:
+        a = self.age(name)
+        return a is not None and a <= self.deadline_s
+
+
+@dataclasses.dataclass
 class Heartbeat:
     """One worker's view of the shared heartbeat board.
 

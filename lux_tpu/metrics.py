@@ -191,6 +191,14 @@ class Histogram:
                 return bucket_mid(idx)
         return bucket_mid(max(buckets))         # unreachable guard
 
+    def mean(self) -> float | None:
+        """Exact mean (sum/count — the scalars are exact even though
+        the buckets quantize); None on an empty histogram.  The fleet
+        dispatcher's projected-wait estimator input
+        (lux_tpu/fleet.py admission control)."""
+        with self._lock:
+            return self.sum / self.count if self.count else None
+
     def merge(self, other: "Histogram") -> "Histogram":
         """Bucket-wise sum — associative and commutative (proven by
         test), the multi-series / multi-replica combine."""

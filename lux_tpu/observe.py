@@ -719,6 +719,17 @@ DEBTS = (
          "on-device per-query latency (and the knee's position vs "
          "the ~9/B ns/edge amortization) is unmeasured",
          "PERF_NOTES round 17 (serving observability)"),
+    Debt("serve-chaos-on-device",
+         "bench.py -config serve-chaos (replicated FleetServer under "
+         "open-loop load with a ReplicaKillPlan armed, "
+         "lux_tpu/fleet.py) on a live tunnel: the kill-under-load "
+         "drill — detect -> re-dispatch -> first retired answer "
+         "failover cost, the SLO burn through a real replica loss, "
+         "and the brownout shed fraction at the saturation knee are "
+         "CPU-mesh-measured only (PERF_NOTES round 18); on-device "
+         "the failover also pays remote recompile/placement for the "
+         "survivor's refilled columns, which nothing has measured",
+         "PERF_NOTES round 18 (serving resilience)"),
     Debt("batch-sweep-on-device",
          "bench.py -config batch-sweep (B in {1,8,64} k-source SSSP "
          "+ personalized PageRank) on a live tunnel: the modeled "

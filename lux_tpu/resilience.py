@@ -118,6 +118,15 @@ def classify(exc: BaseException) -> str:
         #                        its devices are gone with it
     if isinstance(exc, faults.InjectedWorkerCrash):
         return RETRYABLE
+    from lux_tpu import fleet
+    if isinstance(exc, fleet.AdmissionError):
+        return FATAL            # an intentional shed is a DECISION,
+        #                         not a failure: a supervisor that
+        #                         retried it would re-admit a query
+        #                         the serving tier chose to reject
+        #                         (and its message says 'shed'/
+        #                         'deadline', which must never hit
+        #                         the retryable word scan below)
     from lux_tpu import audit
     if isinstance(exc, audit.AuditError):
         return FATAL            # a static-audit violation is a

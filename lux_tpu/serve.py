@@ -69,7 +69,9 @@ from __future__ import annotations
 
 import dataclasses
 import queue as _queuemod
+import threading
 import time
+from typing import Callable
 
 import numpy as np
 
@@ -87,12 +89,19 @@ SLO_WINDOW = 64
 class Request:
     """One query: ``source`` for sssp/components (and one-hot
     pagerank); ``reset`` [nv] overrides it for personalized
-    pagerank."""
+    pagerank.  ``tenant``/``priority``/``deadline_s`` are the
+    serving-tier admission fields (lux_tpu/fleet.py): plain Servers
+    ignore them; the fleet dispatcher quotes quotas per tenant,
+    collects deadline-priority (PriorityCollector) and sheds against
+    the deadline."""
     qid: int
     kind: str
     source: int | None = None
     reset: np.ndarray | None = None
     t_enqueue: float = 0.0
+    tenant: str = "default"
+    priority: int = 0
+    deadline_s: float | None = None
 
 
 @dataclasses.dataclass
@@ -125,17 +134,27 @@ class BatchCollector:
     ``collect`` keep the ``serve_queue_depth`` gauge current and
     ``collect`` observes each request's queue wait (enqueue ->
     collection) into ``serve_wait_seconds`` — host-side, boundary-
-    cadence calls only."""
+    cadence calls only.  ``replica`` (the fleet, lux_tpu/fleet.py)
+    labels the depth GAUGE per replica — N replicas sharing one
+    (name, kind) gauge would be last-writer-wins; shared counters
+    and histograms merge correctly and stay fleet-wide."""
 
-    def __init__(self, metrics=None, kind: str | None = None):
+    def __init__(self, metrics=None, kind: str | None = None,
+                 replica: str | None = None):
         self._q: _queuemod.Queue = _queuemod.Queue()
         self.metrics = metrics
         self.kind = kind
+        self.replica = replica
+
+    def _labels(self) -> dict:
+        if self.replica is None:
+            return {"kind": self.kind}
+        return {"kind": self.kind, "replica": self.replica}
 
     def _depth(self) -> None:
         if self.metrics is not None:
             self.metrics.gauge("serve_queue_depth",
-                               kind=self.kind).set(self._q.qsize())
+                               **self._labels()).set(self._q.qsize())
 
     def put(self, req: Request) -> None:
         self._q.put(req)
@@ -166,6 +185,90 @@ class BatchCollector:
         return out
 
 
+class PriorityCollector(BatchCollector):
+    """Deadline-priority request queue (the fleet dispatcher's
+    admission queue, lux_tpu/fleet.py) replacing the base collector's
+    pure FIFO with a PINNED ordering rule:
+
+    - requests collect highest ``priority`` first, FIFO within a
+      priority — EXCEPT
+    - a request already past HALF its ``deadline_s`` is AGED: aged
+      requests outrank every un-aged one (among themselves: earliest
+      absolute deadline first, then FIFO).
+
+    Without the aging clause a saturated high-priority stream
+    displaces low-priority requests indefinitely; with it a displaced
+    request's extra wait is bounded by half its own deadline plus one
+    collection round (tests/test_serve.py pins both halves with a
+    deterministic injected clock).  ``collect``'s deadline semantics
+    match the base class: wait at most ``deadline_s`` for the FIRST
+    request, then take only what has already arrived."""
+
+    def __init__(self, metrics=None, kind: str | None = None,
+                 replica: str | None = None,
+                 now: Callable[[], float] = time.monotonic):
+        # deliberately NOT calling super().__init__: the base Queue
+        # is replaced wholesale by the condition-guarded list
+        # (collection is a SORT, not a pop), and allocating it would
+        # leave a dead always-empty queue for any base path to
+        # silently read
+        self.metrics = metrics
+        self.kind = kind
+        self.replica = replica
+        self.now = now
+        self._items: list[Request] = []
+        self._cv = threading.Condition()
+
+    def _depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("serve_queue_depth",
+                               **self._labels()).set(len(self))
+
+    def put(self, req: Request) -> None:
+        with self._cv:
+            self._items.append(req)
+            self._cv.notify()
+        self._depth()
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def _key(self, idx: int, req: Request, now: float):
+        aged = (req.deadline_s is not None
+                and now - req.t_enqueue >= 0.5 * req.deadline_s)
+        if aged:
+            # aged bucket outranks everything; earliest absolute
+            # deadline first so the most endangered request leads
+            return (0, req.t_enqueue + req.deadline_s, idx)
+        return (1, -int(req.priority), idx)
+
+    def collect(self, n: int, deadline_s: float = 0.0) -> list[Request]:
+        deadline = time.monotonic() + max(0.0, deadline_s)
+        with self._cv:
+            while not self._items:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                self._cv.wait(timeout)
+            now = self.now()
+            order = sorted(range(len(self._items)),
+                           key=lambda i: self._key(i, self._items[i],
+                                                   now))
+            take = sorted(order[:max(0, n)])
+            out = [self._items[i] for i in order[:max(0, n)]]
+            for i in reversed(take):
+                del self._items[i]
+        if self.metrics is not None:
+            self._depth()
+            t = time.monotonic()
+            wait = self.metrics.histogram("serve_wait_seconds",
+                                          kind=self.kind)
+            for req in out:
+                wait.observe(max(0.0, t - req.t_enqueue))
+        return out
+
+
 @dataclasses.dataclass
 class _Slot:
     req: Request
@@ -193,9 +296,20 @@ class _RunnerBase:
         self.responses: list[Response] = []
         self.metrics = metrics
         self.slo_ms = None if slo_ms is None else float(slo_ms)
+        # serving-tier hooks (lux_tpu/fleet.py): ``replica`` labels
+        # the per-query events with the runner's replica name, and
+        # ``on_boundary(runner)`` fires at the TOP of every segment
+        # boundary — the fleet's heartbeat-beat + chaos-kill-plan
+        # injection point (an exception raised there propagates out
+        # of drain() as a mid-drain replica death)
+        self.replica: str | None = None
+        self.on_boundary: Callable | None = None
         # rolling SLO window: True per retirement = violation
         import collections
         self._slo_window = collections.deque(maxlen=SLO_WINDOW)
+
+    def _rep(self) -> dict:
+        return {} if self.replica is None else {"replica": self.replica}
 
     def _free_cols(self):
         return [c for c, s in enumerate(self.slots) if s is None]
@@ -209,7 +323,7 @@ class _RunnerBase:
                                 iter_start=total_iters)
         _emit("query_start", qid=req.qid, query_kind=self.kind,
               col=col,
-              wait_s=round(now - req.t_enqueue, 6))
+              wait_s=round(now - req.t_enqueue, 6), **self._rep())
 
     def _retire(self, col: int, answer: np.ndarray, total_iters: int,
                 converged: bool = True):
@@ -250,7 +364,7 @@ class _RunnerBase:
               iters=resp.iters, segments=resp.segments,
               latency_s=round(resp.latency_s, 6),
               wait_s=round(resp.wait_s, 6), converged=converged,
-              **slo)
+              **slo, **self._rep())
         return resp
 
     def _boundary_metrics(self, retired: int, filled: int,
@@ -261,10 +375,15 @@ class _RunnerBase:
         if self.metrics is None:
             return
         m = self.metrics
+        # counters are SHARED fleet-wide (they sum correctly across
+        # replicas); the gauges are per-replica quantities and carry
+        # the replica label when one is set — N replicas writing one
+        # (name, kind) gauge would be last-writer-wins noise
         m.counter("serve_segments_total", kind=self.kind).inc()
-        m.gauge("serve_batch_occupancy",
-                kind=self.kind).set(len(self._occupied()))
-        m.gauge("serve_queue_depth", kind=self.kind).set(queued)
+        m.gauge("serve_batch_occupancy", kind=self.kind,
+                **self._rep()).set(len(self._occupied()))
+        m.gauge("serve_queue_depth", kind=self.kind,
+                **self._rep()).set(queued)
         if filled:
             m.counter("serve_refilled_total",
                       kind=self.kind).inc(filled)
@@ -341,6 +460,8 @@ class PushBatchRunner(_RunnerBase):
                                   sg.to_padded(act_h))
 
         def hook(label, active, total, cnt):
+            if self.on_boundary is not None:
+                self.on_boundary(self)
             for s in self.slots:
                 if s is not None:
                     s.segments += 1
@@ -449,6 +570,8 @@ class PullBatchRunner(_RunnerBase):
 
         def hook(state, done_iters):
             nonlocal prev
+            if self.on_boundary is not None:
+                self.on_boundary(self)
             for s in self.slots:
                 if s is not None:
                     s.segments += 1
@@ -525,8 +648,14 @@ class Server:
                  seg_iters: int = DEFAULT_SEG_ITERS,
                  tol: float = 1e-8, deadline_s: float = 0.0,
                  slo_ms: dict | None = None, metrics=None,
-                 snapshot_every_s: float = 1.0):
+                 snapshot_every_s: float = 1.0, on_boundary=None,
+                 replica: str | None = None):
         self.g = g
+        # fleet hooks (lux_tpu/fleet.py): the subprocess replica
+        # worker runs a whole Server and needs its runners to beat
+        # the replica board (and fire kill plans) at every boundary
+        self.on_boundary = on_boundary
+        self.replica = replica
         self.batch = int(batch)
         self.opts = dict(num_parts=num_parts, mesh=mesh,
                          exchange=exchange, health=health)
@@ -573,6 +702,8 @@ class Server:
                     kind, self.g, self.batch,
                     weighted=self.weighted,
                     seg_iters=self.seg_iters, **mkw, **self.opts)
+            self._runners[kind].on_boundary = self.on_boundary
+            self._runners[kind].replica = self.replica
         return self._runners[kind]
 
     def set_metrics(self, registry) -> None:
@@ -596,14 +727,19 @@ class Server:
         return self.metrics.emit_snapshot(**extra)
 
     def submit(self, kind: str, source: int | None = None,
-               reset=None) -> int:
+               reset=None, tenant: str = "default",
+               priority: int = 0,
+               deadline_s: float | None = None) -> int:
         qid = self._next_qid
         self._next_qid += 1
         req = Request(qid=qid, kind=kind,
                       source=None if source is None else int(source),
                       reset=(None if reset is None
                              else np.asarray(reset, np.float32)),
-                      t_enqueue=time.monotonic())
+                      t_enqueue=time.monotonic(), tenant=str(tenant),
+                      priority=int(priority),
+                      deadline_s=(None if deadline_s is None
+                                  else float(deadline_s)))
         if self.metrics is not None:
             self.metrics.counter("serve_queries_total",
                                  kind=kind).inc()

@@ -89,8 +89,18 @@ META_KEYS = ("frontier", "bucket", "advances")
 # per-query serving spans (round 17): query tracks start here, one
 # LANE per set of non-overlapping queries (greedy interval packing —
 # an oversubscribed load renders as stacked lanes whose depth IS the
-# concurrency), leaving tid 1..99 to the execution epochs
+# concurrency), leaving tid 1..99 to the execution epochs.  Round 18
+# (serving fleet, lux_tpu/fleet.py): lanes group PER REPLICA — each
+# replica group gets a contiguous tid range starting at
+# QUERY_TID_BASE, sized max(QUERY_REPLICA_STRIDE, its lane count)
+# (so small traces keep stable base+group*stride tids and a deep
+# group can never collide into the next group's range), and a
+# failover renders as the qid's span SPLITTING onto the new
+# replica's track group (the round-13 mesh-shrink epoch pattern
+# applied to query lanes; ``validate_trace`` machine-checks the
+# transition).
 QUERY_TID_BASE = 100
+QUERY_REPLICA_STRIDE = 40
 
 
 def _num(x) -> bool:
@@ -326,12 +336,21 @@ def _query_spans(run, times, trk: _Track, te: list, rstart, rend):
     into the queue WAIT (enqueue -> column assignment) and the
     engine segments that carried it — so a query's wait-vs-compute
     renders visibly in Perfetto.  Queries pack greedily onto
-    ``queries.N`` lanes (tid QUERY_TID_BASE+N, one lane per set of
-    non-overlapping queries); everything is clamped into the run
-    extent so the run-nesting invariant holds by construction, and
+    ``queries.N`` lanes (one lane per set of non-overlapping
+    queries); everything is clamped into the run extent so the
+    run-nesting invariant holds by construction, and
     ``validate_trace`` machine-checks the query/query_phase nesting
-    rule."""
-    enq, start, done = {}, {}, {}
+    rule.
+
+    Round 18 (serving fleet): lanes group PER REPLICA (thread name
+    ``queries[replica].N``), and a ``failover`` event SPLITS the
+    qid's span at the failover instant — the pre-failover segment
+    stays on the dead replica's track group, the post-failover
+    segment (named ``... (failover)``, args carrying
+    ``failover_from``/``failover_to``) moves onto the survivor's —
+    the same track-transition idiom the mesh-shrink epochs use, now
+    on the query lanes."""
+    enq, starts, done, fo = {}, {}, {}, {}
     segs = []
     for ev, ts in zip(run, times):
         kind = ev["kind"]
@@ -339,7 +358,9 @@ def _query_spans(run, times, trk: _Track, te: list, rstart, rend):
         if kind == "query_enqueue":
             enq.setdefault(qid, ts)
         elif kind == "query_start":
-            start[qid] = ts
+            starts.setdefault(qid, []).append((ts, ev))
+        elif kind == "failover":
+            fo.setdefault(qid, []).append((ts, ev))
         elif kind == "query_done":
             done[qid] = (ts, ev)
         elif kind == "segment" and _num(ev.get("seconds")):
@@ -353,7 +374,8 @@ def _query_spans(run, times, trk: _Track, te: list, rstart, rend):
         t0 = enq.get(qid)
         if t0 is None and _num(ev.get("latency_s")):
             t0 = tend - ev["latency_s"] * 1e6
-        t1 = start.get(qid)
+        sl = starts.get(qid) or []
+        t1 = sl[0][0] if sl else None
         if t1 is None and t0 is not None and _num(ev.get("wait_s")):
             t1 = t0 + ev["wait_s"] * 1e6
         t0 = tend if t0 is None else t0
@@ -363,42 +385,99 @@ def _query_spans(run, times, trk: _Track, te: list, rstart, rend):
         tend = min(max(tend, t1), rend)
         qs.append((t0, t1, tend, qid, ev))
     qs.sort(key=lambda x: (x[0], x[2]))
-    lane_ends: list = []
-    for t0, t1, tend, qid, ev in qs:
-        lane = next((i for i, e in enumerate(lane_ends)
-                     if e <= t0), None)
+    groups: dict = {}           # replica -> lane-group index
+    lane_ends: dict = {}        # group -> per-lane end times
+    lane_labels: dict = {}      # (group, lane) -> thread label
+    placed: list = []           # (group, lane, span dict) pending tid
+
+    def lane_of(replica, s, e):
+        group = groups.setdefault(replica, len(groups))
+        ends = lane_ends.setdefault(group, [])
+        lane = next((i for i, x in enumerate(ends) if x <= s), None)
         if lane is None:
-            lane = len(lane_ends)
-            lane_ends.append(tend)
-            te.append(_meta("thread_name", trk.pid,
-                            f"queries.{lane}",
-                            tid=QUERY_TID_BASE + lane))
+            lane = len(ends)
+            ends.append(e)
+            lane_labels[(group, lane)] = (
+                f"queries.{lane}" if replica is None
+                else f"queries[{replica}].{lane}")
         else:
-            lane_ends[lane] = tend
-        tid = QUERY_TID_BASE + lane
-        args = {k: v for k, v in ev.items()
+            ends[lane] = max(ends[lane], e)
+        return group, lane
+
+    for t0, t1, tend, qid, ev in qs:
+        sl = starts.get(qid) or []
+        fos = sorted(fo.get(qid) or [], key=lambda x: x[0])
+        cuts = [t0]
+        for ts_f, _fev in fos:
+            cuts.append(min(max(ts_f, cuts[-1]), tend))
+        cuts.append(tend)
+
+        def replica_of(i):
+            if i == 0:
+                # the failover record is authoritative for the
+                # pre-failover replica: a query killed while still
+                # QUEUED on the dead replica has its first
+                # query_start on the survivor, but its first life
+                # segment belongs to the replica it was assigned to
+                if fos:
+                    return fos[0][1].get("from_replica")
+                if sl:
+                    return sl[0][1].get("replica")
+                return ev.get("replica")
+            return fos[i - 1][1].get("to_replica")
+
+        base = {k: v for k, v in ev.items()
                 if k in ("qid", "query_kind", "col", "iters",
                          "segments", "latency_s", "wait_s",
                          "converged", "slo_ms", "slo_ok")}
-        te.append(_span(f"q{qid} [{ev.get('query_kind', '?')}]",
-                        "query", t0, tend - t0, trk.pid, tid,
-                        args=args))
-        if t1 > t0:
-            te.append(_span("wait", "query_phase", t0, t1 - t0,
-                            trk.pid, tid))
-        resident = False
-        for s0, s1 in segs:
-            a, b = max(s0, t1), min(s1, tend)
-            if b > a:
-                te.append(_span("seg", "query_phase", a, b - a,
-                                trk.pid, tid))
-                resident = True
-        if not resident and tend > t1:
-            # no overlapping segment events (sparse log): one
-            # undifferentiated residency child keeps wait-vs-compute
-            # readable
-            te.append(_span("resident", "query_phase", t1,
-                            tend - t1, trk.pid, tid))
+        for i in range(len(cuts) - 1):
+            s, e = cuts[i], cuts[i + 1]
+            replica = replica_of(i)
+            gl = lane_of(replica, s, e)
+            args = dict(base)
+            if replica is not None:
+                args["replica"] = replica
+            name = f"q{qid} [{ev.get('query_kind', '?')}]"
+            if i > 0:
+                fev = fos[i - 1][1]
+                args["failover_from"] = fev.get("from_replica")
+                args["failover_to"] = fev.get("to_replica")
+                name += " (failover)"
+            placed.append((*gl, _span(name, "query", s, e - s,
+                                      trk.pid, 0, args=args)))
+            lo = min(max(t1, s), e) if i == 0 else s
+            if i == 0 and lo > s:
+                placed.append((*gl, _span("wait", "query_phase", s,
+                                          lo - s, trk.pid, 0)))
+            resident = False
+            for s0, s1 in segs:
+                a, b = max(s0, lo), min(s1, e)
+                if b > a:
+                    placed.append((*gl, _span("seg", "query_phase",
+                                              a, b - a, trk.pid, 0)))
+                    resident = True
+            if not resident and e > lo:
+                # no overlapping segment events (sparse log): one
+                # undifferentiated residency child keeps
+                # wait-vs-compute readable
+                placed.append((*gl, _span("resident", "query_phase",
+                                          lo, e - lo, trk.pid, 0)))
+
+    # tid assignment is a SECOND pass: each replica group gets a
+    # contiguous lane range sized by its ACTUAL lane count (at least
+    # QUERY_REPLICA_STRIDE, so small traces keep the stable
+    # base+group*stride tids) — a group needing more lanes than the
+    # stride can never collide into the next group's track range
+    offsets, off = {}, 0
+    for group in sorted(lane_ends):
+        offsets[group] = off
+        off += max(QUERY_REPLICA_STRIDE, len(lane_ends[group]))
+    for (group, lane), label in sorted(lane_labels.items()):
+        te.append(_meta("thread_name", trk.pid, label,
+                        tid=QUERY_TID_BASE + offsets[group] + lane))
+    for group, lane, span in placed:
+        span["tid"] = QUERY_TID_BASE + offsets[group] + lane
+        te.append(span)
 
 
 def trace_export(events, out: str | None = None) -> dict:
@@ -478,6 +557,7 @@ def validate_trace(trace, eps_us: float = _EPS_US) -> list[str]:
     runs: dict = {}
     qspans: dict = {}
     qphases: dict = {}
+    qrecords: list = []
     for i, e in enumerate(evs):
         if not isinstance(e, dict):
             errs.append(f"traceEvents[{i}]: not an object")
@@ -512,6 +592,7 @@ def validate_trace(trace, eps_us: float = _EPS_US) -> list[str]:
                 qspans.setdefault((e.get("pid"), e.get("tid")),
                                   []).append(
                     (e["ts"], e["ts"] + e["dur"]))
+                qrecords.append(e)
             elif e.get("cat") == "query_phase":
                 qphases.setdefault((e.get("pid"), e.get("tid")),
                                    []).append(e)
@@ -555,6 +636,52 @@ def validate_trace(trace, eps_us: float = _EPS_US) -> list[str]:
                     f"query_phase span {e['name']!r} [{s}, {end}] "
                     f"lies in no query span — per-query phases must "
                     f"nest inside their query")
+    # round 18 (serving fleet): a qid appearing as MULTIPLE query
+    # spans is a failover split — every span after the first must
+    # carry its failover record and sit on a DIFFERENT track (the
+    # new replica's lane group); anything else is either a duplicate
+    # retirement or a failover that did not transition tracks.
+    # Scoped to the containing run window so qids legitimately reused
+    # across runs in one stream don't conflate.
+    by_qid: dict = {}
+    for e in qrecords:
+        qid = (e.get("args") or {}).get("qid")
+        if not isinstance(qid, int):
+            continue            # reported above
+        pid = e.get("pid")
+        rl = runs.get(pid) or []
+        w = next((i for i, (rs, re) in enumerate(rl)
+                  if rs - eps_us <= e["ts"]
+                  and e["ts"] + e["dur"] <= re + eps_us), None)
+        by_qid.setdefault((pid, w, qid), []).append(e)
+    for (pid, _w, qid), lst in by_qid.items():
+        if len(lst) < 2:
+            continue
+        lst.sort(key=lambda e: e["ts"])
+        for prev, cur in zip(lst, lst[1:]):
+            args = cur.get("args") or {}
+            if "failover_from" not in args:
+                errs.append(
+                    f"qid {qid} (pid {pid}): {len(lst)} query spans "
+                    f"but the span at ts {cur['ts']} carries no "
+                    f"failover record — a qid must retire exactly "
+                    f"once")
+                continue
+            if cur.get("tid") == prev.get("tid"):
+                errs.append(
+                    f"qid {qid} (pid {pid}): post-failover segment "
+                    f"at ts {cur['ts']} sits on the SAME track (tid "
+                    f"{cur.get('tid')}) as the segment it continues "
+                    f"— a failover must transition onto the new "
+                    f"replica's track")
+            rep = args.get("replica")
+            if rep is not None and args.get("failover_to") is not None \
+                    and rep != args["failover_to"]:
+                errs.append(
+                    f"qid {qid} (pid {pid}): post-failover segment "
+                    f"claims replica {rep!r} but its failover record "
+                    f"names {args['failover_to']!r} — the span "
+                    f"contradicts its own transition")
     return errs
 
 

@@ -101,6 +101,14 @@ Checked per metric line:
   outside [0, 1], or a headline value disagreeing with the recorded
   achieved rate.
 
+- serve-chaos lines (round 18, bench.py -config serve-chaos +
+  lux_tpu/fleet.py): the serve-slo record under an injected replica
+  kill, extended with replicas/failovers/shed/shed_fraction/
+  slo_accounted; rejected on shed_fraction outside [0, 1] (or
+  disagreeing with shed/submitted), failovers with replicas=1,
+  served+shed != submitted, or slo_accounted > served (an SLO
+  fraction computed over shed queries).
+
 - telemetry.health (round 9, bench.py -health): the device-side
   watchdog digest — optional and null when off; present it must be a
   clean bill ({engine, tripped=false, flags=[], iters >= 0}; known
@@ -162,6 +170,18 @@ REORDER_METHODS = ("none", "native", "hillclimb")
 # cannot outrun arrivals), and an SLO good fraction outside [0, 1].
 SERVE_SLO_METRIC = re.compile(
     r"^serve_slo_q([0-9pm]+)_rmat(\d+)_qps_per_chip$")
+# round-18 serving chaos lines (bench.py -config serve-chaos +
+# lux_tpu/fleet.py): the serve-slo record under an injected replica
+# kill, extended with replicas/failovers/shed/shed_fraction/
+# slo_accounted.  Contradiction rejects on top of the serve-slo set:
+# shed_fraction outside [0, 1] (or disagreeing with shed/submitted),
+# failovers > 0 with replicas = 1 (no survivor to fail over TO),
+# served + shed != submitted (admitted and shed must partition the
+# offered load), and slo_accounted > served (the SLO fraction was
+# computed over shed queries — the accounting covers ADMITTED
+# retirements only).
+SERVE_CHAOS_METRIC = re.compile(
+    r"^serve_chaos_q([0-9pm]+)_rmat(\d+)_qps_per_chip$")
 
 
 def iter_metric_lines(path: str):
@@ -312,8 +332,12 @@ def check_line(obj: dict, *, legacy_ok: bool):
                                     m.group(1) if m else None,
                                     (m.group(2) or "none") if m
                                     else None)
-    if SERVE_SLO_METRIC.match(name) or "offered_qps" in obj:
+    if SERVE_SLO_METRIC.match(name) or SERVE_CHAOS_METRIC.match(name) \
+            or "offered_qps" in obj:
         errs += check_serve_slo_fields(name, obj)
+    if SERVE_CHAOS_METRIC.match(name) or "shed_fraction" in obj \
+            or "failovers" in obj:
+        errs += check_serve_chaos_fields(name, obj)
     return errs, warns
 
 
@@ -534,6 +558,70 @@ def check_serve_slo_fields(name: str, obj: dict) -> list[str]:
             errs.append(f"{name}: slo_target_ms={tgt!r} must be a "
                         f"positive number or a non-empty "
                         f"{{kind: positive ms}} dict")
+    return errs
+
+
+def check_serve_chaos_fields(name: str, obj: dict) -> list[str]:
+    """Round-18 serving chaos lines (see SERVE_CHAOS_METRIC): the
+    resilience record must be present and free of the contradictions
+    an honest kill-under-load run cannot produce."""
+    errs = []
+
+    def _int(x) -> bool:
+        # bool is an int subclass: a JSON-boolean chaos record must
+        # not validate as 0/1
+        return isinstance(x, int) and not isinstance(x, bool)
+
+    missing = [k for k in ("replicas", "failovers", "shed",
+                           "shed_fraction") if k not in obj]
+    if missing:
+        errs.append(f"{name}: serve-chaos line missing {missing}")
+    reps = obj.get("replicas")
+    if reps is not None and (not _int(reps) or reps < 1):
+        errs.append(f"{name}: replicas={reps!r} must be an int >= 1")
+        reps = None
+    fo = obj.get("failovers")
+    if fo is not None and (not _int(fo) or fo < 0):
+        errs.append(f"{name}: failovers={fo!r} must be an int >= 0")
+        fo = None
+    if fo is not None and fo > 0 and reps == 1:
+        errs.append(
+            f"{name}: failovers={fo} with replicas=1 — there is no "
+            f"surviving replica to fail over TO; the line "
+            f"contradicts its own topology")
+    shed = obj.get("shed")
+    if shed is not None and (not _int(shed) or shed < 0):
+        errs.append(f"{name}: shed={shed!r} must be an int >= 0")
+        shed = None
+    frac = obj.get("shed_fraction")
+    if frac is not None and (not _is_num(frac)
+                             or not 0.0 <= frac <= 1.0):
+        errs.append(f"{name}: shed_fraction={frac!r} must be a "
+                    f"finite number in [0, 1]")
+        frac = None
+    served, submitted = obj.get("served"), obj.get("submitted")
+    ints = all(_int(x) for x in (served, submitted))
+    if ints and shed is not None and served + shed != submitted:
+        errs.append(
+            f"{name}: served={served} + shed={shed} != "
+            f"submitted={submitted} — admitted and shed queries "
+            f"must partition the offered load")
+    if ints and frac is not None and shed is not None \
+            and submitted > 0 \
+            and abs(frac - shed / submitted) > 2e-4:
+        errs.append(
+            f"{name}: shed_fraction={frac} disagrees with "
+            f"shed/submitted = {shed / submitted:.4f}")
+    acc = obj.get("slo_accounted")
+    if acc is not None and (not _int(acc) or acc < 0):
+        errs.append(f"{name}: slo_accounted={acc!r} must be an int "
+                    f">= 0")
+        acc = None
+    if acc is not None and _int(served) and acc > served:
+        errs.append(
+            f"{name}: slo_accounted={acc} > served={served} — the "
+            f"SLO good fraction was computed over shed queries; SLO "
+            f"accounting covers ADMITTED retirements only")
     return errs
 
 

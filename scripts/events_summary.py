@@ -83,7 +83,16 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "straggler", "calibration", "phase_cost", "drift",
          "debt_collected", "heartbeat", "flight_dump",
          "query_enqueue", "query_start", "query_done", "serve_refill",
-         "metrics_snapshot", "log_rotate"}
+         "metrics_snapshot", "log_rotate",
+         "replica_up", "replica_lost", "failover", "query_shed",
+         "brownout"}
+
+# a query_shed without these cannot be diagnosed — the serving
+# fleet's typed-rejection contract (lux_tpu/fleet.py)
+QUERY_SHED_REQUIRED = ("qid", "query_kind", "reason")
+
+# a failover without these cannot name the transition it claims
+FAILOVER_REQUIRED = ("qid", "from_replica", "to_replica")
 
 # a query_done without these cannot account for the query's cost —
 # the serving front-end's per-query latency contract (lux_tpu/serve.py)
@@ -587,6 +596,88 @@ def render_run(run, out=sys.stdout) -> list[str]:
         if refills:
             print(f"  continuous batching: {len(refills)} refill "
                   f"boundary(ies), {live} retire+refill", file=out)
+
+    # round 18 (serving fleet, lux_tpu/fleet.py): the resilience
+    # trail — replica membership, failovers, sheds, brownout — and
+    # its exactly-once / typed-rejection audits:
+    # - a qid that retires TWICE violates exactly-once retirement
+    # - a query_done for a SHED qid means a rejected query ran anyway
+    # - a replica_lost with in-flight queries but no failover (or
+    #   shed) accounting for them is an UNDIAGNOSED loss
+    done_count = {}
+    for q in by.get("query_done", []):
+        if "qid" in q:
+            done_count[q["qid"]] = done_count.get(q["qid"], 0) + 1
+    for qid, n in sorted(done_count.items()):
+        if n > 1:
+            errs.append(f"{title}: qid={qid} retired {n} times — "
+                        f"exactly-once retirement violated")
+    sheds = []          # WELL-FORMED sheds only: a malformed record
+    shed_qids = set()   # must not vouch for anything below
+    for s in by.get("query_shed", []):
+        missing = [k for k in QUERY_SHED_REQUIRED if k not in s]
+        if missing:
+            errs.append(f"{title}: query_shed missing {missing} — "
+                        f"an unaccountable rejection: {s!r}"[:200])
+            continue
+        sheds.append(s)
+        shed_qids.add(s["qid"])
+    for qid in sorted(shed_qids & set(done_count)):
+        errs.append(f"{title}: query_done for qid={qid} which was "
+                    f"SHED — a rejected query must never retire")
+    fos = []
+    for f in by.get("failover", []):
+        missing = [k for k in FAILOVER_REQUIRED if k not in f]
+        if missing:
+            errs.append(f"{title}: failover missing {missing} — an "
+                        f"unaccountable transition: {f!r}"[:200])
+            continue
+        fos.append(f)
+    ups = by.get("replica_up", [])
+    losts = by.get("replica_lost", [])
+    for rl in losts:
+        if not rl.get("replica") or not rl.get("error"):
+            errs.append(f"{title}: replica_lost without its "
+                        f"replica/error: {rl!r}"[:200])
+            continue
+        inflight = rl.get("inflight")
+        if _is_int(inflight) and inflight > 0:
+            # only failovers FROM this replica, or sheds with the
+            # failover-path reasons (no_capacity / retries), diagnose
+            # a loss — an unrelated admission-time shed (brownout,
+            # quota, queue_full, deadline) must not vouch for
+            # vanished in-flight queries
+            accounted = any(f.get("from_replica") == rl["replica"]
+                            for f in fos) \
+                or any(s.get("reason") in ("no_capacity", "retries")
+                       for s in sheds)
+            if not accounted:
+                errs.append(
+                    f"{title}: replica_lost {rl['replica']!r} with "
+                    f"{inflight} in-flight query(ies) but no "
+                    f"failover or shed accounts for them — an "
+                    f"undiagnosed loss")
+    if ups or losts:
+        lost_names = sorted(str(rl.get("replica")) for rl in losts)
+        print(f"  replicas: {len(ups)} up, {len(losts)} lost"
+              + (f" ({', '.join(lost_names)})" if lost_names else ""),
+              file=out)
+    if fos:
+        qids = sorted({f.get("qid") for f in fos})
+        print(f"  failovers: {len(fos)} re-dispatch(es) over "
+              f"{len(qids)} qid(s)", file=out)
+    if sheds:
+        reasons = {}
+        for s in sheds:
+            r = s.get("reason", "?")
+            reasons[r] = reasons.get(r, 0) + 1
+        mix = ", ".join(f"{r} x{n}"
+                        for r, n in sorted(reasons.items()))
+        print(f"  shed: {len(sheds)} query(ies) ({mix})", file=out)
+    for b in by.get("brownout", []):
+        print(f"  BROWNOUT level={b.get('level')} capacity "
+              f"{b.get('capacity_frac')} min_priority="
+              f"{b.get('min_priority')}", file=out)
 
     # round 17: serving metrics snapshots, cross-audited against the
     # raw query_done stream they claim to aggregate

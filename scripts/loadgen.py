@@ -78,6 +78,18 @@ class StepReport:
     slo_good_fraction: float | None
     per_kind: dict            # kind -> {count, p50_ms, p99_ms}
     drained: bool
+    # serving-fleet fields (round 18, lux_tpu/fleet.py): queries the
+    # tier SHED with a typed AdmissionError (admitted + shed
+    # partition the submitted set — ``drained`` counts both), and
+    # the snapshot's SLO-accounted retirement count (good +
+    # violation — computed over ADMITTED queries only; check_bench
+    # rejects a line whose accounting covers shed queries)
+    shed: int = 0
+    slo_accounted: int | None = None
+    # the raw Response objects, for oracle verification by chaos
+    # acceptance harnesses (not rendered, not serialized)
+    responses: list = dataclasses.field(default_factory=list,
+                                        repr=False)
 
 
 def _merged_latency(snapshot) -> tuple:
@@ -97,13 +109,19 @@ def _merged_latency(snapshot) -> tuple:
     return merged, per_kind
 
 
-def _slo_fraction(snapshot) -> float | None:
+def _slo_counts(snapshot) -> tuple:
+    """(good, violation) totals of the snapshot's SLO counters."""
     good = bad = 0.0
     for c in snapshot.get("counters", []):
         if c.get("name") == "serve_slo_good_total":
             good += c.get("value", 0)
         elif c.get("name") == "serve_slo_violation_total":
             bad += c.get("value", 0)
+    return good, bad
+
+
+def _slo_fraction(snapshot) -> float | None:
+    good, bad = _slo_counts(snapshot)
     if good + bad == 0:
         return None
     return good / (good + bad)
@@ -130,11 +148,16 @@ def run_step(srv, rate: float, n: int, kinds, rng,
 
     done = threading.Event()
     enq_last = [0.0]
+    shed0 = len(getattr(srv, "shed_records", ()))
 
     def submit_all():
+        from lux_tpu.fleet import AdmissionError
         for (kind, s), gap in zip(specs, gaps):
             time.sleep(gap)
-            srv.submit(kind, source=s)
+            try:
+                srv.submit(kind, source=s)
+            except AdmissionError:
+                pass        # typed shed: counted via shed_records
             enq_last[0] = time.monotonic()
         done.set()
 
@@ -172,6 +195,8 @@ def run_step(srv, rate: float, n: int, kinds, rng,
     p99 = merged.quantile(0.99)
     offered = len(specs) / max(enq_last[0] - t_start, 1e-9)
     achieved = len(responses) / max(t_last - t_start, 1e-9)
+    shed = len(getattr(srv, "shed_records", ())) - shed0
+    good, bad = _slo_counts(snapshot)
     per_kind = {
         k: {"count": h.get("count"),
             "p50_ms": None if h.get("p50") is None
@@ -186,7 +211,12 @@ def run_step(srv, rate: float, n: int, kinds, rng,
         p50_ms=None if p50 is None else p50 * 1e3,
         p99_ms=None if p99 is None else p99 * 1e3,
         slo_good_fraction=_slo_fraction(snapshot),
-        per_kind=per_kind, drained=len(responses) == len(specs))
+        per_kind=per_kind,
+        drained=len(responses) + shed == len(specs),
+        shed=shed,
+        slo_accounted=(None if good + bad == 0
+                       else int(good + bad)),
+        responses=responses)
 
 
 def warm(srv, kinds) -> int:

@@ -569,6 +569,57 @@ def test_bad_serve_slo_lines_fail(tmp_path, mutate, needle):
     assert needle in r.stderr, r.stderr
 
 
+# -- round-18 serving chaos lines (bench.py -config serve-chaos) -------
+
+SERVE_CHAOS_LINE = {
+    **json.loads(json.dumps(SERVE_SLO_LINE)),
+    "metric": "serve_chaos_q45_rmat12_qps_per_chip",
+    "replicas": 2, "failovers": 3, "shed": 1,
+    "shed_fraction": round(1 / 36, 4), "slo_accounted": 35,
+}
+
+
+def test_serve_chaos_line_passes_strict(tmp_path):
+    r = _audit_one(tmp_path, SERVE_CHAOS_LINE)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    # the round-18 contradiction rejects
+    (lambda o: o.update(shed_fraction=1.2), "shed_fraction"),
+    (lambda o: o.update(shed_fraction=-0.1), "shed_fraction"),
+    (lambda o: o.update(replicas=1), "no surviving replica"),
+    (lambda o: o.update(slo_accounted=36),
+     "computed over shed queries"),
+    (lambda o: o.update(shed=3), "partition the offered load"),
+    (lambda o: o.update(shed_fraction=0.5), "disagrees with"),
+    # record completeness + types
+    (lambda o: o.pop("replicas"), "serve-chaos line missing"),
+    (lambda o: o.pop("shed_fraction"), "serve-chaos line missing"),
+    (lambda o: o.update(failovers=-1), "failovers"),
+    (lambda o: o.update(replicas="two"), "replicas"),
+    # the serve-slo contradictions stay armed on chaos lines
+    (lambda o: o.update(p99_ms=9.0), "p99_ms=9.0 < p50_ms"),
+])
+def test_bad_serve_chaos_lines_fail(tmp_path, mutate, needle):
+    obj = json.loads(json.dumps(SERVE_CHAOS_LINE))
+    mutate(obj)
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 1, "audit passed a bad serve-chaos line"
+    assert needle in r.stderr, r.stderr
+
+
+def test_serve_chaos_zero_failovers_with_replicas_ok(tmp_path):
+    """failovers=0 with any replica count (and shed=0) is a
+    legitimate quiet run — only the impossible combinations
+    reject."""
+    obj = json.loads(json.dumps(SERVE_CHAOS_LINE))
+    obj.update(failovers=0, shed=0, shed_fraction=0.0,
+               served=36, slo_accounted=36)
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 0, r.stderr
+
+
 # ---------------------------------------------------------------------
 # round 16: gather-ab reorder field + pairing rule
 

@@ -609,3 +609,120 @@ def test_snapshot_overcount_disarmed_by_rotation_truncation(
     r = run_summary(p)
     assert r.returncode == 1
     assert "contradicts the raw per-query stream" in r.stderr
+
+
+# ---------------------------------------------------------------------
+# round 18 (serving fleet, lux_tpu/fleet.py): the resilience trail
+
+
+def _fleet_run(extra=()):
+    base = {"pid": 1, "session": "s"}
+    evs = [
+        dict(base, t=1.0, tm=1.0, kind="run_start", schema=1,
+             app="fleet"),
+        dict(base, t=1.01, tm=1.01, kind="replica_up", replica="r0",
+             remote=False, capacity=2),
+        dict(base, t=1.02, tm=1.02, kind="replica_up", replica="r1",
+             remote=False, capacity=2),
+        dict(base, t=1.1, tm=1.1, kind="query_enqueue", qid=0,
+             query_kind="sssp"),
+        dict(base, t=1.15, tm=1.15, kind="query_enqueue", qid=1,
+             query_kind="sssp"),
+        dict(base, t=1.5, tm=1.5, kind="replica_lost", replica="r1",
+             error="InjectedWorkerKill", message="boom", inflight=1),
+        dict(base, t=1.52, tm=1.52, kind="brownout", level=1,
+             capacity_frac=0.5, min_priority=1),
+        dict(base, t=1.55, tm=1.55, kind="failover", qid=1,
+             query_kind="sssp", from_replica="r1", to_replica="r0",
+             attempt=1, backoff_s=0.01),
+        dict(base, t=2.0, tm=2.0, kind="query_done", qid=0,
+             query_kind="sssp", iters=4, segments=2, latency_s=0.9,
+             wait_s=0.1, converged=True, replica="r0"),
+        dict(base, t=2.1, tm=2.1, kind="query_done", qid=1,
+             query_kind="sssp", iters=4, segments=2, latency_s=1.0,
+             wait_s=0.2, converged=True, replica="r0"),
+        dict(base, t=2.2, tm=2.2, kind="run_done", seconds=1.2,
+             iters=8),
+    ]
+    evs.extend(extra)
+    evs.sort(key=lambda e: e["t"])
+    return evs
+
+
+def test_fleet_trail_renders_clean(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run())
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "replicas: 2 up, 1 lost (r1)" in r.stdout
+    assert "failovers: 1 re-dispatch(es) over 1 qid(s)" in r.stdout
+    assert "BROWNOUT level=1" in r.stdout
+
+
+def test_double_query_done_fails(tmp_path):
+    """Exactly-once retirement: a qid retiring twice must fail the
+    audit — the duplicate answer would double-count every SLO
+    series."""
+    dup = {"pid": 1, "session": "s", "t": 2.15, "tm": 2.15,
+           "kind": "query_done", "qid": 1, "query_kind": "sssp",
+           "iters": 4, "segments": 2, "latency_s": 1.05,
+           "wait_s": 0.2, "converged": True, "replica": "r0"}
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run([dup]))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "retired 2 times" in r.stderr
+    assert "exactly-once" in r.stderr
+
+
+def test_query_done_after_shed_fails(tmp_path):
+    """A shed query must never retire: the typed rejection and a
+    served answer for one qid contradict each other."""
+    shed = {"pid": 1, "session": "s", "t": 1.9, "tm": 1.9,
+            "kind": "query_shed", "qid": 1, "query_kind": "sssp",
+            "tenant": "free", "priority": 0, "reason": "brownout"}
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run([shed]))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "SHED" in r.stderr and "never retire" in r.stderr
+
+
+def test_undiagnosed_replica_lost_fails(tmp_path):
+    """A replica_lost with in-flight queries but no failover or shed
+    accounting for them is an UNDIAGNOSED loss — queries vanished
+    without a trail."""
+    evs = [e for e in _fleet_run()
+           if e["kind"] not in ("failover",)]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, evs)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "undiagnosed loss" in r.stderr
+    # inflight=0 needs no diagnosis (the replica died idle)
+    evs2 = _fleet_run()
+    evs2 = [e for e in evs2 if e["kind"] != "failover"]
+    for e in evs2:
+        if e["kind"] == "replica_lost":
+            e["inflight"] = 0
+    write_log(p, evs2)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+
+
+def test_malformed_shed_and_lost_fail(tmp_path):
+    bad_shed = {"pid": 1, "session": "s", "t": 1.9, "tm": 1.9,
+                "kind": "query_shed", "qid": 7}   # no kind/reason
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run([bad_shed]))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "query_shed missing" in r.stderr
+    evs = _fleet_run()
+    for e in evs:
+        if e["kind"] == "replica_lost":
+            del e["error"]
+    write_log(p, evs)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "replica_lost without" in r.stderr

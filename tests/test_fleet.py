@@ -1,0 +1,399 @@
+"""lux_tpu/fleet.py: the resilient serving tier.
+
+THE round-18 chaos acceptance (ISSUE 13): 2+ replicas on the
+8-virtual-device mesh under oversubscribed mixed-kind open-loop
+loadgen traffic, one replica killed mid-load — every ADMITTED query
+retires with an oracle-correct answer, zero duplicate retirements,
+every shed query carries a typed AdmissionError, the SLO-good
+fraction over admitted queries holds, and the trace/event trails
+validate.  Plus the subprocess hard-kill drill (capability-probed,
+in-process WORKER_KILL fallback), admission-control units
+(queue_full / deadline / quota / brownout), exactly-once dedup, and
+the AdmissionError FATAL classification.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lux_tpu import faults, fleet, resilience, telemetry
+from lux_tpu.convert import uniform_random_edges
+from lux_tpu.graph import Graph
+from lux_tpu.serve import _check_answers
+
+REPO = Path(__file__).resolve().parent.parent
+SUMMARY = REPO / "scripts" / "events_summary.py"
+sys.path.insert(0, str(REPO / "scripts"))
+sys.path.insert(0, str(REPO))
+
+NV, NE, SEED = 256, 2048, 5
+GRAPH_SPEC = {"kind": "uniform", "nv": NV, "ne": NE, "seed": SEED}
+
+
+@pytest.fixture(scope="module")
+def g():
+    src, dst = uniform_random_edges(NV, NE, seed=SEED)
+    return Graph.from_edges(src, dst, NV)
+
+
+def fast_retry():
+    return resilience.RetryPolicy(retries=3, backoff_s=0.01,
+                                  max_backoff_s=0.05, jitter_seed=0)
+
+
+def make_fleet(g, tmp_path, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("batch", 2)
+    kw.setdefault("num_parts", 2)
+    kw.setdefault("retry", fast_retry())
+    kw.setdefault("board_path", str(tmp_path / "board"))
+    return fleet.FleetServer(g, **kw)
+
+
+class TestChaosAcceptance:
+    def test_kill_midload_oversubscribed_mesh8(self, g, tmp_path):
+        """THE acceptance: replica r1 dies mid-drain under an
+        oversubscribed open-loop mixed-kind load on the
+        8-virtual-device mesh; admitted answers are oracle-correct
+        and bitwise-stable, nothing retires twice, sheds are typed,
+        the SLO-good fraction over admitted queries holds, and the
+        failover renders as a validated track transition."""
+        import loadgen
+
+        from lux_tpu import tracing
+        from lux_tpu.parallel.mesh import make_mesh
+
+        kinds = ["sssp", "components", "pagerank"]
+        slo = {k: 60000.0 for k in kinds}   # generous: CPU mesh
+        path = tmp_path / "chaos_ev.jsonl"
+        ev = telemetry.EventLog(str(path))
+        with telemetry.use(events=ev):
+            ev.emit("run_start", schema=telemetry.SCHEMA, app="fleet",
+                    file="<test>", mesh=8)
+            flt = make_fleet(g, tmp_path, num_parts=8,
+                             mesh=make_mesh(8), slo_ms=slo,
+                             brownout_min_priority=1)
+            t0 = time.perf_counter()
+            flt.warm(kinds)     # every (replica, kind) engine
+            idx0 = len(ev.events)
+            # arm AFTER warm: r1 dies at its 2nd loaded boundary
+            plan = faults.ReplicaKillPlan({"r1": 1})
+            flt.set_fault(plan)
+            rng = np.random.default_rng(3)
+            # rate far past the CPU mesh's service rate: the whole
+            # load arrives up front and the B=2 columns oversubscribe
+            rep = loadgen.run_step(flt, rate=500.0, n=14,
+                                   kinds=kinds, rng=rng, step=0)
+            # post-kill determinism: the fleet is browned out, so a
+            # below-floor query sheds with a TYPED rejection while a
+            # priority-1 query is still admitted and served
+            assert flt._brownout == 1
+            with pytest.raises(fleet.AdmissionError) as ei:
+                flt.submit("sssp", source=3, tenant="free",
+                           priority=0)
+            assert ei.value.reason == fleet.SHED_BROWNOUT
+            assert ei.value.qid in {e.qid for e in flt.shed_records}
+            paid_qid = flt.submit("sssp", source=3, tenant="paid",
+                                  priority=1)
+            (paid,) = flt.run()
+            assert paid.qid == paid_qid
+            ev.emit("run_done",
+                    seconds=round(time.perf_counter() - t0, 6),
+                    iters=rep.served + 1)
+        ev.close()
+
+        # the kill fired and at least one query failed over
+        assert plan.fired and plan.fired[0][0] == "r1"
+        assert flt.failovers >= 1
+        # admitted + shed partition the offered load; nothing twice
+        assert rep.drained
+        assert rep.served + rep.shed == rep.submitted
+        done = [e for e in ev.events[idx0:]
+                if e["kind"] == "query_done"]
+        qids = [e["qid"] for e in done]
+        assert len(set(qids)) == len(qids), "duplicate retirement"
+        assert flt.dup_dropped == 0
+        # every shed carries a typed AdmissionError record
+        shed_evs = [e for e in ev.events
+                    if e["kind"] == "query_shed"]
+        assert {e.qid for e in flt.shed_records} == \
+            {e["qid"] for e in shed_evs}
+        assert all(isinstance(e, fleet.AdmissionError)
+                   for e in flt.shed_records)
+        # SLO-good fraction over ADMITTED queries at target
+        assert rep.slo_good_fraction is not None
+        assert rep.slo_good_fraction >= 0.9
+        assert rep.slo_accounted == rep.served
+
+        # every admitted answer matches its NumPy oracle — including
+        # the failed-over ones, bitwise for the integer apps
+        assert _check_answers(g, rep.responses + [paid]) == 0
+
+        # the failover is a validated track transition on the query
+        # lanes: post-failover segments sit on the NEW replica's
+        # track group
+        trace = tracing.trace_export(ev.events,
+                                     out=str(tmp_path / "t.json"))
+        assert tracing.validate_trace(trace) == []
+        fo_spans = [e for e in trace["traceEvents"]
+                    if e.get("cat") == "query"
+                    and "failover_from" in (e.get("args") or {})]
+        assert fo_spans, "no failover split rendered"
+        for e in fo_spans:
+            assert e["args"]["failover_from"] == "r1"
+            assert e["args"]["replica"] == e["args"]["failover_to"]
+
+        # the full event trail renders + audits clean
+        r = subprocess.run([sys.executable, str(SUMMARY), str(path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "replicas: 2 up, 1 lost (r1)" in r.stdout
+        assert "failovers:" in r.stdout
+        assert "BROWNOUT level=1" in r.stdout
+
+
+class TestSubprocessDrill:
+    def test_hard_kill_subprocess_failover(self, g, tmp_path):
+        """The hard-kill drill: a subprocess replica (its own OS
+        process, fed through the spool dir, beating the shared
+        ReplicaBoard) is killed by its armed ReplicaKillPlan
+        mid-drain; the parent detects the death and fails the
+        in-flight queries over to the in-process survivor.  Where
+        the capability probe cannot spawn the worker, the documented
+        fallback runs the same drill with an in-process
+        WORKER_KILL."""
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            flt = make_fleet(g, tmp_path, replicas=1,
+                             replica_deadline_s=10.0)
+            rep = flt.add_subprocess_replica(
+                GRAPH_SPEC, workdir=str(tmp_path / "spool"),
+                kill_boundary=2, spawn_budget_s=90.0)
+            if rep is None:     # capability probe failed: fallback
+                flt._add_inproc_replica()
+                flt.set_fault(faults.ReplicaKillPlan(
+                    {flt.replica_names[-1]: 2}))
+            rng = np.random.default_rng(1)
+            for i in range(8):
+                flt.submit(["sssp", "components"][i % 2],
+                           source=int(rng.integers(0, g.nv)))
+            # a personalized-pagerank reset vector must survive the
+            # spool serialization (npy sidecar) wherever it lands
+            reset = np.zeros(g.nv, np.float32)
+            reset[5] = 0.5
+            reset[9] = 0.5
+            ppr_qid = flt.submit("pagerank", reset=reset)
+            responses = flt.run()
+            flt.close()
+        assert len(responses) == 9
+        qids = [r.qid for r in responses]
+        assert len(set(qids)) == len(qids)
+        assert flt.failovers >= 1, \
+            "the killed replica's queries never failed over"
+        assert flt._replicas[1].state == "lost"
+        (ppr,) = [r for r in responses if r.qid == ppr_qid]
+        from lux_tpu.apps import pagerank
+        ref = pagerank.reference_pagerank_batched(
+            g, reset[:, None], max(1, ppr.iters))[:, 0]
+        np.testing.assert_allclose(ppr.answer, ref, atol=5e-5)
+        assert _check_answers(g, [r for r in responses
+                                  if r.qid != ppr_qid]) == 0
+        lost = [e for e in ev.events if e["kind"] == "replica_lost"]
+        assert lost and lost[0]["replica"] == "r1"
+
+
+class TestAdmission:
+    def test_queue_full_sheds_typed(self, g, tmp_path):
+        flt = make_fleet(g, tmp_path, max_queue=2)
+        flt.submit("sssp", source=1)
+        flt.submit("sssp", source=2)
+        with pytest.raises(fleet.AdmissionError) as ei:
+            flt.submit("sssp", source=3)
+        assert ei.value.reason == fleet.SHED_QUEUE_FULL
+        # the queued two still serve
+        rs = flt.run()
+        assert sorted(r.qid for r in rs) == [0, 1]
+
+    def test_deadline_projected_wait_sheds(self, g, tmp_path):
+        """Seed the service-time histogram, stuff the queue, then a
+        tight-deadline query must shed with the projected wait on the
+        typed error; a no-deadline query is still admitted."""
+        flt = make_fleet(g, tmp_path)
+        h = flt.metrics.histogram("fleet_service_seconds",
+                                  kind="sssp")
+        for _ in range(4):
+            h.observe(1.0)      # 1 s mean service time
+        for i in range(8):      # 8 queued / (2 replicas x B=2) = 2 s
+            flt._queue("sssp").put(
+                fleet.Request(qid=1000 + i, kind="sssp", source=1,
+                              t_enqueue=time.monotonic()))
+        with pytest.raises(fleet.AdmissionError) as ei:
+            flt.submit("sssp", source=3, deadline_s=0.5)
+        assert ei.value.reason == fleet.SHED_DEADLINE
+        assert ei.value.projected_wait_s == pytest.approx(2.0)
+        assert flt.submit("sssp", source=3) >= 0
+
+    def test_tenant_quota_sheds(self, g, tmp_path):
+        flt = make_fleet(g, tmp_path, quota={"free": 2})
+        flt.submit("sssp", source=1, tenant="free")
+        flt.submit("sssp", source=2, tenant="free")
+        with pytest.raises(fleet.AdmissionError) as ei:
+            flt.submit("sssp", source=3, tenant="free")
+        assert ei.value.reason == fleet.SHED_QUOTA
+        # another tenant is unaffected
+        flt.submit("sssp", source=3, tenant="paid")
+        rs = flt.run()
+        assert len(rs) == 3
+        # retirement releases the quota
+        assert flt.submit("sssp", source=4, tenant="free") >= 0
+
+    def test_admission_error_classifies_fatal(self):
+        err = fleet.AdmissionError(1, "sssp", "free",
+                                   fleet.SHED_DEADLINE,
+                                   projected_wait_s=2.0,
+                                   deadline_s=0.5)
+        assert resilience.classify(err) == resilience.FATAL
+
+    def test_priority_collector_on_replica_columns(self, g,
+                                                   tmp_path):
+        """Replica collectors are PriorityCollectors: with one
+        replica and B=1 columns, a high-priority late arrival is
+        collected before earlier low-priority requests."""
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            flt = make_fleet(g, tmp_path, replicas=1, batch=1)
+            q0 = flt.submit("sssp", source=1, priority=0)
+            q1 = flt.submit("sssp", source=2, priority=0)
+            q2 = flt.submit("sssp", source=3, priority=5)
+            rs = flt.run()
+        assert len(rs) == 3
+        starts = [e["qid"] for e in ev.events
+                  if e["kind"] == "query_start"]
+        # the priority-5 query starts before the second priority-0
+        assert starts.index(q2) < starts.index(q1)
+        assert starts[0] in (q0, q2)
+
+
+class TestExactlyOnce:
+    def test_replayed_retired_query_dropped(self, g, tmp_path):
+        """The replayed-query guard: re-dispatching a qid that
+        already retired (the detection race) is DROPPED — no second
+        query_done, no double answer."""
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            flt = make_fleet(g, tmp_path)
+            flt.submit("sssp", source=7)
+            (r,) = flt.run()
+            req = fleet.Request(qid=r.qid, kind="sssp", source=7,
+                                t_enqueue=time.monotonic())
+            flt._failover(req, flt._replicas[0])
+            out = flt.run()
+        assert out == []
+        assert flt.dup_dropped == 1
+        assert flt.failovers == 0
+        dones = [e for e in ev.events if e["kind"] == "query_done"]
+        assert len(dones) == 1
+
+    def test_answers_bitwise_equal_faultfree(self, g, tmp_path):
+        """Failed-over integer-app answers are BITWISE equal to a
+        fault-free fleet's: engines are deterministic in the graph
+        arrays and the source, so a restart on the survivor loses
+        time, never bits."""
+        specs = [("sssp", s) for s in (3, 17, 40, 99)] \
+            + [("components", s) for s in (7, 50, 120, 200)]
+
+        def run_once(fault):
+            flt = make_fleet(g, tmp_path, fault=fault)
+            for kind, s in specs:
+                flt.submit(kind, source=s)
+            rs = flt.run()
+            assert len(rs) == len(specs)
+            return {r.qid: r.answer for r in rs}, flt
+
+        plain, _ = run_once(None)
+        chaos, flt = run_once(
+            faults.ReplicaKillPlan({"r1": 1}))
+        assert flt.failovers >= 1
+        for qid in plain:
+            np.testing.assert_array_equal(plain[qid], chaos[qid])
+
+
+class TestServeChaosBench:
+    def test_serve_chaos_line_through_check_bench(self, tmp_path):
+        """The acceptance's bench leg: bench.py -config serve-chaos
+        produces a metric line scripts/check_bench.py ACCEPTS, the
+        kill verifiably fired, and the failovers/shed record rides
+        the line."""
+        import argparse
+
+        import bench
+
+        args = argparse.Namespace(
+            scale=8, ef=8, ni=20, np=2, pair=0, min_fill=None,
+            min_fill_dot=None, repeats=1, verbose=False,
+            health=False, audit="warn", serve_queries=12,
+            serve_batch=2, serve_kinds="sssp,components,pagerank",
+            slo_ms="sssp=30000,components=30000,pagerank=30000",
+            rates="150", batch="1", shape="rmat", reorder="none",
+            serve_replicas=2, kill_boundary=1)
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            idx0 = len(ev.events)
+            name, samples, extra, _rerun = bench.run_config(
+                "serve-chaos@150", args)
+            tel = bench.config_telemetry(ev, idx0, None)
+        assert name == "serve_chaos_q150_rmat8"
+        assert extra["replicas"] == 2 and extra["failovers"] >= 1
+        assert extra["served"] + extra["shed"] == extra["submitted"]
+        assert extra["audit"]["errors"] == 0
+        value = round(float(np.median(samples)), 4)
+        line = {"metric": f"{name}_qps_per_chip", "value": value,
+                "unit": "qps", "vs_baseline": value,
+                "samples": [round(s, 4) for s in samples],
+                "attempts": len(samples), "discarded": [],
+                "telemetry": tel, **extra}
+        p = tmp_path / "bench.jsonl"
+        p.write_text(json.dumps(line) + "\n")
+        r = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "check_bench.py"),
+             "-legacy-ok", str(p)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+
+    def test_serve_chaos_rejects_single_replica(self):
+        import argparse
+
+        import bench
+
+        args = argparse.Namespace(
+            scale=8, ef=8, ni=20, np=2, pair=0, min_fill=None,
+            min_fill_dot=None, repeats=1, verbose=False,
+            health=False, audit="off", serve_queries=4,
+            serve_batch=2, serve_kinds="sssp",
+            slo_ms="sssp=30000", rates="50", batch="1",
+            shape="rmat", reorder="none",
+            serve_replicas=1, kill_boundary=1)
+        with pytest.raises(ValueError, match="serve-replicas"):
+            bench.run_config("serve-chaos@50", args)
+
+
+class TestBoard:
+    def test_replica_board_ages_with_fake_clock(self, tmp_path):
+        clock = [100.0]
+        board = __import__("lux_tpu.heartbeat",
+                           fromlist=["ReplicaBoard"]).ReplicaBoard(
+            str(tmp_path / "b"), deadline_s=3.0,
+            now=lambda: clock[0])
+        assert board.age("r0") is None
+        board.beat("r0", status="up")
+        assert board.age("r0") == 0.0
+        assert board.alive("r0")
+        clock[0] += 5.0
+        assert board.age("r0") == 5.0
+        assert not board.alive("r0")
+        assert board.replicas() == ["r0"]

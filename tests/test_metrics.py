@@ -75,6 +75,18 @@ def test_quantile_edges_and_exact_scalars():
         h.quantile(1.5)
 
 
+def test_mean_is_exact_and_empty_is_none():
+    """Histogram.mean (round 18: the fleet's projected-wait
+    estimator input) is EXACT — sum/count ride beside the quantized
+    buckets — and None on an empty series."""
+    xs = [0.001, 0.003, 0.007, 0.2]
+    h = fill(xs)
+    assert h.mean() == pytest.approx(sum(xs) / len(xs), rel=1e-12)
+    assert metrics.Histogram().mean() is None
+    merged = h.merge(fill([1.0]))
+    assert merged.mean() == pytest.approx((sum(xs) + 1.0) / 5)
+
+
 def test_bucket_geometry_is_consistent():
     """Every in-range value lands in a bucket whose [lo, hi) contains
     it — the invariant the error bound rests on."""

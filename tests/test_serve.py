@@ -169,6 +169,75 @@ class TestCollector:
         assert [r.qid for r in got] == [7]
 
 
+class TestPriorityCollector:
+    """Round-18 deadline-priority collection (lux_tpu/fleet.py's
+    admission queue) — the PINNED ordering rule under a
+    deterministic injected clock: priority-desc FIFO, EXCEPT that a
+    request past HALF its deadline is AGED and cannot be displaced
+    further."""
+
+    @staticmethod
+    def make(clock):
+        return serve.PriorityCollector(now=lambda: clock[0])
+
+    @staticmethod
+    def req(qid, priority=0, deadline_s=None, t=0.0):
+        return serve.Request(qid=qid, kind="sssp", source=qid,
+                             t_enqueue=t, priority=priority,
+                             deadline_s=deadline_s)
+
+    def test_priority_order_fifo_within(self):
+        clock = [0.0]
+        c = self.make(clock)
+        for qid, pr in ((0, 0), (1, 2), (2, 1), (3, 2)):
+            c.put(self.req(qid, priority=pr))
+        assert [r.qid for r in c.collect(10)] == [1, 3, 2, 0]
+
+    def test_deadline_semantics_match_base(self):
+        import threading
+        clock = [0.0]
+        c = self.make(clock)
+        assert c.collect(4, deadline_s=0.0) == []   # never blocks
+        t = threading.Timer(0.05, lambda: c.put(self.req(9)))
+        t.start()
+        got = c.collect(2, deadline_s=2.0)   # waits for the FIRST
+        t.join()
+        assert [r.qid for r in got] == [9]
+
+    def test_aged_low_priority_not_displaced(self):
+        """The pinned aging rule: a low-priority request past half
+        its deadline outranks fresh high-priority traffic — a
+        saturated priority stream cannot displace it indefinitely."""
+        clock = [0.0]
+        c = self.make(clock)
+        c.put(self.req(0, priority=0, deadline_s=10.0, t=0.0))
+        for i in range(1, 4):
+            c.put(self.req(i, priority=5, t=0.0))
+        # fresh: high priority first, the low-priority one last
+        assert [r.qid for r in c.collect(2)] == [1, 2]
+        # past HALF the deadline: the aged request now leads
+        clock[0] = 5.0
+        c.put(self.req(4, priority=5, t=4.9))
+        assert [r.qid for r in c.collect(2)] == [0, 3]
+
+    def test_aged_order_earliest_deadline_first(self):
+        clock = [10.0]
+        c = self.make(clock)
+        c.put(self.req(0, priority=0, deadline_s=16.0, t=0.0))
+        c.put(self.req(1, priority=0, deadline_s=12.0, t=0.0))
+        c.put(self.req(2, priority=9))
+        # both aged (past half deadline); nearest absolute deadline
+        # (t=0 + 12) collects first, the un-aged priority-9 last
+        assert [r.qid for r in c.collect(3)] == [1, 0, 2]
+
+    def test_unaged_deadline_keeps_priority_order(self):
+        clock = [1.0]
+        c = self.make(clock)
+        c.put(self.req(0, priority=0, deadline_s=100.0, t=0.0))
+        c.put(self.req(1, priority=3, deadline_s=100.0, t=0.5))
+        assert [r.qid for r in c.collect(2)] == [1, 0]
+
+
 class TestTelemetryRoundTrip:
     def test_events_summary_validates_query_trail(self, g, tmp_path):
         path = tmp_path / "serve_ev.jsonl"

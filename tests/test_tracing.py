@@ -134,6 +134,92 @@ def test_validate_trace_catches_overlap_and_orphan():
     assert tracing.validate_trace(good) == []
 
 
+def _fleet_events():
+    """Synthetic serving-fleet trail: qid 0 starts on replica r1,
+    fails over to r0 mid-flight, retires on r0."""
+    base = {"pid": 1, "session": "s"}
+    return [
+        dict(base, t=1.0, tm=1.0, kind="run_start", schema=1,
+             app="fleet"),
+        dict(base, t=1.1, tm=1.1, kind="query_enqueue", qid=0,
+             query_kind="sssp"),
+        dict(base, t=1.2, tm=1.2, kind="query_start", qid=0,
+             query_kind="sssp", col=0, wait_s=0.1, replica="r1"),
+        dict(base, t=1.5, tm=1.5, kind="replica_lost", replica="r1",
+             error="InjectedWorkerKill", message="boom", inflight=1),
+        dict(base, t=1.55, tm=1.55, kind="failover", qid=0,
+             query_kind="sssp", from_replica="r1", to_replica="r0",
+             attempt=1, backoff_s=0.01),
+        dict(base, t=1.6, tm=1.6, kind="query_start", qid=0,
+             query_kind="sssp", col=0, wait_s=0.5, replica="r0"),
+        dict(base, t=2.0, tm=2.0, kind="query_done", qid=0,
+             query_kind="sssp", col=0, iters=4, segments=2,
+             latency_s=0.9, wait_s=0.1, converged=True,
+             replica="r0"),
+        dict(base, t=2.1, tm=2.1, kind="run_done", seconds=1.1,
+             iters=4),
+    ]
+
+
+def test_failover_renders_as_query_track_transition(tmp_path):
+    """Round 18 (lux_tpu/fleet.py): a failover SPLITS the qid's span
+    — the pre-failover segment sits on the dead replica's lane
+    group, the post-failover segment (carrying the failover record)
+    on the survivor's, and validate_trace accepts the transition."""
+    trace = tracing.trace_export(_fleet_events(),
+                                 out=str(tmp_path / "t.json"))
+    assert tracing.validate_trace(trace) == []
+    qs = sorted(_spans(trace, "query"), key=lambda e: e["ts"])
+    assert len(qs) == 2
+    pre, post = qs
+    assert pre["args"]["replica"] == "r1"
+    assert "failover_from" not in pre["args"]
+    assert post["args"]["failover_from"] == "r1"
+    assert post["args"]["failover_to"] == "r0"
+    assert post["args"]["replica"] == "r0"
+    assert pre["tid"] != post["tid"], \
+        "failover did not transition tracks"
+    # lanes are labeled per replica
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert "queries[r1].0" in names and "queries[r0].0" in names
+
+
+def test_validate_trace_rejects_broken_failover():
+    run = dict(ph="X", cat="run", name="run", ts=0.0, dur=100.0,
+               pid=0, tid=0)
+
+    def q(ts, dur, tid, **args):
+        return dict(ph="X", cat="query", name="q0", ts=ts, dur=dur,
+                    pid=0, tid=tid, args=dict(qid=0, **args))
+
+    # two spans for one qid without a failover record = a duplicate
+    # retirement
+    dup = {"traceEvents": [run, q(10.0, 20.0, 100),
+                           q(40.0, 20.0, 140)]}
+    errs = tracing.validate_trace(dup)
+    assert any("retire exactly once" in e for e in errs)
+    # a post-failover span on the SAME track is no transition
+    same = {"traceEvents": [run, q(10.0, 20.0, 100),
+                            q(40.0, 20.0, 100, failover_from="r1",
+                              failover_to="r0")]}
+    errs = tracing.validate_trace(same)
+    assert any("track transition" in e or "SAME track" in e
+               for e in errs)
+    # a post-failover span claiming a replica other than its own
+    # failover target contradicts itself
+    lie = {"traceEvents": [run, q(10.0, 20.0, 100),
+                           q(40.0, 20.0, 140, failover_from="r1",
+                             failover_to="r0", replica="r9")]}
+    errs = tracing.validate_trace(lie)
+    assert any("contradicts its own transition" in e for e in errs)
+    # the clean split validates
+    good = {"traceEvents": [run, q(10.0, 20.0, 100),
+                            q(40.0, 20.0, 140, failover_from="r1",
+                              failover_to="r0", replica="r0")]}
+    assert tracing.validate_trace(good) == []
+
+
 # ---------------------------------------------------------------------
 # EventLog: line-atomic appends under concurrent multi-process writers
 
