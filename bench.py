@@ -174,6 +174,30 @@ def _print_coverage(args, eng):
         print(f"# pair-lane coverage {cov * 100:.1f}%", file=sys.stderr)
 
 
+def _comm_build(eng, extra):
+    """Round 19 (lux_tpu/comms.py): the per-collective byte ledger of
+    the engine's step program — traced, oracle- and audit-cross-
+    checked — lands in the metric line's ``comm`` field
+    (comm_bytes_per_edge + the modeled comm_frac at this placement).
+    A failing ledger records errors instead of a digest;
+    scripts/check_bench.py rejects such lines, so a published number
+    can never ride an un-accountable byte bill."""
+    from lux_tpu import comms, observe
+
+    try:
+        led = comms.ledger_for(eng)
+        model = observe._engine_model(eng, 1.0)
+        compute_ns = sum(v for v in model.values() if v)
+        extra["comm"] = comms.bench_digest(led, compute_ns=compute_ns)
+    except Exception as e:  # noqa: BLE001 — a broken ledger must not
+        # kill the run; the line records the failure and check_bench
+        # rejects it from the trajectory
+        extra["comm"] = {"errors": 1,
+                         "error": f"{type(e).__name__}: {e}"[:200]}
+        print(f"# comm ledger failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
 def _audit_build(eng, args, extra):
     """Static program audit of the freshly built engine
     (lux_tpu/audit.py, round 10): traces every compiled loop variant
@@ -183,7 +207,10 @@ def _audit_build(eng, args, extra):
     errors, so a benchmark number can never be published off a build
     that violates the framework's structural invariants; ``-audit
     error`` additionally fails the config at build time (typed
-    AuditError, classified fatal)."""
+    AuditError, classified fatal).  Round 19: the comm byte ledger
+    (``_comm_build``) rides the same hook — every engine metric line
+    carries its ``comm`` digest regardless of the -audit mode."""
+    _comm_build(eng, extra)
     if args.audit == "off":
         return
     from lux_tpu import audit

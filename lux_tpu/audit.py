@@ -102,7 +102,7 @@ __all__ = [
     "LoopInvariantError", "CollectiveScheduleError",
     "CallbackInLoopError", "IdentityInitError", "LedgerDriftError",
     "audit_jaxpr", "audit_engine", "engine_spec", "check_ledger",
-    "run_repo_audit", "main",
+    "matrix_configs", "run_repo_audit", "main",
 ]
 
 # ---------------------------------------------------------------------
@@ -1001,12 +1001,14 @@ def _matrix_graphs():
     }
 
 
-def run_repo_audit(verbose: bool = False, ledger: bool = True):
-    """Build the engine matrix on the current (CPU) backend and audit
-    every program variant of every configuration.  Returns the list
-    of error/warn Findings (empty = clean).  Mesh configurations are
-    included when >= 2 devices are visible (the tier-1 test runs on
-    the 8-virtual-device conftest mesh)."""
+def matrix_configs(ledger: bool = True):
+    """The repo-wide engine configuration matrix: [(label, build
+    thunk, ledger?)] — shared by ``run_repo_audit`` and the
+    communication observatory (lux_tpu/comms.py walks the SAME
+    engines' step programs for its per-collective byte ledger, so
+    the two subsystems can never audit different programs).  Mesh
+    configurations are included when >= 2 devices are visible (the
+    tier-1 test runs on the 8-virtual-device conftest mesh)."""
     import jax
 
     from lux_tpu.apps import colfilter, components, pagerank, sssp
@@ -1204,6 +1206,15 @@ def run_repo_audit(verbose: bool = False, ledger: bool = True):
                             g, num_parts=2, mesh=mesh,
                             sources=QB[:2], exchange="owner"),
                         False))
+        # page-major owner ROUTING on a real mesh axis (round 19):
+        # the all_to_all of complete message rows — audited for
+        # schedule here and priced per byte by the comm ledger
+        # (lux_tpu/comms.py oracle: [P_local, P, Mg, 128] rows)
+        configs.append(("pagerank_mesh2_owner_pagemajor",
+                        lambda: pagerank.build_engine(
+                            g, num_parts=2, mesh=mesh,
+                            exchange="owner", gather="pagemajor"),
+                        False))
     if ndev >= 4:
         from lux_tpu.parallel.mesh import make_mesh
         mesh4 = make_mesh(4)
@@ -1217,9 +1228,16 @@ def run_repo_audit(verbose: bool = False, ledger: bool = True):
                             g, num_parts=8, mesh=mesh4,
                             exchange="owner"),
                         False))
+    return configs
 
+
+def run_repo_audit(verbose: bool = False, ledger: bool = True):
+    """Build the engine matrix (``matrix_configs``) on the current
+    (CPU) backend and audit every program variant of every
+    configuration.  Returns the list of error/warn Findings (empty =
+    clean)."""
     all_findings = []
-    for label, build, do_ledger in configs:
+    for label, build, do_ledger in matrix_configs(ledger=ledger):
         eng = build()
         fs = audit_engine(eng, mode=None, ledger=do_ledger)
         if verbose:

@@ -77,6 +77,9 @@ class DeviceChecker:
             gargs, extra_v = args[:len(keys)], args[len(keys):]
             g = dict(zip(keys, gargs))
             if self.mesh is not None:
+                # audit: allow(collective-scope) — the acceptance
+                # harness re-creates the engines' state exchange on
+                # purpose (it verifies placement, it is never priced)
                 full = jax.lax.all_gather(state, PARTS_AXIS, tiled=True)
             else:
                 full = state

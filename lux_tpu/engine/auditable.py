@@ -65,6 +65,28 @@ class AuditableEngine:
             getattr(self, attr)
         return dict(self._audit_variants)
 
+    def audit_variant(self, name: str):
+        """One registered variant WITHOUT forcing the lazy builds —
+        the comm observatory's entry (lux_tpu/comms.py traces only
+        the per-iteration "step" program, which both engines register
+        eagerly at build time)."""
+        try:
+            return self._audit_variants[name]
+        except KeyError:
+            raise KeyError(
+                f"no registered program variant {name!r} "
+                f"(have {sorted(self._audit_variants)}; lazy "
+                f"variants appear after audit_programs())") from None
+
+    def comm_ledger(self, check: bool = True):
+        """This engine's per-iteration communication ledger
+        (lux_tpu/comms.ledger_for): every collective of the "step"
+        program priced in wire bytes and cross-checked against the
+        NumPy message-count oracle.  Tracing only — no compile, no
+        execution."""
+        from lux_tpu import comms
+        return comms.ledger_for(self, check=check)
+
     def _consume_pending_init(self):
         """The audit's init probe, if one is stashed (see
         ``_audit_state_sds`` in each engine) — consumed at most once.

@@ -859,6 +859,12 @@ class PullEngine(AuditableEngine):
                    "_run_until_stats", "_run_health_fused",
                    "_run_until_health")
 
+    # timed_phases phases whose measured seconds CONTAIN the step's
+    # collectives — the comm observatory's attribution anchor
+    # (lux_tpu/comms.py; observe._comm_attribution grades the wire
+    # lower bound against exactly these phases)
+    COMM_PHASES = ("exchange", "gen_exchange")
+
     @functools.cached_property
     def _audit_state_sds(self):
         """Abstract stand-in for the iterated state (shape/dtype from

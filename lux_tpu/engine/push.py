@@ -1029,6 +1029,14 @@ class PushEngine(AuditableEngine):
 
     _AUDIT_LAZY = ("_converge_stats_fn", "_converge_health_fn")
 
+    # timed_phases phases whose measured seconds CONTAIN the dense
+    # iteration's collectives (label/active all_gather rides the
+    # exchange phase, the owner routing rides gen_exchange; sparse
+    # queue exchanges are timed as one whole program and carry no
+    # phase split) — the comm observatory's attribution anchor
+    # (lux_tpu/comms.py, observe._comm_attribution)
+    COMM_PHASES = ("exchange", "gen_exchange")
+
     @functools.cached_property
     def _audit_state_sds(self):
         """Abstract (label, active) stand-ins — init runs ONCE per

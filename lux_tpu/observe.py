@@ -42,6 +42,15 @@ dissection paper, PAPERS.md, is the exemplar):
    on-device measurements so any live-tunnel session can
    ``collect_debts`` for whichever match its topology.
 
+Round 19 grows the COMM side of each pillar (lux_tpu/comms.py): a
+measured link calibration (``calibrate_links`` — ppermute/all_to_all
+payload sweeps on the same loop_bench recipe, feeding
+``scalemodel.set_measured_link`` on canonical platforms only), a
+per-app comm-attribution verdict inside ``decompose`` (the engine's
+oracle-checked byte ledger vs the measured exchange phases — the
+wire time is a LOWER bound, so a phase beating its own bytes is the
+contradiction), and the ici/dcn bandwidth debts.
+
 CLI: ``python -m lux_tpu.observe`` emits a calibrated
 phase-decomposition report for all four apps with drift verdicts
 (CPU-runnable; tier-1 smoke in tests/test_observe.py).
@@ -356,6 +365,120 @@ def session_scale(fp: Fingerprint) -> float:
 
 
 # ---------------------------------------------------------------------
+# pillar 1b: measured link calibration (round 19, lux_tpu/comms.py)
+
+# payload sizes (f32 elems PER DEVICE) for the link sweep: small
+# enough that the CPU mesh finishes in ~a second, large enough that
+# the top size amortizes launch overhead into a bandwidth figure
+LINK_PAYLOAD_ELEMS = (1 << 12, 1 << 16, 1 << 20)
+
+# tier -> measured record of THIS session ({"bytes_per_s", "prim",
+# "payload_bytes", "sweep"}); None until calibrate_links ran
+_LINKS: dict = {}
+
+
+def _link_step(mesh, prim: str):
+    """One collective launch per loop step, payload riding the carry
+    (the loop_bench contract: loop-dependent, never hoistable).  The
+    probe measures the wire, so the collective lives HERE rather than
+    in ops/ — the scope lint is deliberately waived."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    nd = int(mesh.devices.size)
+    axis = mesh.axis_names[0]
+    perm = [(j, (j + 1) % nd) for j in range(nd)]
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P(axis),
+                       out_specs=P(axis))
+    def hop(v):
+        if prim == "ppermute":
+            # audit: allow(collective-scope) — the link probe IS the
+            # measurement; there is no engine program to ride
+            return jax.lax.ppermute(v, axis, perm)
+        blk = v.reshape(nd, -1)
+        # audit: allow(collective-scope) — link probe (see above)
+        return jax.lax.all_to_all(blk, axis, split_axis=0,
+                                  concat_axis=0,
+                                  tiled=True).reshape(v.shape)
+
+    def step(carry):
+        y = hop(carry)
+        sv = jnp.sum(y.reshape(-1)[:8].astype(jnp.float32))
+        return sv, y
+
+    return step
+
+
+def calibrate_links(payload_elems=LINK_PAYLOAD_ELEMS,
+                    repeats: int = 3,
+                    clock=time.perf_counter) -> dict:
+    """Measure this session's link rate with ppermute-ring and
+    all_to_all payload sweeps on the trusted ``timing.loop_bench``
+    recipe (one jit, loop-dependent carry, scalar-fetch fence).
+    Returns {tier: record} — empty when fewer than 2 devices are
+    visible.  The headline ``bytes_per_s`` is the peak measured
+    ppermute rate (per-device wire bytes over seconds/step).  On a
+    CANONICAL platform the figure is fed into
+    ``scalemodel.set_measured_link`` so the mesh projections price
+    from the measurement (the round-19 replacement for the hardcoded
+    ICI_BYTES_PER_S); elsewhere it is recorded and labeled, never fed
+    — a CPU-mesh memcpy rate must not price a pod."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        return {}
+    from lux_tpu import comms, scalemodel
+    from lux_tpu.parallel.mesh import make_mesh
+
+    nd = len(jax.devices())
+    mesh = make_mesh(nd)
+    tier = comms.mesh_tier(mesh)
+    platform = jax.devices()[0].platform
+    sweep = {}
+    best = (0.0, None, 0)
+    for prim in ("ppermute", "all_to_all"):
+        step = _link_step(mesh, prim)
+        for elems in payload_elems:
+            rng = np.random.default_rng(11)
+            carry = rng.random(nd * int(elems), np.float32)
+            samples, _ = loop_bench(step, carry, PROBE_LOOP_K,
+                                    repeats=repeats, clock=clock)
+            m, mad = median_mad(samples)
+            payload = int(elems) * 4       # per-device f32 bytes
+            wire = comms.shipped_bytes(prim, payload, nd)
+            rate = wire / m if m > 0 else 0.0
+            sweep[f"{prim}@{payload}"] = {
+                "s_per_step": round(m, 6),
+                "mad_s": round(mad, 6),
+                "bytes_per_s": round(rate, 1)}
+            if prim == "ppermute" and rate > best[0]:
+                best = (rate, prim, payload)
+    rec = {"tier": tier, "bytes_per_s": best[0], "prim": best[1],
+           "payload_bytes": best[2], "ndev": nd,
+           "platform": platform, "sweep": sweep,
+           "fed_scalemodel": platform in CANONICAL_PLATFORMS}
+    _LINKS[tier] = rec
+    if rec["fed_scalemodel"] and best[0] > 0:
+        scalemodel.set_measured_link(tier, best[0])
+    telemetry.current().emit(
+        "link_calibration", tier=tier, ndev=nd, platform=platform,
+        bytes_per_s=round(best[0], 1), prim=best[1],
+        payload_bytes=best[2], fed_scalemodel=rec["fed_scalemodel"])
+    return dict(_LINKS)
+
+
+def link_rate(tier: str = "ici") -> float | None:
+    """This session's measured link rate for ``tier`` (bytes/s), or
+    None when calibrate_links never measured one."""
+    rec = _LINKS.get(tier)
+    return rec["bytes_per_s"] if rec else None
+
+
+# ---------------------------------------------------------------------
 # pillar 2: phase-cost attribution
 
 # timed_phases report keys that are counters, not phase seconds
@@ -384,6 +507,9 @@ class AppDecomposition:
     session: str
     scale: float              # session_scale applied to the model
     phases: tuple             # PhaseCost, report order
+    comm: dict | None = None  # round-19 comm attribution (ledger
+    #                           bytes, measured exchange phase vs the
+    #                           wire lower bound, verdict)
 
     def as_dict(self) -> dict:
         return {
@@ -391,6 +517,7 @@ class AppDecomposition:
             "exchange": self.exchange, "ne": self.ne, "nv": self.nv,
             "iters": self.iters, "session": self.session,
             "scale": round(self.scale, 4),
+            "comm": self.comm,
             "phases": [{
                 "phase": p.phase,
                 "median_s": round(p.median_s, 6),
@@ -484,6 +611,15 @@ def decompose(eng, app: str, iters: int = 3,
     run_phases(iters)
     report = run_phases(iters)
 
+    # the raw per-iteration report rides the event trail in the CLI's
+    # ``phases`` shape (lux_tpu/cli.py), so tracing renders phase
+    # spans — and, with the comm_ledger event below, subdivides the
+    # exchange phases into per-collective spans — from a decompose
+    # run's log exactly like from a CLI -phases run
+    tel.emit("phases", app=app, iters=len(report),
+             report=[{k: (v if k in META_KEYS else round(float(v), 6))
+                      for k, v in entry.items()} for entry in report])
+
     by_phase: dict[str, list] = {}
     for entry in report:
         for k, v in entry.items():
@@ -509,10 +645,56 @@ def decompose(eng, app: str, iters: int = 3,
             tel.emit("drift", app=app, phase=name, verdict=verdict,
                      measured_s=round(m, 6), predicted_s=round(pred, 6),
                      ratio=round(m / pred, 3), session=fp.session)
+    comm = _comm_attribution(eng, app, phases, tel)
     return AppDecomposition(
         app=app, engine=kind, exchange=eng.exchange, ne=int(eng.sg.ne),
         nv=int(eng.sg.nv), iters=iters, session=fp.session,
-        scale=scale, phases=tuple(phases))
+        scale=scale, phases=tuple(phases), comm=comm)
+
+
+def _comm_attribution(eng, app: str, phases, tel) -> dict:
+    """Round-19 comm verdict: the engine's per-collective byte ledger
+    (lux_tpu/comms.ledger_for — oracle- and audit-cross-checked, a
+    broken build raises its typed CommLedgerError through here) vs
+    the measured exchange-family phases.  The wire time
+    (ledger bytes / this session's MEASURED link rate) is a LOWER
+    bound on the exchange phase — generation/apply compute rides the
+    same phase, so only a phase FASTER than its own bytes is a
+    contradiction (``drift_fast``); with no measured link rate the
+    verdict is honestly ``unmodeled``, and off-mesh it is
+    ``no-comm``."""
+    from lux_tpu import comms
+
+    led = comms.ledger_for(eng)
+    exch_names = getattr(eng, "COMM_PHASES",
+                         ("exchange", "gen_exchange"))
+    exch = [p for p in phases if p.phase in exch_names]
+    measured = sum(p.median_s for p in exch) if exch else None
+    rate = link_rate(led.tier) if led.tier != "local" else None
+    pred = None
+    if rate and led.bytes_per_iter:
+        pred = led.bytes_per_iter / rate
+    if led.bytes_per_iter == 0:
+        verdict = "no-comm"
+    elif pred is None or measured is None:
+        verdict = "unmodeled"
+    elif measured < pred / DEVIATION_BOUND:
+        verdict = "drift_fast"
+    else:
+        verdict = "ok"
+    comm = {
+        "bytes_per_iter": led.bytes_per_iter,
+        "bytes_per_edge": round(led.bytes_per_edge, 6),
+        "messages": led.messages, "tier": led.tier,
+        "per_collective": led.per_collective(),
+        "audit_eqns": led.audit_eqns,
+        "measured_s": None if measured is None else round(measured, 6),
+        "predicted_s": None if pred is None else round(pred, 9),
+        "verdict": verdict,
+    }
+    tel.emit("comm_ledger", app=app, exchange=eng.exchange,
+             ndev=led.ndev, ne=led.ne, **comm)
+    return comm
 
 
 def render_report(decomps, fp: Fingerprint) -> str:
@@ -542,6 +724,14 @@ def render_report(decomps, fp: Fingerprint) -> str:
                 f"{p.phase:14s} {p.median_s * 1e3:8.2f}ms "
                 f"{p.mad_s * 1e3:7.2f}ms {pred:>10s} {ratio:>7s}  "
                 f"{p.verdict}")
+        if d.comm is not None:
+            c = d.comm
+            wire = ("-" if c["predicted_s"] is None
+                    else f"{c['predicted_s'] * 1e3:.3f}ms wire")
+            lines.append(
+                f"comm: {c['bytes_per_iter']} B/iter over "
+                f"{c['messages']} collective(s) [{c['tier']}] "
+                f"{wire}  {c['verdict']}")
     return "\n".join(lines)
 
 
@@ -667,7 +857,28 @@ DEBTS = (
          auto="_debt_pair_dot_sweep"),
     Debt("fused-exchange-ici-ab",
          "ring_reduce_scatter fused min/max owner exchange A/B over "
-         "real ICI", "PERF_NOTES round-8 pointers", min_ndev=2),
+         "real ICI — price both sides from the ici-bandwidth-probe's "
+         "measured bytes/s against the comm ledger's per-mode byte "
+         "counts (lux_tpu/comms.py: the ring ships (ndev-1) x "
+         "[P/ndev, ntw] rows, the all_to_all (ndev-1)/ndev x "
+         "[P, ntw] + an ndev-way local reduce)",
+         "PERF_NOTES round-8 pointers; round 19 (comm observatory)",
+         min_ndev=2),
+    Debt("ici-bandwidth-probe",
+         "measured ICI link rate: ppermute-ring + all_to_all payload "
+         "sweeps on the loop_bench recipe (observe.calibrate_links); "
+         "on a canonical session the figure FEEDS "
+         "scalemodel.set_measured_link, replacing the hardcoded "
+         "ICI_BYTES_PER_S in every mesh projection",
+         "PERF_NOTES round 19 (comm observatory)", platform="any",
+         min_ndev=2, auto="_debt_ici_bandwidth_probe"),
+    Debt("dcn-bandwidth-probe",
+         "measured inter-slice DCN link rate (the 10-100x thinness "
+         "ROADMAP item 3 prices blind today): the same link sweep on "
+         "a mesh whose axis crosses slice boundaries — gated until a "
+         "session actually spans >= 2 slices",
+         "PERF_NOTES round 19 (comm observatory); ROADMAP item 3",
+         min_ndev=2, auto="_debt_dcn_bandwidth_probe"),
     Debt("watchdog-ab",
          "health watchdog on/off A/B through the tunnel",
          "PERF_NOTES round-9 pointer 1"),
@@ -855,8 +1066,11 @@ def collect_debts(fp: Fingerprint, ledger: PerfLedger | None,
                   only=None, clock=time.perf_counter):
     """Run every matched debt with an implemented probe, appending a
     "debt" record per collection; manual debts are returned as
-    skipped with their pointer.  Returns (collected records,
-    [(debt_id, reason) skipped])."""
+    skipped with their pointer, and a probe returning a STRING is a
+    gated probe declining this session (e.g. the DCN probe on a
+    single-slice mesh) — skipped with the probe's stated reason, no
+    record appended.  Returns (collected records, [(debt_id, reason)
+    skipped])."""
     collected, skipped = [], []
     for d in match_debts(fp):
         if only is not None and d.id not in only:
@@ -865,12 +1079,53 @@ def collect_debts(fp: Fingerprint, ledger: PerfLedger | None,
             skipped.append((d.id, f"manual: {d.pointer}"))
             continue
         payload = globals()[d.auto](fp, clock=clock)
+        if isinstance(payload, str):
+            skipped.append((d.id, payload))
+            continue
         if ledger is not None:
             collected.append(ledger.append("debt", payload, fp))
         else:
             collected.append(payload)
         telemetry.current().emit("debt_collected", debt=d.id)
     return collected, skipped
+
+
+def _debt_ici_bandwidth_probe(fp: Fingerprint,
+                              clock=time.perf_counter):
+    """The measured-link debt: run the payload sweeps and record the
+    headline rate (fed into scalemodel on canonical platforms by
+    calibrate_links itself)."""
+    links = calibrate_links(clock=clock)
+    if not links:
+        return "gated: fewer than 2 devices visible"
+    rec = links.get("ici")
+    if rec is None:
+        # a multi-slice session's all-device mesh measures the DCN
+        # bottleneck — recording that under the ICI debt would be the
+        # mirror image of the mislabeling the DCN probe gates against
+        return ("gated: the all-device mesh axis crosses slices "
+                "(tier dcn) — collect dcn-bandwidth-probe instead")
+    return {"debt": "ici-bandwidth-probe", **rec}
+
+
+def _debt_dcn_bandwidth_probe(fp: Fingerprint,
+                              clock=time.perf_counter):
+    """The inter-slice link debt: only collectable when the visible
+    devices actually span >= 2 slices (ROADMAP item 3's pod
+    topology); gated otherwise so a single-slice session never
+    records an "ICI rate wearing a DCN label"."""
+    import jax
+
+    slices = {getattr(d, "slice_index", 0) or 0
+              for d in jax.devices()}
+    if len(slices) < 2:
+        return ("gated: single-slice session — the DCN probe needs "
+                "a mesh whose axis crosses slice boundaries")
+    links = calibrate_links(clock=clock)
+    rec = links.get("dcn")
+    if rec is None:
+        return "gated: link sweep measured no cross-slice axis"
+    return {"debt": "dcn-bandwidth-probe", **rec}
 
 
 # ---------------------------------------------------------------------

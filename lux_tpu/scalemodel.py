@@ -300,8 +300,65 @@ STATE_NS_PER_VERTEX = 6.0  # apply + epilogues, per padded vertex
                            # np=4 decomposition)
 # ICI: one v5e link direction (public scaling-book figure).  The
 # conclusions are insensitive to 2-4x error here -- comm is permille
-# of compute at the scales this engine targets.
+# of compute at the scales this engine targets.  Round 19: when the
+# communication observatory has MEASURED a link rate on a canonical
+# session (observe.calibrate_links -> set_measured_link), the
+# projections price from the measurement instead of this figure.
 ICI_BYTES_PER_S = 4.5e10
+# DCN: inter-slice links are 10-100x thinner than ICI (ROADMAP item
+# 3); no canonical figure exists yet, so the model carries the
+# midpoint thinness until a multi-slice session collects the
+# dcn-bandwidth-probe debt (lux_tpu/observe.py DEBTS).
+DCN_THINNESS_MODEL = 30.0
+
+# Quantized-exchange wire factors (EQuARX-style in-collective block
+# quantization, PAPERS.md): owner messages (pagerank partials,
+# min-distances) tolerate block-scaled low precision with
+# exact-identity padding.  int8 ships 1 payload byte + one f32 scale
+# per 32-element block; bf16 halves the word.  These price the
+# item-3 target; the quantized exchange itself is not built yet.
+QUANT_FACTORS = {"f32": 1.0, "bf16": 0.5,
+                 "int8": (32 + 4) / (32 * 4)}
+
+# tier -> measured bytes/s, fed by observe.calibrate_links on
+# canonical sessions only (a CPU-mesh "link" rate must never price a
+# pod projection; CPU figures stay in the perf ledger, labeled)
+_MEASURED_LINKS: dict = {}
+
+
+def set_measured_link(tier: str, bytes_per_s: float) -> None:
+    """Record a MEASURED link rate (observe.calibrate_links).  The
+    projections prefer it over the canonical constant from then on."""
+    if tier not in ("ici", "dcn"):
+        raise ValueError(f"unknown link tier {tier!r}")
+    if not bytes_per_s > 0:
+        raise ValueError(f"link rate must be > 0, got {bytes_per_s}")
+    _MEASURED_LINKS[tier] = float(bytes_per_s)
+
+
+def measured_link(tier: str) -> float | None:
+    """The measured rate for ``tier``, or None when never calibrated."""
+    return _MEASURED_LINKS.get(tier)
+
+
+def link_bytes_per_s(tier: str = "ici") -> float:
+    """Link rate of record for a tier: the session's measured figure
+    when one exists, else the canonical model (ICI figure; DCN =
+    ICI / DCN_THINNESS_MODEL — flagged as model until the
+    multi-slice debt is collected).  "local" (single device) has no
+    link; pricing comm there is a caller bug."""
+    if tier == "local":
+        raise ValueError("tier 'local' has no link — single-device "
+                         "placements ship zero bytes")
+    got = _MEASURED_LINKS.get(tier)
+    if got is not None:
+        return got
+    if tier == "ici":
+        return ICI_BYTES_PER_S
+    if tier == "dcn":
+        return _MEASURED_LINKS.get("ici", ICI_BYTES_PER_S) \
+            / DCN_THINNESS_MODEL
+    raise ValueError(f"unknown link tier {tier!r}")
 
 
 @dataclass
@@ -327,14 +384,20 @@ def project_pull(ne: int, nv: int, chips: int, *,
                  pair_coverage: float = 0.0,
                  pair_row_inflation: float = 1.0,
                  state_bytes_per_vertex: int = 4,
-                 ici_bytes_per_s: float = ICI_BYTES_PER_S) -> Projection:
+                 ici_bytes_per_s: float | None = None) -> Projection:
     """Price one pull-engine iteration on a ``chips``-device mesh.
 
     ``chunk_inflation``/``pair_coverage``/``pair_row_inflation`` come
     from the layout stats the engines already report
     (OwnerLayout.stats; StackedPairPlan.stats "coverage"/"inflation");
     pass a measured configuration's stats to price its mesh run.
+    ``ici_bytes_per_s=None`` (default) prices from the link rate of
+    record — this session's MEASURED figure when the comm observatory
+    calibrated one (set_measured_link), the canonical constant
+    otherwise.
     """
+    if ici_bytes_per_s is None:
+        ici_bytes_per_s = link_bytes_per_s("ici")
     if exchange not in ("owner", "gather"):
         raise ValueError(f"unknown exchange {exchange!r}")
     if not 0.0 <= pair_coverage <= 1.0:

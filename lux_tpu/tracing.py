@@ -85,6 +85,10 @@ PROCESS_INSTANTS = {"mesh_shrink", "topology_fault", "replace",
                     "failure", "health_trip", "flight_dump"}
 # timed_phases report keys that are counters, not phase seconds
 META_KEYS = ("frontier", "bucket", "advances")
+# round 19 (lux_tpu/comms.py): phases whose span subdivides into
+# per-collective child spans when the run carries a comm_ledger event
+# with a priced wire time (the engines' COMM_PHASES anchor)
+COMM_PHASE_NAMES = ("exchange", "gen_exchange")
 
 # per-query serving spans (round 17): query tracks start here, one
 # LANE per set of non-overlapping queries (greedy interval packing —
@@ -257,6 +261,14 @@ def _run_spans(run, us, trk: _Track, te: list):
                          "changed_sum")}
     te.append(_span(name, "run", rstart, rend - rstart, trk.pid, 0,
                     args=args or None))
+    # round 19: the run's comm ledgers, keyed by app (a decompose run
+    # holds several apps in one stream) — each phases event below
+    # subdivides with ITS app's ledger; a lone ledger also serves
+    # phases events that carry no app tag (the CLI -phases shape)
+    comm_by_app = {}
+    for ev in run:
+        if ev["kind"] == "comm_ledger":
+            comm_by_app[ev.get("app", ev.get("config"))] = ev
 
     # attempt spans: boundaries at retry / handled-topology events
     # (supervise() retries immediately after a handled topology fault
@@ -290,6 +302,10 @@ def _run_spans(run, us, trk: _Track, te: list):
             total = sum(v for r in report for k, v in r.items()
                         if k not in META_KEYS and _num(v)) * 1e6
             cur = max(rstart, ts - total)
+            comm = comm_by_app.get(ev.get("app"))
+            if comm is None and "app" not in ev \
+                    and len(comm_by_app) == 1:
+                comm = next(iter(comm_by_app.values()))
             for i, r in enumerate(report):
                 for ph, v in r.items():
                     if ph in META_KEYS or not _num(v):
@@ -298,6 +314,9 @@ def _run_spans(run, us, trk: _Track, te: list):
                     s, d = _clamp(cur, d, rstart, rend)
                     te.append(_span(f"i{i}:{ph}", "phase", s, d,
                                     trk.pid, tid))
+                    if ph in COMM_PHASE_NAMES:
+                        te.extend(_collective_spans(
+                            comm, i, ph, s, d, trk.pid, tid))
                     cur += d
         elif kind in RUN_BOUNDARIES:
             pass                       # represented by the run span
@@ -315,6 +334,55 @@ def _run_spans(run, us, trk: _Track, te: list):
                 f"exec (after shrink #{trk.epoch}"
                 + (f", ndev={to}" if _num(to) else "") + ")")
     _query_spans(run, times, trk, te, rstart, rend)
+
+
+def _collective_spans(comm, i, ph, s, d, pid, tid) -> list:
+    """Per-collective child spans inside one exchange-phase span
+    (round 19, lux_tpu/comms.py): the ledger's priced wire window —
+    min(predicted wire seconds, the measured phase) — sits at the
+    START of the phase (the collective launches before the epilogue
+    consumes it), subdivided proportionally to each collective's
+    shipped bytes.  Emitted only when the ledger carries a priced
+    wire time (a measured link rate existed): an unpriced guess must
+    not render as measurement.  Children lie strictly inside the
+    phase span, so the nesting validator holds by construction."""
+    if not isinstance(comm, dict) or d <= 0:
+        return []
+    pred = comm.get("predicted_s")
+    groups = comm.get("per_collective")
+    if not _num(pred) or pred <= 0 or not isinstance(groups, list):
+        return []
+    ents = [g for g in groups if isinstance(g, dict)
+            and _num(g.get("shipped_bytes")) and g["shipped_bytes"] > 0]
+    # cond branches are ALTERNATIVES: predicted_s prices the steady
+    # path (unconditional + heaviest branch, the ledger convention),
+    # so the subdivision keeps exactly that path — rendering a branch
+    # that did not run would show collectives the iteration never
+    # launched
+    by_branch: dict = {}
+    for g in ents:
+        by_branch.setdefault(g.get("branch") or "", []).append(g)
+    keep = by_branch.pop("", [])
+    if by_branch:
+        keep += max(by_branch.values(),
+                    key=lambda gs: sum(g["shipped_bytes"]
+                                       for g in gs))
+    ents = keep
+    total = sum(g["shipped_bytes"] for g in ents)
+    if total <= 0:
+        return []
+    win = min(pred * 1e6, d)
+    out, cur = [], s
+    for g in ents:
+        cd = win * g["shipped_bytes"] / total
+        cur2, cd = _clamp(cur, cd, s, s + d)
+        out.append(_span(f"i{i}:{ph}:{g.get('prim')}", "collective",
+                         cur2, cd, pid, tid,
+                         args={"shipped_bytes": g["shipped_bytes"],
+                               "count": g.get("count"),
+                               "tier": g.get("tier")}))
+        cur = cur2 + cd
+    return out
 
 
 def _merge_windows(windows):

@@ -109,6 +109,14 @@ Checked per metric line:
   served+shed != submitted, or slo_accounted > served (an SLO
   fraction computed over shed queries).
 
+- comm (round 19, lux_tpu/comms.py): the per-collective byte-ledger
+  digest engine metric lines now carry — {errors, ndev, exchange,
+  tier, bytes_per_iter, comm_bytes_per_edge, messages, comm_frac}.
+  Rejected on: a ledger-failing build (errors > 0 — the oracle/audit
+  cross-check failed), comm_frac outside [0, 1], bytes or messages
+  on a single device, a mesh owner/gather exchange shipping zero
+  bytes, or a per-edge figure contradicting bytes_per_iter*ndev/ne.
+
 - telemetry.health (round 9, bench.py -health): the device-side
   watchdog digest — optional and null when off; present it must be a
   clean bill ({engine, tripped=false, flags=[], iters >= 0}; known
@@ -308,6 +316,7 @@ def check_line(obj: dict, *, legacy_ok: bool):
         errs += check_telemetry(name, obj)
 
     errs += check_audit_field(name, obj)
+    errs += check_comm_field(name, obj)
 
     if "calibration" not in obj:
         (warns if legacy_ok else errs).append(
@@ -821,6 +830,102 @@ def check_audit_field(name: str, obj: dict) -> list[str]:
                 f"failed_checks={fc}) — a number measured on a build "
                 f"that violates the structural invariants cannot be "
                 f"a metric of record (lux_tpu/audit.py)")
+    return errs
+
+
+COMM_TIERS = ("local", "ici", "dcn")
+
+
+def check_comm_field(name: str, obj: dict) -> list[str]:
+    """Round-19 comm-ledger digest (bench.py, lux_tpu/comms.py):
+    optional (pre-round-19 artifacts and non-engine lines omit it);
+    present it must be a clean, self-consistent byte bill.
+    Contradiction rejects: a digest whose ledger FAILED its
+    oracle/audit cross-check (errors > 0 — the number was measured on
+    a build whose communication cannot be accounted), comm_frac
+    outside [0, 1], bytes on a single device (ndev=1 ships nothing),
+    a mesh owner/gather exchange shipping ZERO bytes (the exchange's
+    collectives cannot be free), and a per-edge figure disagreeing
+    with bytes_per_iter * ndev / ne."""
+    if "comm" not in obj:
+        return []
+    c = obj["comm"]
+    if c is None:
+        return [f"{name}: comm digest is null — the ledger never "
+                f"ran, so the line's communication is unaccounted "
+                f"(lux_tpu/comms.py)"]
+    if not isinstance(c, dict):
+        return [f"{name}: comm must be a dict, got {c!r}"]
+    errs = []
+    ce = c.get("errors")
+    if not isinstance(ce, int) or isinstance(ce, bool) or ce < 0:
+        errs.append(f"{name}: comm.errors={ce!r} must be an int >= 0")
+        return errs
+    if ce:
+        errs.append(
+            f"{name}: comm digest from a LEDGER-FAILING build "
+            f"(errors={ce}{': ' + str(c.get('error')) if c.get('error') else ''}) "
+            f"— a metric whose byte bill failed its oracle/audit "
+            f"cross-check cannot stand (lux_tpu/comms.py)")
+        return errs
+    nd = c.get("ndev")
+    if not isinstance(nd, int) or isinstance(nd, bool) or nd < 1:
+        errs.append(f"{name}: comm.ndev={nd!r} must be an int >= 1")
+        nd = None
+    tier = c.get("tier")
+    if tier not in COMM_TIERS:
+        errs.append(f"{name}: comm.tier={tier!r} not one of "
+                    f"{COMM_TIERS}")
+    bpi = c.get("bytes_per_iter")
+    if not isinstance(bpi, int) or isinstance(bpi, bool) or bpi < 0:
+        errs.append(f"{name}: comm.bytes_per_iter={bpi!r} must be an "
+                    f"int >= 0")
+        bpi = None
+    msgs = c.get("messages")
+    if not isinstance(msgs, int) or isinstance(msgs, bool) or msgs < 0:
+        errs.append(f"{name}: comm.messages={msgs!r} must be an "
+                    f"int >= 0")
+        msgs = None
+    frac = c.get("comm_frac")
+    if not _is_num(frac) or not 0.0 <= frac <= 1.0:
+        errs.append(f"{name}: comm.comm_frac={frac!r} must be a "
+                    f"finite number in [0, 1] (the modeled comm "
+                    f"share of one iteration)")
+    bpe = c.get("comm_bytes_per_edge")
+    if not _is_num(bpe) or bpe < 0:
+        errs.append(f"{name}: comm.comm_bytes_per_edge={bpe!r} must "
+                    f"be a finite number >= 0")
+        bpe = None
+    if nd == 1:
+        if bpi:
+            errs.append(
+                f"{name}: comm.bytes_per_iter={bpi} on a SINGLE "
+                f"device — one device has no link to ship over; the "
+                f"digest contradicts its own placement")
+        if msgs:
+            errs.append(
+                f"{name}: comm.messages={msgs} on a single device — "
+                f"no mesh axis exists to launch collectives over")
+        if tier in ("ici", "dcn"):
+            errs.append(f"{name}: comm.tier={tier!r} with ndev=1 — a "
+                        f"single device sits on no link tier")
+    ex = c.get("exchange")
+    if nd is not None and nd > 1 and ex in ("owner", "gather") \
+            and bpi == 0:
+        errs.append(
+            f"{name}: comm.bytes_per_iter=0 with exchange={ex!r} on "
+            f"{nd} devices — the {ex} exchange's collectives cannot "
+            f"ship zero bytes; the digest contradicts the exchange "
+            f"mode")
+    ne = obj.get("ne")
+    if _is_num(ne) and ne > 0 and bpi is not None and bpe is not None \
+            and nd is not None:
+        want = bpi * nd / ne
+        if abs(bpe - want) > 1e-4 * max(1.0, want):
+            errs.append(
+                f"{name}: comm.comm_bytes_per_edge={bpe} disagrees "
+                f"with bytes_per_iter * ndev / ne = {want:.6f} — the "
+                f"per-edge claim contradicts the per-iteration bill")
     return errs
 
 

@@ -60,6 +60,17 @@ human shape — and audits it while doing so:
   a crash-flight-recorder FLIGHT.json postmortem instead of an event
   log.
 
+- round 19 (communication observatory, lux_tpu/comms.py):
+  ``comm_ledger`` events render the per-collective byte table
+  (prim / launches / payload / wire bytes, branch-tagged for the
+  sparse-dense alternatives) and are AUDITED against the
+  collective-schedule eqn set they carry: a breakdown whose per-prim
+  eqn counts disagree with ``audit_eqns`` FAILS — the ledger and the
+  auditor walk the same program registry, so a mismatch means the
+  trail lies about the program.  ``link_calibration`` events (the
+  measured ICI/DCN bytes/s probes, observe.calibrate_links) render
+  with their fed-scalemodel flag.
+
 Usage:
     python scripts/events_summary.py FILE [FILE...]
     python scripts/events_summary.py -flight FLIGHT.json
@@ -85,7 +96,13 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "query_enqueue", "query_start", "query_done", "serve_refill",
          "metrics_snapshot", "log_rotate",
          "replica_up", "replica_lost", "failover", "query_shed",
-         "brownout"}
+         "brownout", "comm_ledger", "link_calibration"}
+
+# round 19 (communication observatory, lux_tpu/comms.py): the
+# collective primitives a comm_ledger breakdown may name — matching
+# comms.COLLECTIVE_PRIMS with psum_scatter normalized away
+COMM_PRIMS = {"ppermute", "all_to_all", "reduce_scatter",
+              "all_gather", "psum", "pmin", "pmax"}
 
 # a query_shed without these cannot be diagnosed — the serving
 # fleet's typed-rejection contract (lux_tpu/fleet.py)
@@ -361,6 +378,64 @@ def render_metrics_snapshot(title, snap, qdone_by_kind, out,
     return errs
 
 
+def render_comm_ledger(title, cl, out) -> list[str]:
+    """Round-19 comm-ledger event (lux_tpu/comms.py via
+    observe.decompose / python -m lux_tpu.comms -events): render the
+    per-collective table and AUDIT it — the breakdown's per-prim eqn
+    counts must match the ``audit_eqns`` set the collective-schedule
+    auditor sees on the same program (the two subsystems walk one
+    registry, so a published mismatch means the trail is lying about
+    the program), shipped bytes must be non-negative ints, and prims
+    must be known collectives."""
+    errs = []
+    where = f"{title}/{cl.get('app', cl.get('config', '?'))}"
+    pcs = cl.get("per_collective")
+    audit_eqns = cl.get("audit_eqns")
+    if not isinstance(pcs, list) or not isinstance(audit_eqns, dict):
+        return [f"{where}: comm_ledger without its per_collective "
+                f"list + audit_eqns dict: {cl!r}"[:200]]
+    seen: dict = {}
+    for g in pcs:
+        if not isinstance(g, dict):
+            errs.append(f"{where}: malformed comm_ledger group "
+                        f"{g!r}"[:160])
+            continue
+        prim = g.get("prim")
+        if prim not in COMM_PRIMS:
+            errs.append(f"{where}: comm_ledger names unknown "
+                        f"collective {prim!r}")
+            continue
+        ec = g.get("eqns")
+        sb = g.get("shipped_bytes")
+        if not _is_int(ec) or ec < 1:
+            errs.append(f"{where}: comm_ledger [{prim}] eqns={ec!r} "
+                        f"must be an int >= 1")
+            continue
+        if not _is_int(sb) or sb < 0:
+            errs.append(f"{where}: comm_ledger [{prim}] "
+                        f"shipped_bytes={sb!r} must be an int >= 0")
+        seen[prim] = seen.get(prim, 0) + ec
+    want = {k: v for k, v in audit_eqns.items() if _is_int(v) and v}
+    if seen != want:
+        errs.append(
+            f"{where}: comm_ledger breakdown counts {seen} contradict "
+            f"the audit collective-schedule eqn set {want} — ledger "
+            f"and auditor walk ONE registry, so the published trail "
+            f"is lying about the program")
+    bpi = cl.get("bytes_per_iter")
+    print(f"  comm ledger [{cl.get('app', cl.get('config', '?'))}]: "
+          f"{bpi} B/iter over {cl.get('messages')} collective(s) "
+          f"[{cl.get('tier')}] verdict={cl.get('verdict', '-')}",
+          file=out)
+    for g in pcs:
+        if isinstance(g, dict) and g.get("prim") in COMM_PRIMS:
+            br = f" ({g['branch']})" if g.get("branch") else ""
+            print(f"    {g['prim']:14s}{br} x{g.get('count')}  "
+                  f"payload {g.get('payload_bytes')} B  wire "
+                  f"{g.get('shipped_bytes')} B", file=out)
+    return errs
+
+
 def render_run(run, out=sys.stdout) -> list[str]:
     """Print one run's table; returns audit errors."""
     errs = []
@@ -548,6 +623,15 @@ def render_run(run, out=sys.stdout) -> list[str]:
               f"({d.get('ratio')}x)", file=out)
     for d in by.get("debt_collected", []):
         print(f"  carried debt collected: {d.get('debt')}", file=out)
+    for lc in by.get("link_calibration", []):
+        print(f"  link calibration [{lc.get('tier')}]: "
+              f"{lc.get('bytes_per_s')} B/s ({lc.get('prim')}, "
+              f"payload {lc.get('payload_bytes')} B, ndev "
+              f"{lc.get('ndev')}"
+              f"{', fed scalemodel' if lc.get('fed_scalemodel') else ''})",
+              file=out)
+    for cl in by.get("comm_ledger", []):
+        errs += render_comm_ledger(title, cl, out)
 
     # serving front-end (round 14, lux_tpu/serve.py): per-query
     # latency accounting.  AUDIT: every query_done carries its

@@ -46,6 +46,18 @@ be seen from a jaxpr (CLAUDE.md "Conventions"):
                 a metrics call in a traced loop body either bakes a
                 host callback into the fused program or silently
                 records nothing per iteration.
+  collective-scope
+                No collective-primitive call (``jax.lax.ppermute``,
+                ``all_to_all``, ``psum_scatter``/``reduce_scatter``,
+                ``all_gather``, ``psum``/``pmin``/``pmax``) outside
+                ``lux_tpu/ops/`` and ``lux_tpu/engine/``.  Those two
+                trees are where the jaxpr auditor's
+                collective-schedule check and the comm observatory's
+                byte oracle (lux_tpu/comms.py) know to look — a
+                collective planted elsewhere ships unaccounted bytes
+                the ledger never prices.  Pragma-suppressible for
+                deliberate exceptions (the link-bandwidth probes,
+                the device placement check).
   bench-fence   (scripts/ only) No ``block_until_ready`` fencing in
                 benchmark scripts: it can return early through the
                 axon tunnel AND lets XLA hoist loop-invariant work,
@@ -549,6 +561,42 @@ def check_hot_path_metrics(path, tree, lines, whole_file: bool):
 
 
 # ---------------------------------------------------------------------
+# check: collective primitives stay inside ops/ + engine/
+
+COLLECTIVE_CALLS = {
+    "ppermute", "all_to_all", "psum_scatter", "reduce_scatter",
+    "all_gather", "psum", "pmin", "pmax",
+}
+
+
+def check_collective_scope(path, tree, lines):
+    """Flag collective-primitive calls outside the audited trees (see
+    module docstring): the byte ledger's oracle predicts collectives
+    from engine layout config, so one planted elsewhere in the
+    library is invisible to both the schedule audit and the ledger."""
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) \
+            else getattr(f, "id", None)
+        if name not in COLLECTIVE_CALLS:
+            continue
+        line = getattr(node, "lineno", 1)
+        if _suppressed(lines, line, "collective-scope"):
+            continue
+        findings.append(Finding(
+            path, line, "collective-scope",
+            f"{name} call outside lux_tpu/ops/ + lux_tpu/engine/ — "
+            f"the collective-schedule audit and the comm byte ledger "
+            f"(lux_tpu/comms.py) only account collectives in those "
+            f"trees; move it behind an op interface or carry an "
+            f"explicit pragma with the justification"))
+    return findings
+
+
+# ---------------------------------------------------------------------
 # check: no block_until_ready fencing in benchmark scripts
 
 
@@ -603,6 +651,8 @@ def lint_file(path: str):
         path, tree, lines,
         whole_file=("/lux_tpu/engine/" in norm
                     or "/lux_tpu/ops/" in norm))
+    if "/lux_tpu/engine/" not in norm and "/lux_tpu/ops/" not in norm:
+        findings += check_collective_scope(path, tree, lines)
     if "/lux_tpu/apps/" in norm:
         findings += check_oracle(path, tree, lines)
     if "/lux_tpu/engine/" in norm or "/lux_tpu/ops/" in norm:

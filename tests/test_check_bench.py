@@ -700,3 +700,74 @@ def test_reorder_pair_cross_np_not_compared(tmp_path):
     p.write_text("".join(json.dumps(d) + "\n" for d in [none1, ro4]))
     r = run_check(p)
     assert r.returncode == 1 and "DECREASED" in r.stderr
+
+
+# ---------------------------------------------------------------------
+# round-19 comm-ledger digest (lux_tpu/comms.py, bench.py _comm_build)
+
+GOOD_COMM = {"errors": 0, "ndev": 4, "exchange": "owner",
+             "tier": "ici", "bytes_per_iter": 250000,
+             "comm_bytes_per_edge": 0.001, "messages": 2,
+             "comm_frac": 0.0021}
+
+
+def _with_comm(**over):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["comm"] = dict(GOOD_COMM, **over)
+    return d
+
+
+def test_comm_digest_accepted(tmp_path):
+    """A clean byte-ledger digest passes strict mode; off-mesh
+    single-device digests legitimately carry all-zero bytes."""
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(_with_comm()) + "\n")
+    r = run_check(p)
+    assert r.returncode == 0, r.stderr
+    single = _with_comm(ndev=1, tier="local", bytes_per_iter=0,
+                        comm_bytes_per_edge=0.0, messages=0,
+                        comm_frac=0.0)
+    p.write_text(json.dumps(single) + "\n")
+    assert run_check(p).returncode == 0
+    # lines without the field (pre-round-19, script lines) still pass
+    d = json.loads(json.dumps(GOOD_LINE))
+    p.write_text(json.dumps(d) + "\n")
+    assert run_check(p).returncode == 0
+
+
+@pytest.mark.parametrize("over,needle", [
+    # a digest from a ledger-failing build can never publish
+    ({"errors": 1, "error": "CommLedgerError: oracle disagrees"},
+     "LEDGER-FAILING"),
+    # comm_frac is a fraction of one iteration by construction
+    ({"comm_frac": 1.2}, "comm_frac"),
+    ({"comm_frac": -0.1}, "comm_frac"),
+    # a single device has no link to ship over
+    ({"ndev": 1, "tier": "local"}, "SINGLE device"),
+    ({"ndev": 1, "bytes_per_iter": 0, "comm_bytes_per_edge": 0.0,
+      "comm_frac": 0.0, "tier": "ici"}, "no link tier"),
+    # a mesh owner exchange cannot ship zero bytes
+    ({"bytes_per_iter": 0, "comm_bytes_per_edge": 0.0,
+      "comm_frac": 0.0}, "cannot ship zero bytes"),
+    # per-edge must re-derive from the per-iteration bill
+    ({"comm_bytes_per_edge": 0.5}, "contradicts the per-iteration"),
+    ({"tier": "hyperloop"}, "comm.tier"),
+    ({"bytes_per_iter": -3}, "bytes_per_iter"),
+    ({"messages": True}, "comm.messages"),
+])
+def test_bad_comm_digests_fail(tmp_path, over, needle):
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(_with_comm(**over)) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert needle in r.stderr
+
+
+def test_null_comm_digest_rejected(tmp_path):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["comm"] = None
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert "comm digest is null" in r.stderr

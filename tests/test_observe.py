@@ -283,12 +283,19 @@ def test_debt_registry_matching():
     assert "elastic-shrink-drill" not in ids1
     assert "pagemajor-route-ab" not in ids1         # needs a mesh
     assert "pair-dot-row-k-sweep" in ids1
-    # the CPU test mesh can collect no HARDWARE debts — only the
-    # platform-any reorder fill trail (round 16, host-measured by
-    # construction)
+    # the CPU test mesh can collect no TPU-hardware debts — only the
+    # platform-any probes: the reorder fill trail (round 16,
+    # host-measured by construction) and the link-bandwidth sweep
+    # (round 19 — measured anywhere, recorded with its fingerprint
+    # label, fed into scalemodel only on canonical platforms)
     cpu_ids = {d.id for d in
                observe.match_debts(synthetic_fp(platform="cpu"))}
-    assert cpu_ids == {"reorder-fill-ab"}
+    assert cpu_ids == {"reorder-fill-ab", "ici-bandwidth-probe"}
+    # the DCN probe is TPU-gated at the registry level AND slice-gated
+    # inside its probe (a single-slice session must never record an
+    # ICI rate wearing a DCN label)
+    assert "dcn-bandwidth-probe" not in cpu_ids
+    assert "dcn-bandwidth-probe" in ids
 
 
 def test_collect_debts(tmp_path, monkeypatch):
