@@ -146,7 +146,25 @@ DEFAULT_SHAPE = {"pagerank": (21, 16), "cc": (20, 16),
                  # failovers with replicas=1, SLO accounting over
                  # shed queries).  The real-TPU drill is debt
                  # serve-chaos-on-device.
-                 "serve-chaos": (12, 8)}
+                 "serve-chaos": (12, 8),
+                 # live-graph serving lines (round 20,
+                 # lux_tpu/livegraph.py): `-config serve-live` runs
+                 # mixed-kind traffic against a MUTATING graph —
+                 # WAL-free LiveGraph ingest between drains, per-
+                 # column epoch pinning, the epoch-keyed answer
+                 # cache, and at least one natural threshold-
+                 # triggered compaction — and verifies EVERY answer
+                 # against its NumPy oracle at the query's admission
+                 # epoch before the line may print.  The line carries
+                 # mutations/mutation_rate/epochs_advanced/
+                 # compactions/cache_hit_fraction/peak_occupancy
+                 # (scripts/check_bench.py rejects the
+                 # contradictions: epochs advanced with zero
+                 # mutations, hit fraction outside [0, 1], a
+                 # compaction count with delta occupancy never past
+                 # threshold).  The on-device run is carried as debt
+                 # live-mutation-on-device (lux_tpu/observe.py).
+                 "serve-live": (12, 8)}
 
 # the batch-sweep expansion (one metric line per B per app)
 BATCH_SWEEP_DEFAULT = "1,8,64"
@@ -413,6 +431,156 @@ def run_serve_load(config, args, *, chaos: bool):
             lambda: one_step().achieved_qps)
 
 
+def run_serve_live(config, args):
+    """The live-graph serving line (round 20, lux_tpu/livegraph.py):
+    mixed-kind traffic over a MUTATING graph.  Each phase mutates
+    first (one published epoch), then drains two query waves — the
+    second wave repeats the first's hot sources at the SAME epoch, so
+    the epoch-keyed answer cache measurably hits; delta occupancy
+    crosses the compact threshold mid-run and the natural compaction
+    (+ Server.refresh_live generation adoption) happens between
+    drains.  EVERY answer is verified against its NumPy oracle at the
+    query's admission epoch before the line may print — a wrong
+    answer is a crash, never a published number.  check_bench rejects
+    the line's contradictions (see DEFAULT_SHAPE comment)."""
+    import os
+    import time as _time
+
+    import numpy as np
+
+    from lux_tpu import livegraph, serve, telemetry
+
+    sdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "scripts")
+    if sdir not in sys.path:
+        sys.path.insert(0, sdir)
+    import loadgen
+
+    scale = args.scale or DEFAULT_SHAPE["serve-live"][0]
+    ef = args.ef or DEFAULT_SHAPE["serve-live"][1]
+    kinds = [k.strip() for k in args.serve_kinds.split(",")
+             if k.strip()]
+    slo = loadgen._parse_slo(args.slo_ms)
+    g = build_graph(scale, ef, args.verbose)
+    capacity = args.delta_capacity
+
+    def build_tier():
+        """ONE construction for sample 0 and every rerun — the two
+        must measure the identical workload (live graph shape, cache
+        policy, compaction cadence), so there is exactly one place
+        to tune it."""
+        lv = livegraph.LiveGraph(g, capacity=capacity,
+                                 compact_threshold=0.75)
+        sv = serve.Server(g, batch=args.serve_batch,
+                          num_parts=args.np, seg_iters=2, slo_ms=slo,
+                          health=args.health, live=lv, cache=True)
+        return lv, sv
+
+    live, srv = build_tier()
+    extra = {"np": args.np, "scale": scale, "ef": ef,
+             "serve_batch": args.serve_batch, "kinds": kinds,
+             "unit": "qps", "delta_capacity": capacity,
+             "compact_threshold": live.compact_threshold}
+    if args.audit != "off":
+        from lux_tpu import audit
+        findings = []
+        for k in kinds:
+            eng = srv._runner(k).eng
+            if k in ("sssp", "components"):
+                # the live delta-relax step rides the same audited
+                # gather budget as the dense iterations
+                live.register_audit(eng)
+            findings += audit.audit_engine(eng, mode=None)
+        d = audit.digest(findings, mode=args.audit)
+        extra["audit"] = d
+        if d["errors"] and args.audit == "error":
+            audit.raise_findings(findings, where="serve-live")
+        for f in findings:
+            print(f"# audit: {f}", file=sys.stderr)
+    loadgen.warm(srv, kinds)
+    nv = g.nv
+    phases = 6
+    per = max(len(kinds), args.serve_queries // (2 * phases))
+    # mutation volume sized to cross the compact threshold mid-run:
+    # phases-1 batches of ceil(threshold*cap/(phases-2)) edges pass
+    # 0.75*cap at phase ~ phases-2, leaving >= 1 natural compaction
+    per_mut = int(np.ceil(live.compact_threshold * capacity
+                          / max(1, phases - 2)))
+
+    def load_phase(lv, sv, rng):
+        """One phase: mutate, then two query waves at the SAME
+        epoch — the repeat wave is the cache-hit traffic.  Returns
+        (responses, submitted)."""
+        sv.mutate(rng.integers(nv, size=per_mut),
+                  rng.integers(nv, size=per_mut))
+        hot = {k: int(rng.integers(nv)) for k in kinds}
+        n = 0
+        out = []
+        for wave in range(2):
+            for i in range(per):
+                kind = kinds[i % len(kinds)]
+                s = hot[kind] if i < len(kinds) \
+                    else int(rng.integers(nv))
+                sv.submit(kind, source=s)
+                n += 1
+            out += sv.run()
+        if lv.should_compact():
+            lv.compact()
+            sv.refresh_live()
+        return out, n
+
+    def one_step(lv, sv):
+        rng = np.random.default_rng(7)
+        t0 = _time.monotonic()
+        responses, submitted = [], 0
+        for _ in range(phases):
+            out, n = load_phase(lv, sv, rng)
+            responses += out
+            submitted += n
+        elapsed = _time.monotonic() - t0
+        bad = livegraph.check_live_answers(lv, responses)
+        if bad:
+            raise RuntimeError(
+                f"serve-live: {bad} answer(s) differ from the NumPy "
+                f"oracle at their admission epochs — a wrong-answer "
+                f"line must never print")
+        telemetry.current().emit("timed_run", repeat=0,
+                                 iters=len(responses),
+                                 seconds=round(elapsed, 6))
+        return len(responses) / elapsed, elapsed, submitted
+
+    def fresh_run():
+        """A rerun must measure the SAME workload as the sample it
+        replaces — mutation stream, natural compaction, cold answer
+        cache — so it rebuilds the tier (build_tier, the one shared
+        construction) and replays the identical seeded traffic.  The
+        jit cache is warm (same shapes), so no compile cost recurs;
+        replaying more queries over the now-static mutated graph
+        instead would skip the very mutation/compaction path this
+        line claims to time."""
+        lv, sv = build_tier()
+        loadgen.warm(sv, kinds)
+        return one_step(lv, sv)[0]
+
+    qps, elapsed, submitted = one_step(live, srv)
+    hit_frac = srv.cache.hit_fraction() or 0.0
+    if live.compactions < 1:
+        raise RuntimeError(
+            "serve-live: no compaction fired — the line would not "
+            "measure the generation-swap path it claims to")
+    extra.update(
+        submitted=submitted,
+        served=submitted,
+        mutations=int(live.mutations),
+        mutation_rate_per_s=round(live.mutations / elapsed, 4),
+        epochs_advanced=int(live.epoch),
+        compactions=int(live.compactions),
+        cache_hit_fraction=round(hit_frac, 4),
+        peak_occupancy=round(live.peak_count / capacity, 4))
+    name = f"serve_live_rmat{scale}"
+    return (name, [qps], extra, fresh_run)
+
+
 def run_config(config, args):
     """Returns (name, gteps samples list, extra json fields,
     rerun() -> one more gteps sample)."""
@@ -426,6 +594,9 @@ def run_config(config, args):
 
     if config.startswith("serve-chaos"):
         return run_serve_load(config, args, chaos=True)
+
+    if config.startswith("serve-live"):
+        return run_serve_live(config, args)
 
     if config.startswith("gather-ab"):
         # paged-vs-flat A/B: "gather-ab@paged[:reorder]" names one
@@ -817,6 +988,12 @@ def main() -> int:
                     default="sssp=250,components=250,pagerank=1000",
                     help="per-kind latency SLO targets for "
                          "serve-slo, kind=ms comma list")
+    ap.add_argument("-delta-capacity", type=int, default=64,
+                    dest="delta_capacity",
+                    help="live-graph delta block capacity for the "
+                         "serve-live config (lux_tpu/livegraph.py; "
+                         "sized so the mutation stream crosses the "
+                         "compact threshold mid-run)")
     ap.add_argument("-reorder", default="none",
                     choices=["none", "native", "hillclimb"],
                     help="page-aware vertex reorder for the "

@@ -33,6 +33,9 @@ Layout:
                 phase-cost attribution vs scalemodel, persistent perf
                 ledger + carried-debt registry
                 (report: python -m lux_tpu.observe)
+  livegraph.py  live graphs: CRC-chained mutation WAL, snapshot-
+                isolated epochs, incremental revalidation, chaos-
+                drilled compaction (round 20, ROADMAP item 4)
   native/       C++ converter CLI and partition-slice loader
 """
 
@@ -62,5 +65,11 @@ def __getattr__(name):
     if name == "AuditError":
         from lux_tpu.audit import AuditError
         return AuditError
+    # round-20 live-graph typed errors: lazy for the same
+    # python -m double-import reason as AuditError
+    if name in ("LiveGraphError", "MutationLogError",
+                "DeltaFullError"):
+        from lux_tpu import livegraph
+        return getattr(livegraph, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

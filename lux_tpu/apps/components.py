@@ -134,6 +134,35 @@ def reference_components(g: Graph) -> np.ndarray:
         labels = new
 
 
+def reference_components_incremental(g_new: Graph,
+                                     labels_old: np.ndarray,
+                                     new_src, new_dst) -> np.ndarray:
+    """NumPy INCREMENTAL oracle (round 20, live graphs): revalidate
+    converged max-propagation labels after edge appends by
+    propagating ONLY from the touched endpoints (the worklist
+    analogue of lux_tpu/livegraph.LiveGraph.revalidate).  Appends
+    only ever RAISE max-fixed-point labels (components can merge,
+    never split), so seeding from the old fixed point and pushing
+    improvements from the new edges converges to exactly
+    ``reference_components(g_new)`` — proved in
+    tests/test_livegraph.py."""
+    src, dst = g_new.edge_arrays()
+    labels = np.asarray(labels_old, dtype=np.int64).copy()
+    frontier = np.zeros(g_new.nv, dtype=bool)
+    for s, d in zip(np.asarray(new_src, np.int64),
+                    np.asarray(new_dst, np.int64)):
+        if labels[s] > labels[d]:
+            labels[d] = labels[s]
+            frontier[d] = True
+    while frontier.any():
+        on = frontier[src]
+        new = labels.copy()
+        np.maximum.at(new, dst[on], labels[src[on]])
+        frontier = new > labels
+        labels = new
+    return labels
+
+
 def reference_components_batched(g: Graph, seeds) -> np.ndarray:
     """NumPy seeded-propagation oracle -> ``[nv, B]`` labels: column q
     is ``seeds[q]`` where the vertex is reachable from the seed, -1

@@ -212,6 +212,59 @@ def reference_sssp(g: Graph, start_vertex: int = 0,
     return dist
 
 
+def reference_sssp_incremental(g_new: Graph, dist_old: np.ndarray,
+                               new_src, new_dst, new_w=None,
+                               weighted: bool = False) -> np.ndarray:
+    """NumPy INCREMENTAL oracle (round 20, live graphs): revalidate a
+    converged distance vector after edge appends by re-relaxing ONLY
+    vertices reachable from the touched endpoints — the worklist
+    analogue of the frontier-seeded device revalidation
+    (lux_tpu/livegraph.LiveGraph.revalidate).
+
+    ``g_new`` is the AUGMENTED graph (base plus the new edges —
+    ``Graph.with_edges``), ``dist_old`` the fixed point on the base
+    graph, (new_src, new_dst[, new_w]) the appended edges.  Edge
+    appends only ever LOWER min-fixed-point distances, so seeding
+    from the old fixed point and propagating improvements from the
+    new edges' destinations converges to exactly
+    ``reference_sssp(g_new, ...)`` — the equality
+    tests/test_livegraph.py proves on every sweep point.  Returns the
+    new distance vector in dist_old's dtype discipline (int64 hops /
+    float64 weighted, matching reference_sssp)."""
+    src, dst = g_new.edge_arrays()
+    if weighted:
+        if new_w is None:
+            # same contract as Graph.with_edges: a silently
+            # one-weighted append seeds below the true fixed point,
+            # and monotone propagation can never repair it
+            raise ValueError("weighted incremental oracle needs "
+                             "new_w for every appended edge")
+        w = np.asarray(g_new.weights, dtype=np.float64)
+        dist = np.asarray(dist_old, dtype=np.float64).copy()
+        nw = np.asarray(new_w, np.float64)
+    else:
+        w = np.ones(g_new.ne, dtype=np.int64)
+        dist = np.asarray(dist_old, dtype=np.int64).copy()
+        nw = np.ones(len(new_src), dtype=np.int64)
+    # seed: relax the appended edges against the old fixed point
+    frontier = np.zeros(g_new.nv, dtype=bool)
+    cand = dist[np.asarray(new_src, np.int64)] + nw
+    for d, c in zip(np.asarray(new_dst, np.int64), cand):
+        if c < dist[d]:
+            dist[d] = c
+            frontier[d] = True
+    # propagate: only out-edges of improved vertices relax — the
+    # touched-reachable region, not the whole graph
+    while frontier.any():
+        on = frontier[src]
+        cand = dist[src[on]] + w[on]
+        new = dist.copy()
+        np.minimum.at(new, dst[on], cand)
+        frontier = new < dist
+        dist = new
+    return dist
+
+
 def reference_sssp_batched(g: Graph, sources,
                            weighted: bool = False) -> np.ndarray:
     """NumPy k-source Bellman-Ford oracle -> ``[nv, B]`` distances.

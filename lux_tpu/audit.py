@@ -1136,6 +1136,34 @@ def matrix_configs(ledger: bool = True):
                     lambda: components.build_engine(g, num_parts=2,
                                                     sources=QB[:2]),
                     False))
+
+    # live-graph delta revalidation (round 20, lux_tpu/livegraph.py):
+    # the delta-relax step rides the SAME gather budget as the dense
+    # iterations — ONE state-table gather (the delta-source fetch;
+    # improvements come from a whole-table compare, never a second
+    # gather) — and the dense programs themselves are UNCHANGED by
+    # serving a live graph, so the budget holds across the whole
+    # matrix with no pragma.
+    def _live(builder):
+        from lux_tpu.livegraph import LiveGraph
+        lg = LiveGraph(g, capacity=64)
+        lg.append_edges([1, 2, 3], [9, 17, 33])
+        eng = builder()
+        lg.register_audit(eng)
+        return eng
+
+    configs.append(("sssp_np2_live_delta",
+                    lambda: _live(lambda: sssp.build_engine(
+                        g, 0, num_parts=2)),
+                    False))
+    configs.append(("ksssp_np2_live_batched",
+                    lambda: _live(lambda: sssp.build_engine(
+                        g, num_parts=2, sources=QB)),
+                    False))
+    configs.append(("cc_np2_live_delta",
+                    lambda: _live(lambda: components.build_engine(
+                        g, num_parts=2)),
+                    False))
     if ledger:
         gd = graphs["dense"]
         gdw = graphs["dense_w"]

@@ -104,6 +104,16 @@ def _leaf_crc(leaf: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(leaf).tobytes()) & 0xFFFFFFFF
 
 
+def chained_crc32(data: bytes, prev: int = 0) -> int:
+    """CRC32 of ``data`` seeded with the previous link's CRC — the
+    per-record integrity discipline the checkpoint's per-leaf CRCs
+    use, extended into a CHAIN for append-only logs: record i's CRC
+    covers record i's bytes AND (through the seed) every byte before
+    it, so a torn or reordered tail cannot re-validate.  Shared with
+    the live-graph mutation log (lux_tpu/livegraph.MutationLog)."""
+    return zlib.crc32(data, prev & 0xFFFFFFFF) & 0xFFFFFFFF
+
+
 def save(path: str, state, meta: dict | None = None,
          rotate: bool = True) -> None:
     """Atomically write a checkpoint: ``state`` is a pytree of arrays

@@ -62,6 +62,21 @@ WORKER_KILL = "worker_kill"      # raise InjectedWorkerKill (a whole
 #                                  death, detected by the peers'
 #                                  heartbeat deadline)
 
+# round 20 (live graphs, lux_tpu/livegraph.py): mutation-scoped
+# actions for the crash-consistent mutation log — each exercises one
+# leg of the WAL/compaction recovery contract
+MUT_CRASH = "mut_crash"          # crash BEFORE the WAL append lands:
+#                                  the mutation was never durable, so
+#                                  replay must not show it
+WAL_TORN = "wal_torn"            # crash MID-append: only a PREFIX of
+#                                  the record's bytes reach disk — the
+#                                  torn tail replay must detect (CRC
+#                                  chain), truncate, and never replay
+COMPACT_CRASH = "compact_crash"  # crash between COMPACT_START and the
+#                                  atomic generation swap: recovery
+#                                  resumes from the SURVIVING
+#                                  generation (base + published delta)
+
 
 # exit code of a hard_kill WORKER_KILL: distinguishable from a crash
 # (nonzero, outside the shell/signal ranges) in the harness's asserts
@@ -277,6 +292,77 @@ class ReplicaKillPlan:
             f"to the replica timed out", ())
 
 
+@dataclasses.dataclass
+class MutationFaultPlan:
+    """Mutation-scoped fault schedule for the live-graph subsystem
+    (lux_tpu/livegraph.py, round 20).  Two independent deterministic
+    counters:
+
+    - ``schedule`` maps a MUTATION-append index to MUT_CRASH (crash
+      before the WAL record lands — the mutation must be absent from
+      any replay) or WAL_TORN (a torn mid-append write: only a prefix
+      of the record's bytes reach disk, then the crash — replay must
+      detect the broken CRC chain, truncate the tail, and recover the
+      exact pre-append state).
+    - ``compact_schedule`` maps a COMPACTION index to COMPACT_CRASH
+      (crash after the WAL COMPACT_START marker but before the atomic
+      generation swap — recovery must come up on the SURVIVING
+      generation, base + published delta, with the half-built
+      generation discarded).
+
+    Like FaultPlan, fired entries never re-fire (the counters advance
+    past them), so recovery always terminates; ``fired`` records what
+    happened, for assertions."""
+
+    schedule: dict = dataclasses.field(default_factory=dict)
+    compact_schedule: dict = dataclasses.field(default_factory=dict)
+    mutations: int = dataclasses.field(default=0, init=False)
+    compactions: int = dataclasses.field(default=0, init=False)
+    fired: list = dataclasses.field(default_factory=list, init=False)
+
+    def __post_init__(self):
+        for i, a in self.schedule.items():
+            if a not in (MUT_CRASH, WAL_TORN):
+                raise ValueError(
+                    f"MutationFaultPlan schedule[{i}] must be "
+                    f"MUT_CRASH or WAL_TORN, got {a!r}")
+        for i, a in self.compact_schedule.items():
+            if a != COMPACT_CRASH:
+                raise ValueError(
+                    f"MutationFaultPlan compact_schedule[{i}] must "
+                    f"be COMPACT_CRASH, got {a!r}")
+
+    def fire_append(self, wal, record: bytes) -> None:
+        """Called by MutationLog.append BEFORE the record is written.
+        MUT_CRASH raises with nothing on disk; WAL_TORN writes a
+        strict prefix of ``record`` (the torn write) and then
+        raises.  ``wal`` may be None (un-logged LiveGraph): the crash
+        still fires, there is just nothing to tear."""
+        i = self.mutations
+        self.mutations += 1
+        action = self.schedule.get(i)
+        if action is None:
+            return
+        self.fired.append((i, action))
+        if action == WAL_TORN and wal is not None:
+            wal.write_torn(record)
+        raise InjectedWorkerCrash(
+            f"injected {action} at mutation {i}: worker died "
+            f"{'mid-append (torn WAL write)' if action == WAL_TORN else 'before the WAL append landed'}")
+
+    def fire_compact(self) -> None:
+        """Called by LiveGraph.compact between the COMPACT_START WAL
+        marker and the atomic generation swap."""
+        i = self.compactions
+        self.compactions += 1
+        if self.compact_schedule.get(i) != COMPACT_CRASH:
+            return
+        self.fired.append((i, COMPACT_CRASH))
+        raise InjectedWorkerCrash(
+            f"injected compact_crash at compaction {i}: worker died "
+            f"after COMPACT_START, before the generation swap")
+
+
 def nan_corrupt(state, count: int = 1):
     """Host copy of ``state`` with NaN poked into the first ``count``
     cells of its first floating leaf (what a corrupted segment output
@@ -379,3 +465,21 @@ def truncate_checkpoint(path: str, keep: float = 0.5) -> None:
     size = os.path.getsize(path)
     with open(path, "r+b") as f:
         f.truncate(max(1, int(size * keep)))
+
+
+def tear_wal(path: str, keep_bytes: int = 7) -> None:
+    """Append ``keep_bytes`` of a partial garbage record to a
+    mutation log at rest — what a power loss mid-append leaves on
+    disk (the torn tail scripts/fsck_lux.py and MutationLog.replay
+    must diagnose via the CRC chain, never replay).  A mid-append
+    tear is by definition a STRICT record prefix, so keep_bytes is
+    clamped below the record size — a full-record-sized garbage
+    tail would read as a complete record with a bad CRC, which
+    MutationLog.scan rightly classifies as hard crc_chain
+    corruption of a possibly-acknowledged mutation, not the
+    recoverable torn tail this helper promises."""
+    from lux_tpu import format as luxfmt
+
+    with open(path, "ab") as f:
+        f.write(b"\x7f" * min(max(1, int(keep_bytes)),
+                              luxfmt.WAL_RECORD_SIZE - 1))

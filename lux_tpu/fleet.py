@@ -96,6 +96,7 @@ import numpy as np
 from lux_tpu import faults as faults_mod
 from lux_tpu import heartbeat as heartbeat_mod
 from lux_tpu import resilience
+from lux_tpu import serve as serve_mod
 from lux_tpu.serve import (KINDS, DEFAULT_SEG_ITERS, PriorityCollector,
                            PullBatchRunner, PushBatchRunner, Request,
                            Response, _emit)
@@ -108,6 +109,12 @@ SHED_QUOTA = "quota"
 SHED_QUEUE_FULL = "queue_full"
 SHED_DEADLINE = "deadline"
 SHED_RETRIES = "retries"
+# round 20 (live graphs, lux_tpu/livegraph.py): the INGEST path's
+# backpressure — the fixed-capacity delta blocks are full because
+# mutations outran compaction, so the append is shed with the same
+# typed AdmissionError discipline as a query (never silently dropped,
+# never blocking the serving loop)
+SHED_DELTA_FULL = "delta_full"
 
 # routing health score: beat age (s) + BURN_WEIGHT x the replica's
 # rolling SLO-burn fraction — a replica burning its whole SLO budget
@@ -287,11 +294,33 @@ class FleetServer:
                  brownout_min_priority: int = 0,
                  retry: resilience.RetryPolicy | None = None,
                  fault: faults_mod.ReplicaKillPlan | None = None,
-                 replica_deadline_s: float = 3.0):
+                 replica_deadline_s: float = 3.0, live=None,
+                 cache: bool = False):
         if replicas < 1:
             raise ValueError(f"fleet needs >= 1 replica, got "
                              f"{replicas}")
         self.g = g
+        # live-graph serving (round 20, lux_tpu/livegraph.py): one
+        # SHARED LiveGraph across every in-process replica — its
+        # published delta blocks are immutable, so a failed-over
+        # query re-runs on the survivor at its ORIGINAL admission
+        # epoch and (integer apps) answers bitwise-identically.
+        # Subprocess replicas serve the static graph spec and carry
+        # no live handle, so a live fleet REFUSES them (typed, in
+        # add_subprocess_replica): a remote answer computed on the
+        # static base would wear epoch=None and evade both the
+        # torn-epoch audit and check_live_answers.
+        self.live = live
+        if live is not None and g is not live.base:
+            raise ValueError(
+                "FleetServer(live=...) requires g to be live.base")
+        if cache is True:
+            from lux_tpu.serve import AnswerCache
+            self.cache = AnswerCache.from_slo(slo_ms)
+        elif cache:
+            self.cache = cache
+        else:
+            self.cache = None
         self.batch = int(batch)
         self.opts = dict(num_parts=num_parts, mesh=mesh,
                          exchange=exchange, health=health)
@@ -381,6 +410,11 @@ class FleetServer:
         worker."""
         import subprocess
 
+        if self.live is not None:
+            raise ValueError(
+                "subprocess replicas serve the static graph spec "
+                "and cannot answer at a live admission epoch — a "
+                "live-graph fleet is in-process only")
         name = f"r{len(self._replicas)}"
         spool = workdir or tempfile.mkdtemp(prefix="lux_fleet_")
         os.makedirs(os.path.join(spool, f"inbox_{name}"),
@@ -423,7 +457,8 @@ class FleetServer:
 
     def _build_runner(self, kind: str):
         mkw = dict(metrics=self.metrics,
-                   slo_ms=self.slo_ms.get(kind))
+                   slo_ms=self.slo_ms.get(kind),
+                   live=self.live, cache=self.cache)
         if kind == "pagerank":
             return PullBatchRunner(kind, self.g, self.batch,
                                    seg_iters=self.seg_iters,
@@ -517,6 +552,8 @@ class FleetServer:
                 self._qreq.pop(req.qid, None)
                 self._tenant_load[req.tenant] = max(
                     0, self._tenant_load.get(req.tenant, 1) - 1)
+                if self.live is not None:
+                    self.live.release()
         if self.metrics is not None:
             self.metrics.counter("fleet_shed_total", kind=req.kind,
                                  reason=reason).inc()
@@ -545,6 +582,67 @@ class FleetServer:
             if p > req.deadline_s:
                 self._shed(req, SHED_DEADLINE, projected=p)
 
+    def _admission_epoch(self, kind: str) -> int | None:
+        """READ the epoch a query of ``kind`` would pin (cache
+        sweeps; admission itself stamps atomically through
+        serve.admit_query).  The pin survives failover re-dispatch,
+        so a re-run on a survivor answers at the same epoch bitwise
+        (serve._engine_family is the one kind-to-family rule)."""
+        return serve_mod.admission_epoch(self.live, kind)
+
+    def mutate(self, src, dst, weights=None,
+               tenant: str = "default") -> int:
+        """The serving tier's INGEST path: publish an edge-append
+        batch into the shared live graph.  When the delta blocks are
+        full (ingest outran compaction) the append is shed with a
+        typed ``AdmissionError(reason="delta_full")`` — recorded in
+        shed_records and as a query_shed event like every other
+        rejection — instead of blocking or silently dropping."""
+        from lux_tpu import livegraph
+
+        if self.live is None:
+            raise ValueError("mutate() needs a live graph "
+                             "(FleetServer(live=LiveGraph(...)))")
+        try:
+            return self.live.append_edges(src, dst, weights)
+        except livegraph.DeltaFullError:
+            with self._lock:
+                qid = self._next_qid
+                self._next_qid += 1
+            req = Request(qid=qid, kind="mutation",
+                          t_enqueue=time.monotonic(),
+                          tenant=str(tenant))
+            self._shed(req, SHED_DELTA_FULL)
+
+    def refresh_live(self) -> None:
+        """Adopt the live graph's new generation after a compaction
+        (serve.Server.refresh_live's fleet analogue): every replica's
+        runners are dropped and lazily rebuilt over the compacted
+        base.  Refuses while queries are dispatched/resident at a
+        replica, or CENTRALLY queued at an epoch the new base cannot
+        REPRODUCE (serve._epoch_reproducible — push kinds replay any
+        epoch >= base_epoch via the delta mask, pull kinds only the
+        base generation; serve.Server.refresh_live's rule)."""
+        if self.live is None:
+            return
+        stale = [req for q in self._queues.values()
+                 for req in q.pending_requests()
+                 if not serve_mod._epoch_reproducible(self.live,
+                                                     req)]
+        if stale:
+            raise RuntimeError(
+                f"refresh_live with {len(stale)} query(ies) queued "
+                f"at an epoch the new generation cannot reproduce — "
+                f"drain first")
+        if any(rep.pending_total() for rep in self._healthy()):
+            raise RuntimeError("refresh_live with queries still "
+                               "dispatched or resident — drain "
+                               "first")
+        self.g = self.live.base
+        for rep in self._replicas:
+            if not rep.remote:
+                rep._runners.clear()
+
     def submit(self, kind: str, source: int | None = None,
                reset=None, tenant: str = "default", priority: int = 0,
                deadline_s: float | None = None) -> int:
@@ -562,7 +660,14 @@ class FleetServer:
                       t_enqueue=time.monotonic(), tenant=str(tenant),
                       priority=int(priority),
                       deadline_s=(None if deadline_s is None
-                                  else float(deadline_s)))
+                                  else float(deadline_s)),
+                      # stamp + admission-ledger entry atomically
+                      # (serve.admit_query): the pinned epoch must
+                      # stay serveable until this query's
+                      # exactly-once retirement (_accept) or
+                      # post-admission shed — released there; an
+                      # admission-time shed releases below
+                      epoch=serve_mod.admit_query(self.live, kind))
         if self.metrics is not None:
             self.metrics.counter("serve_queries_total",
                                  kind=kind).inc()
@@ -570,7 +675,12 @@ class FleetServer:
               source=req.source, tenant=req.tenant,
               priority=req.priority, queued=len(q))
         with self._lock:
-            self._admission(req)
+            try:
+                self._admission(req)
+            except AdmissionError:
+                if self.live is not None:
+                    self.live.release()
+                raise
             self._qreq[qid] = req
             self._tenant_load[req.tenant] = \
                 self._tenant_load.get(req.tenant, 0) + 1
@@ -595,7 +705,10 @@ class FleetServer:
                     qid = self._next_qid
                     self._next_qid += 1
                 req = Request(qid=qid, kind=k, source=0,
-                              t_enqueue=time.monotonic())
+                              t_enqueue=time.monotonic(),
+                              epoch=serve_mod.admit_query(self.live,
+                                                          k),
+                              no_cache=True)
                 _emit("query_enqueue", qid=qid, query_kind=k,
                       source=0, tenant=req.tenant,
                       priority=req.priority, queued=0)
@@ -629,7 +742,16 @@ class FleetServer:
             if req is not None:
                 self._tenant_load[req.tenant] = max(
                     0, self._tenant_load.get(req.tenant, 1) - 1)
-        if self.metrics is not None:
+                if self.live is not None:
+                    # exactly-once: the pop above is the dedup gate,
+                    # so a replayed answer can never double-release
+                    self.live.release()
+        if self.metrics is not None and not resp.cached:
+            # cache hits retire in ~0s and never touch an engine —
+            # feeding them into the service-time histogram would
+            # drag down the mean the deadline-admission projection
+            # divides by, admitting queries that will actually wait
+            # a full engine drain instead of shedding them typed
             self.metrics.histogram(
                 "fleet_service_seconds", kind=resp.kind).observe(
                 max(0.0, resp.latency_s - resp.wait_s))
@@ -665,6 +787,11 @@ class FleetServer:
                     if slot is not None:
                         inflight.append(slot.req)
                         runner.slots[c] = None
+                        if runner.live is not None:
+                            # the dead replica's resident queries no
+                            # longer pin the generation; the
+                            # re-dispatch pins again at _start
+                            runner.live.unpin()
             for coll in rep._collectors.values():
                 # suppress the dead collector's metrics for this
                 # drain: the requests are about to re-queue on a
@@ -839,6 +966,19 @@ class FleetServer:
         replicas through continuous-batching refill, polls subprocess
         answers, and fails over on any replica death observed on the
         way.  Returns this call's responses in retirement order."""
+        if self.live is not None and self.g is not self.live.base:
+            # generation adoption is ENFORCED (serve.Server.run's
+            # guard, fleet-wide): replica engines built over a stale
+            # base would serve old-base + empty delta — a wrong
+            # answer the torn-epoch audit cannot see
+            raise RuntimeError(
+                "live graph compacted to a new generation — call "
+                "refresh_live() before serving")
+        if self.cache is not None and self.live is not None:
+            # invalidation on epoch advance (serve.Server.run's
+            # sweep, fleet-wide: the cache is SHARED across replicas)
+            self.cache.sweep({k: self._admission_epoch(k)
+                              for k in KINDS})
         out: list[Response] = []
         while True:
             progressed = False

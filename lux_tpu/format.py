@@ -54,7 +54,8 @@ class GraphFormatError(ValueError):
     header, section_size, weighted_mismatch, ambiguous_layout,
     row_ptrs_monotone, row_ptrs_total, col_idx_range,
     degrees_length, degrees_consistent, partition_starts,
-    partition_edges, perm_header, perm_length, perm_bijection)."""
+    partition_edges, perm_header, perm_length, perm_bijection,
+    wal_header, wal_version, wal_capacity)."""
 
     def __init__(self, path: str, check: str, detail: str):
         super().__init__(f"{path}: invalid graph [{check}] — {detail}")
@@ -329,6 +330,70 @@ def read_perm_sidecar(lux_path: str, nv: int | None = None,
             f"sidecar from a different graph?")
     validate_perm(perm, n, p)
     return perm
+
+
+# ---------------------------------------------------------------------
+# mutation-log (WAL) header (round 20, live graphs)
+#
+# The live-graph subsystem (lux_tpu/livegraph.py) journals every
+# mutation into a CRC-chained append-only log BESIDE the graph it
+# mutates.  The on-disk format knowledge lives here with the other
+# formats (.lux, .perm): a 16-byte header (magic "LUXW" + uint32
+# version + uint32 nv + uint32 delta capacity) followed by fixed
+# 24-byte records whose chained CRC32 livegraph.MutationLog owns.
+# Header validation is the same crash-don't-corrupt conversion as
+# validate_graph — a log from a different graph (nv mismatch) or a
+# foreign/garbage file raises a typed GraphFormatError instead of
+# replaying wrong mutations into a wrong-answer serving epoch.
+
+WAL_MAGIC = b"LUXW"
+WAL_VERSION = 1
+WAL_HEADER_SIZE = 16
+WAL_RECORD_SIZE = 24
+WAL_SUFFIX = ".wal"
+
+
+def wal_sidecar_path(lux_path: str) -> str:
+    return lux_path + WAL_SUFFIX
+
+
+def pack_wal_header(nv: int, capacity: int) -> bytes:
+    return WAL_MAGIC + np.array(
+        [WAL_VERSION, nv, capacity], V_DTYPE).tobytes()
+
+
+def read_wal_header(path: str, nv: int | None = None,
+                    head: bytes | None = None):
+    """Read + VALIDATE a mutation-log header; returns (nv, capacity).
+    ``nv`` (when given) must match the header's — a log copied from a
+    different graph raises instead of silently replaying foreign
+    mutations.  ``head`` skips the file read (replay already holds
+    the bytes)."""
+    if head is None:
+        with open(path, "rb") as f:
+            head = f.read(WAL_HEADER_SIZE)
+    if len(head) != WAL_HEADER_SIZE or head[:4] != WAL_MAGIC:
+        raise GraphFormatError(
+            path, "wal_header",
+            f"bad magic/length {head[:4]!r} ({len(head)} bytes) — a "
+            f"mutation log starts with {WAL_MAGIC!r} and a "
+            f"{WAL_HEADER_SIZE}-byte header")
+    ver, hnv, cap = (int(x) for x in
+                     np.frombuffer(head, V_DTYPE, count=3, offset=4))
+    if ver != WAL_VERSION:
+        raise GraphFormatError(
+            path, "wal_version",
+            f"log version {ver}, this build reads {WAL_VERSION}")
+    if cap < 1:
+        raise GraphFormatError(
+            path, "wal_capacity",
+            f"delta capacity {cap} must be >= 1")
+    if nv is not None and hnv != nv:
+        raise GraphFormatError(
+            path, "wal_header",
+            f"log written for nv={hnv} but the graph has nv={nv} — "
+            f"mutation log from a different graph?")
+    return hnv, cap
 
 
 def write_lux(path: str, row_ptrs, col_idx, weights=None, degrees=None):

@@ -156,6 +156,34 @@ class Graph:
         return cls(nv=nv, ne=int(col_idx.shape[0]), row_ptrs=row_ptrs,
                    col_idx=col_idx, weights=w_sorted, out_degrees=deg)
 
+    def with_edges(self, src, dst, weights=None) -> "Graph":
+        """New Graph = this graph's edge multiset plus (src, dst[,
+        weights]) — the live-graph compaction fold (lux_tpu/
+        livegraph.py): the canonical (dst, src) CSC rebuild through
+        ``convert.edges_to_csc`` is deterministic, so two processes
+        folding the same delta into the same base produce
+        byte-identical arrays (the WAL-replay bitwise contract).
+        Weighted graphs require weights for the new edges and vice
+        versa — a silently zero-weighted append would corrupt
+        shortest paths instead of erroring."""
+        src = np.asarray(src)
+        dst = np.asarray(dst)
+        if (self.weights is None) != (weights is None):
+            raise ValueError(
+                f"with_edges weights mismatch: graph is "
+                f"{'weighted' if self.weights is not None else 'unweighted'}"
+                f" but new edges are "
+                f"{'weighted' if weights is not None else 'unweighted'}")
+        base_src, base_dst = self.edge_arrays()
+        w = None
+        if self.weights is not None:
+            w = np.concatenate([np.asarray(self.weights),
+                                np.asarray(weights)])
+        return Graph.from_edges(
+            np.concatenate([base_src, src.astype(np.int64)]),
+            np.concatenate([base_dst, dst.astype(np.int64)]),
+            self.nv, weights=w)
+
     def in_degrees(self) -> np.ndarray:
         return np.diff(self.row_ptrs.astype(np.int64), prepend=0)
 

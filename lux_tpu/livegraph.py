@@ -1,0 +1,966 @@
+"""Live graphs: crash-consistent mutation log, snapshot-isolated
+epochs, incremental revalidation, and chaos-drilled compaction.
+
+The reference is a static-graph batch system (its graphs are loaded
+once and never mutate, reference pull_model.inl:253-320); the serving
+tier built in rounds 14-18 answers live traffic against that frozen
+snapshot.  This module makes the graph MUTABLE underneath the queries
+with a robustness-first correctness story (ROADMAP item 4):
+
+1. **Durable mutation log** (:class:`MutationLog`): every edge append
+   is journaled to a CRC-CHAINED append-only WAL before it is
+   visible — record i's CRC32 seeds from record i-1's
+   (checkpoint.chained_crc32, the same per-leaf CRC discipline the
+   checkpoints carry), so a torn mid-append write (power loss, the
+   injected ``faults.WAL_TORN``) breaks the chain at the exact tear
+   point.  Replay truncates a torn TAIL and recovers the precise
+   pre-append state (bitwise — tests/test_livegraph.py); a broken
+   chain FOLLOWED by further whole records cannot be a torn append
+   and raises a typed :class:`MutationLogError` instead of replaying
+   garbage.  The on-disk header format lives with the other formats
+   (format.py ``read_wal_header``: magic/version/nv/capacity — a log
+   from a DIFFERENT graph errors instead of replaying foreign
+   mutations).
+
+2. **Fixed-capacity delta blocks, snapshot-isolated epochs**:
+   published mutations land in fixed-capacity host arrays
+   (src/dst/weight/epoch) that are passed to the engines' delta-relax
+   step as jit ARGUMENTS — no pair/page plan rebuild, no recompile,
+   per append (the Ragged-Paged-Attention idiom from PAPERS.md:
+   ragged growth through fixed-shape blocks).  Isolation is BY
+   CONSTRUCTION: a published slot is never rewritten (compaction
+   swaps in FRESH arrays rather than zeroing), the base generation's
+   arrays are never mutated in place, unwritten slots carry an
+   i32-max epoch sentinel written LAST — so a reader pinned to epoch
+   e sees exactly the edges with ``d_epoch <= e`` no matter how the
+   writer thread interleaves, and a torn read is impossible rather
+   than merely unlikely.  ``epoch`` is a monotone counter advanced
+   once per published append batch; scripts/events_summary.py FAILS
+   any trail whose answers were computed at a different epoch than
+   their admission pinned (the torn-epoch audit).
+
+3. **Incremental revalidation** (:meth:`LiveGraph.revalidate`):
+   frontier-seeded re-convergence — the delta-relax step gathers the
+   delta sources from the state table (ONE state-table gather,
+   machine-checked against the same audit gather budget as the dense
+   iterations: lux_tpu/audit.py matrix configs ``*_live_delta``),
+   relaxes the delta edges, epoch-masks per query column, scatters
+   min/max into the table, and activates improved destinations; the
+   push engine then re-converges only the reachable-from-touched
+   region.  NumPy incremental oracles came FIRST per convention
+   (apps/sssp.reference_sssp_incremental,
+   components.reference_components_incremental) and the device path
+   is proved equal to full recompute at the same epoch, bitwise for
+   the integer apps.  Measured on CPU it beats full recompute across
+   the touched-fraction sweep (scripts/sweep_live.py; PERF_NOTES
+   round 20).
+
+4. **Background compaction** (:meth:`LiveGraph.compact`): when delta
+   occupancy degrades the delta-drag economics
+   (:meth:`compact_economics`, priced with the scalemodel gather
+   terms), the delta folds into the base layout
+   (``Graph.with_edges`` — a deterministic CSC rebuild) and the
+   generation swaps ATOMICALLY under the lock: readers see the old
+   (base, delta) pair or the new one, never a mixture.  The WAL
+   brackets the fold with COMPACT_START/COMPACT_DONE markers; an
+   injected crash between them (``faults.COMPACT_CRASH``) leaves a
+   START without a DONE, and recovery comes up on the SURVIVING
+   generation (origin base + full replay) — compaction is a LAYOUT
+   transition, never a durability transition, so a half-built
+   generation can always be discarded.  Serving-tier backpressure:
+   when ingest outruns compaction the delta blocks fill and appends
+   raise a typed :class:`DeltaFullError`, which the fleet's admission
+   sheds as ``AdmissionError(reason="delta_full")``
+   (lux_tpu/fleet.py).
+
+Epoch visibility per engine family: the PUSH kinds (sssp /
+components) see base + published delta at the latest epoch — their
+monotone min/max programs absorb delta edges exactly through the
+delta-relax step.  The PULL kinds (pagerank) have no monotone
+revalidation (appends change out-degree normalization), so their
+snapshot view is the base GENERATION: mutations become visible to
+them at compaction, and their queries pin the generation's
+``base_epoch``.  Both pinnings are recorded at admission and audited
+at answer time (serve.py / scripts/events_summary.py).
+
+Durability scope: the WAL journals MUTATIONS; the base graph is the
+caller's (a .lux file or a deterministic generator spec), so recovery
+is ``LiveGraph.recover(origin_graph, wal_path)`` — replay the full
+log onto the origin and re-fold any completed compactions
+(deterministic, hence bitwise).  ``graph_at(epoch)`` materializes the
+host Graph as of any epoch — the NumPy-oracle surface every
+live-serving answer is checked against (O(total mutations) host
+memory; a diagnostic/test surface, documented as such).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import weakref
+
+import numpy as np
+
+from lux_tpu import format as luxfmt
+from lux_tpu.checkpoint import chained_crc32
+from lux_tpu.graph import Graph
+
+# WAL record kinds (format.py owns the header; the 24-byte record
+# layout is [epoch u32, kind u32, a u32, b u32, c u32, crc u32] with
+# crc = chained_crc32(first 20 bytes, prev record's crc; the chain
+# seeds from the header's CRC so a re-headered log cannot re-validate)
+REC_EDGE = 1           # a=src, b=dst, c=float32 weight bits
+REC_COMPACT_START = 2  # a=delta count folded, b=new generation
+REC_COMPACT_DONE = 3   # a=new generation, b=base epoch after fold
+
+# unwritten delta slots carry this epoch sentinel (written LAST in a
+# slot publish) so a concurrent reader's epoch mask can never see a
+# half-written slot — the torn-read-free-by-construction invariant
+EPOCH_SENTINEL = np.int32(np.iinfo(np.int32).max)
+
+
+class LiveGraphError(RuntimeError):
+    """Base of the live-graph subsystem's typed failures."""
+
+
+class MutationLogError(LiveGraphError):
+    """The mutation log failed verification.  Carries ``path``,
+    ``check`` (torn_tail / crc_chain / epoch_order / record_kind /
+    compact_pair / capacity_overflow / wal_exists) and ``detail`` —
+    the same typed-diagnosis shape as
+    format.GraphFormatError, consumed by scripts/fsck_lux.py (exit
+    2).  ``torn_tail`` is the RECOVERABLE class: replay truncates it;
+    every other check is hard corruption that must never replay."""
+
+    def __init__(self, path: str, check: str, detail: str):
+        super().__init__(f"{path}: mutation log [{check}] — {detail}")
+        self.path = path
+        self.check = check
+        self.detail = detail
+
+
+class DeltaFullError(LiveGraphError):
+    """The fixed-capacity delta blocks are full: ingest has outrun
+    compaction.  The serving tier's admission converts this into the
+    typed ``AdmissionError(reason="delta_full")`` backpressure shed
+    (lux_tpu/fleet.py) instead of blocking or silently dropping."""
+
+    def __init__(self, capacity: int):
+        super().__init__(
+            f"delta blocks full ({capacity} slots): compact before "
+            f"appending more mutations")
+        self.capacity = capacity
+
+
+class CompactPinnedError(LiveGraphError):
+    """compact() was called while queries still pin the current
+    generation — swapping under them would un-mask base edges newer
+    than their admission epochs (a torn read by another name).  The
+    serving layer compacts between drains, when nothing is
+    resident."""
+
+
+def _emit(kind: str, **fields):
+    from lux_tpu import telemetry
+    telemetry.current().emit(kind, **fields)
+
+
+@dataclasses.dataclass(frozen=True)
+class WalRecord:
+    epoch: int
+    kind: int
+    a: int
+    b: int
+    c: int
+
+
+def _pack_record(epoch: int, kind: int, a: int, b: int, c: int,
+                 prev_crc: int) -> bytes:
+    body = np.array([epoch, kind, a, b, c],
+                    luxfmt.V_DTYPE).tobytes()
+    crc = chained_crc32(body, prev_crc)
+    return body + np.array([crc], luxfmt.V_DTYPE).tobytes()
+
+
+class MutationLog:
+    """The CRC-chained append-only WAL (module docstring pillar 1).
+
+    One instance owns an open append handle; each ``append_*`` writes
+    one 24-byte record and fsyncs — durability is per record, so a
+    crash between two records of a batch replays the durable prefix
+    (the documented half-batch semantics).  ``replay`` is a
+    classmethod: verify the chain, truncate a torn tail (emitting a
+    ``wal_truncate`` telemetry event), raise typed MutationLogError
+    on anything that cannot be a torn append."""
+
+    def __init__(self, path: str, nv: int, capacity: int,
+                 _resume: tuple | None = None):
+        self.path = path
+        self.nv = int(nv)
+        self.capacity = int(capacity)
+        if _resume is None:
+            header = luxfmt.pack_wal_header(self.nv, self.capacity)
+            try:
+                fd = os.open(path,
+                             os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                             0o644)
+            except FileExistsError:
+                # the restart-after-crash path is the very situation
+                # the WAL exists for — refuse typed, pointing at the
+                # recovery entry, never an opaque builtin traceback
+                raise MutationLogError(
+                    path, "wal_exists",
+                    "a mutation log already exists at this path — "
+                    "a fresh log would orphan its durable history; "
+                    "use LiveGraph.recover(g, path) to replay it, "
+                    "or remove the file to start over") from None
+            self._f = os.fdopen(fd, "wb")
+            self._f.write(header)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._crc = chained_crc32(header)
+        else:
+            size, crc = _resume
+            self._f = open(path, "r+b")
+            self._f.seek(size)
+            self._crc = crc
+
+    # -- append side ---------------------------------------------------
+
+    def _append(self, record: bytes) -> None:
+        self._f.write(record)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._crc = int(np.frombuffer(record, luxfmt.V_DTYPE)[5])
+
+    def pack_edge(self, epoch: int, src: int, dst: int,
+                  wbits: int) -> bytes:
+        return _pack_record(epoch, REC_EDGE, src, dst, wbits,
+                            self._crc)
+
+    def append_edge(self, epoch: int, src: int, dst: int,
+                    wbits: int) -> None:
+        self._append(self.pack_edge(epoch, src, dst, wbits))
+
+    def append_marker(self, epoch: int, kind: int, a: int,
+                      b: int) -> None:
+        self._append(_pack_record(epoch, kind, a, b, 0, self._crc))
+
+    def write_torn(self, record: bytes) -> None:
+        """Fault-injection hook (faults.MutationFaultPlan WAL_TORN):
+        persist a STRICT PREFIX of ``record`` — what a power loss
+        mid-append leaves on disk — and fsync it so the tear is
+        really there for the replay to diagnose."""
+        self._f.write(record[:len(record) // 2])
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    # -- replay / verify side ------------------------------------------
+
+    @classmethod
+    def scan(cls, path: str, nv: int | None = None):
+        """Verify the whole log WITHOUT modifying it.  Returns
+        (records, header_nv, capacity, torn_bytes): ``torn_bytes`` is
+        the length of a recoverable torn tail (0 = clean); hard
+        corruption raises MutationLogError.  scripts/fsck_lux.py's
+        WAL leg and ``replay`` both run through here so the checker
+        and the recovery path can never disagree on validity."""
+        recs, hnv, cap, tail, _crc = cls._scan(path, nv=nv)
+        return recs, hnv, cap, tail
+
+    @classmethod
+    def _scan(cls, path: str, nv: int | None = None):
+        """scan + the final chain CRC (the resume seed), so replay
+        never re-reads the file to recompute a chain the scan just
+        walked."""
+        with open(path, "rb") as f:
+            blob = f.read()
+        head = blob[:luxfmt.WAL_HEADER_SIZE]
+        hnv, cap = luxfmt.read_wal_header(path, nv=nv, head=head)
+        crc = chained_crc32(head)
+        recs: list[WalRecord] = []
+        off = luxfmt.WAL_HEADER_SIZE
+        R = luxfmt.WAL_RECORD_SIZE
+        last_epoch = 0
+        bad_at = None
+        while off + R <= len(blob):
+            raw = blob[off:off + R]
+            words = np.frombuffer(raw, luxfmt.V_DTYPE)
+            want = chained_crc32(raw[:20], crc)
+            if int(words[5]) != want:
+                bad_at = off
+                break
+            epoch, kind = int(words[0]), int(words[1])
+            if kind not in (REC_EDGE, REC_COMPACT_START,
+                            REC_COMPACT_DONE):
+                raise MutationLogError(
+                    path, "record_kind",
+                    f"record at byte {off} has unknown kind {kind} "
+                    f"with a VALID chain CRC — log written by a "
+                    f"newer/foreign build, refusing to replay")
+            if epoch < last_epoch:
+                raise MutationLogError(
+                    path, "epoch_order",
+                    f"record at byte {off} carries epoch {epoch} "
+                    f"after epoch {last_epoch} — the monotone epoch "
+                    f"counter never goes backwards; the log is "
+                    f"corrupt or spliced")
+            last_epoch = epoch
+            recs.append(WalRecord(epoch, kind, int(words[2]),
+                                  int(words[3]), int(words[4])))
+            crc = int(words[5])
+            off += R
+        tail = len(blob) - off
+        if bad_at is not None:
+            # a torn append can only leave a STRICT PREFIX of the
+            # record on disk (the writer's model: faults.WAL_TORN;
+            # a complete record that landed carries its valid CRC) —
+            # those never reach here (the loop stops short of a
+            # partial record and reports them as ``tail``).  A
+            # FULL-SIZE bad-CRC record is rot of a possibly-fsync-
+            # acknowledged append, and one with further records
+            # behind it is mid-file corruption — both must refuse,
+            # never silently truncate an acknowledged mutation away
+            behind = len(blob) - bad_at - R
+            what = (f"with {behind} byte(s) of further records "
+                    f"behind it — mid-file corruption"
+                    if behind else
+                    "at full record size — corruption of a "
+                    "possibly-acknowledged final record")
+            raise MutationLogError(
+                path, "crc_chain",
+                f"record at byte {bad_at} fails the CRC chain "
+                f"{what}, not a torn append; refusing to replay")
+        return recs, hnv, cap, tail, crc
+
+    @classmethod
+    def replay(cls, path: str, nv: int | None = None):
+        """Crash-recovery entry: scan, TRUNCATE a torn tail in place
+        (the pre-append state is the correct durable state — the torn
+        record was never acknowledged), and return (records,
+        truncated_bytes, resumable MutationLog open at the end)."""
+        recs, hnv, cap, torn, crc = cls._scan(path, nv=nv)
+        good = luxfmt.WAL_HEADER_SIZE + len(recs) * luxfmt.WAL_RECORD_SIZE
+        if torn:
+            with open(path, "r+b") as f:
+                f.truncate(good)
+                f.flush()
+                os.fsync(f.fileno())
+            _emit("wal_truncate", path=path, torn_bytes=int(torn),
+                  records=len(recs))
+        # the scan's final chain CRC IS the resume seed — no second
+        # read of the file, no recomputed chain
+        log = cls(path, hnv, cap, _resume=(good, crc))
+        return recs, torn, log
+
+
+# ---------------------------------------------------------------------
+# the live graph
+
+
+class LiveGraph:
+    """Mutable graph = base generation + fixed-capacity delta blocks
+    + monotone epochs (module docstring).  Thread contract: appends
+    take the lock; readers snapshot ``(epoch, count)`` lock-free and
+    epoch-mask — published slots are immutable and unwritten slots
+    carry the EPOCH_SENTINEL, so a reader can never observe a torn
+    slot regardless of interleaving."""
+
+    def __init__(self, g: Graph, *, capacity: int = 1024,
+                 wal_path: str | None = None,
+                 fault=None, compact_threshold: float = 0.75,
+                 _recovering: bool = False):
+        if capacity < 1:
+            raise ValueError(f"delta capacity {capacity} must be >= 1")
+        if not 0.0 < compact_threshold <= 1.0:
+            raise ValueError(f"compact_threshold {compact_threshold} "
+                             f"must be in (0, 1]")
+        self.origin = g               # recovery/oracle anchor
+        self.base = g                 # current generation's base
+        self.base_epoch = 0           # epoch folded into the base
+        self.generation = 0
+        self.epoch = 0                # latest published epoch
+        self.capacity = int(capacity)
+        self.weighted = g.weights is not None
+        self.compact_threshold = float(compact_threshold)
+        self.fault = fault
+        self._lock = threading.Lock()
+        self._fresh_delta()
+        self.count = 0                # published delta slots
+        self.pins = 0                 # RESIDENT queries on this gen
+        self.admitted = 0             # admitted-but-unretired queries
+        self.mutations = 0            # edges ever published
+        self.compactions = 0
+        self.peak_count = 0
+        # full publish history [(src, dst, w, epoch)] — the
+        # graph_at/oracle surface (O(total mutations) host memory;
+        # diagnostic/test scope, module docstring)
+        self._history: list[tuple] = []
+        self._graph_cache: dict[int, Graph] = {}
+        self._slot_cache: dict[int, tuple] = {}
+        self._vslot_cache: dict[int, tuple] = {}  # geometry-keyed
+        self._step_cache: dict[int, object] = {}
+        self._wal: MutationLog | None = None
+        if wal_path is not None and not _recovering:
+            self._wal = MutationLog(wal_path, g.nv, capacity)
+
+    def _fresh_delta(self) -> None:
+        # FRESH arrays on every generation swap — a concurrent reader
+        # holding the old arrays keeps a consistent published block
+        # (immutable-once-published), never a zeroed-under-it one
+        cap = self.capacity
+        self.d_src = np.zeros(cap, np.int32)
+        self.d_dst = np.zeros(cap, np.int32)
+        self.d_w = np.zeros(cap, np.float32)
+        self.d_epoch = np.full(cap, EPOCH_SENTINEL, np.int32)
+
+    # -- ingest --------------------------------------------------------
+
+    def append_edges(self, src, dst, weights=None) -> int:
+        """Publish one mutation batch: WAL-journal then delta-publish
+        each edge; the batch becomes ONE new epoch (visible the
+        moment ``self.epoch`` advances, after every slot is fully
+        written).  Returns the new epoch.  Raises DeltaFullError when
+        the batch does not fit (the admission backpressure signal),
+        MutationLogError/InjectedWorkerCrash from the fault plan's
+        crash legs."""
+        src = np.atleast_1d(np.asarray(src, np.int64))
+        dst = np.atleast_1d(np.asarray(dst, np.int64))
+        n = len(src)
+        if n == 0:
+            return self.epoch
+        if len(dst) != n:
+            raise ValueError(f"append_edges src/dst length mismatch "
+                             f"({n} vs {len(dst)})")
+        if self.weighted:
+            if weights is None:
+                raise ValueError("weighted live graph needs weights "
+                                 "for every appended edge")
+            w = np.atleast_1d(np.asarray(weights, np.float32))
+            if len(w) != n:
+                raise ValueError(
+                    f"append_edges src/weights length mismatch "
+                    f"({n} vs {len(w)})")
+        else:
+            if weights is not None:
+                # Graph.with_edges refuses this same mismatch typed —
+                # silently zeroing the caller's weight data would
+                # journal 0.0 bits and serve hop-count semantics with
+                # no signal that the weights vanished
+                raise ValueError(
+                    "append_edges got weights for an UNWEIGHTED live "
+                    "graph — build the LiveGraph over a weighted "
+                    "base, or drop the weights")
+            w = np.zeros(n, np.float32)
+        nv = self.base.nv
+        if src.size and (int(src.max()) >= nv or int(src.min()) < 0
+                         or int(dst.max()) >= nv or int(dst.min()) < 0):
+            raise ValueError(f"appended edge endpoint outside "
+                             f"[0, {nv})")
+        with self._lock:
+            if self.count + n > self.capacity:
+                raise DeltaFullError(self.capacity)
+            epoch = self.epoch + 1
+            for i in range(n):
+                s, d = int(src[i]), int(dst[i])
+                wbits = int(np.float32(w[i]).view(np.uint32))
+                if self.fault is not None:
+                    record = (self._wal.pack_edge(epoch, s, d, wbits)
+                              if self._wal is not None else b"")
+                    self.fault.fire_append(self._wal, record)
+                if self._wal is not None:
+                    self._wal.append_edge(epoch, s, d, wbits)
+                slot = self.count
+                self.d_src[slot] = s
+                self.d_dst[slot] = d
+                self.d_w[slot] = w[i]
+                # epoch LAST: a concurrent reader's epoch mask never
+                # admits a half-written slot
+                self.d_epoch[slot] = epoch
+                self.count = slot + 1
+                self._history.append((s, d, float(w[i]), epoch))
+            self.mutations += n
+            self.peak_count = max(self.peak_count, self.count)
+            self.epoch = epoch
+        # the wal path keys the events_summary CROSS-process
+        # replay-regression audit: a crash and its recovery are
+        # different processes, so the publisher's epochs and the
+        # recovering wal_replay pair on the log path, not the run
+        wal_kw = ({"wal": self._wal.path}
+                  if self._wal is not None else {})
+        _emit("mutation", edges=int(n), epoch=int(epoch),
+              delta_count=int(self.count),
+              occupancy=round(self.count / self.capacity, 4),
+              **wal_kw)
+        _emit("epoch_advance", from_epoch=int(epoch - 1),
+              to_epoch=int(epoch), **wal_kw)
+        return epoch
+
+    def occupancy(self) -> float:
+        return self.count / self.capacity
+
+    # -- pins (snapshot isolation vs compaction) -----------------------
+
+    def pin(self) -> None:
+        with self._lock:
+            self.pins += 1
+
+    def unpin(self) -> None:
+        with self._lock:
+            self.pins = max(0, self.pins - 1)
+
+    def admit(self, family: str | None = None) -> int | None:
+        """Count one ADMITTED query and return the epoch it pins —
+        ONE lock acquisition, so the stamp and the ledger entry are
+        atomic (a mutate+compact between a separate read and a
+        separate increment could fold the stamped view away before
+        the ledger protected it).  Resident pins alone cannot
+        protect a queued query: its epoch was pinned at admission,
+        and a compaction before it reaches a column folds the delta
+        out from under the OLD-base engines it will be served on — a
+        wrong answer the torn-epoch audit is structurally blind to
+        (answer_epoch == admission epoch both point at the vanished
+        view).  The serving tier admits at submit and releases at
+        exactly-once retirement/shed."""
+        with self._lock:
+            self.admitted += 1
+            if family is None:
+                return None
+            return (self.epoch if family == "push"
+                    else self.base_epoch)
+
+    def release(self) -> None:
+        with self._lock:
+            self.admitted = max(0, self.admitted - 1)
+
+    # -- epoch views ---------------------------------------------------
+
+    def view_epoch(self, family: str = "push") -> int:
+        """The epoch a newly admitted query of this engine family
+        pins: push kinds see base + published delta (latest epoch);
+        pull kinds see the base generation only (module docstring —
+        no monotone revalidation exists for them, so their mutations
+        become visible at compaction)."""
+        return self.epoch if family == "push" else self.base_epoch
+
+    def graph_at(self, epoch: int) -> Graph:
+        """Host Graph as of ``epoch`` — the NumPy-oracle surface
+        (origin + every published edge with epoch <= e; cached)."""
+        if not 0 <= epoch <= self.epoch:
+            raise ValueError(f"epoch {epoch} outside [0, "
+                             f"{self.epoch}]")
+        if epoch not in self._graph_cache:
+            hist = [h for h in self._history if h[3] <= epoch]
+            src = np.array([h[0] for h in hist], np.int64)
+            dst = np.array([h[1] for h in hist], np.int64)
+            w = (np.array([h[2] for h in hist], np.float32)
+                 if self.weighted else None)
+            self._graph_cache[epoch] = self.origin.with_edges(
+                src, dst, w) if hist else self.origin
+        return self._graph_cache[epoch]
+
+    # -- delta relax (the device step; jit ARGUMENTS) ------------------
+
+    @staticmethod
+    def _evict_dead(cache: dict) -> None:
+        """Drop entries whose weakref referent is gone.  The id()-
+        keyed caches validate hits by weakref identity, but a dead
+        geometry/engine's id may never be probed again (each
+        refresh_live rebuilds engines at fresh addresses), so stale
+        entries would accrete forever — O(nv) slot maps and compiled
+        steps pinned per retired generation.  Run on every miss:
+        the dicts hold a handful of live entries, so the sweep is
+        O(live + newly dead)."""
+        dead = [k for k, v in cache.items() if v[0]() is None]
+        for k in dead:
+            del cache[k]
+
+    def _vertex_slots(self, sg) -> np.ndarray:
+        """The O(nv) vertex -> padded-part-major-slot map for one
+        shard geometry — depends only on the IMMUTABLE geometry
+        (starts/vpad), never on the delta, so it is computed once per
+        sg and survives every mutation batch and compaction —
+        rebuilding it per batch would put O(nv) work (tens of MB of
+        temporaries at RMAT25 scale) on the ingest hot path for a
+        batch that touched a handful of slots."""
+        key = id(sg)
+        vs = self._vslot_cache.get(key)
+        if vs is None or vs[0]() is not sg:
+            self._evict_dead(self._vslot_cache)
+            v = np.arange(sg.nv, dtype=np.int64)
+            v_part = np.searchsorted(sg.starts, v, side="right") - 1
+            v_slot = (v_part * sg.vpad
+                      + (v - sg.starts[v_part])).astype(np.int32)
+            vs = (weakref.ref(sg), v_slot)
+            self._vslot_cache[key] = vs
+        return vs[1]
+
+    def delta_arrays(self, sg):
+        """The fixed-capacity delta block TRANSLATED into ``sg``'s
+        padded part-major slots, ready to pass as jit arguments:
+        (src_slot i32 [cap], dst_slot i32 [cap], w f32 [cap],
+        epoch i32 [cap]).  Published slots are immutable; per miss
+        only O(capacity) translation work runs (the O(nv) vertex
+        map is geometry-cached in ``_vertex_slots``) and the
+        returned arrays are fresh copies (never aliases of the
+        mutable tail)."""
+        # keyed by id() but VALIDATED by a weakref identity check:
+        # a dict key alone holds no reference, and CPython reuses a
+        # freed object's address — a stale hit would translate slots
+        # for a different shard geometry
+        key = id(sg)
+        cached = self._slot_cache.get(key)
+        n = self.count
+        if cached is None or cached[0]() is not sg \
+                or cached[1] is not self.d_src or cached[2] < n:
+            self._evict_dead(self._slot_cache)
+            v_slot = self._vertex_slots(sg)
+            src_slot = np.zeros(self.capacity, np.int32)
+            dst_slot = np.full(self.capacity,
+                               sg.num_parts * sg.vpad, np.int32)
+            src_slot[:n] = v_slot[self.d_src[:n]]
+            dst_slot[:n] = v_slot[self.d_dst[:n]]
+            cached = (weakref.ref(sg), self.d_src, n, src_slot,
+                      dst_slot, self.d_w.copy(), self.d_epoch.copy())
+            self._slot_cache[key] = cached
+        return cached[3], cached[4], cached[5], cached[6]
+
+    def delta_step(self, eng):
+        """The compiled delta-relax step for one push engine, CACHED
+        per engine (keyed by id(), validated by weakref identity, dead
+        entries evicted on miss) — every caller (revalidate, the serve
+        runners' _apply_delta, register_audit) shares ONE compile per
+        engine instead of re-inventing caching per site; a fresh
+        jax.jit per call was the exact recompile-per-revalidate bug
+        scripts/sweep_live.py found once already (PERF_NOTES round
+        20)."""
+        ent = self._step_cache.get(id(eng))
+        if ent is None or ent[0]() is not eng:
+            self._evict_dead(self._step_cache)
+            step = self._build_delta_step(eng)
+            self._step_cache[id(eng)] = (weakref.ref(eng), step)
+        else:
+            step = ent[1]
+        return step
+
+    def _build_delta_step(self, eng):
+        """Delta-relax step for one push engine: (label
+        [P, vpad(, B)], active, src_slot, dst_slot, w, epoch,
+        col_epoch) -> (label, active, improved count).  ONE
+        state-table gather (the delta-source fetch), candidates
+        epoch-masked PER QUERY COLUMN to the reduce identity, then a
+        scatter-min/max into the flat table; improvements come from a
+        whole-table compare (no second gather), so the audit's
+        gather budget holds at the dense iterations' own bound
+        (audit.matrix_configs ``*_live_delta``).  The delta arrays
+        are jit ARGUMENTS — appends never recompile."""
+        import jax
+        import jax.numpy as jnp
+
+        prog = eng.program
+        sg = eng.sg
+        flat_n = sg.num_parts * sg.vpad
+        reduce = prog.reduce
+        if reduce not in ("min", "max"):
+            raise ValueError(
+                f"live delta relax requires a monotone min/max "
+                f"program, got reduce={reduce!r} (pull kinds pin the "
+                f"base generation instead — module docstring)")
+
+        def step(label, active, src_slot, dst_slot, w, d_epoch,
+                 col_epoch):
+            ident = jnp.asarray(prog.identity, label.dtype)
+            flat = label.reshape((flat_n,) + label.shape[2:])
+            # weights pass RAW [cap] — the program's relax owns the
+            # query-axis broadcast, exactly as in the dense iteration
+            # (batched relax does w[..., None] itself)
+            src_l = jnp.take(flat, src_slot, axis=0)
+            cand = prog.relax(src_l, w if self.weighted else None)
+            cand = jnp.where(src_l == ident, ident,
+                             cand.astype(label.dtype))
+            # per-column epoch mask: a column pinned to epoch e must
+            # never see an edge published after it — the snapshot-
+            # isolation contract, enforced inside the step
+            mask = d_epoch.reshape(d_epoch.shape
+                                   + (1,) * (cand.ndim - 1)) \
+                <= col_epoch
+            cand = jnp.where(mask, cand, ident)
+            at = flat.at[dst_slot]
+            new_flat = at.min(cand, mode="drop") if reduce == "min" \
+                else at.max(cand, mode="drop")
+            improved = new_flat != flat
+            new_label = new_flat.reshape(label.shape)
+            new_active = active | improved.reshape(active.shape)
+            return new_label, new_active, \
+                jnp.sum(improved.astype(jnp.int32))
+
+        return jax.jit(step)
+
+    def register_audit(self, eng) -> None:
+        """Expose the delta-relax step to the static program auditor
+        as an engine variant (engine/auditable.py) so the repo-wide
+        matrix machine-checks its single state-table gather with the
+        engine's own ProgramSpec."""
+        import jax
+
+        jitted = self.delta_step(eng)
+        cap = self.capacity
+
+        def _thunk():
+            lab_sds, act_sds = eng._audit_state_sds
+            i32 = np.int32
+            col = (jax.ShapeDtypeStruct((lab_sds.shape[2],), i32)
+                   if len(lab_sds.shape) > 2
+                   else jax.ShapeDtypeStruct((), i32))
+            return (lab_sds, act_sds,
+                    jax.ShapeDtypeStruct((cap,), i32),
+                    jax.ShapeDtypeStruct((cap,), i32),
+                    jax.ShapeDtypeStruct((cap,), np.float32),
+                    jax.ShapeDtypeStruct((cap,), i32), col)
+
+        eng._register_variant("live_delta", jitted, _thunk)
+
+    # -- incremental revalidation --------------------------------------
+
+    def revalidate(self, eng, label, active, col_epoch=None):
+        """Frontier-seeded incremental re-convergence of a converged
+        state to this graph's published epoch (or per-column epochs):
+        interleave the delta-relax step with the engine's compiled
+        converge until the delta edges offer no further improvement —
+        the fixed point of base + epoch-masked delta, reached by
+        touching only the reachable-from-touched region (the
+        incremental-vs-full sweep: scripts/sweep_live.py, PERF_NOTES
+        round 20).  Returns (label, active, engine iterations)."""
+        import jax
+        import jax.numpy as jnp
+
+        step = self.delta_step(eng)     # cached per engine
+        args = self.delta_arrays(eng.sg)
+        if col_epoch is None:
+            col_epoch = self.epoch
+        batched = getattr(eng.program, "batch", None)
+        ce = (jnp.asarray(np.full(batched, col_epoch, np.int32))
+              if batched is not None and np.ndim(col_epoch) == 0
+              else jnp.asarray(np.asarray(col_epoch, np.int32)))
+        total = 0
+        while True:
+            label, active, imp = step(label, active, *args, ce)
+            if int(jax.device_get(imp)) == 0:
+                break
+            label, active, it = eng.converge(label, active)
+            total += int(jax.device_get(it))
+        return label, active, total
+
+    # -- compaction ----------------------------------------------------
+
+    def compact_economics(self) -> dict:
+        """Price the standing delta drag against the one-time re-pack
+        with the existing scalemodel terms: every dense boundary pays
+        ~GATHER_SMALL_NS per delta slot for the delta-source fetch
+        (the same per-edge gather rate the pair/page break-evens are
+        priced from), while the re-pack is a host CSC rebuild over
+        base+delta.  Compaction triggers when occupancy crosses
+        ``compact_threshold`` — past it the fixed-capacity block is
+        close enough to full that admission backpressure
+        (DeltaFullError) threatens before the next natural quiet
+        window."""
+        from lux_tpu import scalemodel
+
+        occ = self.occupancy()
+        return {
+            "occupancy": round(occ, 4),
+            "threshold": self.compact_threshold,
+            "should_compact": occ >= self.compact_threshold,
+            "delta_count": int(self.count),
+            "delta_drag_ns_per_boundary":
+                round(self.count * scalemodel.GATHER_SMALL_NS, 1),
+            "repack_edges": int(self.base.ne + self.count),
+        }
+
+    def should_compact(self) -> bool:
+        return self.compact_economics()["should_compact"]
+
+    def compact(self, force: bool = False):
+        """Fold the published delta into a NEW base generation and
+        swap atomically (module docstring pillar 4).  Returns the new
+        generation number, or None when there is nothing to fold (or
+        occupancy is under threshold and ``force`` is False).  Raises
+        CompactPinnedError while queries pin the current generation —
+        the serving layer compacts between drains.
+
+        Holds the mutation lock END TO END.  The fold is ~40 ms
+        (PERF_NOTES round 20) and a concurrent append in a released
+        window would be lost twice over: its published slot silently
+        discarded by the fresh-delta swap (in neither the new base
+        nor the delta — wrong answers the torn-epoch audit cannot
+        see), and its epoch-e+1 WAL record landing BEFORE this
+        compaction's epoch-e START marker — a log that fails its own
+        epoch_order validation, turning acknowledged durable
+        mutations unrecoverable.  Ingest simply blocks for the fold
+        (the backpressure-friendly choice); pin() takes the same
+        lock, so the pin check cannot race either."""
+        with self._lock:
+            if self.pins or self.admitted:
+                raise CompactPinnedError(
+                    f"{self.pins} resident / {self.admitted} "
+                    f"admitted query(ies) pin generation "
+                    f"{self.generation}; drain before compacting")
+            n = self.count
+            epoch = self.epoch
+            if n == 0 or (not force and not self.should_compact()):
+                return None
+            new_gen = self.generation + 1
+            if self._wal is not None:
+                self._wal.append_marker(epoch, REC_COMPACT_START, n,
+                                        new_gen)
+            _emit("compact_start", epoch=int(epoch),
+                  generation=new_gen, delta_count=int(n),
+                  occupancy=round(n / self.capacity, 4))
+            if self.fault is not None:
+                # the injected COMPACT_CRASH leg: die between the
+                # START marker and the swap — recovery must come up
+                # on the SURVIVING generation (base + published
+                # delta)
+                self.fault.fire_compact()
+            new_base = self.base.with_edges(
+                self.d_src[:n], self.d_dst[:n],
+                self.d_w[:n] if self.weighted else None)
+            self.base = new_base
+            self.base_epoch = epoch
+            self.generation = new_gen
+            self._fresh_delta()
+            self.count = 0
+            self.compactions += 1
+            self._slot_cache.clear()
+            if self._wal is not None:
+                self._wal.append_marker(epoch, REC_COMPACT_DONE,
+                                        new_gen, epoch)
+        _emit("compact_done", epoch=int(epoch), generation=new_gen,
+              folded=int(n), ne=int(new_base.ne))
+        return new_gen
+
+    # -- recovery ------------------------------------------------------
+
+    @classmethod
+    def recover(cls, origin: Graph, wal_path: str, *,
+                fault=None, compact_threshold: float = 0.75
+                ) -> "LiveGraph":
+        """Rebuild the live graph from the origin graph + the WAL:
+        verify the chain (truncating a torn tail), replay every edge
+        into the delta blocks, and re-fold every COMPLETED compaction
+        (START..DONE pair) — deterministic CSC rebuilds, so the
+        recovered generation is bitwise-identical to the pre-crash
+        one.  A START without a DONE (COMPACT_CRASH) is ignored: the
+        surviving generation is base + published delta, exactly what
+        the log proves durable."""
+        recs, torn, log = MutationLog.replay(wal_path, nv=origin.nv)
+        lg = cls(origin, capacity=log.capacity, wal_path=wal_path,
+                 fault=fault, compact_threshold=compact_threshold,
+                 _recovering=True)
+        lg._wal = log
+        pending_start = None
+        for rec in recs:
+            if rec.kind == REC_EDGE:
+                if lg.count >= lg.capacity:
+                    raise MutationLogError(
+                        wal_path, "capacity_overflow",
+                        f"replay overflows the delta capacity "
+                        f"{lg.capacity} with no compaction marker — "
+                        f"log inconsistent with its own header")
+                slot = lg.count
+                lg.d_src[slot] = rec.a
+                lg.d_dst[slot] = rec.b
+                w = float(np.uint32(rec.c).view(np.float32))
+                lg.d_w[slot] = w
+                lg.d_epoch[slot] = rec.epoch
+                lg.count = slot + 1
+                lg._history.append((rec.a, rec.b, w, rec.epoch))
+                lg.mutations += 1
+                lg.peak_count = max(lg.peak_count, lg.count)
+                lg.epoch = max(lg.epoch, rec.epoch)
+            elif rec.kind == REC_COMPACT_START:
+                pending_start = rec
+            elif rec.kind == REC_COMPACT_DONE:
+                if pending_start is None:
+                    raise MutationLogError(
+                        wal_path, "compact_pair",
+                        f"COMPACT_DONE at epoch {rec.epoch} without "
+                        f"a preceding COMPACT_START — the log's "
+                        f"compaction bracket is broken")
+                n = pending_start.a
+                lg.base = lg.base.with_edges(
+                    lg.d_src[:n], lg.d_dst[:n],
+                    lg.d_w[:n] if lg.weighted else None)
+                lg.base_epoch = rec.epoch
+                lg.generation = rec.a
+                # the surviving delta tail (appended after the fold's
+                # snapshot) shifts down into a fresh block
+                tail = lg.count - n
+                ts, td = lg.d_src[n:lg.count].copy(), \
+                    lg.d_dst[n:lg.count].copy()
+                tw = lg.d_w[n:lg.count].copy()
+                te = lg.d_epoch[n:lg.count].copy()
+                lg._fresh_delta()
+                lg.d_src[:tail], lg.d_dst[:tail] = ts, td
+                lg.d_w[:tail], lg.d_epoch[:tail] = tw, te
+                lg.count = tail
+                lg.compactions += 1
+                pending_start = None
+        lg._slot_cache.clear()
+        _emit("wal_replay", path=wal_path, records=len(recs),
+              epoch=int(lg.epoch), generation=int(lg.generation),
+              truncated_bytes=int(torn),
+              delta_count=int(lg.count))
+        return lg
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+
+
+# ---------------------------------------------------------------------
+# oracle verification of live-serving answers
+
+
+def check_live_answers(live: LiveGraph, responses,
+                       weighted: bool = False) -> int:
+    """Verify serving responses against the NumPy oracles evaluated
+    at each response's ADMISSION epoch (``graph_at``) — bitwise for
+    the integer apps, the chaos acceptance's correctness bar.
+    Returns the mismatch count."""
+    from lux_tpu.apps import components, pagerank, sssp
+
+    bad = 0
+    for r in responses:
+        epoch = r.epoch or 0
+        g_e = live.graph_at(epoch)
+        if r.kind == "sssp":
+            ref = sssp.reference_sssp_batched(
+                g_e, [r.source], weighted=weighted)[:, 0]
+            if not weighted:
+                ref = np.where(ref >= int(sssp.HOP_INF),
+                               int(sssp.HOP_INF), ref)
+                ok = np.array_equal(r.answer.astype(np.int64), ref)
+            else:
+                ok = bool(np.allclose(r.answer, ref))
+        elif r.kind == "components":
+            ref = components.reference_components_batched(
+                g_e, [r.source])[:, 0]
+            ok = np.array_equal(r.answer.astype(np.int64), ref)
+        else:
+            reset = pagerank.one_hot_resets(g_e.nv, [r.source])
+            ref = pagerank.reference_pagerank_batched(
+                g_e, reset, max(1, r.iters))[:, 0]
+            ok = bool(np.allclose(r.answer, ref, atol=5e-5))
+        if not ok:
+            bad += 1
+            print(f"LIVE MISMATCH qid={r.qid} kind={r.kind} "
+                  f"source={r.source} epoch={epoch}")
+    return bad

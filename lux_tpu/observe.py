@@ -949,6 +949,18 @@ DEBTS = (
          "serve refill path's host column scatter also wants a "
          "device-side scatter once measured",
          "PERF_NOTES round 14 (query batching)"),
+    Debt("live-mutation-on-device",
+         "bench.py -config serve-live (live-graph serving: mutation "
+         "stream + delta-relax boundaries + epoch-keyed cache + "
+         "compaction, lux_tpu/livegraph.py) on a live tunnel: the "
+         "per-boundary delta-relax cost (modeled "
+         "count x GATHER_SMALL_NS, the compact_economics drag term), "
+         "the WAL fsync cadence vs the tunnel wall, and the "
+         "compaction pause under real traffic are CPU-measured only "
+         "(PERF_NOTES round 20); the incremental-vs-full "
+         "revalidation sweep (scripts/sweep_live.py) also wants the "
+         "on-device crossover point",
+         "PERF_NOTES round 20 (live graphs)"),
 )
 
 

@@ -102,6 +102,19 @@ class Request:
     tenant: str = "default"
     priority: int = 0
     deadline_s: float | None = None
+    # live-graph serving (round 20, lux_tpu/livegraph.py): the epoch
+    # this query was ADMITTED at — stamped by Server/FleetServer
+    # submit from the live view, pinned for the query's whole life
+    # (failover re-dispatch included), and audited at answer time
+    # (scripts/events_summary.py torn-epoch rule).  None = static
+    # graph.
+    epoch: int | None = None
+    # bypass the answer-cache LOOKUP for this request (retirement
+    # still populates).  The fleet's warm queries set it: a warm
+    # query served from a sibling replica's cached answer leaves
+    # this replica's engines UNCOMPILED, defeating warm's whole
+    # contract (lux_tpu/fleet.py FleetServer.warm).
+    no_cache: bool = False
 
 
 @dataclasses.dataclass
@@ -115,6 +128,8 @@ class Response:
     latency_s: float            # enqueue -> retire
     wait_s: float               # enqueue -> column assignment
     converged: bool = True      # False: retired on the segment cap
+    epoch: int | None = None    # admission epoch (live graphs)
+    cached: bool = False        # served from the epoch-keyed cache
 
 
 class _Drained(Exception):
@@ -150,6 +165,12 @@ class BatchCollector:
         if self.replica is None:
             return {"kind": self.kind}
         return {"kind": self.kind, "replica": self.replica}
+
+    def pending_requests(self) -> list:
+        """Snapshot of the queued requests WITHOUT consuming them
+        (refresh_live's epoch-consistency guard)."""
+        with self._q.mutex:
+            return list(self._q.queue)
 
     def _depth(self) -> None:
         if self.metrics is not None:
@@ -234,6 +255,10 @@ class PriorityCollector(BatchCollector):
         with self._cv:
             return len(self._items)
 
+    def pending_requests(self) -> list:
+        with self._cv:
+            return list(self._items)
+
     def _key(self, idx: int, req: Request, now: float):
         aged = (req.deadline_s is not None
                 and now - req.t_enqueue >= 0.5 * req.deadline_s)
@@ -269,6 +294,202 @@ class PriorityCollector(BatchCollector):
         return out
 
 
+# epoch-keyed answer cache (round 20, ROADMAP item 5a): a cached
+# entry is served only while younger than its kind's TTL; with a
+# per-kind SLO configured the TTL is SLO-derived (an answer this much
+# older than the latency target the operator cares about is stale by
+# that same standard), else unbounded — epoch keys already guarantee
+# correctness, the TTL is a freshness policy on top.
+CACHE_TTL_SLO_MULT = 50.0
+CACHE_MAX_ENTRIES = 4096
+# each entry copies a full nv-length answer vector, so an entry-count
+# cap alone scales cache memory with GRAPH SIZE (4096 entries at
+# rmat21 nv~2M f32 is ~34 GB) — the byte budget is the binding cap on
+# big graphs, the entry count on small ones
+CACHE_MAX_BYTES = 256 * 1024 * 1024
+
+
+def _engine_family(kind: str) -> str:
+    """The ONE kind-to-family rule (push kinds see base + published
+    delta, pull kinds the base generation — livegraph module
+    docstring): Server and FleetServer both pin through here, so a
+    failover re-dispatch and the original admission can never
+    disagree about the epoch."""
+    return "pull" if kind == "pagerank" else "push"
+
+
+def admission_epoch(live, kind: str) -> int | None:
+    """READ the epoch a query of ``kind`` would pin (cache sweeps,
+    re-stamps).  Admission itself must use ``admit_query`` — a
+    separate read + admit would leave a window where a
+    mutate+compact folds the just-stamped view away before the
+    admission ledger protects it."""
+    if live is None:
+        return None
+    return live.view_epoch(_engine_family(kind))
+
+
+def _epoch_reproducible(live, req) -> bool:
+    """Can the CURRENT generation still serve a queued query pinned
+    at ``req.epoch``?  Push kinds replay any epoch in [base_epoch,
+    epoch] through the per-column delta mask (the delta holds exactly
+    the mutations past base_epoch); pull kinds see only the base
+    generation, so nothing but base_epoch itself is reproducible.
+    The ONE staleness rule refresh_live (Server and FleetServer)
+    checks — comparing against the LATEST view epoch instead would
+    wedge the server whenever ingest lands between compact() and
+    refresh_live() while a reproducible query sits queued
+    (compact refuses on the admission ledger, run() refuses on the
+    stale base, refresh_live refuses on the false mismatch)."""
+    if req.epoch is None:
+        return False
+    base = int(live.base_epoch)
+    if _engine_family(req.kind) == "push":
+        return req.epoch >= base
+    return req.epoch == base
+
+
+def admit_query(live, kind: str) -> int | None:
+    """ATOMIC admission: take the ledger entry and the epoch stamp
+    under one LiveGraph lock acquisition (livegraph.LiveGraph.admit).
+    Paired with exactly one ``live.release()`` at retirement/shed."""
+    if live is None:
+        return None
+    return live.admit(_engine_family(kind))
+
+
+@dataclasses.dataclass
+class _CacheEntry:
+    answer: np.ndarray
+    iters: int
+    epoch: int
+    t: float
+
+
+class AnswerCache:
+    """Epoch-keyed (kind, source/reset-hash, epoch) -> answer cache
+    for the serving front-end (round 20, ROADMAP item 5a).
+
+    The EPOCH is part of the key, so a stale-epoch hit is impossible
+    by construction — a query admitted after a mutation carries the
+    new epoch and misses (tests pin this: a stale-epoch hit is a
+    test failure).  ``sweep`` drops entries whose epoch is no longer
+    any kind's live view epoch (invalidation on epoch advance keeps
+    the map from accreting dead generations); ``ttl_s`` per kind
+    bounds entry age (SLO-aware when built by Server from slo_ms);
+    LRU-evicted past ``max_entries`` OR ``max_bytes`` — each entry
+    copies a full nv-length answer, so the byte budget is the
+    binding cap on big graphs.
+    Thread-safe: submit threads look up while the drain thread
+    inserts.  Hit/miss Counter metrics are incremented by the
+    runners (serve_cache_hit_total / serve_cache_miss_total)."""
+
+    def __init__(self, ttl_s: dict | None = None,
+                 max_entries: int = CACHE_MAX_ENTRIES,
+                 max_bytes: int = CACHE_MAX_BYTES):
+        import collections
+        self._d: dict = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.ttl_s = dict(ttl_s or {})
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.bytes = 0              # sum of cached answer nbytes
+        self.hits = 0
+        self.misses = 0
+
+    def _pop(self, key) -> None:
+        """Drop one entry, keeping the byte ledger exact (caller
+        holds the lock)."""
+        ent = self._d.pop(key)
+        self.bytes -= ent.answer.nbytes
+
+    @classmethod
+    def from_slo(cls, slo_ms: dict | None) -> "AnswerCache":
+        """SLO-derived TTLs: an answer older than
+        ``CACHE_TTL_SLO_MULT`` x the kind's latency target is stale
+        by the operator's own standard.  The ONE construction rule
+        behind ``cache=True`` — Server and FleetServer both build
+        through here, so the TTL semantics can never desynchronize
+        between the single-server and fleet tiers."""
+        return cls(ttl_s={k: CACHE_TTL_SLO_MULT * v / 1e3
+                          for k, v in (slo_ms or {}).items()})
+
+    @staticmethod
+    def query_key(req: Request):
+        # memoized per Request: the reset digest hashes a full
+        # nv-length vector, and get (lookup) + put (populate) would
+        # otherwise both pay it inside the SLO-measured latency
+        key = getattr(req, "_cache_key", None)
+        if key is not None:
+            return key
+        if req.reset is not None:
+            import hashlib
+            buf = np.ascontiguousarray(req.reset,
+                                       np.float32).tobytes()
+            # 128-bit digest, NOT a 32-bit CRC: two distinct reset
+            # vectors colliding would serve each other's answers —
+            # a silently WRONG answer (converged, epoch-consistent,
+            # invisible to every audit), and at ~77k distinct resets
+            # a 32-bit key reaches even birthday odds
+            key = ("reset",
+                   hashlib.blake2b(buf, digest_size=16).digest(),
+                   len(buf))
+        else:
+            key = ("source", req.source)
+        req._cache_key = key
+        return key
+
+    def get(self, kind: str, req: Request,
+            now: float) -> _CacheEntry | None:
+        key = (kind, self.query_key(req), req.epoch or 0)
+        ttl = self.ttl_s.get(kind)
+        with self._lock:
+            ent = self._d.get(key)
+            if ent is not None and ttl is not None \
+                    and now - ent.t > ttl:
+                self._pop(key)       # expired: miss, and forget it
+                ent = None
+            if ent is None:
+                self.misses += 1
+                return None
+            self._d.move_to_end(key)     # LRU: a hit renews recency
+            self.hits += 1
+            return ent
+
+    def put(self, kind: str, req: Request, answer: np.ndarray,
+            iters: int, epoch: int, now: float) -> None:
+        key = (kind, self.query_key(req), epoch or 0)
+        ent = _CacheEntry(np.asarray(answer).copy(), int(iters),
+                          int(epoch or 0), now)
+        with self._lock:
+            old = self._d.get(key)
+            if old is not None:
+                self.bytes -= old.answer.nbytes
+            self._d[key] = ent
+            self._d.move_to_end(key)     # LRU: replace renews too
+            self.bytes += ent.answer.nbytes
+            while len(self._d) > 1 \
+                    and (len(self._d) > self.max_entries
+                         or self.bytes > self.max_bytes):
+                self._pop(next(iter(self._d)))
+
+    def sweep(self, live_epochs: dict) -> int:
+        """Drop entries whose (kind, epoch) is no longer a live view
+        epoch — the invalidation-on-epoch-advance leg.  Returns the
+        number dropped."""
+        with self._lock:
+            dead = [k for k in self._d
+                    if k[0] in live_epochs
+                    and k[2] != (live_epochs[k[0]] or 0)]
+            for k in dead:
+                self._pop(k)
+        return len(dead)
+
+    def hit_fraction(self) -> float | None:
+        n = self.hits + self.misses
+        return None if n == 0 else self.hits / n
+
+
 @dataclasses.dataclass
 class _Slot:
     req: Request
@@ -287,7 +508,7 @@ class _RunnerBase:
 
     def __init__(self, kind: str, B: int, seg_iters: int,
                  max_segments: int, metrics=None,
-                 slo_ms: float | None = None):
+                 slo_ms: float | None = None, live=None, cache=None):
         self.kind = kind
         self.B = int(B)
         self.seg_iters = int(seg_iters)
@@ -296,6 +517,12 @@ class _RunnerBase:
         self.responses: list[Response] = []
         self.metrics = metrics
         self.slo_ms = None if slo_ms is None else float(slo_ms)
+        # live-graph serving (round 20, lux_tpu/livegraph.py): the
+        # shared LiveGraph (resident queries PIN its generation so a
+        # compaction cannot swap the base under them) and the
+        # epoch-keyed answer cache (ROADMAP item 5a)
+        self.live = live
+        self.cache = cache
         # serving-tier hooks (lux_tpu/fleet.py): ``replica`` labels
         # the per-query events with the runner's replica name, and
         # ``on_boundary(runner)`` fires at the TOP of every segment
@@ -317,18 +544,34 @@ class _RunnerBase:
     def _occupied(self):
         return [c for c, s in enumerate(self.slots) if s is not None]
 
+    def _answer_epoch(self, col: int) -> int | None:
+        """The epoch the answer in ``col`` was actually computed at —
+        runner-specific (push: the column's delta-mask epoch; pull:
+        the engine's base-generation epoch).  Audited against the
+        admission epoch by scripts/events_summary.py; a divergence is
+        a torn read, so this must come from the MECHANISM, never be
+        copied from the request."""
+        return None
+
     def _start(self, col: int, req: Request, total_iters: int):
         now = time.monotonic()
         self.slots[col] = _Slot(req=req, t_start=now,
                                 iter_start=total_iters)
+        if self.live is not None:
+            self.live.pin()
+        ep = {} if req.epoch is None else {"epoch": req.epoch}
         _emit("query_start", qid=req.qid, query_kind=self.kind,
               col=col,
-              wait_s=round(now - req.t_enqueue, 6), **self._rep())
+              wait_s=round(now - req.t_enqueue, 6), **ep,
+              **self._rep())
 
     def _retire(self, col: int, answer: np.ndarray, total_iters: int,
                 converged: bool = True):
         slot = self.slots[col]
+        answer_epoch = self._answer_epoch(col)
         self.slots[col] = None
+        if self.live is not None:
+            self.live.unpin()
         now = time.monotonic()
         resp = Response(
             qid=slot.req.qid, kind=self.kind, source=slot.req.source,
@@ -336,8 +579,12 @@ class _RunnerBase:
             segments=slot.segments,
             latency_s=now - slot.req.t_enqueue,
             wait_s=slot.t_start - slot.req.t_enqueue,
-            converged=converged)
+            converged=converged, epoch=slot.req.epoch)
         self.responses.append(resp)
+        if self.cache is not None and converged:
+            self.cache.put(self.kind, slot.req, answer, resp.iters,
+                           (answer_epoch if answer_epoch is not None
+                            else slot.req.epoch or 0), now)
         slo = {}
         if self.slo_ms is not None:
             slo_ok = resp.latency_s * 1e3 <= self.slo_ms
@@ -359,13 +606,67 @@ class _RunnerBase:
                         / max(1, len(self._slo_window)))
                 m.gauge("serve_slo_burn_rate",
                         kind=self.kind).set(burn)
+        ep = {}
+        if resp.epoch is not None:
+            # answer_epoch comes from the serving MECHANISM (delta
+            # mask / engine generation), epoch from admission — the
+            # events_summary torn-epoch audit fails any divergence
+            ep = {"epoch": resp.epoch,
+                  "answer_epoch": (answer_epoch
+                                   if answer_epoch is not None
+                                   else resp.epoch)}
         _emit("query_done", qid=resp.qid, query_kind=self.kind,
               col=col,
               iters=resp.iters, segments=resp.segments,
               latency_s=round(resp.latency_s, 6),
               wait_s=round(resp.wait_s, 6), converged=converged,
-              **slo, **self._rep())
+              **ep, **slo, **self._rep())
         return resp
+
+    def _serve_cached(self, req: Request) -> bool:
+        """Serve ``req`` straight from the epoch-keyed answer cache
+        when possible — no column, no engine dispatch (ROADMAP item
+        5a).  The entry's epoch equals the request's admission epoch
+        BY KEY, so a hit can never be stale-epoch."""
+        if self.cache is None or req.no_cache:
+            return False
+        now = time.monotonic()
+        ent = self.cache.get(self.kind, req, now)
+        if self.metrics is not None:
+            self.metrics.counter(
+                "serve_cache_hit_total" if ent is not None
+                else "serve_cache_miss_total", kind=self.kind).inc()
+        if ent is None:
+            return False
+        resp = Response(
+            qid=req.qid, kind=self.kind, source=req.source,
+            answer=ent.answer.copy(), iters=ent.iters, segments=0,
+            latency_s=now - req.t_enqueue,
+            wait_s=now - req.t_enqueue, converged=True,
+            epoch=req.epoch, cached=True)
+        self.responses.append(resp)
+        slo = {}
+        if self.slo_ms is not None:
+            ok = resp.latency_s * 1e3 <= self.slo_ms
+            slo = {"slo_ms": self.slo_ms, "slo_ok": ok}
+            self._slo_window.append(not ok)
+        if self.metrics is not None:
+            m = self.metrics
+            m.histogram("serve_latency_seconds",
+                        kind=self.kind).observe(resp.latency_s)
+            m.counter("serve_retired_total", kind=self.kind).inc()
+            if self.slo_ms is not None:
+                m.counter("serve_slo_good_total" if slo["slo_ok"]
+                          else "serve_slo_violation_total",
+                          kind=self.kind).inc()
+        ep = {} if req.epoch is None else \
+            {"epoch": req.epoch, "answer_epoch": ent.epoch}
+        _emit("query_done", qid=resp.qid, query_kind=self.kind,
+              col=-1, iters=resp.iters, segments=0,
+              latency_s=round(resp.latency_s, 6),
+              wait_s=round(resp.wait_s, 6), converged=True,
+              cached=True, **ep, **slo, **self._rep())
+        return True
 
     def _boundary_metrics(self, retired: int, filled: int,
                           queued: int) -> None:
@@ -400,10 +701,16 @@ class PushBatchRunner(_RunnerBase):
                  health: bool = False, weighted: bool = False,
                  seg_iters: int = DEFAULT_SEG_ITERS,
                  max_segments: int = 10_000, metrics=None,
-                 slo_ms: float | None = None):
+                 slo_ms: float | None = None, live=None, cache=None):
         super().__init__(kind, B, seg_iters, max_segments,
-                         metrics=metrics, slo_ms=slo_ms)
+                         metrics=metrics, slo_ms=slo_ms, live=live,
+                         cache=cache)
         self.g = g
+        # per-column admission epochs (live graphs): the delta-relax
+        # step masks each column's delta edges to its OWN epoch, so
+        # columns admitted at different epochs share one engine
+        # dispatch with snapshot isolation intact
+        self._col_epoch = np.zeros(self.B, np.int32)
         self.weighted = bool(weighted and kind == "sssp")
         placeholder = [0] * self.B
         if kind == "sssp":
@@ -455,7 +762,9 @@ class PushBatchRunner(_RunnerBase):
         act_h = np.zeros((nv, B), dtype=bool)
         filled = self._fill(lab_h, act_h, collector, 0, deadline_s)
         if not filled:
-            return []
+            # cache hits may have retired queries without taking a
+            # column — they are this drain's responses
+            return self.responses[n0:]
         label, active = eng.place(sg.to_padded(lab_h),
                                   sg.to_padded(act_h))
 
@@ -465,6 +774,14 @@ class PushBatchRunner(_RunnerBase):
             for s in self.slots:
                 if s is not None:
                     s.segments += 1
+            if self.live is not None:
+                # the live delta-relax step: delta blocks as jit
+                # ARGUMENTS, each column masked to its OWN admission
+                # epoch (snapshot isolation inside one dispatch).  A
+                # column retires only when its frontier is empty AND
+                # the delta offered no improvement — i.e. at the
+                # fixed point of base + delta@its-epoch.
+                label, active = self._apply_delta(label, active)
             counts = np.asarray(jax.device_get(
                 jnp.sum(active, axis=tuple(range(active.ndim - 1)))))
             done = [c for c in self._occupied()
@@ -474,7 +791,10 @@ class PushBatchRunner(_RunnerBase):
                 done or self._free_cols())
             if not done and not want_fill:
                 self._boundary_metrics(0, 0, len(collector))
-                return None
+                # the delta step may have changed the device state —
+                # hand the updated arrays back to the driver
+                return (label, active) if self.live is not None \
+                    else None
             lab_h = sg.from_padded(np.asarray(jax.device_get(label)))
             act_h = sg.from_padded(np.asarray(jax.device_get(active)))
             for c in done:
@@ -496,14 +816,43 @@ class PushBatchRunner(_RunnerBase):
                           on_segment=hook)
         return self.responses[n0:]
 
+    def _apply_delta(self, label, active):
+        """One live delta-relax application (livegraph.delta_step —
+        cached per engine inside LiveGraph, shared with revalidate
+        and register_audit) on the DEVICE state at a segment
+        boundary."""
+        import jax.numpy as jnp
+
+        args = self.live.delta_arrays(self.eng.sg)
+        label, active, _imp = self.live.delta_step(self.eng)(
+            label, active, *args, jnp.asarray(self._col_epoch))
+        return label, active
+
+    def _answer_epoch(self, col: int) -> int | None:
+        if self.live is None:
+            return None
+        return int(self._col_epoch[col])
+
     def _fill(self, lab_h, act_h, collector, total_iters,
               deadline_s) -> int:
         free = self._free_cols()
-        reqs = collector.collect(len(free), deadline_s)
-        for col, req in zip(free, reqs):
-            lab_h[:, col], act_h[:, col] = self._col_init(req)
-            self._start(col, req, total_iters)
-        return len(reqs)
+        filled = 0
+        first = True
+        while free:
+            reqs = collector.collect(len(free),
+                                     deadline_s if first else 0.0)
+            first = False
+            if not reqs:
+                break
+            for req in reqs:
+                if self._serve_cached(req):
+                    continue     # answered without a column
+                col = free.pop(0)
+                lab_h[:, col], act_h[:, col] = self._col_init(req)
+                self._col_epoch[col] = req.epoch or 0
+                self._start(col, req, total_iters)
+                filled += 1
+        return filled
 
 
 class PullBatchRunner(_RunnerBase):
@@ -518,11 +867,20 @@ class PullBatchRunner(_RunnerBase):
                  health: bool = False,
                  seg_iters: int = DEFAULT_SEG_ITERS,
                  tol: float = 1e-8, max_segments: int = 500,
-                 metrics=None, slo_ms: float | None = None):
+                 metrics=None, slo_ms: float | None = None,
+                 live=None, cache=None):
         super().__init__(kind, B, seg_iters, max_segments,
-                         metrics=metrics, slo_ms=slo_ms)
+                         metrics=metrics, slo_ms=slo_ms, live=live,
+                         cache=cache)
         if kind != "pagerank":
             raise ValueError(f"unknown pull kind {kind!r}")
+        # pull kinds have no monotone delta revalidation (appends
+        # change out-degree normalization), so their snapshot view is
+        # the base GENERATION: the engine serves live.base and every
+        # answer is computed at the generation's epoch — which is
+        # exactly what submit pinned as these queries' admission
+        # epoch (livegraph.view_epoch("pull"))
+        self.gen_epoch = None if live is None else int(live.base_epoch)
         from lux_tpu.apps import pagerank as app
         self.g = g
         self.app = app
@@ -563,7 +921,7 @@ class PullBatchRunner(_RunnerBase):
         state_h = sg.from_padded(np.asarray(
             self.eng.program.init(sg)))          # [nv, B]
         if not self._fill(state_h, collector, 0, deadline_s):
-            return []
+            return self.responses[n0:]   # cache hits take no column
         self._push_resets()
         prev = state_h.copy()
         state = eng.place(sg.to_padded(state_h))
@@ -610,6 +968,9 @@ class PullBatchRunner(_RunnerBase):
             pass
         return self.responses[n0:]
 
+    def _answer_epoch(self, col: int) -> int | None:
+        return self.gen_epoch
+
     def _push_resets(self):
         self.eng.update_program_arrays(
             reset=self.eng.sg.to_padded(self.resets))
@@ -617,13 +978,24 @@ class PullBatchRunner(_RunnerBase):
     def _fill(self, state_h, collector, total_iters,
               deadline_s) -> int:
         free = self._free_cols()
-        reqs = collector.collect(len(free), deadline_s)
-        for col, req in zip(free, reqs):
-            reset = self._col_reset(req)
-            self.resets[:, col] = reset
-            state_h[:, col] = self._col_init(reset)
-            self._start(col, req, total_iters)
-        return len(reqs)
+        filled = 0
+        first = True
+        while free:
+            reqs = collector.collect(len(free),
+                                     deadline_s if first else 0.0)
+            first = False
+            if not reqs:
+                break
+            for req in reqs:
+                if self._serve_cached(req):
+                    continue     # answered without a column
+                col = free.pop(0)
+                reset = self._col_reset(req)
+                self.resets[:, col] = reset
+                state_h[:, col] = self._col_init(reset)
+                self._start(col, req, total_iters)
+                filled += 1
+        return filled
 
 
 class Server:
@@ -649,8 +1021,29 @@ class Server:
                  tol: float = 1e-8, deadline_s: float = 0.0,
                  slo_ms: dict | None = None, metrics=None,
                  snapshot_every_s: float = 1.0, on_boundary=None,
-                 replica: str | None = None):
+                 replica: str | None = None, live=None,
+                 cache: bool | AnswerCache = False):
         self.g = g
+        # live-graph serving (round 20, lux_tpu/livegraph.py):
+        # ``live`` mutates under the queries — submit pins each
+        # query's admission epoch from the live view, the push
+        # runners apply the delta-relax step at boundaries, and
+        # ``mutate``/``refresh_live`` are the ingest/compaction
+        # surfaces.  ``g`` must be the live graph's CURRENT base
+        # (engines and oracles key off it).
+        self.live = live
+        if live is not None and g is not live.base:
+            raise ValueError(
+                "Server(live=...) requires g to be live.base — the "
+                "engines must serve the live graph's own base "
+                "generation")
+        if cache is True:
+            self.cache: AnswerCache | None = \
+                AnswerCache.from_slo(slo_ms)
+        elif cache:
+            self.cache = cache
+        else:
+            self.cache = None
         # fleet hooks (lux_tpu/fleet.py): the subprocess replica
         # worker runs a whole Server and needs its runners to beat
         # the replica board (and fire kill plans) at every boundary
@@ -691,7 +1084,8 @@ class Server:
     def _runner(self, kind: str) -> _RunnerBase:
         if kind not in self._runners:
             mkw = dict(metrics=self.metrics,
-                       slo_ms=self.slo_ms.get(kind))
+                       slo_ms=self.slo_ms.get(kind),
+                       live=self.live, cache=self.cache)
             if kind == "pagerank":
                 self._runners[kind] = PullBatchRunner(
                     kind, self.g, self.batch,
@@ -726,6 +1120,9 @@ class Server:
             return None
         return self.metrics.emit_snapshot(**extra)
 
+    def _admission_epoch(self, kind: str) -> int | None:
+        return admission_epoch(self.live, kind)
+
     def submit(self, kind: str, source: int | None = None,
                reset=None, tenant: str = "default",
                priority: int = 0,
@@ -739,7 +1136,13 @@ class Server:
                       t_enqueue=time.monotonic(), tenant=str(tenant),
                       priority=int(priority),
                       deadline_s=(None if deadline_s is None
-                                  else float(deadline_s)))
+                                  else float(deadline_s)),
+                      # stamp + admission-ledger entry in ONE lock
+                      # acquisition: the generation must survive
+                      # until this query retires, and resident pins
+                      # alone cannot protect it while QUEUED;
+                      # released per response in run()
+                      epoch=admit_query(self.live, kind))
         if self.metrics is not None:
             self.metrics.counter("serve_queries_total",
                                  kind=kind).inc()
@@ -747,6 +1150,49 @@ class Server:
         _emit("query_enqueue", qid=qid, query_kind=kind,
               source=req.source, queued=len(self._collector(kind)))
         return qid
+
+    def mutate(self, src, dst, weights=None) -> int:
+        """Ingest path: publish an edge-append batch into the live
+        graph (WAL-journaled, one new epoch).  Raises
+        livegraph.DeltaFullError when ingest has outrun compaction —
+        the backpressure signal the fleet's admission converts into a
+        typed ``AdmissionError(reason="delta_full")`` shed
+        (lux_tpu/fleet.py)."""
+        if self.live is None:
+            raise ValueError("mutate() needs a live graph "
+                             "(Server(live=LiveGraph(...)))")
+        return self.live.append_edges(src, dst, weights)
+
+    def refresh_live(self) -> None:
+        """Adopt the live graph's NEW generation after a compaction:
+        drop the runners so the next drain rebuilds engines over the
+        compacted base.  Refuses while anything is resident, or
+        while a QUEUED query pins an epoch the new base cannot
+        REPRODUCE: push kinds replay any epoch >= base_epoch via the
+        per-column delta mask (the post-compact delta holds exactly
+        the mutations past base_epoch, so later ingest does NOT
+        strand an already-queued query), pull kinds only the base
+        generation itself — anything older was folded away and
+        adoption would serve a torn view."""
+        if self.live is None:
+            return
+        # list(): a submitter thread may add a new kind's collector
+        # mid-iteration (same race run() guards against)
+        for kind, coll in list(self._collectors.items()):
+            stale = [req for req in coll.pending_requests()
+                     if not _epoch_reproducible(self.live, req)]
+            if stale:
+                raise RuntimeError(
+                    f"refresh_live with {len(stale)} {kind!r} "
+                    f"query(ies) queued at an epoch the new "
+                    f"generation cannot reproduce — drain first")
+        for kind, r in self._runners.items():
+            if r._occupied():
+                raise RuntimeError(
+                    f"refresh_live with resident {kind!r} columns — "
+                    f"drain first")
+        self.g = self.live.base
+        self._runners.clear()
 
     def run(self) -> list[Response]:
         """Drain every kind's queue; returns responses in retirement
@@ -756,12 +1202,32 @@ class Server:
         ``snapshot_every_s`` of non-empty drains — the cadence a
         long-lived serving loop rides; ``emit_metrics_snapshot()``
         snapshots on demand)."""
+        if self.live is not None and self.g is not self.live.base:
+            # generation adoption is ENFORCED, not caller etiquette:
+            # serving on a stale base after a compaction converges
+            # old-base + empty delta — a wrong answer whose
+            # answer_epoch still equals its admission epoch, so the
+            # torn-epoch audit can never see it.  A wrong answer is
+            # a crash, never a published number.
+            raise RuntimeError(
+                "live graph compacted to a new generation — call "
+                "refresh_live() before serving")
+        if self.cache is not None and self.live is not None:
+            # invalidation on epoch advance: entries keyed to epochs
+            # no view still exposes can never hit again — drop them
+            self.cache.sweep({k: self._admission_epoch(k)
+                              for k in KINDS})
         out: list[Response] = []
         # list(): submit() may add a NEW kind's collector from a
         # submitter thread while an open-loop drain iterates
         for kind, coll in list(self._collectors.items()):
             while len(coll):
                 out += self._runner(kind).drain(coll, self.deadline_s)
+        if self.live is not None:
+            # one release per retired response: the admit() taken at
+            # submit ends exactly when the answer leaves the server
+            for _ in out:
+                self.live.release()
         now = time.monotonic()
         if out and now - self._last_snapshot >= self.snapshot_every_s:
             self._last_snapshot = now

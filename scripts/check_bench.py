@@ -190,6 +190,16 @@ SERVE_SLO_METRIC = re.compile(
 # retirements only).
 SERVE_CHAOS_METRIC = re.compile(
     r"^serve_chaos_q([0-9pm]+)_rmat(\d+)_qps_per_chip$")
+# round-20 live-graph serving lines (bench.py -config serve-live +
+# lux_tpu/livegraph.py): mixed traffic over a mutating graph with
+# epoch-pinned answers, the epoch-keyed cache and threshold-triggered
+# compaction.  Contradiction rejects: epochs_advanced > 0 with
+# mutations = 0 (epochs only advance when a mutation batch publishes)
+# and vice versa, cache_hit_fraction outside [0, 1], compactions > 0
+# with peak_occupancy strictly under compact_threshold (the trigger
+# the line claims fired never could have).
+SERVE_LIVE_METRIC = re.compile(
+    r"^serve_live_rmat(\d+)_qps_per_chip$")
 
 
 def iter_metric_lines(path: str):
@@ -347,6 +357,9 @@ def check_line(obj: dict, *, legacy_ok: bool):
     if SERVE_CHAOS_METRIC.match(name) or "shed_fraction" in obj \
             or "failovers" in obj:
         errs += check_serve_chaos_fields(name, obj)
+    if SERVE_LIVE_METRIC.match(name) or "epochs_advanced" in obj \
+            or "cache_hit_fraction" in obj:
+        errs += check_serve_live_fields(name, obj)
     return errs, warns
 
 
@@ -631,6 +644,89 @@ def check_serve_chaos_fields(name: str, obj: dict) -> list[str]:
             f"{name}: slo_accounted={acc} > served={served} — the "
             f"SLO good fraction was computed over shed queries; SLO "
             f"accounting covers ADMITTED retirements only")
+    return errs
+
+
+def check_serve_live_fields(name: str, obj: dict) -> list[str]:
+    """Round-20 live-graph serving lines (see SERVE_LIVE_METRIC): the
+    mutation/epoch/compaction/cache record must be present and free
+    of the contradictions an honest live-serving run cannot produce
+    — epochs that advanced without mutations (the monotone counter
+    only moves when an append batch publishes), a hit fraction
+    outside [0, 1], and a compaction count whose claimed trigger
+    (delta occupancy crossing the threshold) never happened."""
+    errs = []
+
+    def _int(x) -> bool:
+        return isinstance(x, int) and not isinstance(x, bool)
+
+    missing = [k for k in ("mutations", "epochs_advanced",
+                           "compactions", "cache_hit_fraction",
+                           "peak_occupancy", "compact_threshold")
+               if k not in obj]
+    if missing:
+        errs.append(f"{name}: serve-live line missing {missing}")
+    muts = obj.get("mutations")
+    if muts is not None and (not _int(muts) or muts < 0):
+        errs.append(f"{name}: mutations={muts!r} must be an int "
+                    f">= 0")
+        muts = None
+    eps = obj.get("epochs_advanced")
+    if eps is not None and (not _int(eps) or eps < 0):
+        errs.append(f"{name}: epochs_advanced={eps!r} must be an "
+                    f"int >= 0")
+        eps = None
+    if eps is not None and muts is not None:
+        if eps > 0 and muts == 0:
+            errs.append(
+                f"{name}: epochs_advanced={eps} with mutations=0 — "
+                f"the monotone epoch counter only advances when a "
+                f"mutation batch publishes; the line contradicts "
+                f"its own ingest record")
+        if muts > 0 and eps == 0:
+            errs.append(
+                f"{name}: mutations={muts} with epochs_advanced=0 — "
+                f"every published append batch IS one epoch "
+                f"advance; acknowledged mutations cannot be "
+                f"epoch-invisible")
+        if eps > muts:
+            errs.append(
+                f"{name}: epochs_advanced={eps} > mutations={muts} "
+                f"— one epoch per PUBLISHED BATCH of >= 1 edge(s); "
+                f"more epochs than edges is a contradiction")
+    frac = obj.get("cache_hit_fraction")
+    if frac is not None and (not _is_num(frac)
+                             or not 0.0 <= frac <= 1.0):
+        errs.append(f"{name}: cache_hit_fraction={frac!r} must be a "
+                    f"finite number in [0, 1]")
+    occ = obj.get("peak_occupancy")
+    if occ is not None and (not _is_num(occ)
+                            or not 0.0 <= occ <= 1.0):
+        errs.append(f"{name}: peak_occupancy={occ!r} must be a "
+                    f"finite number in [0, 1] (count/capacity of a "
+                    f"fixed-capacity block)")
+        occ = None
+    thr = obj.get("compact_threshold")
+    if thr is not None and (not _is_num(thr) or not 0.0 < thr <= 1.0):
+        errs.append(f"{name}: compact_threshold={thr!r} must be a "
+                    f"finite number in (0, 1]")
+        thr = None
+    comp = obj.get("compactions")
+    if comp is not None and (not _int(comp) or comp < 0):
+        errs.append(f"{name}: compactions={comp!r} must be an int "
+                    f">= 0")
+        comp = None
+    if comp is not None and comp > 0 and occ is not None \
+            and thr is not None and occ < thr - 1e-9:
+        errs.append(
+            f"{name}: compactions={comp} but peak_occupancy={occ} "
+            f"never reached compact_threshold={thr} — the trigger "
+            f"the line claims fired could not have; occupancy and "
+            f"the compaction count contradict each other")
+    cap = obj.get("delta_capacity")
+    if cap is not None and (not _int(cap) or cap < 1):
+        errs.append(f"{name}: delta_capacity={cap!r} must be an int "
+                    f">= 1")
     return errs
 
 

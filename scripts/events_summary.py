@@ -71,6 +71,24 @@ human shape — and audits it while doing so:
   measured ICI/DCN bytes/s probes, observe.calibrate_links) render
   with their fed-scalemodel flag.
 
+- round 20 (live graphs, lux_tpu/livegraph.py): the mutation /
+  epoch / compaction / cache trail renders (mutation batches, epoch
+  advances, peak delta occupancy, compaction fold counts, WAL
+  truncate/replay records, epoch-keyed cache hits) and is AUDITED
+  for the snapshot-isolation contract: a ``query_done`` whose
+  ``answer_epoch`` differs from its admission ``epoch`` is a
+  TORN-EPOCH answer and FAILS (as does an epoch-carrying answer
+  with no answer_epoch at all); a ``compact_done`` whose generation
+  has no preceding ``compact_start`` breaks the WAL compaction
+  bracket and FAILS; a ``wal_replay`` that recovers a LOWER epoch
+  than the trail already published is a replay-after-crash epoch
+  regression (acknowledged mutations vanished) and FAILS — checked
+  both in-stream (render_run's ordered walk) and CROSS-process
+  (audit_wal_replays pairs wal-carrying publishes with replays on
+  the log path across (session, pid) streams, wall-clock ordered:
+  the crashing publisher and the recovering process are never the
+  same pid).
+
 Usage:
     python scripts/events_summary.py FILE [FILE...]
     python scripts/events_summary.py -flight FLIGHT.json
@@ -96,7 +114,9 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "query_enqueue", "query_start", "query_done", "serve_refill",
          "metrics_snapshot", "log_rotate",
          "replica_up", "replica_lost", "failover", "query_shed",
-         "brownout", "comm_ledger", "link_calibration"}
+         "brownout", "comm_ledger", "link_calibration",
+         "mutation", "epoch_advance", "compact_start", "compact_done",
+         "wal_truncate", "wal_replay"}
 
 # round 19 (communication observatory, lux_tpu/comms.py): the
 # collective primitives a comm_ledger breakdown may name — matching
@@ -763,6 +783,112 @@ def render_run(run, out=sys.stdout) -> list[str]:
               f"{b.get('capacity_frac')} min_priority="
               f"{b.get('min_priority')}", file=out)
 
+    # round 20 (live graphs, lux_tpu/livegraph.py): the mutation /
+    # epoch / compaction / cache trail and its audits:
+    # - TORN-EPOCH: a query_done carrying an admission ``epoch`` must
+    #   carry ``answer_epoch`` EQUAL to it — the answer was computed
+    #   at a different epoch than the query pinned at admission,
+    #   which is a torn read published as an answer (serve.py stamps
+    #   answer_epoch from the serving MECHANISM: the column's delta
+    #   mask / the engine's base generation — never from the request)
+    # - a compact_done whose generation has no preceding
+    #   compact_start breaks the WAL compaction bracket
+    # - a wal_replay that comes up at a LOWER epoch than the trail
+    #   already published is a replay-after-crash epoch REGRESSION:
+    #   acknowledged mutations vanished
+    muts = by.get("mutation", [])
+    for q in qdone:
+        if "epoch" not in q:
+            continue
+        if "answer_epoch" not in q:
+            errs.append(f"{title}: query_done qid={q.get('qid')} "
+                        f"carries admission epoch {q['epoch']} but "
+                        f"no answer_epoch — the live-serving answer "
+                        f"cannot prove it was computed at its "
+                        f"admission epoch")
+        elif q["answer_epoch"] != q["epoch"]:
+            errs.append(f"{title}: TORN-EPOCH answer qid="
+                        f"{q.get('qid')}: admitted at epoch "
+                        f"{q['epoch']} but answered at epoch "
+                        f"{q['answer_epoch']} — snapshot isolation "
+                        f"violated")
+    # order-sensitive audits walk the raw run, not the by-kind map
+    pending_gens, compacts_done = set(), 0
+    # per-WAL-path epoch high-water marks (same pairing rule as the
+    # cross-process audit_wal_replays): a replay of log B must never
+    # be judged against epochs published to log A in the same run —
+    # two LiveGraphs beside each other is a clean trail, not a
+    # regression.  No-WAL publishes key on None and no replay can
+    # ever pair with them (a replay always carries its path).
+    max_epoch_seen: dict = {}
+
+    def _saw_epoch(path, e):
+        max_epoch_seen[path] = max(max_epoch_seen.get(path, 0), e)
+
+    for ev in run:
+        k = ev["kind"]
+        if k == "mutation":
+            e = ev.get("epoch")
+            if _is_int(e):
+                _saw_epoch(ev.get("wal"), e)
+        elif k == "epoch_advance":
+            e = ev.get("to_epoch")
+            if _is_int(e):
+                _saw_epoch(ev.get("wal"), e)
+        elif k == "compact_start":
+            pending_gens.add(ev.get("generation"))
+        elif k == "compact_done":
+            g_ = ev.get("generation")
+            if g_ not in pending_gens:
+                errs.append(f"{title}: compact_done generation={g_} "
+                            f"without a preceding compact_start — "
+                            f"the compaction bracket is broken")
+            else:
+                pending_gens.discard(g_)
+                compacts_done += 1
+        elif k == "wal_replay":
+            e = ev.get("epoch")
+            seen = max_epoch_seen.get(ev.get("path"), 0)
+            if _is_int(e) and e < seen:
+                errs.append(f"{title}: wal_replay recovered epoch "
+                            f"{e} < already-published epoch "
+                            f"{seen} — replay-after-crash "
+                            f"epoch regression (acknowledged "
+                            f"mutations vanished)")
+            if _is_int(e):
+                _saw_epoch(ev.get("path"), e)
+    if muts:
+        edges = sum(m.get("edges", 0) for m in muts
+                    if _is_int(m.get("edges")))
+        advances = len(by.get("epoch_advance", []))
+        occ = max((m.get("occupancy", 0) for m in muts
+                   if _is_num(m.get("occupancy"))), default=0)
+        print(f"  live graph: {edges} edge(s) over {len(muts)} "
+              f"mutation batch(es), {advances} epoch advance(s), "
+              f"peak delta occupancy {occ}", file=out)
+    if by.get("compact_start") or compacts_done:
+        folded = sum(c.get("folded", 0)
+                     for c in by.get("compact_done", [])
+                     if _is_int(c.get("folded")))
+        open_note = (f", {len(pending_gens)} OPEN (crashed "
+                     f"mid-compaction)" if pending_gens else "")
+        print(f"  compaction: {compacts_done} completed, {folded} "
+              f"edge(s) folded{open_note}", file=out)
+    for wt in by.get("wal_truncate", []):
+        print(f"  WAL torn tail truncated: {wt.get('torn_bytes')} "
+              f"byte(s) after {wt.get('records')} good record(s) "
+              f"({wt.get('path')})", file=out)
+    for wr in by.get("wal_replay", []):
+        print(f"  WAL replay: {wr.get('records')} record(s) -> "
+              f"epoch {wr.get('epoch')} generation "
+              f"{wr.get('generation')} delta {wr.get('delta_count')} "
+              f"(truncated {wr.get('truncated_bytes')} B)", file=out)
+    cached = [q for q in qdone if q.get("cached")]
+    if cached:
+        n_live = sum(1 for q in qdone if "epoch" in q)
+        print(f"  answer cache: {len(cached)} of {n_live or len(qdone)}"
+              f" served cached (epoch-keyed)", file=out)
+
     # round 17: serving metrics snapshots, cross-audited against the
     # raw query_done stream they claim to aggregate
     qdone_by_kind = {}
@@ -809,6 +935,50 @@ def render_run(run, out=sys.stdout) -> list[str]:
         print(f"  (other events: "
               f"{', '.join(f'{k} x{len(by[k])}' for k in unknown)})",
               file=out)
+    return errs
+
+
+def audit_wal_replays(events) -> list[str]:
+    """CROSS-process replay-after-crash epoch regression (round 20,
+    lux_tpu/livegraph.py): a real crash and its recovery are
+    DIFFERENT processes, so the per-run walk in render_run — scoped
+    to one (session, pid) stream — can never see the publisher's
+    epochs.  Publishes (mutation / epoch_advance events carrying a
+    ``wal`` path) and recoveries (wal_replay, ``path``) pair on the
+    log path; wall-clock ``t`` orders across processes (the tracing
+    alignment convention — a crash and its recovery are seconds
+    apart, far past clock skew).  A replay recovering a LOWER epoch
+    than one already published to the same WAL by an earlier other
+    process means acknowledged mutations vanished: FAIL.  Same-
+    process regressions stay with render_run's in-order walk (no
+    double report: this audit skips same-stream pairs)."""
+    pubs, reps = [], []
+    for ev in events:
+        k = ev.get("kind")
+        t = ev.get("t")
+        if not _is_num(t):
+            continue
+        key = (ev.get("session"), ev.get("pid"))
+        if k in ("mutation", "epoch_advance"):
+            wal = ev.get("wal")
+            e = (ev.get("epoch") if k == "mutation"
+                 else ev.get("to_epoch"))
+            if wal and _is_int(e):
+                pubs.append((t, key, wal, e))
+        elif k == "wal_replay":
+            e = ev.get("epoch")
+            if ev.get("path") and _is_int(e):
+                reps.append((t, key, ev.get("path"), e))
+    errs = []
+    for rt, rkey, rpath, re_ in reps:
+        prior = [e for (t, key, wal, e) in pubs
+                 if wal == rpath and t < rt and key != rkey]
+        if prior and re_ < max(prior):
+            errs.append(
+                f"wal_replay ({rpath}) recovered epoch {re_} < "
+                f"epoch {max(prior)} published by an earlier "
+                f"process — cross-process replay-after-crash epoch "
+                f"regression (acknowledged mutations vanished)")
     return errs
 
 
@@ -909,6 +1079,8 @@ def main(argv=None) -> int:
         all_errs += [f"{path}: {e}" for e in errs]
         streams, serrs = split_streams(events)
         all_errs += [f"{path}: {e}" for e in serrs]
+        all_errs += [f"{path}: {e}"
+                     for e in audit_wal_replays(events)]
         for key, stream in streams:
             if key is not None and len(streams) > 1:
                 print(f"-- process session={key[0]} pid={key[1]} --")

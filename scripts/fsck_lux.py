@@ -15,13 +15,26 @@ torn copies fail HERE, before a multi-hour run:
 - every ``col_idx`` source in ``[0, nv)``;
 - trailing degrees (when present) exactly the out-degree histogram.
 
+Round 20 (live graphs): the checker also knows the mutation-log
+format (lux_tpu/livegraph.py WAL, format.py ``read_wal_header``).
+``.wal`` files on the command line — and a ``<graph>.wal`` sidecar
+beside any checked ``.lux`` — are verified at rest: header magic /
+version / nv-vs-graph, the CRC CHAIN over every record, monotone
+epochs, known record kinds, COMPACT_START/DONE bracket pairing.  A
+recoverable torn tail (a crash mid-append) is REPORTED but clean —
+``MutationLog.replay`` truncates it deterministically; hard
+corruption (typed ``MutationLogError``) fails the file.
+
 Usage:
     python scripts/fsck_lux.py [-weighted | -unweighted] FILE...
 
 Weightedness is inferred from the file size by default (pass
 -weighted/-unweighted for the ambiguous nv*4 == ne*w case).
 
-Exit status: 0 every file clean, 1 any failure (listed on stderr).
+Exit status: 0 every file clean, 1 any .lux structural failure,
+2 any mutation-log failure (the typed-MutationLogError class — wrong
+graph, broken chain, non-monotone epochs; matches the apps'
+``-validate`` exit-2 convention for integrity refusals).
 """
 
 from __future__ import annotations
@@ -34,6 +47,47 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from lux_tpu import format as luxfmt  # noqa: E402
+
+
+def fsck_wal(path: str, nv: int | None = None) -> str | None:
+    """Verify one mutation log at rest (lux_tpu/livegraph.py WAL):
+    header, CRC chain, monotone epochs, record kinds, compaction
+    bracket pairing — through ``MutationLog.scan``, the SAME pass the
+    recovery path replays through, so the checker and recovery can
+    never disagree on validity.  Returns None when clean (a
+    recoverable torn tail is reported but clean), the failure
+    message otherwise."""
+    from lux_tpu.livegraph import (MutationLog, MutationLogError,
+                                   REC_COMPACT_DONE,
+                                   REC_COMPACT_START, REC_EDGE)
+
+    try:
+        recs, hnv, cap, torn = MutationLog.scan(path, nv=nv)
+    except MutationLogError as e:
+        return f"[{e.check}] {e.detail}"
+    except luxfmt.GraphFormatError as e:
+        return f"[{e.check}] {e.detail}"
+    except (OSError, ValueError) as e:
+        return f"[wal unreadable] {type(e).__name__}: {e}"
+    # scan validates chain/epochs/kinds; the bracket pairing is the
+    # replay loop's invariant — check it at rest too
+    pending = 0
+    for r in recs:
+        if r.kind == REC_COMPACT_START:
+            pending += 1
+        elif r.kind == REC_COMPACT_DONE:
+            if pending == 0:
+                return ("[compact_pair] COMPACT_DONE at epoch "
+                        f"{r.epoch} without a preceding "
+                        f"COMPACT_START")
+            pending -= 1
+    edges = sum(1 for r in recs if r.kind == REC_EDGE)
+    epoch = max((r.epoch for r in recs), default=0)
+    tornmsg = f" TORN-TAIL={torn}B (recoverable)" if torn else ""
+    print(f"{path}: OK wal nv={hnv} capacity={cap} records={len(recs)} "
+          f"edges={edges} epoch={epoch}"
+          f"{' open-compaction' if pending else ''}{tornmsg}")
+    return None
 
 
 def fsck(path: str, weighted: bool | None) -> str | None:
@@ -81,17 +135,39 @@ def main(argv=None) -> int:
     weighted = True if args.weighted else \
         False if args.unweighted else None
 
-    bad = 0
+    bad_lux = bad_wal = checked = 0
     for path in args.files:
+        checked += 1
+        if path.endswith(luxfmt.WAL_SUFFIX):
+            err = fsck_wal(path)
+            if err is not None:
+                bad_wal += 1
+                print(f"ERROR: {path}: {err}", file=sys.stderr)
+            continue
         err = fsck(path, weighted)
         if err is not None:
-            bad += 1
+            bad_lux += 1
             print(f"ERROR: {path}: {err}", file=sys.stderr)
+            continue
+        # a mutation-log sidecar beside a clean graph is checked
+        # AGAINST that graph (nv must match) — a foreign log fails
+        # here, at rest, never as wrong replayed mutations
+        wal = luxfmt.wal_sidecar_path(path)
+        if os.path.exists(wal):
+            checked += 1
+            hdr = luxfmt.peek_lux(path, weighted=weighted)
+            err = fsck_wal(wal, nv=hdr.nv)
+            if err is not None:
+                bad_wal += 1
+                print(f"ERROR: {wal}: {err}", file=sys.stderr)
+    bad = bad_lux + bad_wal
     if bad:
-        print(f"fsck_lux: {bad} of {len(args.files)} file(s) FAILED",
+        print(f"fsck_lux: {bad} of {checked} file(s) FAILED",
               file=sys.stderr)
-        return 1
-    print(f"fsck_lux: {len(args.files)} file(s) OK")
+        # mutation-log corruption exits 2 (the typed-integrity-
+        # refusal convention of the apps' -validate flag)
+        return 2 if bad_wal else 1
+    print(f"fsck_lux: {checked} file(s) OK")
     return 0
 
 

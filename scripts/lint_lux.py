@@ -404,6 +404,40 @@ def check_oracle(path, tree, lines):
             f"device code needs its columns-vs-independent-runs "
             f"oracle first (CLAUDE.md convention; ROADMAP item 2)"))
         break
+    # incremental revalidation (round 20, live graphs): a module
+    # shipping an incremental builder/revalidator must also ship its
+    # incremental oracle — the proved-equal-to-full-recompute-at-the-
+    # same-epoch contract (lux_tpu/livegraph.py) needs a NumPy
+    # reference_*_incremental to be provable at all
+    # ast.walk, not tree.body: the revalidator may be a METHOD
+    # (LiveGraph.revalidate is exactly this shape) — a top-level-only
+    # scan is dead for class-based code
+    incr_defs = [n for n in ast.walk(tree)
+                 if isinstance(n, ast.FunctionDef)
+                 and ("incremental" in n.name
+                      or "revalidate" in n.name)
+                 and not n.name.startswith("reference_")]
+    # the oracle may live in another module per convention ("oracle
+    # in its app module or test") — an explicit reference_*incremental
+    # citation anywhere in the source (docstring pointer, import)
+    # satisfies the check; a module naming NO oracle at all fails
+    has_incr_oracle = any(
+        isinstance(n, ast.FunctionDef)
+        and n.name.startswith("reference_")
+        and "incremental" in n.name
+        for n in tree.body) or bool(
+            re.search(r"reference_\w*incremental", "\n".join(lines)))
+    for n in incr_defs:
+        if has_incr_oracle or _suppressed(lines, n.lineno, "oracle"):
+            continue
+        findings.append(Finding(
+            path, n.lineno, "oracle",
+            f"{n.name} builds an incremental-revalidation variant "
+            f"but the module has no reference_*_incremental NumPy "
+            f"oracle — incremental device code must be proved equal "
+            f"to full recompute at the same epoch (CLAUDE.md "
+            f"convention; lux_tpu/livegraph.py round 20)"))
+        break
     return findings
 
 

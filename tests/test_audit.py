@@ -481,6 +481,60 @@ def test_lint_batched_oracle_coverage(tmp_path):
     assert r.returncode == 0, r.stderr
 
 
+def test_lint_incremental_oracle_coverage(tmp_path):
+    """Round 20 (live graphs): an app module shipping an incremental
+    builder/revalidator without its reference_*_incremental oracle is
+    flagged — incremental device code must be provable equal to full
+    recompute at the same epoch (lux_tpu/livegraph.py); adding the
+    oracle clears it."""
+    apps = tmp_path / "lux_tpu" / "apps"
+    apps.mkdir(parents=True)
+    bad = apps / "newapp.py"
+    bad.write_text(
+        "def build_incremental_step(g):\n    return None\n\n\n"
+        "def reference_newapp(g):\n    return None\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(bad)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "incremental" in r.stderr and "oracle" in r.stderr
+
+    bad.write_text(
+        "def build_incremental_step(g):\n    return None\n\n\n"
+        "def reference_newapp(g):\n    return None\n\n\n"
+        "def reference_newapp_incremental(g, old):\n    return None\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(bad)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+    # a METHOD revalidator (the LiveGraph.revalidate shape) is
+    # caught too — tree.body-only scans are blind to it
+    bad.write_text(
+        "class Live:\n"
+        "    def revalidate(self, eng):\n        return None\n\n\n"
+        "def reference_newapp(g):\n    return None\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(bad)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "incremental" in r.stderr and "oracle" in r.stderr
+
+    # ... and an explicit cross-module oracle citation clears it
+    # (the convention allows the oracle to live in its app module)
+    bad.write_text(
+        "class Live:\n"
+        "    def revalidate(self, eng):\n"
+        "        '''proved equal to apps/sssp."
+        "reference_sssp_incremental'''\n"
+        "        return None\n\n\n"
+        "def reference_newapp(g):\n    return None\n")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(bad)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+
 def test_unknown_audit_mode_is_typed_error():
     """A typo'd mode must not silently disable enforcement — both
     the engine param and audit_engine reject it."""

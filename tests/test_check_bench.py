@@ -620,6 +620,74 @@ def test_serve_chaos_zero_failovers_with_replicas_ok(tmp_path):
     assert r.returncode == 0, r.stderr
 
 
+# -- round-20 live-graph serving lines (bench.py -config serve-live) ---
+
+SERVE_LIVE_LINE = {
+    "metric": "serve_live_rmat12_qps_per_chip",
+    "value": 9.2, "unit": "qps", "vs_baseline": 9.2,
+    "samples": [9.2], "attempts": 1, "discarded": [],
+    "np": 2, "scale": 12, "ef": 8, "serve_batch": 4,
+    "kinds": ["sssp", "components", "pagerank"],
+    "delta_capacity": 64, "compact_threshold": 0.75,
+    "submitted": 36, "served": 36,
+    "mutations": 72, "mutation_rate_per_s": 18.3,
+    "epochs_advanced": 6, "compactions": 1,
+    "cache_hit_fraction": 0.4615, "peak_occupancy": 0.75,
+    "telemetry": {"runs": [{"repeat": 0, "iters": 36,
+                            "seconds": 3.91}],
+                  "counters": None},
+    "calibration": GOOD_CAL,
+}
+
+
+def test_serve_live_line_passes_strict(tmp_path):
+    r = _audit_one(tmp_path, SERVE_LIVE_LINE)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    # the round-20 contradiction rejects
+    (lambda o: o.update(mutations=0), "with mutations=0"),
+    (lambda o: o.update(epochs_advanced=0),
+     "epoch-invisible"),
+    (lambda o: o.update(epochs_advanced=100),
+     "more epochs than edges"),
+    (lambda o: o.update(cache_hit_fraction=1.2),
+     "cache_hit_fraction"),
+    (lambda o: o.update(cache_hit_fraction=-0.1),
+     "cache_hit_fraction"),
+    (lambda o: o.update(peak_occupancy=0.3),
+     "never reached compact_threshold"),
+    # record completeness + types
+    (lambda o: o.pop("mutations"), "serve-live line missing"),
+    (lambda o: o.pop("compactions"), "serve-live line missing"),
+    (lambda o: o.pop("peak_occupancy"), "serve-live line missing"),
+    (lambda o: o.update(compactions=-1), "compactions"),
+    (lambda o: o.update(peak_occupancy=1.5), "peak_occupancy"),
+    (lambda o: o.update(compact_threshold=0.0), "compact_threshold"),
+    (lambda o: o.update(delta_capacity=0), "delta_capacity"),
+    (lambda o: o.update(mutations="many"), "mutations"),
+])
+def test_bad_serve_live_lines_fail(tmp_path, mutate, needle):
+    obj = json.loads(json.dumps(SERVE_LIVE_LINE))
+    mutate(obj)
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 1, "audit passed a bad serve-live line"
+    assert needle in r.stderr, r.stderr
+
+
+def test_serve_live_quiet_run_ok(tmp_path):
+    """Zero mutations + zero epochs + zero compactions (a static
+    drain through the live path) is legitimate — only the impossible
+    combinations reject, and a sub-threshold peak occupancy is fine
+    when nothing compacted."""
+    obj = json.loads(json.dumps(SERVE_LIVE_LINE))
+    obj.update(mutations=0, epochs_advanced=0, compactions=0,
+               peak_occupancy=0.0, mutation_rate_per_s=0.0)
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 0, r.stderr
+
+
 # ---------------------------------------------------------------------
 # round 16: gather-ab reorder field + pairing rule
 

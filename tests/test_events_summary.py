@@ -726,3 +726,194 @@ def test_malformed_shed_and_lost_fail(tmp_path):
     r = run_summary(p)
     assert r.returncode == 1
     assert "replica_lost without" in r.stderr
+
+
+# ---------------------------------------------------------------------
+# round 20: the live-graph mutation/epoch/compaction/cache trail
+# (lux_tpu/livegraph.py) — torn-epoch, compaction-bracket and
+# replay-regression audits
+
+
+def _live_run(extra=(), drop=()):
+    base = {"pid": 1, "session": "s"}
+    evs = [
+        dict(base, t=1.0, tm=1.0, kind="run_start", schema=1,
+             app="live"),
+        dict(base, t=1.1, tm=1.1, kind="query_enqueue", qid=0,
+             query_kind="sssp", source=3, tenant="default",
+             priority=0, queued=1),
+        # WAL-backed publishes always carry the wal path (livegraph
+        # wal_kw) — the replay-regression audit pairs on it
+        dict(base, t=1.2, tm=1.2, kind="mutation", edges=4, epoch=1,
+             delta_count=4, occupancy=0.25, wal="/tmp/g.wal"),
+        dict(base, t=1.2, tm=1.21, kind="epoch_advance",
+             from_epoch=0, to_epoch=1, wal="/tmp/g.wal"),
+        dict(base, t=1.3, tm=1.3, kind="query_enqueue", qid=1,
+             query_kind="sssp", source=3, tenant="default",
+             priority=0, queued=1),
+        dict(base, t=1.4, tm=1.4, kind="query_start", qid=0,
+             query_kind="sssp", col=0, wait_s=0.1, epoch=0),
+        dict(base, t=1.5, tm=1.5, kind="query_done", qid=0,
+             query_kind="sssp", col=0, iters=4, segments=2,
+             latency_s=0.5, wait_s=0.1, converged=True, epoch=0,
+             answer_epoch=0),
+        dict(base, t=1.6, tm=1.6, kind="query_done", qid=1,
+             query_kind="sssp", col=-1, iters=4, segments=0,
+             latency_s=0.01, wait_s=0.01, converged=True, epoch=1,
+             answer_epoch=1, cached=True),
+        dict(base, t=1.7, tm=1.7, kind="compact_start", epoch=1,
+             generation=1, delta_count=4, occupancy=0.25),
+        dict(base, t=1.8, tm=1.8, kind="compact_done", epoch=1,
+             generation=1, folded=4, ne=904),
+        dict(base, t=1.9, tm=1.9, kind="wal_replay",
+             path="/tmp/g.wal", records=6, epoch=1, generation=1,
+             truncated_bytes=0, delta_count=0),
+        dict(base, t=2.0, tm=2.0, kind="run_done", seconds=1.0,
+             iters=8),
+    ]
+    evs = [e for e in evs if e["kind"] not in drop]
+    evs.extend(extra)
+    evs.sort(key=lambda e: e["tm"])
+    return evs
+
+
+def test_live_trail_renders_clean(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _live_run())
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "live graph: 4 edge(s) over 1 mutation batch(es)" \
+        in r.stdout
+    assert "compaction: 1 completed, 4 edge(s) folded" in r.stdout
+    assert "WAL replay: 6 record(s)" in r.stdout
+    assert "answer cache: 1 of 2 served cached" in r.stdout
+
+
+def test_torn_epoch_answer_fails(tmp_path):
+    """THE snapshot-isolation audit: a query answered at a different
+    epoch than its admission pinned is a torn read published as an
+    answer."""
+    evs = _live_run()
+    for e in evs:
+        if e["kind"] == "query_done" and e["qid"] == 0:
+            e["answer_epoch"] = 1       # admitted at 0, answered at 1
+    p = tmp_path / "ev.jsonl"
+    write_log(p, evs)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "TORN-EPOCH" in r.stderr
+
+
+def test_epoch_without_answer_epoch_fails(tmp_path):
+    evs = _live_run()
+    for e in evs:
+        if e["kind"] == "query_done" and e["qid"] == 0:
+            del e["answer_epoch"]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, evs)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "no answer_epoch" in r.stderr
+
+
+def test_compact_done_without_start_fails(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _live_run(drop=("compact_start",)))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "without a preceding compact_start" in r.stderr
+
+
+def test_open_compaction_renders_not_fails(tmp_path):
+    """A compact_start with no done is the COMPACT_CRASH signature —
+    rendered as open, never an audit failure (recovery's job)."""
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _live_run(drop=("compact_done", "wal_replay")))
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "OPEN (crashed mid-compaction)" in r.stdout
+
+
+def test_replay_epoch_regression_fails(tmp_path):
+    """A WAL replay that comes up at a lower epoch than the trail
+    already published means acknowledged mutations vanished."""
+    evs = _live_run()
+    for e in evs:
+        if e["kind"] == "wal_replay":
+            e["epoch"] = 0              # published epoch was 1
+    p = tmp_path / "ev.jsonl"
+    write_log(p, evs)
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "epoch regression" in r.stderr
+
+
+def test_replay_of_other_log_not_a_regression(tmp_path):
+    """REGRESSION: the in-stream audit kept one path-BLIND epoch
+    high-water mark, so a replay of an UNRELATED log legitimately
+    recovering a lower epoch (two live graphs beside each other, or
+    a recovery drill beside a live bench) failed a clean trail —
+    publishes and replays pair on the wal path, exactly like the
+    cross-process audit_wal_replays."""
+    evs = _live_run()
+    for e in evs:
+        if e["kind"] == "wal_replay":
+            e["path"] = "/tmp/other.wal"
+            e["epoch"] = 0              # log A published epoch 1
+    p = tmp_path / "ev.jsonl"
+    write_log(p, evs)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+
+
+def _crash_recovery_streams(replay_epoch):
+    """Process A (pid 1) publishes up to epoch 2 on a WAL and
+    crashes; process B (pid 2) recovers the SAME wal later.  The
+    per-run walk can never pair these — only audit_wal_replays."""
+    a = {"pid": 1, "session": "aaaa"}
+    b = {"pid": 2, "session": "bbbb"}
+    return [
+        dict(a, t=1.0, tm=1.0, kind="run_start", schema=1,
+             app="live"),
+        dict(a, t=1.2, tm=1.2, kind="mutation", edges=4, epoch=1,
+             delta_count=4, occupancy=0.25, wal="/tmp/g.wal"),
+        dict(a, t=1.21, tm=1.21, kind="epoch_advance", from_epoch=0,
+             to_epoch=1, wal="/tmp/g.wal"),
+        dict(a, t=1.3, tm=1.3, kind="mutation", edges=2, epoch=2,
+             delta_count=6, occupancy=0.375, wal="/tmp/g.wal"),
+        dict(a, t=1.31, tm=1.31, kind="epoch_advance", from_epoch=1,
+             to_epoch=2, wal="/tmp/g.wal"),
+        # process A crashes here (no run_done) — B recovers
+        dict(b, t=5.0, tm=0.1, kind="run_start", schema=1,
+             app="live"),
+        dict(b, t=5.1, tm=0.2, kind="wal_replay", path="/tmp/g.wal",
+             records=6, epoch=replay_epoch, generation=1,
+             truncated_bytes=0, delta_count=6),
+        dict(b, t=5.2, tm=0.3, kind="run_done", seconds=0.2,
+             iters=0),
+    ]
+
+
+def test_cross_process_replay_epoch_regression_fails(tmp_path):
+    """THE crash shape the audit was built for: publisher and
+    recoverer are different processes (different (session, pid)
+    streams), so only the cross-process pairing on the WAL path can
+    see acknowledged epoch-2 mutations vanish."""
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _crash_recovery_streams(replay_epoch=1))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "cross-process replay-after-crash" in r.stderr
+
+
+def test_cross_process_replay_clean(tmp_path):
+    """The same two-process shape with a FULL recovery (epoch 2)
+    audits clean — and a replay at a HIGHER epoch (another process
+    kept appending) is never a regression."""
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _crash_recovery_streams(replay_epoch=2))
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    write_log(p, _crash_recovery_streams(replay_epoch=3))
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr

@@ -218,3 +218,45 @@ def test_fsck_lux_script(tmp_path):
                         str(bad)], capture_output=True, text=True)
     assert r.returncode == 1
     assert "col_idx_range" in r.stderr and "1 of 2" in r.stderr
+
+
+# ---------------------------------------------------------------------
+# round 20: the mutation-log (WAL) header (lux_tpu/livegraph.py)
+
+
+def test_wal_header_roundtrip():
+    head = luxfmt.pack_wal_header(1234, 64)
+    assert len(head) == luxfmt.WAL_HEADER_SIZE
+    assert head[:4] == luxfmt.WAL_MAGIC
+    nv, cap = luxfmt.read_wal_header("<mem>", head=head)
+    assert (nv, cap) == (1234, 64)
+    # the nv cross-check: a log from a DIFFERENT graph is typed
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.read_wal_header("<mem>", nv=1235, head=head)
+    assert ei.value.check == "wal_header"
+
+
+def test_wal_header_rejects_garbage_and_versions(tmp_path):
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.read_wal_header("<mem>", head=b"LUXWxx")   # short
+    assert ei.value.check == "wal_header"
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.read_wal_header(
+            "<mem>", head=b"NOPE" + np.array([1, 4, 4],
+                                             luxfmt.V_DTYPE).tobytes())
+    assert ei.value.check == "wal_header"
+    bad_ver = luxfmt.WAL_MAGIC + np.array(
+        [luxfmt.WAL_VERSION + 1, 4, 4], luxfmt.V_DTYPE).tobytes()
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.read_wal_header("<mem>", head=bad_ver)
+    assert ei.value.check == "wal_version"
+    bad_cap = luxfmt.WAL_MAGIC + np.array(
+        [luxfmt.WAL_VERSION, 4, 0], luxfmt.V_DTYPE).tobytes()
+    with pytest.raises(luxfmt.GraphFormatError) as ei:
+        luxfmt.read_wal_header("<mem>", head=bad_cap)
+    assert ei.value.check == "wal_capacity"
+    # file-read path (no head=): same validation
+    p = tmp_path / "g.wal"
+    p.write_bytes(bad_ver)
+    with pytest.raises(luxfmt.GraphFormatError):
+        luxfmt.read_wal_header(str(p))
