@@ -432,23 +432,32 @@ def run_serve_load(config, args, *, chaos: bool):
 
 
 def run_serve_live(config, args):
-    """The live-graph serving line (round 20, lux_tpu/livegraph.py):
-    mixed-kind traffic over a MUTATING graph.  Each phase mutates
-    first (one published epoch), then drains two query waves — the
-    second wave repeats the first's hot sources at the SAME epoch, so
-    the epoch-keyed answer cache measurably hits; delta occupancy
-    crosses the compact threshold mid-run and the natural compaction
-    (+ Server.refresh_live generation adoption) happens between
-    drains.  EVERY answer is verified against its NumPy oracle at the
-    query's admission epoch before the line may print — a wrong
-    answer is a crash, never a published number.  check_bench rejects
-    the line's contradictions (see DEFAULT_SHAPE comment)."""
+    """The live-graph serving line (rounds 20-21,
+    lux_tpu/livegraph.py): mixed-kind traffic over a MUTATING graph
+    exercising the FULL mutation algebra.  Each phase appends first
+    (one published epoch), then drains two query waves — the second
+    wave repeats the first's hot sources at the SAME epoch, so the
+    epoch-keyed answer cache measurably hits.  Two of the phases
+    DELETE a previously-appended edge and run the honest
+    anti-monotone re-seed (a converged pre-deletion state repaired
+    to the published epoch on a standalone engine over
+    ``graph_at(target)``, bitwise-checked against the full
+    recompute); compaction is decided by the round-21
+    CompactionScheduler (anti-monotone pressure / occupancy / drag
+    economics) instead of the bare occupancy heuristic, with
+    Server.refresh_live generation adoption between drains.  EVERY
+    answer is verified against its NumPy oracle at the query's
+    admission epoch before the line may print — a wrong answer is a
+    crash, never a published number.  check_bench rejects the line's
+    contradictions, round-21 algebra fields included (see
+    DEFAULT_SHAPE comment)."""
     import os
     import time as _time
 
     import numpy as np
 
     from lux_tpu import livegraph, serve, telemetry
+    from lux_tpu.apps import sssp as _sssp
 
     sdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "scripts")
@@ -467,16 +476,17 @@ def run_serve_live(config, args):
     def build_tier():
         """ONE construction for sample 0 and every rerun — the two
         must measure the identical workload (live graph shape, cache
-        policy, compaction cadence), so there is exactly one place
+        policy, scheduler cadence), so there is exactly one place
         to tune it."""
         lv = livegraph.LiveGraph(g, capacity=capacity,
                                  compact_threshold=0.75)
         sv = serve.Server(g, batch=args.serve_batch,
                           num_parts=args.np, seg_iters=2, slo_ms=slo,
                           health=args.health, live=lv, cache=True)
-        return lv, sv
+        sc = livegraph.CompactionScheduler(lv, burn=sv.slo_burn)
+        return lv, sv, sc
 
-    live, srv = build_tier()
+    live, srv, sched = build_tier()
     extra = {"np": args.np, "scale": scale, "ef": ef,
              "serve_batch": args.serve_batch, "kinds": kinds,
              "unit": "qps", "delta_capacity": capacity,
@@ -507,12 +517,53 @@ def run_serve_live(config, args):
     per_mut = int(np.ceil(live.compact_threshold * capacity
                           / max(1, phases - 2)))
 
-    def load_phase(lv, sv, rng):
-        """One phase: mutate, then two query waves at the SAME
-        epoch — the repeat wave is the cache-hit traffic.  Returns
-        (responses, submitted)."""
-        sv.mutate(rng.integers(nv, size=per_mut),
-                  rng.integers(nv, size=per_mut))
+    delete_phases = (2, 4)
+
+    def reseed_honest(lv, target):
+        """The HONEST anti-monotone re-seed: converge over the
+        pre-deletion snapshot, repair that state to ``target`` on a
+        standalone engine built over ``graph_at(target)`` (the
+        revalidate contract), and refuse the line unless the result
+        is bitwise the full recompute."""
+        import jax
+
+        pre = lv.graph_at(target - 1)
+        eng0 = _sssp.build_engine(pre, 0, num_parts=args.np)
+        lab, act = eng0.init_state()
+        lab, act, _ = eng0.converge(lab, act)
+        host = eng0.sg.from_padded(np.asarray(jax.device_get(lab)))
+        g_t = lv.graph_at(target)
+        eng1 = _sssp.build_engine(g_t, 0, num_parts=args.np)
+        lab1, act1 = eng1.place(
+            eng1.sg.to_padded(host),
+            eng1.sg.to_padded(np.zeros(nv, bool)))
+        lab1, act1, _ = lv.revalidate(eng1, lab1, act1)
+        got = eng1.sg.from_padded(
+            np.asarray(jax.device_get(lab1))).astype(np.int64)
+        inf = int(_sssp.HOP_INF)
+        got = np.where(got >= inf, inf, got)
+        ref = _sssp.reference_sssp(g_t, 0)
+        ref = np.where(ref >= inf, inf, ref)
+        if not np.array_equal(got, ref):
+            raise RuntimeError(
+                "serve-live: the anti-monotone re-seed differs from "
+                "the full recompute at its target epoch — a wrong "
+                "repair must never print a line")
+
+    def load_phase(lv, sv, sc, rng, phase, tracked):
+        """One phase: append (tracking an edge for later deletion),
+        on the deletion phases delete a tracked edge + run the
+        honest re-seed, then two query waves — the repeat wave is
+        the cache-hit traffic.  The scheduler alone decides folds at
+        the phase boundary.  Returns (responses, submitted)."""
+        s_new = rng.integers(nv, size=per_mut)
+        d_new = rng.integers(nv, size=per_mut)
+        sv.mutate(s_new, d_new)
+        tracked.append((int(s_new[0]), int(d_new[0])))
+        if phase in delete_phases and len(tracked) > 1:
+            es, ed = tracked.pop(0)
+            sv.mutate([es], [ed], op="delete")
+            reseed_honest(lv, lv.epoch)
         hot = {k: int(rng.integers(nv)) for k in kinds}
         n = 0
         out = []
@@ -524,17 +575,16 @@ def run_serve_live(config, args):
                 sv.submit(kind, source=s)
                 n += 1
             out += sv.run()
-        if lv.should_compact():
-            lv.compact()
-            sv.refresh_live()
+        sc.maybe_compact(server=sv)
         return out, n
 
-    def one_step(lv, sv):
+    def one_step(lv, sv, sc):
         rng = np.random.default_rng(7)
         t0 = _time.monotonic()
         responses, submitted = [], 0
-        for _ in range(phases):
-            out, n = load_phase(lv, sv, rng)
+        tracked = []
+        for phase in range(phases):
+            out, n = load_phase(lv, sv, sc, rng, phase, tracked)
             responses += out
             submitted += n
         elapsed = _time.monotonic() - t0
@@ -551,23 +601,28 @@ def run_serve_live(config, args):
 
     def fresh_run():
         """A rerun must measure the SAME workload as the sample it
-        replaces — mutation stream, natural compaction, cold answer
-        cache — so it rebuilds the tier (build_tier, the one shared
-        construction) and replays the identical seeded traffic.  The
-        jit cache is warm (same shapes), so no compile cost recurs;
-        replaying more queries over the now-static mutated graph
-        instead would skip the very mutation/compaction path this
-        line claims to time."""
-        lv, sv = build_tier()
+        replaces — mutation stream, deletions + re-seeds, scheduler
+        folds, cold answer cache — so it rebuilds the tier
+        (build_tier, the one shared construction) and replays the
+        identical seeded traffic.  The jit cache is warm (same
+        shapes), so no compile cost recurs; replaying more queries
+        over the now-static mutated graph instead would skip the
+        very mutation/compaction path this line claims to time."""
+        lv, sv, sc = build_tier()
         loadgen.warm(sv, kinds)
-        return one_step(lv, sv)[0]
+        return one_step(lv, sv, sc)[0]
 
-    qps, elapsed, submitted = one_step(live, srv)
+    qps, elapsed, submitted = one_step(live, srv, sched)
     hit_frac = srv.cache.hit_fraction() or 0.0
     if live.compactions < 1:
         raise RuntimeError(
             "serve-live: no compaction fired — the line would not "
             "measure the generation-swap path it claims to")
+    if live.deletions < 1 or live.reseeds < 1:
+        raise RuntimeError(
+            "serve-live: the deletion/re-seed phases did not run — "
+            "the line would not measure the mutation algebra it "
+            "claims to")
     extra.update(
         submitted=submitted,
         served=submitted,
@@ -575,6 +630,10 @@ def run_serve_live(config, args):
         mutation_rate_per_s=round(live.mutations / elapsed, 4),
         epochs_advanced=int(live.epoch),
         compactions=int(live.compactions),
+        deletions=int(live.deletions),
+        reweights=int(live.reweights),
+        reseeds=int(live.reseeds),
+        scheduler_compactions=int(sched.scheduler_compactions),
         cache_hit_fraction=round(hit_frac, 4),
         peak_occupancy=round(live.peak_count / capacity, 4))
     name = f"serve_live_rmat{scale}"

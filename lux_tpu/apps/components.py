@@ -163,6 +163,42 @@ def reference_components_incremental(g_new: Graph,
     return labels
 
 
+def reference_components_decremental(g_new: Graph,
+                                     labels_old: np.ndarray,
+                                     touched_dst) -> np.ndarray:
+    """NumPy DECREMENTAL oracle (round 21, mutation algebra): repair
+    converged max-propagation labels after edge DELETIONS by the
+    affected-cone re-seed rule (lux_tpu/livegraph.LiveGraph.
+    revalidate's device mirror).  A deletion can LOWER a label
+    (a component splits), which max-propagation can never repair; any
+    vertex whose label changes is reachable in ``g_new`` from some
+    deleted edge's destination (the suffix of its stale label-witness
+    path past the LAST deleted edge survives).  Re-seed the cone —
+    forward reachability from ``touched_dst`` over ``g_new`` — to the
+    init labels (own id) and propagate to fixed point: every label
+    starts <= the true fixed point and >= its init seed, so the max
+    fixed point is exactly ``reference_components(g_new)`` (proved in
+    tests/test_livegraph.py)."""
+    src, dst = g_new.edge_arrays()
+    labels = np.asarray(labels_old, dtype=np.int64).copy()
+    cone = np.zeros(g_new.nv, dtype=bool)
+    cone[np.asarray(touched_dst, np.int64)] = True
+    while True:
+        add = np.zeros(g_new.nv, dtype=bool)
+        add[dst[cone[src]]] = True
+        add &= ~cone
+        if not add.any():
+            break
+        cone |= add
+    labels[cone] = np.arange(g_new.nv, dtype=np.int64)[cone]
+    while True:
+        new = labels.copy()
+        np.maximum.at(new, dst, labels[src])
+        if np.array_equal(new, labels):
+            return labels
+        labels = new
+
+
 def reference_components_batched(g: Graph, seeds) -> np.ndarray:
     """NumPy seeded-propagation oracle -> ``[nv, B]`` labels: column q
     is ``seeds[q]`` where the vertex is reachable from the seed, -1

@@ -75,7 +75,18 @@ def make_batched_program(resets, dtype=jnp.float32) -> PullProgram:
     (PullEngine.update_program_arrays).  ONE state-table gather per
     dense iteration serves all B queries (audit gather-budget);
     ``state_bytes = 4B`` keeps the auto-exchange and ledger
-    estimates honest at B > 1."""
+    estimates honest at B > 1.
+
+    ``deg_corr`` (round 21, live graphs) is a second extra array
+    [nv, B] of per-column out-degree CORRECTIONS, zero by default (a
+    float 0 add keeps the static case bitwise).  The live serving
+    tier sets column q to the delta-append out-degree at q's
+    admission epoch, so the engine normalizes by the EFFECTIVE
+    degree of ``graph_at(epoch_q)`` while iterating the base edges;
+    the host-side correction step adds the delta edges' rank mass at
+    each boundary (serve.PullBatchRunner — together one exact PPR
+    iteration over the epoch's graph, which is how pull admissions
+    advance with published epochs without waiting for a fold)."""
     resets = np.asarray(resets, dtype=np.dtype(dtype))
     if resets.ndim != 2:
         raise ValueError(f"resets must be [nv, B], got {resets.shape}")
@@ -87,9 +98,9 @@ def make_batched_program(resets, dtype=jnp.float32) -> PullProgram:
     def apply(old, red, ctx):
         reset = ctx.extra["reset"]
         pr = (1.0 - ALPHA) * reset + ALPHA * red
-        deg = ctx.deg.astype(pr.dtype)[:, None]
-        return jnp.where(ctx.deg[:, None] > 0,
-                         pr / jnp.maximum(deg, 1), pr)
+        deg = ctx.deg.astype(pr.dtype)[:, None] \
+            + ctx.extra["deg_corr"]
+        return jnp.where(deg > 0, pr / jnp.maximum(deg, 1), pr)
 
     def init(sg: ShardedGraph):
         if resets.shape[0] != sg.nv:
@@ -101,7 +112,9 @@ def make_batched_program(resets, dtype=jnp.float32) -> PullProgram:
                         r).astype(np.dtype(dtype))
 
     def extra_arrays(sg: ShardedGraph):
-        return {"reset": sg.to_padded(resets)}
+        zeros = np.zeros(resets.shape, np.dtype(dtype))
+        return {"reset": sg.to_padded(resets),
+                "deg_corr": sg.to_padded(zeros)}
 
     return PullProgram(reduce="sum", edge_value=edge_value, apply=apply,
                        init=init, needs_dst=False,

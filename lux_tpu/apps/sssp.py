@@ -265,6 +265,59 @@ def reference_sssp_incremental(g_new: Graph, dist_old: np.ndarray,
     return dist
 
 
+def reference_sssp_decremental(g_new: Graph, dist_old: np.ndarray,
+                               touched_dst, start_vertex: int = 0,
+                               weighted: bool = False) -> np.ndarray:
+    """NumPy DECREMENTAL oracle (round 21, mutation algebra): repair a
+    converged distance vector after ANTI-MONOTONE mutations — edge
+    deletions and weight updates — by the affected-cone re-seed rule
+    the device path mirrors (lux_tpu/livegraph.LiveGraph.revalidate).
+
+    ``g_new`` is the post-mutation graph, ``dist_old`` the fixed point
+    on the pre-mutation graph, ``touched_dst`` the destinations of
+    every deleted/reweighted edge.  Deletions and weight increases can
+    RAISE min-fixed-point distances, which monotone relaxation can
+    never repair; but any vertex whose distance changes is reachable
+    in ``g_new`` from some touched destination (take the LAST mutated
+    edge (u, v) on its stale shortest path: the suffix from v survives
+    in ``g_new``).  So: (1) the affected CONE = forward reachability
+    from the touched destinations over ``g_new``, (2) re-seed the cone
+    from identity (keeping the source seed), (3) relax to fixed point
+    — every label starts >= the true fixed point with the source at 0,
+    so Bellman-Ford converges to exactly ``reference_sssp(g_new)``
+    (the equality tests/test_livegraph.py proves per sweep point;
+    weight DECREASES are covered too — the improved paths route
+    through a touched destination, hence through the cone)."""
+    src, dst = g_new.edge_arrays()
+    if weighted:
+        w = np.asarray(g_new.weights, dtype=np.float64)
+        dist = np.asarray(dist_old, dtype=np.float64).copy()
+        inf = np.inf
+    else:
+        w = np.ones(g_new.ne, dtype=np.int64)
+        dist = np.asarray(dist_old, dtype=np.int64).copy()
+        inf = np.int64(int(HOP_INF))
+    cone = np.zeros(g_new.nv, dtype=bool)
+    cone[np.asarray(touched_dst, np.int64)] = True
+    while True:
+        add = np.zeros(g_new.nv, dtype=bool)
+        add[dst[cone[src]]] = True
+        add &= ~cone
+        if not add.any():
+            break
+        cone |= add
+    dist[cone] = inf
+    dist[start_vertex] = 0
+    while True:
+        cand = dist[src] + w
+        new = dist.copy()
+        np.minimum.at(new, dst, cand)
+        if np.array_equal(new, dist):
+            break
+        dist = new
+    return dist
+
+
 def reference_sssp_batched(g: Graph, sources,
                            weighted: bool = False) -> np.ndarray:
     """NumPy k-source Bellman-Ford oracle -> ``[nv, B]`` distances.

@@ -965,6 +965,16 @@ def check_ledger(engine, tol: float = 0.5, where: str | None = None):
     sb = getattr(engine.program, "state_bytes", None)
     if sb:
         expected += engine.sg.num_parts * engine.sg.vpad * (sb - 4)
+    # program-contributed extra arrays (batched reset vectors, the
+    # round-21 pull deg_corr columns) are jit ARGUMENTS by the
+    # no-closure convention — price their actual bytes, or every
+    # extra-carrying program reads as edge-ledger drift (batched ppr
+    # rode the tolerance on one [vpad, B] extra and tripped it on
+    # the second)
+    xa = getattr(engine.program, "extra_arrays", None)
+    if xa is not None:
+        expected += sum(np.asarray(v).nbytes
+                        for v in xa(engine.sg).values())
     ratio = measured / max(1, expected)
     if not (1.0 / (1.0 + tol) <= ratio <= 1.0 + tol):
         return [Finding(
@@ -1148,6 +1158,10 @@ def matrix_configs(ledger: bool = True):
         from lux_tpu.livegraph import LiveGraph
         lg = LiveGraph(g, capacity=64)
         lg.append_edges([1, 2, 3], [9, 17, 33])
+        # a published TOMBSTONE slot (round 21): the audited step
+        # must keep its single state-table gather with the d_kind
+        # mask in the jaxpr, not just for pure-append deltas
+        lg.delete_edges([1], [9])
         eng = builder()
         lg.register_audit(eng)
         return eng

@@ -77,6 +77,22 @@ COMPACT_CRASH = "compact_crash"  # crash between COMPACT_START and the
 #                                  resumes from the SURVIVING
 #                                  generation (base + published delta)
 
+# round 21 (mutation algebra): op-ASSERTING crash legs.  Each behaves
+# like MUT_CRASH (die before the WAL record lands) but additionally
+# validates that the mutation firing at that index really is the
+# scheduled op — a drill that says "kill the 3rd mutation, which is a
+# deletion" fails typed if the stream reordered, instead of silently
+# testing the wrong op's recovery leg.
+MUT_DELETE = "mut_delete"        # crash before a DELETE record lands
+MUT_REWEIGHT = "mut_reweight"    # crash before a REWEIGHT record lands
+RESEED_CRASH = "reseed_crash"    # crash MID-RE-SEED: between the
+#                                  affected-cone computation and the
+#                                  re-converge — recovery must come up
+#                                  with the anti-monotone ops still
+#                                  pending (admission stays capped; no
+#                                  answer was produced from the
+#                                  half-re-seeded state)
+
 
 # exit code of a hard_kill WORKER_KILL: distinguishable from a crash
 # (nonzero, outside the shell/signal ranges) in the harness's asserts
@@ -309,6 +325,13 @@ class MutationFaultPlan:
       generation swap — recovery must come up on the SURVIVING
       generation, base + published delta, with the half-built
       generation discarded).
+    - round 21: ``schedule`` also accepts the op-asserting crash legs
+      MUT_DELETE/MUT_REWEIGHT (MUT_CRASH semantics, but the firing
+      mutation's op must match — a typed ValueError otherwise), and
+      ``reseed_schedule`` maps a RE-SEED index to RESEED_CRASH (crash
+      between the affected-cone computation and the re-converge:
+      recovery must come up with the anti-monotone ops still pending
+      and admission still capped).
 
     Like FaultPlan, fired entries never re-fire (the counters advance
     past them), so recovery always terminates; ``fired`` records what
@@ -316,39 +339,62 @@ class MutationFaultPlan:
 
     schedule: dict = dataclasses.field(default_factory=dict)
     compact_schedule: dict = dataclasses.field(default_factory=dict)
+    reseed_schedule: dict = dataclasses.field(default_factory=dict)
     mutations: int = dataclasses.field(default=0, init=False)
     compactions: int = dataclasses.field(default=0, init=False)
+    reseeds: int = dataclasses.field(default=0, init=False)
     fired: list = dataclasses.field(default_factory=list, init=False)
+
+    # the op each op-asserting crash action demands of the firing
+    # mutation (MUT_CRASH/WAL_TORN stay op-agnostic)
+    _OP_BY_ACTION = {MUT_DELETE: "delete", MUT_REWEIGHT: "reweight"}
 
     def __post_init__(self):
         for i, a in self.schedule.items():
-            if a not in (MUT_CRASH, WAL_TORN):
+            if a not in (MUT_CRASH, WAL_TORN, MUT_DELETE,
+                         MUT_REWEIGHT):
                 raise ValueError(
                     f"MutationFaultPlan schedule[{i}] must be "
-                    f"MUT_CRASH or WAL_TORN, got {a!r}")
+                    f"MUT_CRASH, WAL_TORN, MUT_DELETE, or "
+                    f"MUT_REWEIGHT, got {a!r}")
         for i, a in self.compact_schedule.items():
             if a != COMPACT_CRASH:
                 raise ValueError(
                     f"MutationFaultPlan compact_schedule[{i}] must "
                     f"be COMPACT_CRASH, got {a!r}")
+        for i, a in self.reseed_schedule.items():
+            if a != RESEED_CRASH:
+                raise ValueError(
+                    f"MutationFaultPlan reseed_schedule[{i}] must "
+                    f"be RESEED_CRASH, got {a!r}")
 
-    def fire_append(self, wal, record: bytes) -> None:
-        """Called by MutationLog.append BEFORE the record is written.
+    def fire_append(self, wal, record: bytes,
+                    op: str = "append") -> None:
+        """Called by LiveGraph._publish BEFORE the record is written.
         MUT_CRASH raises with nothing on disk; WAL_TORN writes a
-        strict prefix of ``record`` (the torn write) and then
-        raises.  ``wal`` may be None (un-logged LiveGraph): the crash
-        still fires, there is just nothing to tear."""
+        strict prefix of ``record`` (the torn write) and then raises;
+        MUT_DELETE/MUT_REWEIGHT assert ``op`` matches, then crash
+        like MUT_CRASH.  ``wal`` may be None (un-logged LiveGraph):
+        the crash still fires, there is just nothing to tear."""
         i = self.mutations
         self.mutations += 1
         action = self.schedule.get(i)
         if action is None:
             return
+        want = self._OP_BY_ACTION.get(action)
+        if want is not None and op != want:
+            raise ValueError(
+                f"MutationFaultPlan schedule[{i}] = {action} expects "
+                f"a {want!r} mutation at index {i}, but a {op!r} "
+                f"fired — the drill's mutation stream is not the one "
+                f"the plan was written against")
         self.fired.append((i, action))
         if action == WAL_TORN and wal is not None:
             wal.write_torn(record)
         raise InjectedWorkerCrash(
-            f"injected {action} at mutation {i}: worker died "
-            f"{'mid-append (torn WAL write)' if action == WAL_TORN else 'before the WAL append landed'}")
+            f"injected {action} at mutation {i} (op={op}): worker "
+            f"died "
+            f"{'mid-append (torn WAL write)' if action == WAL_TORN else 'before the WAL record landed'}")
 
     def fire_compact(self) -> None:
         """Called by LiveGraph.compact between the COMPACT_START WAL
@@ -361,6 +407,19 @@ class MutationFaultPlan:
         raise InjectedWorkerCrash(
             f"injected compact_crash at compaction {i}: worker died "
             f"after COMPACT_START, before the generation swap")
+
+    def fire_reseed(self) -> None:
+        """Called by LiveGraph._revalidate_anti between the
+        affected-cone computation and the re-converge."""
+        i = self.reseeds
+        self.reseeds += 1
+        if self.reseed_schedule.get(i) != RESEED_CRASH:
+            return
+        self.fired.append((i, RESEED_CRASH))
+        raise InjectedWorkerCrash(
+            f"injected reseed_crash at re-seed {i}: worker died "
+            f"after the affected-cone computation, before the "
+            f"re-converge")
 
 
 def nan_corrupt(state, count: int = 1):

@@ -591,10 +591,12 @@ class FleetServer:
         return serve_mod.admission_epoch(self.live, kind)
 
     def mutate(self, src, dst, weights=None,
-               tenant: str = "default") -> int:
-        """The serving tier's INGEST path: publish an edge-append
-        batch into the shared live graph.  When the delta blocks are
-        full (ingest outran compaction) the append is shed with a
+               tenant: str = "default", op: str = "append") -> int:
+        """The serving tier's INGEST path: publish one mutation
+        batch into the shared live graph — ``op`` routes the full
+        round-21 algebra ("append" default / "delete" / "reweight",
+        serve.Server.mutate's rule).  When the delta blocks are full
+        (ingest outran compaction) the mutation is shed with a
         typed ``AdmissionError(reason="delta_full")`` — recorded in
         shed_records and as a query_shed event like every other
         rejection — instead of blocking or silently dropping."""
@@ -603,7 +605,15 @@ class FleetServer:
         if self.live is None:
             raise ValueError("mutate() needs a live graph "
                              "(FleetServer(live=LiveGraph(...)))")
+        if op not in ("append", "delete", "reweight"):
+            raise ValueError(f"unknown mutation op {op!r}; choose "
+                             f"from ('append', 'delete', "
+                             f"'reweight')")
         try:
+            if op == "delete":
+                return self.live.delete_edges(src, dst)
+            if op == "reweight":
+                return self.live.reweight_edges(src, dst, weights)
             return self.live.append_edges(src, dst, weights)
         except livegraph.DeltaFullError:
             with self._lock:
@@ -614,15 +624,23 @@ class FleetServer:
                           tenant=str(tenant))
             self._shed(req, SHED_DELTA_FULL)
 
+    def slo_burn(self) -> float:
+        """Worst replica rolling SLO-burn fraction — the
+        CompactionScheduler's backoff input (the same per-replica
+        gauge routing already weighs, taken fleet-wide)."""
+        return max((rep.slo_burn() for rep in self._replicas),
+                   default=0.0)
+
     def refresh_live(self) -> None:
         """Adopt the live graph's new generation after a compaction
         (serve.Server.refresh_live's fleet analogue): every replica's
         runners are dropped and lazily rebuilt over the compacted
         base.  Refuses while queries are dispatched/resident at a
         replica, or CENTRALLY queued at an epoch the new base cannot
-        REPRODUCE (serve._epoch_reproducible — push kinds replay any
-        epoch >= base_epoch via the delta mask, pull kinds only the
-        base generation; serve.Server.refresh_live's rule)."""
+        REPRODUCE (serve._epoch_reproducible — both families replay
+        any epoch >= base_epoch: push via the delta mask, pull via
+        the degree-correction step; serve.Server.refresh_live's
+        rule)."""
         if self.live is None:
             return
         stale = [req for q in self._queues.values()

@@ -347,7 +347,14 @@ def read_perm_sidecar(lux_path: str, nv: int | None = None,
 # replaying wrong mutations into a wrong-answer serving epoch.
 
 WAL_MAGIC = b"LUXW"
-WAL_VERSION = 1
+# v1 (round 20): append-only — record kinds EDGE/COMPACT_START/DONE.
+# v2 (round 21): the full mutation algebra — DELETE and REWEIGHT
+# record kinds join.  The record LAYOUT is unchanged (24-byte chained
+# records), so the v2 reader replays v1 logs bitwise; a v2 record
+# kind inside a v1-headered log is typed corruption (the kind set is
+# part of the header version's contract — livegraph.MutationLog).
+WAL_VERSION = 2
+WAL_KNOWN_VERSIONS = (1, 2)
 WAL_HEADER_SIZE = 16
 WAL_RECORD_SIZE = 24
 WAL_SUFFIX = ".wal"
@@ -357,18 +364,22 @@ def wal_sidecar_path(lux_path: str) -> str:
     return lux_path + WAL_SUFFIX
 
 
-def pack_wal_header(nv: int, capacity: int) -> bytes:
+def pack_wal_header(nv: int, capacity: int,
+                    version: int = WAL_VERSION) -> bytes:
+    if version not in WAL_KNOWN_VERSIONS:
+        raise ValueError(f"unknown WAL version {version} "
+                         f"(known: {WAL_KNOWN_VERSIONS})")
     return WAL_MAGIC + np.array(
-        [WAL_VERSION, nv, capacity], V_DTYPE).tobytes()
+        [version, nv, capacity], V_DTYPE).tobytes()
 
 
 def read_wal_header(path: str, nv: int | None = None,
                     head: bytes | None = None):
-    """Read + VALIDATE a mutation-log header; returns (nv, capacity).
-    ``nv`` (when given) must match the header's — a log copied from a
-    different graph raises instead of silently replaying foreign
-    mutations.  ``head`` skips the file read (replay already holds
-    the bytes)."""
+    """Read + VALIDATE a mutation-log header; returns (nv, capacity,
+    version).  ``nv`` (when given) must match the header's — a log
+    copied from a different graph raises instead of silently replaying
+    foreign mutations.  ``head`` skips the file read (replay already
+    holds the bytes)."""
     if head is None:
         with open(path, "rb") as f:
             head = f.read(WAL_HEADER_SIZE)
@@ -380,10 +391,11 @@ def read_wal_header(path: str, nv: int | None = None,
             f"{WAL_HEADER_SIZE}-byte header")
     ver, hnv, cap = (int(x) for x in
                      np.frombuffer(head, V_DTYPE, count=3, offset=4))
-    if ver != WAL_VERSION:
+    if ver not in WAL_KNOWN_VERSIONS:
         raise GraphFormatError(
             path, "wal_version",
-            f"log version {ver}, this build reads {WAL_VERSION}")
+            f"log version {ver}, this build reads "
+            f"{WAL_KNOWN_VERSIONS}")
     if cap < 1:
         raise GraphFormatError(
             path, "wal_capacity",
@@ -393,7 +405,7 @@ def read_wal_header(path: str, nv: int | None = None,
             path, "wal_header",
             f"log written for nv={hnv} but the graph has nv={nv} — "
             f"mutation log from a different graph?")
-    return hnv, cap
+    return hnv, cap, ver
 
 
 def write_lux(path: str, row_ptrs, col_idx, weights=None, degrees=None):

@@ -961,6 +961,20 @@ DEBTS = (
          "revalidation sweep (scripts/sweep_live.py) also wants the "
          "on-device crossover point",
          "PERF_NOTES round 20 (live graphs)"),
+    Debt("live-deletion-on-device",
+         "the anti-monotone re-seed (lux_tpu/livegraph.py "
+         "_revalidate_anti) computes the deletion cone — forward "
+         "reachability from every pending anti op's destination — "
+         "on the HOST and re-places the re-seeded state; the "
+         "deletion sweep (scripts/sweep_live.py -mode delete, "
+         "PERF_NOTES round 21) measured that machinery 3-12x "
+         "SLOWER than full recompute at CPU scales because RMAT "
+         "cones reach 30-70% of the graph from one deleted "
+         "destination, so the cone cap's full-recompute fallback "
+         "is doing the serving; a device-side cone (frontier BFS "
+         "inside one jit) + in-place re-seed is the open lever, "
+         "and the crossover wants measuring through the tunnel",
+         "PERF_NOTES round 21 (mutation algebra)"),
 )
 
 

@@ -84,6 +84,13 @@ KINDS = ("sssp", "components", "pagerank")
 # shows within a few boundaries)
 SLO_WINDOW = 64
 
+# live-graph delta-drag sampling cadence (round 21): every Nth
+# _apply_delta boundary is fenced-timed into the compaction
+# scheduler's economics — sparse enough that the fence's host
+# round-trip never shows in serving latency, frequent enough that a
+# drain leaves the scheduler a measured median
+DRAG_SAMPLE_N = 8
+
 
 @dataclasses.dataclass
 class Request:
@@ -331,22 +338,24 @@ def admission_epoch(live, kind: str) -> int | None:
 
 def _epoch_reproducible(live, req) -> bool:
     """Can the CURRENT generation still serve a queued query pinned
-    at ``req.epoch``?  Push kinds replay any epoch in [base_epoch,
-    epoch] through the per-column delta mask (the delta holds exactly
-    the mutations past base_epoch); pull kinds see only the base
-    generation, so nothing but base_epoch itself is reproducible.
-    The ONE staleness rule refresh_live (Server and FleetServer)
-    checks — comparing against the LATEST view epoch instead would
-    wedge the server whenever ingest lands between compact() and
-    refresh_live() while a reproducible query sits queued
-    (compact refuses on the admission ledger, run() refuses on the
-    stale base, refresh_live refuses on the false mismatch)."""
+    at ``req.epoch``?  BOTH families replay any epoch in
+    [base_epoch, epoch] (round 21): push kinds through the
+    per-column delta mask, pull kinds through the base-generation +
+    degree-correction step — the delta holds exactly the mutations
+    past base_epoch, and admission never pins past a pending
+    anti-monotone op (livegraph.view_epoch), so every mutation in
+    the pinned window is an append both mechanisms express.
+    Anything older was folded away and adoption would serve a torn
+    view.  The ONE staleness rule refresh_live (Server and
+    FleetServer) checks — comparing against the LATEST view epoch
+    instead would wedge the server whenever ingest lands between
+    compact() and refresh_live() while a reproducible query sits
+    queued (compact refuses on the admission ledger, run() refuses
+    on the stale base, refresh_live refuses on the false
+    mismatch)."""
     if req.epoch is None:
         return False
-    base = int(live.base_epoch)
-    if _engine_family(req.kind) == "push":
-        return req.epoch >= base
-    return req.epoch == base
+    return req.epoch >= int(live.base_epoch)
 
 
 def admit_query(live, kind: str) -> int | None:
@@ -711,6 +720,10 @@ class PushBatchRunner(_RunnerBase):
         # columns admitted at different epochs share one engine
         # dispatch with snapshot isolation intact
         self._col_epoch = np.zeros(self.B, np.int32)
+        # delta-drag sampling cadence (round 21): every DRAG_SAMPLE_N
+        # boundaries one _apply_delta is fenced-timed and fed to the
+        # scheduler's economics (LiveGraph.record_drag_sample)
+        self._delta_n = 0
         self.weighted = bool(weighted and kind == "sssp")
         placeholder = [0] * self.B
         if kind == "sssp":
@@ -820,12 +833,27 @@ class PushBatchRunner(_RunnerBase):
         """One live delta-relax application (livegraph.delta_step —
         cached per engine inside LiveGraph, shared with revalidate
         and register_audit) on the DEVICE state at a segment
-        boundary."""
+        boundary.  Every ``DRAG_SAMPLE_N``-th application is
+        fenced-timed (timing.fence — O(1) bytes, never a full-state
+        fetch inside the timed region) and fed to the compaction
+        scheduler's economics as a MEASURED per-boundary drag sample
+        (LiveGraph.record_drag_sample)."""
         import jax.numpy as jnp
 
         args = self.live.delta_arrays(self.eng.sg)
+        n_slots = int(self.live.count)
+        self._delta_n += 1
+        sample = (n_slots > 0
+                  and self._delta_n % DRAG_SAMPLE_N == 1)
+        if sample:
+            t0 = time.perf_counter()
         label, active, _imp = self.live.delta_step(self.eng)(
             label, active, *args, jnp.asarray(self._col_epoch))
+        if sample:
+            from lux_tpu import timing
+            timing.fence(label)
+            self.live.record_drag_sample(
+                time.perf_counter() - t0, n_slots)
         return label, active
 
     def _answer_epoch(self, col: int) -> int | None:
@@ -874,17 +902,25 @@ class PullBatchRunner(_RunnerBase):
                          cache=cache)
         if kind != "pagerank":
             raise ValueError(f"unknown pull kind {kind!r}")
-        # pull kinds have no monotone delta revalidation (appends
-        # change out-degree normalization), so their snapshot view is
-        # the base GENERATION: the engine serves live.base and every
-        # answer is computed at the generation's epoch — which is
-        # exactly what submit pinned as these queries' admission
-        # epoch (livegraph.view_epoch("pull"))
-        self.gen_epoch = None if live is None else int(live.base_epoch)
         from lux_tpu.apps import pagerank as app
         self.g = g
         self.app = app
         self.tol = float(tol)
+        # live pull serving (round 21): appends change out-degree
+        # normalization, which the engine's base iteration cannot
+        # see — so each column runs at its OWN admission epoch via
+        # the base-generation + correction split: the engine
+        # normalizes by the EFFECTIVE degree (base + the column's
+        # delta-append out-degree, the ``deg_corr`` extra array) and
+        # the boundary hook adds the delta edges' rank mass
+        # host-side — together one exact PPR iteration over
+        # graph_at(col_epoch).  The correction is per-ITERATION
+        # math, so live forces seg_iters to 1 (the hook must run
+        # between consecutive iterations, not after a burst).
+        self._col_epoch = np.zeros(B, np.int32)
+        self.deg_corr = np.zeros((g.nv, B), np.float32)
+        if live is not None:
+            self.seg_iters = 1
         # idle columns carry the uniform reset's fixed-point-bound
         # trajectory — cheap, and refilled before they matter
         self.resets = np.full((g.nv, B), 1.0 / g.nv, dtype=np.float32)
@@ -903,8 +939,13 @@ class PullBatchRunner(_RunnerBase):
         return self.app.one_hot_resets(self.g.nv,
                                        [int(req.source)])[:, 0]
 
-    def _col_init(self, reset: np.ndarray) -> np.ndarray:
-        deg = np.asarray(self.g.out_degrees, np.float32)
+    def _col_init(self, reset: np.ndarray, col: int) -> np.ndarray:
+        # the column's init state normalizes by the same EFFECTIVE
+        # degree the engine's apply uses (base + deg_corr) — mixing
+        # base-degree init with corrected-degree iteration would
+        # start the column off its own trajectory
+        deg = np.asarray(self.g.out_degrees, np.float32) \
+            + self.deg_corr[:, col]
         return np.where(deg > 0, reset / np.maximum(deg, 1),
                         reset).astype(np.float32)
 
@@ -934,6 +975,14 @@ class PullBatchRunner(_RunnerBase):
                 if s is not None:
                     s.segments += 1
             new = sg.from_padded(np.asarray(jax.device_get(state)))
+            corrected = False
+            if self.live is not None:
+                # the host half of the live pull iteration: add the
+                # delta appends' rank mass (the engine already
+                # normalized by the effective degree) — new is now
+                # one exact PPR iteration of prev over each column's
+                # graph_at(col_epoch)
+                new, corrected = self._correct(prev, new)
             # per-query convergence: max-abs state change over the
             # WHOLE segment <= tol (an upper bound on any single
             # iteration's residual — strictly conservative)
@@ -959,6 +1008,11 @@ class PullBatchRunner(_RunnerBase):
             if n_filled:
                 self._push_resets()
                 return eng.place(sg.to_padded(new))
+            if corrected:
+                # the host correction changed the state the next
+                # iteration must start from — hand it back even when
+                # no column turned over
+                return eng.place(sg.to_padded(new))
             return None
 
         try:
@@ -968,12 +1022,39 @@ class PullBatchRunner(_RunnerBase):
             pass
         return self.responses[n0:]
 
+    def _correct(self, prev, new):
+        """Host half of the live pull iteration (round 21): the
+        engine produced ``apply(acc_base)`` of ``prev`` with
+        effective-degree normalization; one exact PPR iteration over
+        ``graph_at(col_epoch)`` additionally accumulates ``ALPHA *
+        prev[src]`` into each delta-append edge's destination, with
+        the SAME normalization (linearity of the divide).  Each
+        column masks the delta to its own admission epoch — the
+        snapshot-isolation rule the push delta step enforces
+        on-device, applied host-side."""
+        ds, dd, _dw, de = self.live.append_deltas()
+        if not len(ds):
+            return new, False
+        mask = de[:, None] <= self._col_epoch[None, :]
+        if not mask.any():
+            return new, False
+        acc = np.zeros_like(new)
+        np.add.at(acc, dd, prev[ds] * mask)
+        deg_eff = np.asarray(self.g.out_degrees,
+                             np.float32)[:, None] + self.deg_corr
+        new = new + self.app.ALPHA * acc / np.maximum(deg_eff, 1.0)
+        return new.astype(np.float32), True
+
     def _answer_epoch(self, col: int) -> int | None:
-        return self.gen_epoch
+        if self.live is None:
+            return None
+        return int(self._col_epoch[col])
 
     def _push_resets(self):
-        self.eng.update_program_arrays(
-            reset=self.eng.sg.to_padded(self.resets))
+        kw = {"reset": self.eng.sg.to_padded(self.resets)}
+        if self.live is not None:
+            kw["deg_corr"] = self.eng.sg.to_padded(self.deg_corr)
+        self.eng.update_program_arrays(**kw)
 
     def _fill(self, state_h, collector, total_iters,
               deadline_s) -> int:
@@ -992,7 +1073,19 @@ class PullBatchRunner(_RunnerBase):
                 col = free.pop(0)
                 reset = self._col_reset(req)
                 self.resets[:, col] = reset
-                state_h[:, col] = self._col_init(reset)
+                if self.live is not None:
+                    # pin the column's epoch and materialize its
+                    # delta-append out-degree correction — fixed for
+                    # the column's residence (later appends carry
+                    # later epochs, anti ops cap admission below
+                    # themselves, so nothing admitted can change it)
+                    e = int(req.epoch or 0)
+                    self._col_epoch[col] = e
+                    self.deg_corr[:, col] = 0.0
+                    ds, _dd, _dw, de = self.live.append_deltas()
+                    np.add.at(self.deg_corr[:, col], ds[de <= e],
+                              1.0)
+                state_h[:, col] = self._col_init(reset, col)
                 self._start(col, req, total_iters)
                 filled += 1
         return filled
@@ -1151,17 +1244,39 @@ class Server:
               source=req.source, queued=len(self._collector(kind)))
         return qid
 
-    def mutate(self, src, dst, weights=None) -> int:
-        """Ingest path: publish an edge-append batch into the live
-        graph (WAL-journaled, one new epoch).  Raises
-        livegraph.DeltaFullError when ingest has outrun compaction —
-        the backpressure signal the fleet's admission converts into a
-        typed ``AdmissionError(reason="delta_full")`` shed
-        (lux_tpu/fleet.py)."""
+    def mutate(self, src, dst, weights=None,
+               op: str = "append") -> int:
+        """Ingest path: publish one mutation batch into the live
+        graph (WAL-journaled, one new epoch).  ``op`` routes the
+        full round-21 algebra: "append" (default), "delete"
+        (weights ignored), "reweight" (weights are the NEW values).
+        Raises livegraph.DeltaFullError when ingest has outrun
+        compaction — the backpressure signal the fleet's admission
+        converts into a typed ``AdmissionError(reason="delta_full")``
+        shed (lux_tpu/fleet.py)."""
         if self.live is None:
             raise ValueError("mutate() needs a live graph "
                              "(Server(live=LiveGraph(...)))")
-        return self.live.append_edges(src, dst, weights)
+        if op == "append":
+            return self.live.append_edges(src, dst, weights)
+        if op == "delete":
+            return self.live.delete_edges(src, dst)
+        if op == "reweight":
+            return self.live.reweight_edges(src, dst, weights)
+        raise ValueError(f"unknown mutation op {op!r}; choose from "
+                         f"('append', 'delete', 'reweight')")
+
+    def slo_burn(self) -> float:
+        """Worst per-kind rolling SLO-burn fraction across this
+        server's runners (0.0 before any SLO accounting) — the
+        CompactionScheduler's backoff input
+        (livegraph.CompactionScheduler(burn=server.slo_burn))."""
+        worst = 0.0
+        for r in self._runners.values():
+            if r._slo_window:
+                worst = max(worst, sum(r._slo_window)
+                            / len(r._slo_window))
+        return worst
 
     def refresh_live(self) -> None:
         """Adopt the live graph's NEW generation after a compaction:

@@ -196,8 +196,14 @@ SERVE_CHAOS_METRIC = re.compile(
 # compaction.  Contradiction rejects: epochs_advanced > 0 with
 # mutations = 0 (epochs only advance when a mutation batch publishes)
 # and vice versa, cache_hit_fraction outside [0, 1], compactions > 0
-# with peak_occupancy strictly under compact_threshold (the trigger
-# the line claims fired never could have).
+# with peak_occupancy strictly under compact_threshold AND no
+# pending anti-monotone op (neither trigger the line claims could
+# have fired).  Round 21 adds the mutation-algebra counters
+# (deletions / reweights / reseeds / scheduler_compactions) with
+# their own contradictions: a re-seed without any deletion/reweight
+# to re-seed from, algebra ops exceeding the mutation total, and
+# scheduler folds exceeding the compaction count or justified by no
+# evidenceable trigger.
 SERVE_LIVE_METRIC = re.compile(
     r"^serve_live_rmat(\d+)_qps_per_chip$")
 
@@ -662,7 +668,9 @@ def check_serve_live_fields(name: str, obj: dict) -> list[str]:
 
     missing = [k for k in ("mutations", "epochs_advanced",
                            "compactions", "cache_hit_fraction",
-                           "peak_occupancy", "compact_threshold")
+                           "peak_occupancy", "compact_threshold",
+                           "deletions", "reweights", "reseeds",
+                           "scheduler_compactions")
                if k not in obj]
     if missing:
         errs.append(f"{name}: serve-live line missing {missing}")
@@ -671,6 +679,33 @@ def check_serve_live_fields(name: str, obj: dict) -> list[str]:
         errs.append(f"{name}: mutations={muts!r} must be an int "
                     f">= 0")
         muts = None
+    # round-21 mutation-algebra fields: simple int >= 0 counters
+    algebra = {}
+    for k in ("deletions", "reweights", "reseeds",
+              "scheduler_compactions"):
+        v = obj.get(k)
+        if v is not None and (not _int(v) or v < 0):
+            errs.append(f"{name}: {k}={v!r} must be an int >= 0")
+            v = None
+        algebra[k] = v
+    anti = (None
+            if algebra["deletions"] is None
+            or algebra["reweights"] is None
+            else algebra["deletions"] + algebra["reweights"])
+    if algebra["reseeds"] is not None and anti is not None \
+            and algebra["reseeds"] > 0 and anti == 0:
+        errs.append(
+            f"{name}: reseeds={algebra['reseeds']} with "
+            f"deletions=0 and reweights=0 — the anti-monotone "
+            f"re-seed only runs past a published deletion/reweight; "
+            f"a re-seed with nothing to re-seed FROM contradicts "
+            f"the line's own mutation record")
+    if muts is not None and anti is not None and anti > muts:
+        errs.append(
+            f"{name}: deletions+reweights={anti} > "
+            f"mutations={muts} — every deletion/reweight IS a "
+            f"mutation; the algebra counters exceed their own "
+            f"total")
     eps = obj.get("epochs_advanced")
     if eps is not None and (not _int(eps) or eps < 0):
         errs.append(f"{name}: epochs_advanced={eps!r} must be an "
@@ -717,12 +752,30 @@ def check_serve_live_fields(name: str, obj: dict) -> list[str]:
                     f">= 0")
         comp = None
     if comp is not None and comp > 0 and occ is not None \
-            and thr is not None and occ < thr - 1e-9:
+            and thr is not None and occ < thr - 1e-9 \
+            and (anti is None or anti == 0):
         errs.append(
             f"{name}: compactions={comp} but peak_occupancy={occ} "
-            f"never reached compact_threshold={thr} — the trigger "
-            f"the line claims fired could not have; occupancy and "
-            f"the compaction count contradict each other")
+            f"never reached compact_threshold={thr} (and no "
+            f"deletion/reweight was pending) — the trigger the line "
+            f"claims fired could not have; occupancy and the "
+            f"compaction count contradict each other")
+    sched = algebra["scheduler_compactions"]
+    if sched is not None and comp is not None and sched > comp:
+        errs.append(
+            f"{name}: scheduler_compactions={sched} > "
+            f"compactions={comp} — every scheduler fold IS a "
+            f"compaction; the scheduler cannot have folded more "
+            f"often than the log compacted")
+    if sched is not None and sched > 0 and anti is not None \
+            and anti == 0 and occ is not None and thr is not None \
+            and occ < thr - 1e-9:
+        errs.append(
+            f"{name}: scheduler_compactions={sched} with "
+            f"deletions=0, reweights=0 and peak_occupancy={occ} "
+            f"under compact_threshold={thr} — neither scheduler "
+            f"trigger the line can evidence (pending anti-monotone "
+            f"ops, occupancy) could have fired")
     cap = obj.get("delta_capacity")
     if cap is not None and (not _int(cap) or cap < 1):
         errs.append(f"{name}: delta_capacity={cap!r} must be an int "

@@ -116,7 +116,7 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "replica_up", "replica_lost", "failover", "query_shed",
          "brownout", "comm_ledger", "link_calibration",
          "mutation", "epoch_advance", "compact_start", "compact_done",
-         "wal_truncate", "wal_replay"}
+         "wal_truncate", "wal_replay", "reseed", "compact_scheduled"}
 
 # round 19 (communication observatory, lux_tpu/comms.py): the
 # collective primitives a comm_ledger breakdown may name — matching
@@ -127,6 +127,12 @@ COMM_PRIMS = {"ppermute", "all_to_all", "reduce_scatter",
 # a query_shed without these cannot be diagnosed — the serving
 # fleet's typed-rejection contract (lux_tpu/fleet.py)
 QUERY_SHED_REQUIRED = ("qid", "query_kind", "reason")
+
+# round 21 (mutation algebra, lux_tpu/livegraph.py
+# CompactionScheduler): a scheduler compaction must carry the
+# economics that justified it, or the decision cannot be audited
+COMPACT_SCHEDULED_REQUIRED = ("occupancy", "threshold", "delta_count",
+                              "drag_ns", "drag_source", "reason")
 
 # a failover without these cannot name the transition it claims
 FAILOVER_REQUIRED = ("qid", "from_replica", "to_replica")
@@ -796,6 +802,15 @@ def render_run(run, out=sys.stdout) -> list[str]:
     # - a wal_replay that comes up at a LOWER epoch than the trail
     #   already published is a replay-after-crash epoch REGRESSION:
     #   acknowledged mutations vanished
+    # round 21 (mutation algebra): two more ordered audits —
+    # - a ``reseed`` is the anti-monotone revalidation of a deletion
+    #   or weight update; one appearing BEFORE any delete/reweight
+    #   mutation publish on its log (or a wal_replay, which can
+    #   restore pending anti ops from a crashed publisher) re-seeded
+    #   state that had nothing to re-seed — the trail is incoherent
+    # - a ``compact_scheduled`` missing its economics fields
+    #   (COMPACT_SCHEDULED_REQUIRED) is a fold that cannot justify
+    #   itself — the scheduler's decision contract
     muts = by.get("mutation", [])
     for q in qdone:
         if "epoch" not in q:
@@ -821,6 +836,10 @@ def render_run(run, out=sys.stdout) -> list[str]:
     # regression.  No-WAL publishes key on None and no replay can
     # ever pair with them (a replay always carries its path).
     max_epoch_seen: dict = {}
+    # wal keys that have seen a delete/reweight publish (or a
+    # wal_replay, which can restore a crashed publisher's pending
+    # anti ops) — the only trails a reseed may follow
+    anti_published: set = set()
 
     def _saw_epoch(path, e):
         max_epoch_seen[path] = max(max_epoch_seen.get(path, 0), e)
@@ -831,6 +850,25 @@ def render_run(run, out=sys.stdout) -> list[str]:
             e = ev.get("epoch")
             if _is_int(e):
                 _saw_epoch(ev.get("wal"), e)
+            # ``op`` is round 21; its absence means an append-only
+            # round-20 publisher — never an anti op
+            if ev.get("op") in ("delete", "reweight"):
+                anti_published.add(ev.get("wal"))
+        elif k == "reseed":
+            if ev.get("wal") not in anti_published:
+                errs.append(f"{title}: reseed at epoch "
+                            f"{ev.get('epoch')} without any preceding "
+                            f"delete/reweight publish (or wal_replay) "
+                            f"on its log — anti-monotone revalidation "
+                            f"with nothing to revalidate")
+        elif k == "compact_scheduled":
+            missing = [f for f in COMPACT_SCHEDULED_REQUIRED
+                       if f not in ev]
+            if missing:
+                errs.append(f"{title}: compact_scheduled missing "
+                            f"economics field(s) {missing} — a "
+                            f"scheduler fold that cannot justify "
+                            f"itself")
         elif k == "epoch_advance":
             e = ev.get("to_epoch")
             if _is_int(e):
@@ -857,15 +895,40 @@ def render_run(run, out=sys.stdout) -> list[str]:
                             f"mutations vanished)")
             if _is_int(e):
                 _saw_epoch(ev.get("path"), e)
+            anti_published.add(ev.get("path"))
     if muts:
         edges = sum(m.get("edges", 0) for m in muts
                     if _is_int(m.get("edges")))
         advances = len(by.get("epoch_advance", []))
         occ = max((m.get("occupancy", 0) for m in muts
                    if _is_num(m.get("occupancy"))), default=0)
+        n_del = sum(1 for m in muts if m.get("op") == "delete")
+        n_rew = sum(1 for m in muts if m.get("op") == "reweight")
+        mix = (f" ({n_del} delete, {n_rew} reweight batch(es))"
+               if (n_del or n_rew) else "")
         print(f"  live graph: {edges} edge(s) over {len(muts)} "
-              f"mutation batch(es), {advances} epoch advance(s), "
+              f"mutation batch(es){mix}, {advances} epoch advance(s), "
               f"peak delta occupancy {occ}", file=out)
+    reseeds = by.get("reseed", [])
+    if reseeds:
+        fb = sum(1 for r in reseeds if r.get("fallback"))
+        cone = max((r.get("cone", 0) for r in reseeds
+                    if _is_int(r.get("cone"))), default=0)
+        print(f"  re-seed: {len(reseeds)} anti-monotone "
+              f"revalidation(s), peak cone {cone} vertex(ices), "
+              f"{fb} full-recompute fallback(s)", file=out)
+    scheds = by.get("compact_scheduled", [])
+    if scheds:
+        reasons = {}
+        for s_ in scheds:
+            r_ = s_.get("reason", "?")
+            reasons[r_] = reasons.get(r_, 0) + 1
+        mix = ", ".join(f"{v} {k}" for k, v in sorted(reasons.items()))
+        drag = max((s_.get("drag_ns", 0) for s_ in scheds
+                    if _is_num(s_.get("drag_ns"))), default=0)
+        print(f"  compaction scheduler: {len(scheds)} fold(s) "
+              f"scheduled ({mix}), peak delta drag {drag} "
+              f"ns/boundary", file=out)
     if by.get("compact_start") or compacts_done:
         folded = sum(c.get("folded", 0)
                      for c in by.get("compact_done", [])

@@ -59,18 +59,22 @@ def fsck_wal(path: str, nv: int | None = None) -> str | None:
     message otherwise."""
     from lux_tpu.livegraph import (MutationLog, MutationLogError,
                                    REC_COMPACT_DONE,
-                                   REC_COMPACT_START, REC_EDGE)
+                                   REC_COMPACT_START, REC_DELETE,
+                                   REC_EDGE, REC_REWEIGHT)
 
     try:
         recs, hnv, cap, torn = MutationLog.scan(path, nv=nv)
+        _hnv2, _cap2, ver = luxfmt.read_wal_header(path, nv=nv)
     except MutationLogError as e:
         return f"[{e.check}] {e.detail}"
     except luxfmt.GraphFormatError as e:
         return f"[{e.check}] {e.detail}"
     except (OSError, ValueError) as e:
         return f"[wal unreadable] {type(e).__name__}: {e}"
-    # scan validates chain/epochs/kinds; the bracket pairing is the
-    # replay loop's invariant — check it at rest too
+    # scan validates chain/epochs/kinds (a v2 mutation kind inside a
+    # v1 header is typed record_kind corruption — the kind set is
+    # part of the header version's contract); the bracket pairing is
+    # the replay loop's invariant — check it at rest too
     pending = 0
     for r in recs:
         if r.kind == REC_COMPACT_START:
@@ -82,10 +86,14 @@ def fsck_wal(path: str, nv: int | None = None) -> str | None:
                         f"COMPACT_START")
             pending -= 1
     edges = sum(1 for r in recs if r.kind == REC_EDGE)
+    dels = sum(1 for r in recs if r.kind == REC_DELETE)
+    rews = sum(1 for r in recs if r.kind == REC_REWEIGHT)
     epoch = max((r.epoch for r in recs), default=0)
     tornmsg = f" TORN-TAIL={torn}B (recoverable)" if torn else ""
-    print(f"{path}: OK wal nv={hnv} capacity={cap} records={len(recs)} "
-          f"edges={edges} epoch={epoch}"
+    mut = (f" deletes={dels} reweights={rews}"
+           if (dels or rews or ver >= 2) else "")
+    print(f"{path}: OK wal v{ver} nv={hnv} capacity={cap} "
+          f"records={len(recs)} edges={edges}{mut} epoch={epoch}"
           f"{' open-compaction' if pending else ''}{tornmsg}")
     return None
 

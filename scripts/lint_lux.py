@@ -17,7 +17,12 @@ be seen from a jaxpr (CLAUDE.md "Conventions"):
   oracle        Every app module (lux_tpu/apps/*.py) must define a
                 top-level NumPy oracle named ``reference_*`` — the
                 "new device code gets an oracle test first"
-                convention.
+                convention.  Round 21: deletion-capable builders
+                (``*decremental*``, ``delete_edges``,
+                ``reweight_edges``) anywhere in the library tree must
+                define or cite a ``reference_*decremental`` oracle —
+                anti-monotone mutations are proved equal to full
+                recompute at the same epoch.
   citation      Every module in lux_tpu/engine/ and lux_tpu/ops/
                 must cite the reference implementation (a
                 ``file:line`` pattern like ``pull_model.inl:423``) in
@@ -441,6 +446,45 @@ def check_oracle(path, tree, lines):
     return findings
 
 
+def check_decremental_oracle(path, tree, lines):
+    """Round 21 (mutation algebra): a deletion-capable builder — any
+    def with ``decremental`` in its name, or named ``delete_edges`` /
+    ``reweight_edges`` — must be provable against a decremental NumPy
+    oracle: the module defines a ``reference_*decremental`` function
+    or cites one (apps/sssp.reference_sssp_decremental,
+    apps/components.reference_components_decremental).  Anti-monotone
+    re-seed results (lux_tpu/livegraph.py) are proved equal to full
+    recompute at the same epoch — deletion code with no decremental
+    reference cannot carry that proof.  Same shape as the incremental
+    rule above; ast.walk because the builders are METHODS."""
+    decr_defs = [n for n in ast.walk(tree)
+                 if isinstance(n, ast.FunctionDef)
+                 and ("decremental" in n.name
+                      or n.name in ("delete_edges", "reweight_edges"))
+                 and not n.name.startswith("reference_")]
+    if not decr_defs:
+        return []
+    has_decr_oracle = any(
+        isinstance(n, ast.FunctionDef)
+        and n.name.startswith("reference_")
+        and "decremental" in n.name
+        for n in ast.walk(tree)) or bool(
+            re.search(r"reference_\w*decremental", "\n".join(lines)))
+    findings = []
+    for n in decr_defs:
+        if has_decr_oracle or _suppressed(lines, n.lineno, "oracle"):
+            continue
+        findings.append(Finding(
+            path, n.lineno, "oracle",
+            f"{n.name} is a deletion-capable builder but the module "
+            f"neither defines nor cites a reference_*decremental "
+            f"NumPy oracle — anti-monotone mutations must be proved "
+            f"equal to full recompute at the same epoch (CLAUDE.md "
+            f"convention; lux_tpu/livegraph.py round 21)"))
+        break
+    return findings
+
+
 # ---------------------------------------------------------------------
 # check: citation presence
 
@@ -689,6 +733,9 @@ def lint_file(path: str):
         findings += check_collective_scope(path, tree, lines)
     if "/lux_tpu/apps/" in norm:
         findings += check_oracle(path, tree, lines)
+    # decremental rule runs TREE-WIDE: the deletion-capable builders
+    # live in lux_tpu/livegraph.py, not under apps/
+    findings += check_decremental_oracle(path, tree, lines)
     if "/lux_tpu/engine/" in norm or "/lux_tpu/ops/" in norm:
         findings += check_citation(path, tree, lines)
     if "/lux_tpu/engine/" in norm:

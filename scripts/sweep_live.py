@@ -25,9 +25,21 @@ under a mutation stream.  This sweep MEASURES that claim on CPU
 Timing fences with host fetches of the results (jax.device_get), the
 round-3 discipline; medians of -reps timed runs with MAD spread.
 
+Round 21 (the mutation algebra): ``-mode delete`` sweeps DELETION
+fractions instead — per point it deletes ``max(1, f * ne)`` random
+base edges and times the anti-monotone cone RE-SEED
+(``LiveGraph.revalidate`` dispatching to ``_revalidate_anti``: host
+re-seed of the forward-reachability cone from the deleted edges'
+destinations, then the compiled converge) against the full recompute
+it must bitwise-equal, reporting the measured cone fraction and
+whether the cone cap forced the full-recompute fallback.  The
+on-device deletion path is carried as debt
+``live-deletion-on-device`` (lux_tpu/observe.py).
+
 Usage:
     PYTHONPATH=. python scripts/sweep_live.py [-scale N] [-ef E]
-        [-np P] [-kind sssp|components] [-fracs f1,f2,...] [-reps R]
+        [-np P] [-kind sssp|components] [-mode append|delete]
+        [-fracs f1,f2,...] [-reps R]
 """
 
 from __future__ import annotations
@@ -115,6 +127,91 @@ def sweep_point(g, eng, lab0, act0, frac, *, kind, num_parts, reps,
             float("inf")}
 
 
+def _forward_cone(g_new, seeds):
+    """Forward reachability from ``seeds`` over ``g_new`` — the same
+    rule ``_revalidate_anti`` re-seeds by, recomputed here so the
+    sweep can REPORT the cone it measured."""
+    reach = np.zeros(g_new.nv, bool)
+    frontier = np.unique(np.asarray(seeds))
+    reach[frontier] = True
+    s_a, d_a = g_new.edge_arrays()
+    while frontier.size:
+        nxt = np.unique(d_a[np.isin(s_a, frontier)])
+        nxt = nxt[~reach[nxt]]
+        reach[nxt] = True
+        frontier = nxt
+    return int(reach.sum())
+
+
+def sweep_delete_point(g, eng, lab0, act0, frac, *, kind, num_parts,
+                       reps, seed):
+    """One DELETION-fraction point (round 21).  Times the
+    anti-monotone re-seed (place the old converged state onto an
+    engine over ``graph_at(target)``, then ``revalidate``) against
+    ``init_state + converge`` on the same engine, after proving the
+    two fixed points bitwise-equal."""
+    import jax
+
+    from lux_tpu import timing
+    from lux_tpu.livegraph import LiveGraph
+    from lux_tpu.apps import components, sssp
+
+    m = max(1, int(frac * g.ne))
+    rng = np.random.default_rng(seed)
+    idx = rng.choice(g.ne, size=min(m, g.ne), replace=False)
+    esrc, edst = g.edge_arrays()
+    live = LiveGraph(g, capacity=len(idx))
+    live.delete_edges(esrc[idx], edst[idx])
+    g_new = live.graph_at(live.epoch)
+    app = sssp if kind == "sssp" else components
+    eng_t = (app.build_engine(g_new, 0, num_parts=num_parts)
+             if kind == "sssp"
+             else app.build_engine(g_new, num_parts=num_parts))
+    old_h = eng.sg.from_padded(np.asarray(jax.device_get(lab0)))
+    zeros = np.zeros(g.nv, bool)
+
+    def reseed():
+        lab, act = eng_t.place(eng_t.sg.to_padded(old_h),
+                               eng_t.sg.to_padded(zeros))
+        return live.revalidate(eng_t, lab, act)
+
+    # warm both sides (compile excluded), then the proof obligation
+    rlab, _ract, _ = reseed()
+    flab, fact = eng_t.init_state()
+    flab, fact, _ = eng_t.converge(flab, fact)
+    r_h = eng_t.sg.from_padded(np.asarray(jax.device_get(rlab)))
+    f_h = eng_t.sg.from_padded(np.asarray(jax.device_get(flab)))
+    if not np.array_equal(r_h, f_h):
+        raise AssertionError(
+            f"frac={frac}: re-seeded fixed point differs from full "
+            f"recompute — a fast wrong repair is not a speedup")
+    cone = _forward_cone(g_new, edst[idx])
+    fell_back = live.reseed_fallbacks > 0
+
+    timing.fence(rlab)
+    t_rs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        rl, _ra, _ = reseed()
+        timing.fence(rl)
+        t_rs.append((time.perf_counter() - t0) * 1e3)
+    t_full = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fl, fa = eng_t.init_state()
+        fl, fa, _ = eng_t.converge(fl, fa)
+        timing.fence(fl)
+        t_full.append((time.perf_counter() - t0) * 1e3)
+    rs_med, rs_mad = _median_mad(t_rs)
+    full_med, full_mad = _median_mad(t_full)
+    return {"frac": frac, "edges": len(idx),
+            "cone_frac": cone / g.nv, "fallback": fell_back,
+            "reseed_ms": rs_med, "reseed_mad": rs_mad,
+            "full_ms": full_med, "full_mad": full_mad,
+            "speedup": full_med / rs_med if rs_med > 0 else
+            float("inf")}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="incremental-vs-full revalidation sweep "
@@ -124,10 +221,20 @@ def main(argv=None) -> int:
     ap.add_argument("-np", type=int, default=2, dest="num_parts")
     ap.add_argument("-kind", default="sssp",
                     choices=["sssp", "components"])
-    ap.add_argument("-fracs", default="0.0005,0.002,0.01,0.05,0.2")
+    ap.add_argument("-mode", default="append",
+                    choices=["append", "delete"])
+    ap.add_argument("-fracs", default=None,
+                    help="touched fractions (default depends on "
+                         "-mode: deletions cone out fast on "
+                         "scale-free graphs, so the delete sweep "
+                         "defaults to smaller points)")
     ap.add_argument("-reps", type=int, default=5)
     ap.add_argument("-seed", type=int, default=7)
     args = ap.parse_args(argv)
+    if args.fracs is None:
+        args.fracs = ("0.0005,0.002,0.01,0.05,0.2"
+                      if args.mode == "append"
+                      else "0.00001,0.0001,0.001,0.01")
 
     from lux_tpu import convert
     from lux_tpu.graph import Graph
@@ -145,17 +252,34 @@ def main(argv=None) -> int:
     lab0, act0 = eng.init_state()
     lab0, act0, _ = eng.converge(lab0, act0)
 
-    print(f"# sweep_live kind={args.kind} rmat{args.scale} "
-          f"ef{args.ef} nv={g.nv} ne={g.ne} np={args.num_parts} "
-          f"reps={args.reps}")
-    print(f"{'frac':>8} {'edges':>8} {'incr_ms':>10} {'full_ms':>10} "
-          f"{'speedup':>8}")
+    print(f"# sweep_live kind={args.kind} mode={args.mode} "
+          f"rmat{args.scale} ef{args.ef} nv={g.nv} ne={g.ne} "
+          f"np={args.num_parts} reps={args.reps}")
+    if args.mode == "append":
+        print(f"{'frac':>8} {'edges':>8} {'incr_ms':>10} "
+              f"{'full_ms':>10} {'speedup':>8}")
+        for i, f in enumerate(fracs):
+            r = sweep_point(g, eng, lab0, act0, f, kind=args.kind,
+                            num_parts=args.num_parts,
+                            reps=args.reps,
+                            seed=args.seed + 100 + i)
+            print(f"{r['frac']:>8g} {r['edges']:>8d} "
+                  f"{r['inc_ms']:>7.1f}±{r['inc_mad']:<4.1f} "
+                  f"{r['full_ms']:>7.1f}±{r['full_mad']:<4.1f} "
+                  f"{r['speedup']:>7.2f}x")
+        return 0
+    print(f"{'frac':>8} {'edges':>7} {'cone':>7} {'fb':>3} "
+          f"{'reseed_ms':>11} {'full_ms':>10} {'speedup':>8}")
     for i, f in enumerate(fracs):
-        r = sweep_point(g, eng, lab0, act0, f, kind=args.kind,
-                        num_parts=args.num_parts, reps=args.reps,
-                        seed=args.seed + 100 + i)
-        print(f"{r['frac']:>8g} {r['edges']:>8d} "
-              f"{r['inc_ms']:>7.1f}±{r['inc_mad']:<4.1f} "
+        r = sweep_delete_point(g, eng, lab0, act0, f,
+                               kind=args.kind,
+                               num_parts=args.num_parts,
+                               reps=args.reps,
+                               seed=args.seed + 200 + i)
+        print(f"{r['frac']:>8g} {r['edges']:>7d} "
+              f"{r['cone_frac']:>6.1%} "
+              f"{'Y' if r['fallback'] else 'n':>3} "
+              f"{r['reseed_ms']:>8.1f}±{r['reseed_mad']:<4.1f} "
               f"{r['full_ms']:>7.1f}±{r['full_mad']:<4.1f} "
               f"{r['speedup']:>7.2f}x")
     return 0

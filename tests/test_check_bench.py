@@ -632,6 +632,9 @@ SERVE_LIVE_LINE = {
     "submitted": 36, "served": 36,
     "mutations": 72, "mutation_rate_per_s": 18.3,
     "epochs_advanced": 6, "compactions": 1,
+    # round 21: the mutation-algebra record rides on every line
+    "deletions": 3, "reweights": 2, "reseeds": 2,
+    "scheduler_compactions": 1,
     "cache_hit_fraction": 0.4615, "peak_occupancy": 0.75,
     "telemetry": {"runs": [{"repeat": 0, "iters": 36,
                             "seconds": 3.91}],
@@ -656,13 +659,31 @@ def test_serve_live_line_passes_strict(tmp_path):
      "cache_hit_fraction"),
     (lambda o: o.update(cache_hit_fraction=-0.1),
      "cache_hit_fraction"),
-    (lambda o: o.update(peak_occupancy=0.3),
+    # sub-threshold occupancy only contradicts a compaction when no
+    # anti-monotone op could have triggered the fold instead
+    (lambda o: o.update(peak_occupancy=0.3, deletions=0,
+                        reweights=0, reseeds=0),
      "never reached compact_threshold"),
+    # round-21 mutation-algebra contradictions
+    (lambda o: o.update(deletions=0, reweights=0),
+     "nothing to re-seed FROM"),
+    (lambda o: o.update(deletions=100),
+     "the algebra counters exceed"),
+    (lambda o: o.update(scheduler_compactions=5),
+     "cannot have folded more"),
+    (lambda o: o.update(deletions=0, reweights=0, reseeds=0,
+                        peak_occupancy=0.3),
+     "neither scheduler trigger"),
     # record completeness + types
     (lambda o: o.pop("mutations"), "serve-live line missing"),
     (lambda o: o.pop("compactions"), "serve-live line missing"),
     (lambda o: o.pop("peak_occupancy"), "serve-live line missing"),
+    (lambda o: o.pop("deletions"), "serve-live line missing"),
+    (lambda o: o.pop("scheduler_compactions"),
+     "serve-live line missing"),
     (lambda o: o.update(compactions=-1), "compactions"),
+    (lambda o: o.update(reseeds=-1), "reseeds"),
+    (lambda o: o.update(deletions="some"), "deletions"),
     (lambda o: o.update(peak_occupancy=1.5), "peak_occupancy"),
     (lambda o: o.update(compact_threshold=0.0), "compact_threshold"),
     (lambda o: o.update(delta_capacity=0), "delta_capacity"),
@@ -683,7 +704,9 @@ def test_serve_live_quiet_run_ok(tmp_path):
     when nothing compacted."""
     obj = json.loads(json.dumps(SERVE_LIVE_LINE))
     obj.update(mutations=0, epochs_advanced=0, compactions=0,
-               peak_occupancy=0.0, mutation_rate_per_s=0.0)
+               peak_occupancy=0.0, mutation_rate_per_s=0.0,
+               deletions=0, reweights=0, reseeds=0,
+               scheduler_compactions=0)
     r = _audit_one(tmp_path, obj)
     assert r.returncode == 0, r.stderr
 

@@ -917,3 +917,110 @@ def test_cross_process_replay_clean(tmp_path):
     write_log(p, _crash_recovery_streams(replay_epoch=3))
     r = run_summary(p)
     assert r.returncode == 0, r.stderr
+
+
+# ---------------------------------------------------------------------
+# round 21: the mutation-algebra trail — re-seed pairing + scheduler
+# economics audits (lux_tpu/livegraph.py deletions/reweights +
+# CompactionScheduler)
+
+
+def _algebra_run(extra=(), drop=(), patch=None):
+    base = {"pid": 1, "session": "s"}
+    evs = [
+        dict(base, t=1.0, tm=1.0, kind="run_start", schema=1,
+             app="live"),
+        dict(base, t=1.1, tm=1.1, kind="mutation", edges=4, epoch=1,
+             delta_count=4, occupancy=0.25, wal="/tmp/g.wal"),
+        dict(base, t=1.11, tm=1.11, kind="epoch_advance",
+             from_epoch=0, to_epoch=1, wal="/tmp/g.wal"),
+        dict(base, t=1.2, tm=1.2, kind="mutation", op="delete",
+             edges=1, epoch=2, delta_count=5, occupancy=0.3125,
+             wal="/tmp/g.wal"),
+        dict(base, t=1.21, tm=1.21, kind="epoch_advance",
+             from_epoch=1, to_epoch=2, wal="/tmp/g.wal"),
+        dict(base, t=1.3, tm=1.3, kind="reseed", epoch=2, cone=37,
+             cone_frac=0.1445, fallback=False, anti=1,
+             wal="/tmp/g.wal"),
+        dict(base, t=1.4, tm=1.4, kind="compact_scheduled",
+             action="compact", reason="anti_monotone",
+             occupancy=0.3125, threshold=0.5, delta_count=5,
+             anti_pending=1, drag_ns=44.8, drag_source="modeled",
+             admitted=0, pins=0, burn=0.0),
+        dict(base, t=1.5, tm=1.5, kind="compact_start", epoch=2,
+             generation=1, delta_count=5, occupancy=0.3125),
+        dict(base, t=1.6, tm=1.6, kind="compact_done", epoch=2,
+             generation=1, folded=5, ne=903),
+        dict(base, t=2.0, tm=2.0, kind="run_done", seconds=1.0,
+             iters=4),
+    ]
+    evs = [e for e in evs if e["kind"] not in drop]
+    if patch:
+        for e in evs:
+            patch(e)
+    evs.extend(extra)
+    evs.sort(key=lambda e: e["tm"])
+    return evs
+
+
+def test_algebra_trail_renders_clean(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _algebra_run())
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "(1 delete, 0 reweight batch(es))" in r.stdout
+    assert ("re-seed: 1 anti-monotone revalidation(s), peak cone 37 "
+            "vertex(ices), 0 full-recompute fallback(s)") in r.stdout
+    assert ("compaction scheduler: 1 fold(s) scheduled "
+            "(1 anti_monotone)") in r.stdout
+
+
+def test_reseed_without_anti_publish_fails(tmp_path):
+    """A re-seed with no preceding delete/reweight publish (or WAL
+    replay) on its log has nothing to revalidate — the trail claims
+    a repair it never journaled a cause for."""
+    def patch(e):
+        if e["kind"] == "mutation" and e.get("op") == "delete":
+            del e["op"]                 # now a plain append
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _algebra_run(patch=patch))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "without any preceding delete/reweight publish" \
+        in r.stderr
+
+
+def test_reseed_after_wal_replay_ok(tmp_path):
+    """Recovery re-seeds anti ops it REPLAYED rather than published
+    — a wal_replay on the same path justifies the re-seed, exactly
+    like the cross-process epoch audit pairs on the log path."""
+    base = {"pid": 2, "session": "r"}
+    evs = [
+        dict(base, t=1.0, tm=1.0, kind="run_start", schema=1,
+             app="live"),
+        dict(base, t=1.1, tm=1.1, kind="wal_replay",
+             path="/tmp/g.wal", records=5, epoch=2, generation=0,
+             truncated_bytes=0, delta_count=5),
+        dict(base, t=1.2, tm=1.2, kind="reseed", epoch=2, cone=12,
+             cone_frac=0.05, fallback=True, anti=1,
+             wal="/tmp/g.wal"),
+        dict(base, t=2.0, tm=2.0, kind="run_done", seconds=1.0,
+             iters=0),
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, evs)
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert "1 full-recompute fallback(s)" in r.stdout
+
+
+def test_compact_scheduled_missing_economics_fails(tmp_path):
+    def patch(e):
+        if e["kind"] == "compact_scheduled":
+            del e["drag_ns"]
+            del e["drag_source"]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _algebra_run(patch=patch))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "cannot justify itself" in r.stderr

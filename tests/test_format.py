@@ -228,8 +228,14 @@ def test_wal_header_roundtrip():
     head = luxfmt.pack_wal_header(1234, 64)
     assert len(head) == luxfmt.WAL_HEADER_SIZE
     assert head[:4] == luxfmt.WAL_MAGIC
-    nv, cap = luxfmt.read_wal_header("<mem>", head=head)
-    assert (nv, cap) == (1234, 64)
+    nv, cap, ver = luxfmt.read_wal_header("<mem>", head=head)
+    assert (nv, cap, ver) == (1234, 64, luxfmt.WAL_VERSION)
+    # the v2 reader still reads v1 headers (round-21 compat contract)
+    head1 = luxfmt.pack_wal_header(1234, 64, version=1)
+    nv, cap, ver = luxfmt.read_wal_header("<mem>", head=head1)
+    assert (nv, cap, ver) == (1234, 64, 1)
+    with pytest.raises(ValueError, match="unknown WAL version"):
+        luxfmt.pack_wal_header(1234, 64, version=99)
     # the nv cross-check: a log from a DIFFERENT graph is typed
     with pytest.raises(luxfmt.GraphFormatError) as ei:
         luxfmt.read_wal_header("<mem>", nv=1235, head=head)

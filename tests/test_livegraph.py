@@ -1,6 +1,13 @@
 """lux_tpu/livegraph.py: live graphs — crash-consistent mutation log,
 snapshot-isolated epochs, incremental revalidation, chaos-drilled
-compaction (ISSUE 15, round 20).
+compaction (ISSUE 15, round 20), and the FULL mutation algebra
+(ISSUE 16, round 21): edge deletions + weight updates (v2 WAL
+records, v1 bitwise compat), the anti-monotone re-seed proved equal
+to full recompute at the same epoch (bitwise for the integer apps)
+against the decremental oracles, pull-kind incremental revalidation
+(pagerank epochs advance WITHOUT a fold), and the economics-driven
+CompactionScheduler soak-proven on mesh8 under seeded mixed traffic
+with bounded occupancy and zero delta_full sheds.
 
 THE chaos acceptance: oversubscribed mixed-kind open-loop loadgen
 traffic on the 8-virtual-device mesh with a LIVE mutation stream
@@ -76,12 +83,33 @@ def _clamp_ref(ref):
 
 
 def _wal_state(lg: LiveGraph):
-    """Everything the WAL-replay bitwise contract covers."""
+    """Everything the WAL-replay bitwise contract covers.  Round-21
+    leaves (d_kind, the deletion/reweight counters) append at the
+    END — test_wal_torn_fault_mid_append slices positionally."""
     return (lg.base.row_ptrs.copy(), lg.base.col_idx.copy(),
             None if lg.base.weights is None else lg.base.weights.copy(),
             lg.d_src.copy(), lg.d_dst.copy(), lg.d_w.copy(),
             lg.d_epoch.copy(), lg.count, lg.epoch, lg.base_epoch,
-            lg.generation, lg.compactions)
+            lg.generation, lg.compactions, lg.d_kind.copy(),
+            lg.deletions, lg.reweights)
+
+
+def _live_edge(g, i: int = 0):
+    """The i-th base edge — a guaranteed-live deletion/reweight
+    target at epoch 0."""
+    src, dst = g.edge_arrays()
+    return int(src[i]), int(dst[i])
+
+
+def _phantom_edge(g):
+    """A (src, dst) pair that is NOT an edge of g."""
+    src, dst = g.edge_arrays()
+    have = set(zip(src.tolist(), dst.tolist()))
+    for s in range(g.nv):
+        for d in range(g.nv):
+            if (s, d) not in have:
+                return s, d
+    raise AssertionError("complete graph")
 
 
 def _assert_state_equal(a, b):
@@ -816,23 +844,37 @@ class TestServeLive:
         assert check_live_answers(lg, [r1]) == 1, \
             "the oracle harness failed to flag a stale-epoch answer"
 
-    def test_pagerank_pins_base_generation(self, g):
+    def test_pagerank_advances_epochs_without_fold(self, g):
+        """Round 21 (pull-kind incremental revalidation): appends
+        advance the PULL admission epoch with NO compaction — the
+        engine normalizes by effective degree (the deg_corr program
+        array) and the drain hook adds the delta appends' rank mass
+        per column's admission epoch, together one exact PPR
+        iteration over graph_at(epoch)."""
         lg = LiveGraph(g, capacity=32)
         srv = self._server(g, lg)
         s1, d1 = _mutations(g.nv, 6, 64)
         srv.mutate(s1, d1)
         srv.submit("pagerank", source=5)
         (r,) = srv.run()
-        # pull kinds pin the BASE generation epoch, not the delta's
-        assert r.epoch == 0
+        assert r.epoch == 1 and lg.compactions == 0
         assert check_live_answers(lg, [r]) == 0
-        # after compaction + adoption the pull view advances
-        lg.compact(force=True)
-        srv.refresh_live()
+        # a DELETION caps pull admission below its epoch — the host
+        # correction is append-linear and cannot express an
+        # anti-monotone op
+        ds, dd = _live_edge(g, 3)
+        srv.mutate([ds], [dd], op="delete")
         srv.submit("pagerank", source=5)
         (r2,) = srv.run()
         assert r2.epoch == 1
         assert check_live_answers(lg, [r2]) == 0
+        # the fold + adoption advances past the deletion
+        lg.compact(force=True)
+        srv.refresh_live()
+        srv.submit("pagerank", source=5)
+        (r3,) = srv.run()
+        assert r3.epoch == 2
+        assert check_live_answers(lg, [r3]) == 0
 
     def test_refresh_live_guards_and_delta_full(self, g):
         lg = LiveGraph(g, capacity=4)
@@ -933,6 +975,47 @@ class TestServeLive:
         other = g.with_edges([1], [2])
         with pytest.raises(ValueError, match="live.base"):
             self._server(other, lg)
+
+    def test_drag_samples_feed_scheduler_economics(self, g):
+        """The serve runners fence-time every Nth delta boundary and
+        feed it to the live graph (round 21) — after a few live
+        drains the scheduler's economics run on MEASURED drag, not
+        the scalemodel term."""
+        lg = LiveGraph(g, capacity=64)
+        srv = self._server(g, lg, batch=4)
+        s1, d1 = _mutations(g.nv, 10, 71)
+        srv.mutate(s1, d1)
+        for q in range(4):
+            srv.submit("sssp", source=q + 1)
+        responses = srv.run()
+        assert check_live_answers(lg, responses) == 0
+        assert len(lg._drag_samples) >= 1
+        eco = lg.compact_economics()
+        assert eco["drag_source"] == "measured"
+        assert eco["drag_samples"] >= 1
+        assert eco["delta_drag_ns_per_boundary"] > 0
+
+    def test_mutate_routes_the_algebra(self, g, gw):
+        """Server.mutate is the single ingest door for all three
+        ops; an unknown op refuses typed."""
+        lg = LiveGraph(g, capacity=16)
+        srv = self._server(g, lg)
+        es, ed = _live_edge(g, 2)
+        srv.mutate([es], [ed], op="delete")
+        assert lg.deletions == 1 and lg.epoch == 1
+        with pytest.raises(ValueError, match="unknown mutation op"):
+            srv.mutate([1], [2], op="merge")
+        lgw = LiveGraph(gw, capacity=16)
+        srvw = self._server(gw, lgw, weighted=True)
+        rs, rd = _live_edge(gw, 4)
+        srvw.mutate([rs], [rd], weights=[1.25], op="reweight")
+        assert lgw.reweights == 1
+        # the admission cap is live through the serving door too
+        assert lgw.view_epoch("push") == 0
+        srvw.submit("sssp", source=3)
+        (r,) = srvw.run()
+        assert r.epoch == 0
+        assert check_live_answers(lgw, [r], weighted=True) == 0
 
 
 class TestFleetLive:
@@ -1051,6 +1134,584 @@ class TestFleetLive:
             assert ei.value.qid in {e.qid for e in flt.shed_records}
         sheds = [e for e in ev.events if e["kind"] == "query_shed"]
         assert sheds and sheds[0]["reason"] == "delta_full"
+
+
+# ---------------------------------------------------------------------
+# round 21: the mutation algebra — v2 WAL records, version compat
+
+
+class TestMutationAlgebraLog:
+    def test_wal_v2_roundtrip_bitwise(self, gw, tmp_path):
+        """Deletes + reweights journal as v2 records and recover
+        BITWISE — including the d_kind block, the op counters, and
+        the pending-anti admission cap."""
+        wal = str(tmp_path / "g.lux.wal")
+        lg = LiveGraph(gw, capacity=64, wal_path=wal)
+        s1, d1 = _mutations(gw.nv, 5, 1)
+        rng = np.random.default_rng(7)
+        lg.append_edges(s1, d1,
+                        rng.uniform(0.5, 4.0, 5).astype(np.float32))
+        es, ed = _live_edge(gw, 3)
+        lg.delete_edges([es], [ed])
+        rs, rd = _live_edge(gw, 10)
+        lg.reweight_edges([rs], [rd], [2.25])
+        assert lg.deletions == 1 and lg.reweights == 1
+        assert lg.anti_pending() == 2
+        want = _wal_state(lg)
+        lg.close()
+        lg2 = LiveGraph.recover(gw, wal)
+        _assert_state_equal(_wal_state(lg2), want)
+        # recovery restores the ANTI ledger: admission stays capped
+        # below the earliest pending deletion for BOTH families
+        assert lg2.anti_pending() == 2
+        assert lg2.view_epoch("push") == 1
+        assert lg2.view_epoch("pull") == 1
+        # the oracle surfaces agree bitwise at every epoch
+        for e in range(lg2.epoch + 1):
+            a, b = lg.graph_at(e), lg2.graph_at(e)
+            np.testing.assert_array_equal(a.col_idx, b.col_idx)
+            np.testing.assert_array_equal(a.weights, b.weights)
+        lg2.close()
+
+    def test_wal_v1_replays_bitwise_under_v2_reader(self, g,
+                                                    tmp_path):
+        """Version compat: a v1 (round-20, append-only) log replays
+        bitwise under the round-21 reader, the recovered log RESUMES
+        at the HEADER's version, and the v2 kinds refuse typed
+        against it — never silently journaling a record a v1 reader
+        would reject as corruption."""
+        wal = str(tmp_path / "g.lux.wal")
+        log = MutationLog(wal, g.nv, 16, version=1)
+        log.append_edge(1, 1, 2, 0)
+        log.append_edge(2, 3, 4, 0)
+        log.close()
+        assert luxfmt.read_wal_header(wal, nv=g.nv)[2] == 1
+        lg = LiveGraph.recover(g, wal)
+        assert lg.count == 2 and lg.epoch == 2
+        np.testing.assert_array_equal(lg.d_src[:2], [1, 3])
+        np.testing.assert_array_equal(lg.d_kind[:2], [0, 0])
+        assert lg.anti_pending() == 0
+        # appends keep chaining onto the resumed v1 log ...
+        lg.append_edges([5], [6])
+        assert lg.epoch == 3
+        # ... but the v2 mutation kinds refuse typed (the kind set
+        # is part of the header version's contract)
+        with pytest.raises(MutationLogError) as ei:
+            lg.delete_edges([1], [2])
+        assert ei.value.check == "record_kind"
+        # the refusal journaled NOTHING: state unchanged, replayable
+        assert lg.epoch == 3 and lg.deletions == 0
+        lg.close()
+        lg2 = LiveGraph.recover(g, wal)
+        assert lg2.count == 3 and lg2.epoch == 3
+        lg2.close()
+
+    def test_v2_kind_inside_v1_header_is_corruption(self, g,
+                                                    tmp_path):
+        """A DELETE record inside a v1-headed log at rest is typed
+        record_kind corruption — scan enforces the header version's
+        kind set, so a v1 reader and the v2 reader agree the file is
+        bad rather than disagreeing on its meaning."""
+        from lux_tpu.livegraph import REC_DELETE, _pack_record
+        wal = str(tmp_path / "g.lux.wal")
+        log = MutationLog(wal, g.nv, 16, version=1)
+        log.append_edge(1, 1, 2, 0)
+        log._append(_pack_record(2, REC_DELETE, 1, 2, 0, log._crc))
+        log.close()
+        with pytest.raises(MutationLogError) as ei:
+            MutationLog.scan(wal)
+        assert ei.value.check == "record_kind"
+
+    @pytest.mark.parametrize("op", ["delete", "reweight"])
+    def test_torn_tail_and_rot_per_new_kind(self, gw, tmp_path, op):
+        """Per new record kind: a torn tail is recoverable (strict
+        prefix, truncated deterministically), a FULL-SIZE bad-CRC
+        final record is hard corruption — same taxonomy as the
+        round-20 append records."""
+        wal = str(tmp_path / "g.lux.wal")
+        lg = LiveGraph(gw, capacity=16, wal_path=wal)
+        s0, d0 = _live_edge(gw, 2)
+        if op == "delete":
+            lg.delete_edges([s0], [d0])
+        else:
+            lg.reweight_edges([s0], [d0], [3.5])
+        want = _wal_state(lg)
+        lg.close()
+        good = open(wal, "rb").read()
+        faults.tear_wal(wal, keep_bytes=9)
+        recs, _nv, _cap, torn = MutationLog.scan(wal, nv=gw.nv)
+        assert len(recs) == 1 and torn == 9
+        lg2 = LiveGraph.recover(gw, wal)
+        _assert_state_equal(_wal_state(lg2), want)
+        assert lg2.anti_pending() == 1
+        lg2.close()
+        # full-size rot INSIDE the mutation record: typed crc_chain
+        blob = bytearray(good)
+        blob[-10] ^= 0xFF
+        open(wal, "wb").write(bytes(blob))
+        with pytest.raises(MutationLogError) as ei:
+            MutationLog.scan(wal)
+        assert ei.value.check == "crc_chain"
+
+    @pytest.mark.parametrize("action,op", [
+        (faults.MUT_DELETE, "delete"),
+        (faults.MUT_REWEIGHT, "reweight")])
+    def test_mut_delete_reweight_crash_legs(self, gw, tmp_path,
+                                            action, op):
+        """The op-asserting crash legs: the injected crash lands
+        BEFORE the WAL record — recovery is bitwise the pre-batch
+        state with the anti ledger intact — and a plan written
+        against the wrong op refuses loudly instead of drilling a
+        different stream than intended."""
+        wal = str(tmp_path / "g.lux.wal")
+        plan = faults.MutationFaultPlan(schedule={1: action})
+        lg = LiveGraph(gw, capacity=16, wal_path=wal, fault=plan)
+        lg.append_edges([1], [2], [1.0])
+        want = _wal_state(lg)
+        s0, d0 = _live_edge(gw, 4)
+        with pytest.raises(faults.InjectedWorkerCrash):
+            if op == "delete":
+                lg.delete_edges([s0], [d0])
+            else:
+                lg.reweight_edges([s0], [d0], [2.0])
+        assert plan.fired == [(1, action)]
+        assert lg.anti_pending() == 0
+        lg.close()
+        lg2 = LiveGraph.recover(gw, wal)
+        _assert_state_equal(_wal_state(lg2), want)
+        lg2.close()
+        # the op-assert arm: an append firing where the plan
+        # scheduled a delete/reweight crash is a drill-script bug
+        plan2 = faults.MutationFaultPlan(schedule={0: action})
+        lg3 = LiveGraph(gw, capacity=16, fault=plan2)
+        with pytest.raises(ValueError, match="expects"):
+            lg3.append_edges([3], [4], [1.0])
+
+    def test_fsck_reports_v2_mutation_mix(self, gw, g, tmp_path):
+        """scripts/fsck_lux.py renders the v2 mutation mix; a v1 log
+        reports its version with no phantom algebra counters."""
+        wal = str(tmp_path / "g.lux.wal")
+        lg = LiveGraph(gw, capacity=16, wal_path=wal)
+        lg.append_edges([1], [2], [1.5])
+        ds, dd = _live_edge(gw, 0)
+        lg.delete_edges([ds], [dd])
+        rs, rd = _live_edge(gw, 5)
+        lg.reweight_edges([rs], [rd], [0.75])
+        lg.close()
+        r = subprocess.run([sys.executable, str(FSCK), wal],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "OK wal v2" in r.stdout
+        assert "deletes=1 reweights=1" in r.stdout
+        wal1 = str(tmp_path / "v1.lux.wal")
+        log = MutationLog(wal1, g.nv, 8, version=1)
+        log.append_edge(1, 1, 2, 0)
+        log.close()
+        r = subprocess.run([sys.executable, str(FSCK), wal1],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "OK wal v1" in r.stdout
+        assert "deletes=" not in r.stdout
+
+
+# ---------------------------------------------------------------------
+# round 21: deletions / reweights on the live graph
+
+
+class TestMutationAlgebraLive:
+    def test_delete_validation_typed(self, g, gw):
+        lg = LiveGraph(g, capacity=8)
+        ps, pd = _phantom_edge(g)
+        with pytest.raises(ValueError, match="live edge"):
+            lg.delete_edges([ps], [pd])
+        assert lg.epoch == 0 and lg.count == 0
+        # deletions CONSUME multiplicity: a batch deleting one edge
+        # more often than it lives refuses whole
+        us, ud = _live_edge(g, 0)
+        k = int(np.sum((g.edge_arrays()[0] == us)
+                       & (g.edge_arrays()[1] == ud)))
+        with pytest.raises(ValueError, match="live edge"):
+            lg.delete_edges([us] * (k + 1), [ud] * (k + 1))
+        assert lg.epoch == 0
+        # reweight on an unweighted base refuses before journaling
+        with pytest.raises(ValueError, match="UNWEIGHTED"):
+            lg.reweight_edges([us], [ud], [2.0])
+        lw = LiveGraph(gw, capacity=8)
+        with pytest.raises(ValueError, match="weights"):
+            lw.reweight_edges([us], [ud], None)
+
+    def test_tombstones_consume_delta_capacity(self, g):
+        lg = LiveGraph(g, capacity=2)
+        s0, d0 = _live_edge(g, 0)
+        s1, d1 = _live_edge(g, 1)
+        lg.delete_edges([s0], [d0])
+        lg.append_edges([1], [2])
+        with pytest.raises(DeltaFullError):
+            lg.delete_edges([s1], [d1])
+        assert lg.epoch == 2 and lg.count == 2
+
+    def test_graph_at_and_compact_fold_deletions(self, gw,
+                                                 tmp_path):
+        """The deterministic fold: graph_at (the oracle surface),
+        compact (the live base), and recover (the crash path) all
+        run _apply_ops, so all three agree bitwise on which edge a
+        deletion tombstones and which a reweight restates."""
+        wal = str(tmp_path / "g.lux.wal")
+        lg = LiveGraph(gw, capacity=16, wal_path=wal)
+        s0, d0 = _live_edge(gw, 6)
+        lg.delete_edges([s0], [d0])                 # epoch 1
+        # a MULTIPLICITY-1 edge, so the restatement is unambiguous
+        # (duplicate pairs would leave "which instance" to the
+        # deterministic targeting rule, fine for the fold-equality
+        # checks below but not for a direct weight assertion)
+        sa, da = gw.edge_arrays()
+        uniq = next(i for i in range(gw.ne)
+                    if (sa[i], da[i]) != (s0, d0)
+                    and np.sum((sa == sa[i]) & (da == da[i])) == 1)
+        rs, rd = int(sa[uniq]), int(da[uniq])
+        lg.reweight_edges([rs], [rd], [9.0])        # epoch 2
+        g1, g2 = lg.graph_at(1), lg.graph_at(2)
+        assert g1.ne == gw.ne - 1 and g2.ne == gw.ne - 1
+        s2, d2 = g2.edge_arrays()
+        m = (s2 == rs) & (d2 == rd)
+        assert m.sum() == 1
+        assert np.isclose(float(np.asarray(g2.weights)[m][0]), 9.0)
+        gen = lg.compact(force=True)
+        assert gen == 1 and lg.anti_pending() == 0
+        np.testing.assert_array_equal(lg.base.col_idx, g2.col_idx)
+        np.testing.assert_array_equal(lg.base.weights, g2.weights)
+        assert lg.view_epoch("push") == 2
+        lg.close()
+        lg2 = LiveGraph.recover(gw, wal)
+        assert lg2.generation == 1 and lg2.anti_pending() == 0
+        np.testing.assert_array_equal(lg2.base.col_idx,
+                                      lg.base.col_idx)
+        np.testing.assert_array_equal(lg2.base.weights,
+                                      lg.base.weights)
+        lg2.close()
+
+    def test_view_epoch_caps_both_families_until_fold(self, g):
+        lg = LiveGraph(g, capacity=16)
+        lg.append_edges([1], [2])                   # epoch 1
+        s0, d0 = _live_edge(g, 0)
+        lg.delete_edges([s0], [d0])                 # epoch 2 (anti)
+        lg.append_edges([3], [4])                   # epoch 3
+        for fam in ("push", "pull"):
+            assert lg.view_epoch(fam) == 1
+        lg.compact(force=True)
+        for fam in ("push", "pull"):
+            assert lg.view_epoch(fam) == 3
+
+
+# ---------------------------------------------------------------------
+# round 21: decremental oracles — proved equal to full recompute
+
+
+class TestDecrementalOracles:
+    @pytest.mark.parametrize("n_del,seed", [(1, 24), (5, 25),
+                                            (40, 26)])
+    def test_sssp_decremental_equals_full(self, g, n_del, seed):
+        rng = np.random.default_rng(seed)
+        src, dst = g.edge_arrays()
+        idx = rng.choice(g.ne, size=n_del, replace=False)
+        keep = np.ones(g.ne, bool)
+        keep[idx] = False
+        g_new = Graph.from_edges(src[keep], dst[keep], g.nv)
+        d0 = sssp.reference_sssp(g, 0)
+        dec = sssp.reference_sssp_decremental(g_new, d0, dst[idx])
+        np.testing.assert_array_equal(
+            _clamp_ref(dec), _clamp_ref(sssp.reference_sssp(g_new,
+                                                            0)))
+
+    @pytest.mark.parametrize("n_mut,seed", [(3, 34), (25, 35)])
+    def test_sssp_weighted_reweight_equals_full(self, gw, n_mut,
+                                                seed):
+        """Weight updates in BOTH directions (increases degrade the
+        fixed point, decreases improve it) repair to exactly the
+        full recompute through the same cone rule."""
+        rng = np.random.default_rng(seed)
+        src, dst = gw.edge_arrays()
+        idx = rng.choice(gw.ne, size=n_mut, replace=False)
+        w_new = np.asarray(gw.weights).copy()
+        w_new[idx] = rng.uniform(0.25, 8.0,
+                                 size=n_mut).astype(np.float32)
+        g_new = Graph.from_edges(src, dst, gw.nv, weights=w_new)
+        d0 = sssp.reference_sssp(gw, 0, weighted=True)
+        dec = sssp.reference_sssp_decremental(
+            g_new, d0, dst[idx], weighted=True)
+        np.testing.assert_allclose(
+            dec, sssp.reference_sssp(g_new, 0, weighted=True),
+            rtol=1e-6)
+
+    @pytest.mark.parametrize("n_del,seed", [(1, 44), (7, 45),
+                                            (40, 46)])
+    def test_components_decremental_equals_full(self, g, n_del,
+                                                seed):
+        rng = np.random.default_rng(seed)
+        src, dst = g.edge_arrays()
+        idx = rng.choice(g.ne, size=n_del, replace=False)
+        keep = np.ones(g.ne, bool)
+        keep[idx] = False
+        g_new = Graph.from_edges(src[keep], dst[keep], g.nv)
+        c0 = components.reference_components(g)
+        dec = components.reference_components_decremental(
+            g_new, c0, dst[idx])
+        np.testing.assert_array_equal(
+            dec, components.reference_components(g_new))
+
+
+# ---------------------------------------------------------------------
+# round 21: the anti-monotone re-seed on device
+
+
+class TestReseed:
+    def _deleted(self, lg, g, rng, n):
+        """Delete n distinct base edges; returns their dst."""
+        src, dst = g.edge_arrays()
+        idx = rng.choice(g.ne, size=n, replace=False)
+        lg.delete_edges(src[idx], dst[idx])
+        return dst[idx]
+
+    def test_sssp_deletion_reseed_bitwise(self, g):
+        """Converged push state + deletions -> revalidate dispatches
+        to the cone re-seed and lands BITWISE on full recompute at
+        the target epoch (== the decremental oracle)."""
+        import jax
+        eng0 = sssp.build_engine(g, 0, num_parts=2)
+        lab, act = eng0.init_state()
+        lab, act, _ = eng0.converge(lab, act)
+        dist0 = eng0.sg.from_padded(np.asarray(jax.device_get(lab)))
+        lg = LiveGraph(g, capacity=32)
+        rng = np.random.default_rng(57)
+        touched = self._deleted(lg, g, rng, 5)
+        g_new = lg.graph_at(lg.epoch)
+        # CONTRACT: the re-seed engine is built over graph_at(target)
+        eng = sssp.build_engine(g_new, 0, num_parts=2)
+        lab1, act1 = eng.place(
+            eng.sg.to_padded(dist0),
+            eng.sg.to_padded(np.zeros(g.nv, bool)))
+        lab1, act1, _ = lg.revalidate(eng, lab1, act1)
+        assert lg.reseeds == 1
+        full = _clamp_ref(sssp.reference_sssp(g_new, 0))
+        dec = _clamp_ref(sssp.reference_sssp_decremental(
+            g_new, _clamp_ref(sssp.reference_sssp(g, 0)), touched))
+        np.testing.assert_array_equal(dec, full)
+        np.testing.assert_array_equal(_sssp_host(eng, lab1), full)
+
+    def test_sssp_weighted_reweight_reseed(self, gw):
+        import jax
+        eng0 = sssp.build_engine(gw, 0, num_parts=2, weighted=True)
+        lab, act = eng0.init_state()
+        lab, act, _ = eng0.converge(lab, act)
+        d0 = eng0.sg.from_padded(np.asarray(jax.device_get(lab)))
+        lg = LiveGraph(gw, capacity=32)
+        rng = np.random.default_rng(58)
+        src, dst = gw.edge_arrays()
+        idx = rng.choice(gw.ne, size=4, replace=False)
+        # both directions: two raises, two improvements
+        w_new = np.concatenate([
+            rng.uniform(4.5, 8.0, 2),
+            rng.uniform(0.1, 0.4, 2)]).astype(np.float32)
+        lg.reweight_edges(src[idx], dst[idx], w_new)
+        g_new = lg.graph_at(1)
+        eng = sssp.build_engine(g_new, 0, num_parts=2,
+                                weighted=True)
+        lab1, act1 = eng.place(
+            eng.sg.to_padded(d0),
+            eng.sg.to_padded(np.zeros(gw.nv, bool)))
+        lab1, act1, _ = lg.revalidate(eng, lab1, act1)
+        h = eng.sg.from_padded(np.asarray(jax.device_get(lab1)))
+        ref = sssp.reference_sssp(g_new, 0, weighted=True)
+        reach = np.isfinite(ref)
+        np.testing.assert_allclose(h[reach], ref[reach], rtol=1e-5)
+        assert not np.isfinite(h[~reach]).any()
+
+    def test_components_deletion_reseed_bitwise(self, g):
+        import jax
+        eng0 = components.build_engine(g, num_parts=2)
+        lab, act = eng0.init_state()
+        lab, act, _ = eng0.converge(lab, act)
+        c0 = eng0.sg.from_padded(np.asarray(jax.device_get(lab)))
+        lg = LiveGraph(g, capacity=32)
+        rng = np.random.default_rng(59)
+        self._deleted(lg, g, rng, 5)
+        g_new = lg.graph_at(lg.epoch)
+        eng = components.build_engine(g_new, num_parts=2)
+        lab1, act1 = eng.place(
+            eng.sg.to_padded(c0),
+            eng.sg.to_padded(np.zeros(g.nv, bool)))
+        lab1, _, _ = lg.revalidate(eng, lab1, act1)
+        h = eng.sg.from_padded(np.asarray(jax.device_get(lab1)))
+        np.testing.assert_array_equal(
+            h.astype(np.int64),
+            components.reference_components(g_new))
+
+    def test_cone_cap_falls_back_to_full_recompute(self, g):
+        lg = LiveGraph(g, capacity=32, cone_cap=1 / g.nv)
+        rng = np.random.default_rng(60)
+        self._deleted(lg, g, rng, 2)
+        g_new = lg.graph_at(lg.epoch)
+        eng = sssp.build_engine(g_new, 0, num_parts=2)
+        lab, act = eng.init_state()
+        lab, act, _ = lg.revalidate(eng, lab, act)
+        assert lg.reseeds == 1 and lg.reseed_fallbacks == 1
+        np.testing.assert_array_equal(
+            _sssp_host(eng, lab),
+            _clamp_ref(sssp.reference_sssp(g_new, 0)))
+
+    def test_reseed_crash_leaves_anti_pending(self, g):
+        """The RESEED_CRASH leg: the crash lands between the cone
+        computation and the re-converge — no answer was produced
+        from the half-re-seeded state, the anti ledger is intact,
+        admission stays capped, and the retry completes bitwise."""
+        plan = faults.MutationFaultPlan(
+            reseed_schedule={0: faults.RESEED_CRASH})
+        lg = LiveGraph(g, capacity=32, fault=plan)
+        s0, d0 = _live_edge(g, 4)
+        lg.delete_edges([s0], [d0])
+        g_new = lg.graph_at(1)
+        eng = sssp.build_engine(g_new, 0, num_parts=2)
+        lab, act = eng.init_state()
+        with pytest.raises(faults.InjectedWorkerCrash):
+            lg.revalidate(eng, lab, act)
+        assert plan.fired == [(0, faults.RESEED_CRASH)]
+        assert lg.reseeds == 0 and lg.anti_pending() == 1
+        assert lg.view_epoch("push") == 0
+        # the retry (schedule exhausted) converges to full recompute
+        lab, act = eng.init_state()
+        lab, act, _ = lg.revalidate(eng, lab, act)
+        assert lg.reseeds == 1
+        np.testing.assert_array_equal(
+            _sssp_host(eng, lab),
+            _clamp_ref(sssp.reference_sssp(g_new, 0)))
+
+    def test_per_column_targets_cannot_cross_anti_epoch(self, g):
+        from lux_tpu.livegraph import LiveGraphError
+        lg = LiveGraph(g, capacity=32)
+        lg.append_edges([1], [2])
+        s0, d0 = _live_edge(g, 0)
+        lg.delete_edges([s0], [d0])             # anti at epoch 2
+        eng = sssp.build_engine(g, num_parts=2, sources=[3, 17])
+        lab, act = eng.init_state()
+        with pytest.raises(LiveGraphError, match="anti-monotone"):
+            lg.revalidate(eng, lab, act,
+                          col_epoch=np.array([1, 2], np.int32))
+
+
+# ---------------------------------------------------------------------
+# round 21: the economics-driven compaction scheduler
+
+
+class TestCompactionScheduler:
+    def test_decision_ladder(self, g):
+        """Every leg of the decision order, in order: empty ->
+        admitted -> slo_burn -> anti_monotone -> occupancy -> drag
+        -> idle."""
+        from lux_tpu.livegraph import CompactionScheduler
+        lg = LiveGraph(g, capacity=64, compact_threshold=0.5)
+        sched = CompactionScheduler(lg, burn=lambda: 0.0)
+        d = sched.decide()
+        assert (d["action"], d["reason"]) == ("none", "empty")
+        lg.append_edges([1], [2])
+        d = sched.decide()
+        assert (d["action"], d["reason"]) == ("none", "idle")
+        # economics ride on every decision
+        for f in ("occupancy", "threshold", "delta_count",
+                  "anti_pending", "drag_ns", "drag_source",
+                  "admitted", "pins", "burn"):
+            assert f in d
+        lg.admit("push")
+        assert sched.decide()["reason"] == "admitted"
+        lg.release()
+        # slo burn defers non-urgent folds
+        s0, d0 = _live_edge(g, 3)
+        lg.delete_edges([s0], [d0])
+        hot = CompactionScheduler(lg, burn=lambda: 0.9)
+        assert hot.decide()["reason"] == "slo_burn"
+        # anti-monotone pressure folds at the first quiet window
+        d = sched.decide()
+        assert (d["action"], d["reason"]) == ("compact",
+                                              "anti_monotone")
+        r = sched.maybe_compact()
+        assert r["action"] == "compact" and r["generation"] == 1
+        assert sched.scheduler_compactions == 1
+        assert lg.anti_pending() == 0 and lg.count == 0
+        # occupancy trigger
+        for i in range(33):
+            lg.append_edges([i % g.nv], [(i + 1) % g.nv])
+        d = sched.decide()
+        assert (d["action"], d["reason"]) == ("compact", "occupancy")
+        # measured drag trigger (below threshold, standing drag)
+        lg2 = LiveGraph(g, capacity=4096, compact_threshold=0.99)
+        lg2.append_edges(np.arange(10) % g.nv,
+                         (np.arange(10) + 1) % g.nv)
+        lg2.record_drag_sample(1e-3, 10)    # 1e5 ns/slot
+        sched2 = CompactionScheduler(lg2)
+        d = sched2.decide()
+        assert (d["action"], d["reason"]) == ("compact", "drag")
+        assert d["drag_source"] == "measured"
+
+    def test_pin_race_demotes_to_deferral(self, g):
+        from lux_tpu.livegraph import CompactionScheduler
+
+        class Racy(CompactionScheduler):
+            def decide(self):
+                d = super().decide()
+                if d["action"] == "compact":
+                    self.live.pin()     # the race window
+                return d
+
+        lg = LiveGraph(g, capacity=8)
+        s0, d0 = _live_edge(g, 0)
+        lg.delete_edges([s0], [d0])
+        sched = Racy(lg)
+        d = sched.maybe_compact()
+        assert (d["action"], d["reason"]) == ("defer", "pin_race")
+        assert sched.scheduler_compactions == 0
+        lg.unpin()
+
+    def test_scheduler_soak_mesh8(self, g):
+        """THE round-21 scheduler acceptance: seeded Poisson mixed
+        traffic (all three kinds) + a live mutation stream with
+        deletions on mesh8, the scheduler alone deciding folds —
+        occupancy stays bounded, ZERO delta_full sheds, at least
+        one scheduler compaction fires, and every admitted answer
+        equals its oracle at its admission epoch."""
+        from lux_tpu import serve
+        from lux_tpu.livegraph import CompactionScheduler
+        from lux_tpu.parallel.mesh import make_mesh
+
+        lg = LiveGraph(g, capacity=48, compact_threshold=0.5)
+        srv = serve.Server(g, batch=2, num_parts=8,
+                           mesh=make_mesh(8), live=lg, seg_iters=4)
+        sched = CompactionScheduler(lg, burn=srv.slo_burn)
+        rng = np.random.default_rng(67)
+        kinds = ["sssp", "components", "pagerank"]
+        appended: list = []
+        responses = []
+        peak_occ = 0.0
+        for step in range(8):
+            for _ in range(int(rng.poisson(3)) + 1):
+                srv.submit(rng.choice(kinds),
+                           source=int(rng.integers(g.nv)))
+            n = int(rng.poisson(5)) + 1
+            s, d = rng.integers(g.nv, size=n), rng.integers(
+                g.nv, size=n)
+            srv.mutate(s, d)            # zero delta_full sheds: a
+            appended += list(zip(s.tolist(), d.tolist()))
+            if step in (2, 5):          # deletions in the stream
+                es, ed = appended.pop(0)
+                srv.mutate([es], [ed], op="delete")
+            peak_occ = max(peak_occ, lg.occupancy())
+            responses += srv.run()
+            sched.maybe_compact(server=srv)
+        assert peak_occ < 1.0
+        assert sched.scheduler_compactions >= 1
+        assert lg.deletions == 2
+        assert check_live_answers(lg, responses) == 0
+        # the trail is coherent: every fold the scheduler ran is a
+        # real compaction, and deferrals never exceeded decisions
+        assert lg.compactions == sched.scheduler_compactions
 
 
 # ---------------------------------------------------------------------
@@ -1192,4 +1853,142 @@ class TestLiveChaosAcceptance:
         assert "live graph:" in r.stdout
         assert "WAL replay:" in r.stdout
         assert "replicas: 2 up, 1 lost (r1)" in r.stdout
+        live2.close()
+
+    def test_mutation_algebra_chaos_mesh8(self, g, tmp_path):
+        """THE round-21 chaos acceptance: the FULL mutation algebra
+        under fire on mesh8 — deletions in the live stream, a
+        replica killed mid-drain, an injected crash MID-RE-SEED and
+        another mid-compaction, WAL replay bitwise with the anti
+        ledger intact, the retried re-seed bitwise-equal to both the
+        full recompute and the decremental oracle, the scheduler
+        completing the crashed fold, and every admitted answer
+        oracle-equal at its admission epoch with the events trail
+        (re-seed pairing + scheduler economics audits armed)
+        rendering clean."""
+        import loadgen
+
+        from lux_tpu import fleet, resilience
+        from lux_tpu.livegraph import CompactionScheduler
+        from lux_tpu.parallel.mesh import make_mesh
+
+        kinds = ["sssp", "components", "pagerank"]
+        slo = {k: 60000.0 for k in kinds}
+        wal = str(tmp_path / "g.lux.wal")
+        plan = faults.MutationFaultPlan(
+            compact_schedule={0: faults.COMPACT_CRASH},
+            reseed_schedule={0: faults.RESEED_CRASH})
+        live = LiveGraph(g, capacity=96, wal_path=wal, fault=plan,
+                         compact_threshold=0.5)
+        path = tmp_path / "algebra_chaos_ev.jsonl"
+        ev = telemetry.EventLog(str(path))
+        with telemetry.use(events=ev):
+            ev.emit("run_start", schema=telemetry.SCHEMA,
+                    app="live-algebra", file="<test>", mesh=8)
+            t0 = time.perf_counter()
+            flt = fleet.FleetServer(
+                g, live=live, cache=True, replicas=2, batch=2,
+                num_parts=8, mesh=make_mesh(8), slo_ms=slo,
+                retry=resilience.RetryPolicy(retries=3,
+                                             backoff_s=0.01,
+                                             max_backoff_s=0.05,
+                                             jitter_seed=0),
+                board_path=str(tmp_path / "board"))
+            flt.warm(kinds)
+            flt.mutate(*_mutations(g.nv, 8, 91))   # epoch 1
+            s7, d7 = _live_edge(g, 7)
+            flt.mutate([s7], [d7], op="delete")    # epoch 2 (anti)
+            assert live.view_epoch("push") == 1
+            kill = faults.ReplicaKillPlan({"r1": 1})
+            flt.set_fault(kill)
+            rng = np.random.default_rng(92)
+            rep = loadgen.run_step(flt, rate=500.0, n=12,
+                                   kinds=kinds, rng=rng, step=0)
+            # admission NEVER crossed the pending deletion
+            assert rep.drained
+            assert all(r.epoch <= 1 for r in rep.responses)
+            s31, d31 = _live_edge(g, 31)
+            flt.mutate([s31], [d31], op="delete")  # epoch 3 (anti)
+
+            # the HONEST re-seed: a standalone engine over
+            # graph_at(3) — crash lands between cone and converge
+            g3 = live.graph_at(3)
+            eng = sssp.build_engine(g3, 0, num_parts=2)
+            lab, act = eng.init_state()
+            with pytest.raises(faults.InjectedWorkerCrash):
+                live.revalidate(eng, lab, act)
+            # no answer escaped the half-re-seeded state: ledger
+            # intact, admission still capped, nothing counted
+            assert live.anti_pending() == 2
+            assert live.view_epoch("push") == 1
+            assert live.reseeds == 0
+            # the retry (schedule exhausted) lands bitwise on BOTH
+            # the full recompute and the decremental oracle
+            lab, act = eng.init_state()
+            lab, act, _ = live.revalidate(eng, lab, act)
+            got = _sssp_host(eng, lab)
+            full = _clamp_ref(sssp.reference_sssp(g3, 0))
+            dec = _clamp_ref(sssp.reference_sssp_decremental(
+                g3, _clamp_ref(sssp.reference_sssp(live.graph_at(1),
+                                                   0)),
+                np.array([d7, d31])))
+            np.testing.assert_array_equal(dec, full)
+            np.testing.assert_array_equal(got, full)
+            assert live.reseeds == 1
+
+            # the scheduler sees the anti pressure; its first fold
+            # hits the injected COMPACT_CRASH
+            sched = CompactionScheduler(live, burn=flt.slo_burn)
+            d = sched.decide()
+            assert (d["action"], d["reason"]) == ("compact",
+                                                  "anti_monotone")
+            with pytest.raises(faults.InjectedWorkerCrash):
+                live.compact(force=True)
+            pre_crash = _wal_state(live)
+            live.close()
+
+            # recovery: bitwise replay, anti ledger restored
+            live2 = LiveGraph.recover(g, wal)
+            _assert_state_equal(_wal_state(live2), pre_crash)
+            assert live2.anti_pending() == 2
+            assert live2.deletions == 2
+            # the scheduler completes the crashed fold on the
+            # recovered log (schedule exhausted)
+            sched2 = CompactionScheduler(live2)
+            r2 = sched2.maybe_compact()
+            assert r2["action"] == "compact"
+            assert r2["generation"] == 1
+            assert live2.anti_pending() == 0
+            ev.emit("run_start", schema=telemetry.SCHEMA,
+                    app="live-algebra-recovered", file="<test>",
+                    mesh=8)
+            flt2 = fleet.FleetServer(
+                live2.base, live=live2, cache=True, replicas=2,
+                batch=2, num_parts=8, mesh=make_mesh(8),
+                slo_ms=slo, board_path=str(tmp_path / "board2"))
+            for kind in kinds:
+                flt2.submit(kind, source=9)
+            post = flt2.run()
+            assert all(r.epoch == live2.epoch for r in post)
+            ev.emit("run_done",
+                    seconds=round(time.perf_counter() - t0, 6),
+                    iters=rep.served + len(post))
+        ev.close()
+
+        assert kill.fired and kill.fired[0][0] == "r1"
+        assert rep.served + rep.shed == rep.submitted
+        qids = [r.qid for r in rep.responses]
+        assert len(set(qids)) == len(qids)
+        # every admitted answer oracle-equal at its admission epoch
+        # — through two deletions, a kill, and two injected crashes
+        assert check_live_answers(live2, rep.responses) == 0
+        assert check_live_answers(live2, post) == 0
+        # the trail renders clean with the round-21 audits armed:
+        # re-seed pairing, scheduler economics, epoch regression
+        r = subprocess.run([sys.executable, str(SUMMARY), str(path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "re-seed: 1 anti-monotone revalidation(s)" in r.stdout
+        assert "compaction scheduler:" in r.stdout
+        assert "delete" in r.stdout
         live2.close()
