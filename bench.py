@@ -216,6 +216,30 @@ def _comm_build(eng, extra):
               file=sys.stderr)
 
 
+def _mem_build(eng, extra, consumers=None, trail=None):
+    """Round 22 (lux_tpu/memwatch.py): the runtime memory drift
+    verdict of the engine's build — measured (or memory_analysis-
+    modeled) peak vs the unified byte ledger — lands in the metric
+    line's ``mem`` field.  A drifting or failing verdict records
+    errors instead of a clean digest; scripts/check_bench.py rejects
+    such lines, so a published number can never ride a build whose
+    byte accounting has rotted."""
+    from lux_tpu import memwatch
+
+    try:
+        extra["mem"] = memwatch.bench_digest(eng, trail=trail,
+                                             consumers=consumers)
+        if extra["mem"].get("errors"):
+            print(f"# mem drift: {extra['mem']}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — a broken ledger must not
+        # kill the run; the line records the failure and check_bench
+        # rejects it from the trajectory
+        extra["mem"] = {"errors": 1,
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+        print(f"# mem ledger failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
 def _audit_build(eng, args, extra):
     """Static program audit of the freshly built engine
     (lux_tpu/audit.py, round 10): traces every compiled loop variant
@@ -227,8 +251,12 @@ def _audit_build(eng, args, extra):
     error`` additionally fails the config at build time (typed
     AuditError, classified fatal).  Round 19: the comm byte ledger
     (``_comm_build``) rides the same hook — every engine metric line
-    carries its ``comm`` digest regardless of the -audit mode."""
+    carries its ``comm`` digest regardless of the -audit mode.
+    Round 22: the memory drift verdict (``_mem_build``) rides the
+    same hook — every engine metric line carries its ``mem``
+    digest."""
     _comm_build(eng, extra)
+    _mem_build(eng, extra)
     if args.audit == "off":
         return
     from lux_tpu import audit
@@ -379,9 +407,16 @@ def run_serve_load(config, args, *, chaos: bool):
     if chaos:
         srv.warm(kinds)
         # arm the kill AFTER warm so its boundary counter sees only
-        # loaded traffic: the LAST replica dies mid-load
+        # loaded traffic — and on the replica routing WILL pick
+        # (fleet.routing_target): routing is a positive-feedback
+        # loop (drain -> fresh beat -> picked again), so a plan
+        # armed on any fixed index is a coin flip on beat timing
+        # inside warm, and the losing side is a chaos line that
+        # silently measured a fault-free run (the round-22 fix;
+        # the regression test pins it)
+        victim = srv.routing_target(kinds[0])
         srv.set_fault(faults.ReplicaKillPlan(
-            {srv.replica_names[-1]: args.kill_boundary}))
+            {victim: args.kill_boundary}))
     else:
         loadgen.warm(srv, kinds)
     rng = np.random.default_rng(7)   # fixed seed: one query schedule
@@ -405,6 +440,14 @@ def run_serve_load(config, args, *, chaos: bool):
         return rep
 
     rep = one_step()
+    # round 22: the serving line's mem digest — one drained engine's
+    # drift verdict widened by the dynamic consumer terms (cache is
+    # absent on these configs; the digest still prices the engine)
+    from lux_tpu import memwatch
+    _mem_build(runner_of(kinds[0]).eng, extra,
+               consumers=memwatch.consumer_terms(
+                   cache=getattr(srv, "cache", None),
+                   live=getattr(srv, "live", None)))
     if chaos and (not srv.fault.fired or srv.failovers < 1):
         raise RuntimeError(
             "serve-chaos kill plan never fired (or nothing failed "
@@ -432,17 +475,21 @@ def run_serve_load(config, args, *, chaos: bool):
 
 
 def run_serve_live(config, args):
-    """The live-graph serving line (rounds 20-21,
-    lux_tpu/livegraph.py): mixed-kind traffic over a MUTATING graph
-    exercising the FULL mutation algebra.  Each phase appends first
-    (one published epoch), then drains two query waves — the second
-    wave repeats the first's hot sources at the SAME epoch, so the
+    """The live-graph serving line (rounds 20-22,
+    lux_tpu/livegraph.py): mixed-kind traffic over a MUTATING
+    WEIGHTED graph exercising the FULL mutation algebra — appends,
+    deletions + the honest re-seed, and (round 22) per-phase
+    REWEIGHTS, the algebra leg an unweighted headline structurally
+    reported as reweights=0.  Each phase appends first (one
+    published epoch), then drains two query waves — the second wave
+    repeats the first's hot sources at the SAME epoch, so the
     epoch-keyed answer cache measurably hits.  Two of the phases
     DELETE a previously-appended edge and run the honest
     anti-monotone re-seed (a converged pre-deletion state repaired
     to the published epoch on a standalone engine over
-    ``graph_at(target)``, bitwise-checked against the full
-    recompute); compaction is decided by the round-21
+    ``graph_at(target)``, exactly equal to the full recompute —
+    integer-valued f32 weights keep the comparison exact);
+    compaction is decided by the round-21
     CompactionScheduler (anti-monotone pressure / occupancy / drag
     economics) instead of the bare occupancy heuristic, with
     Server.refresh_live generation adoption between drains.  EVERY
@@ -470,7 +517,13 @@ def run_serve_live(config, args):
     kinds = [k.strip() for k in args.serve_kinds.split(",")
              if k.strip()]
     slo = loadgen._parse_slo(args.slo_ms)
-    g = build_graph(scale, ef, args.verbose)
+    # round 22: the headline line is WEIGHTED — integer-valued f32
+    # weights (1..5) keep every device f32 distance exact, so the
+    # weighted oracle checks and the honest re-seed stay exact
+    # comparisons, and the line's reweight counter measures the one
+    # algebra leg (round 21) the unweighted line structurally
+    # couldn't (reweights=0 forever)
+    g = build_graph(scale, ef, args.verbose, weighted=True)
     capacity = args.delta_capacity
 
     def build_tier():
@@ -478,11 +531,20 @@ def run_serve_live(config, args):
         must measure the identical workload (live graph shape, cache
         policy, scheduler cadence), so there is exactly one place
         to tune it."""
+        from lux_tpu import memwatch
         lv = livegraph.LiveGraph(g, capacity=capacity,
                                  compact_threshold=0.75)
         sv = serve.Server(g, batch=args.serve_batch,
                           num_parts=args.np, seg_iters=2, slo_ms=slo,
-                          health=args.health, live=lv, cache=True)
+                          health=args.health, weighted=True,
+                          live=lv, cache=True)
+        # round 22: the runtime occupancy trail rides the drain —
+        # boundary-only samples (measured free, PERF_NOTES round 22)
+        # over the unified server ledger, so the events trail carries
+        # the mem_sample/mem_watermark series events_summary renders
+        sv.mem = memwatch.MemoryTrail(
+            bytes_fn=lambda: memwatch.MemoryLedger
+            .for_server(sv).total_bytes, emit_every=4)
         sc = livegraph.CompactionScheduler(lv, burn=sv.slo_burn)
         return lv, sv, sc
 
@@ -524,27 +586,32 @@ def run_serve_live(config, args):
         pre-deletion snapshot, repair that state to ``target`` on a
         standalone engine built over ``graph_at(target)`` (the
         revalidate contract), and refuse the line unless the result
-        is bitwise the full recompute."""
+        is exactly the full recompute — the weighted line's
+        integer-valued f32 weights make every finite distance exact,
+        so this stays an equality check, not a tolerance."""
         import jax
 
         pre = lv.graph_at(target - 1)
-        eng0 = _sssp.build_engine(pre, 0, num_parts=args.np)
+        eng0 = _sssp.build_engine(pre, 0, num_parts=args.np,
+                                  weighted=True)
         lab, act = eng0.init_state()
         lab, act, _ = eng0.converge(lab, act)
         host = eng0.sg.from_padded(np.asarray(jax.device_get(lab)))
         g_t = lv.graph_at(target)
-        eng1 = _sssp.build_engine(g_t, 0, num_parts=args.np)
+        eng1 = _sssp.build_engine(g_t, 0, num_parts=args.np,
+                                  weighted=True)
         lab1, act1 = eng1.place(
             eng1.sg.to_padded(host),
             eng1.sg.to_padded(np.zeros(nv, bool)))
         lab1, act1, _ = lv.revalidate(eng1, lab1, act1)
         got = eng1.sg.from_padded(
-            np.asarray(jax.device_get(lab1))).astype(np.int64)
-        inf = int(_sssp.HOP_INF)
-        got = np.where(got >= inf, inf, got)
-        ref = _sssp.reference_sssp(g_t, 0)
-        ref = np.where(ref >= inf, inf, ref)
-        if not np.array_equal(got, ref):
+            np.asarray(jax.device_get(lab1)))
+        ref = _sssp.reference_sssp(g_t, 0, weighted=True)
+        fin_g, fin_r = np.isfinite(got), np.isfinite(ref)
+        if not (np.array_equal(fin_g, fin_r)
+                and np.array_equal(
+                    got[fin_g].astype(np.float64),
+                    ref[fin_r].astype(np.float64))):
             raise RuntimeError(
                 "serve-live: the anti-monotone re-seed differs from "
                 "the full recompute at its target epoch — a wrong "
@@ -553,17 +620,25 @@ def run_serve_live(config, args):
     def load_phase(lv, sv, sc, rng, phase, tracked):
         """One phase: append (tracking an edge for later deletion),
         on the deletion phases delete a tracked edge + run the
-        honest re-seed, then two query waves — the repeat wave is
-        the cache-hit traffic.  The scheduler alone decides folds at
-        the phase boundary.  Returns (responses, submitted)."""
+        honest re-seed, on the others REWEIGHT the newest tracked
+        edge (the round-21 algebra leg an unweighted line cannot
+        carry), then two query waves — the repeat wave is the
+        cache-hit traffic.  The scheduler alone decides folds at the
+        phase boundary.  Returns (responses, submitted)."""
         s_new = rng.integers(nv, size=per_mut)
         d_new = rng.integers(nv, size=per_mut)
-        sv.mutate(s_new, d_new)
+        w_new = rng.integers(1, 6, size=per_mut).astype(np.float32)
+        sv.mutate(s_new, d_new, w_new)
         tracked.append((int(s_new[0]), int(d_new[0])))
         if phase in delete_phases and len(tracked) > 1:
             es, ed = tracked.pop(0)
             sv.mutate([es], [ed], op="delete")
             reseed_honest(lv, lv.epoch)
+        elif phase and tracked:
+            rs, rd = tracked[-1]
+            sv.mutate([rs], [rd],
+                      weights=[float(rng.integers(1, 6))],
+                      op="reweight")
         hot = {k: int(rng.integers(nv)) for k in kinds}
         n = 0
         out = []
@@ -588,7 +663,8 @@ def run_serve_live(config, args):
             responses += out
             submitted += n
         elapsed = _time.monotonic() - t0
-        bad = livegraph.check_live_answers(lv, responses)
+        bad = livegraph.check_live_answers(lv, responses,
+                                           weighted=True)
         if bad:
             raise RuntimeError(
                 f"serve-live: {bad} answer(s) differ from the NumPy "
@@ -614,6 +690,13 @@ def run_serve_live(config, args):
 
     qps, elapsed, submitted = one_step(live, srv, sched)
     hit_frac = srv.cache.hit_fraction() or 0.0
+    # round 22: the live line's mem digest prices the full unified
+    # ledger — engine terms + the REAL post-run consumer bytes
+    # (answer cache, delta blocks, WAL, multiset, staging)
+    from lux_tpu import memwatch
+    _mem_build(srv._runner(kinds[0]).eng, extra,
+               consumers=memwatch.consumer_terms(cache=srv.cache,
+                                                 live=live))
     if live.compactions < 1:
         raise RuntimeError(
             "serve-live: no compaction fired — the line would not "
@@ -623,7 +706,12 @@ def run_serve_live(config, args):
             "serve-live: the deletion/re-seed phases did not run — "
             "the line would not measure the mutation algebra it "
             "claims to")
+    if live.reweights < 1:
+        raise RuntimeError(
+            "serve-live: no reweight ran — the weighted line would "
+            "not measure the algebra leg it exists to carry")
     extra.update(
+        weighted=True,
         submitted=submitted,
         served=submitted,
         mutations=int(live.mutations),

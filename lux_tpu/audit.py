@@ -904,27 +904,14 @@ def audit_engine(engine, mode: str | None = "error",
 # ---------------------------------------------------------------------
 # check 8: ledger cross-validation
 
-def check_ledger(engine, tol: float = 0.5, where: str | None = None):
-    """Compile the engine's single step on the CURRENT backend and
-    compare XLA ``memory_analysis`` argument bytes against the priced
-    ledger ``sg.memory_report(...)``.  The ratio must stay within
-    [1/(1+tol), 1+tol] — see the module docstring for the tolerance
-    rationale (chunk/tile padding sits above the ledger's epad-based
-    lower bounds; only meaningful on graphs dense enough that edges
-    dominate padding)."""
-    where = where or type(engine).__name__
-    variants = engine.audit_programs()
-    jitted, args_thunk = variants["step"]
-    try:
-        compiled = jitted.lower(*args_thunk()).compile()
-        ma = compiled.memory_analysis()
-    except Exception as e:  # noqa: BLE001 — backend without AOT stats
-        return [Finding("ledger-drift", "warn", where,
-                        f"memory_analysis unavailable ({e}); ledger "
-                        f"cross-validation skipped")]
-    if ma is None or not getattr(ma, "argument_size_in_bytes", 0):
-        return []
-    measured = int(ma.argument_size_in_bytes)
+def report_kwargs(engine) -> dict:
+    """The ``sg.memory_report(...)`` kwargs matching this engine's
+    actual build (exchange / page plan / pair plan / push sparsity /
+    query batch) — factored out of ``check_ledger`` so the runtime
+    memory observatory (lux_tpu/memwatch.py, round 22) prices the
+    SAME program the compile-time drift check audits; two
+    independently-maintained kwarg derivations would let the two
+    ledgers silently diverge."""
     from lux_tpu.engine.push import PushEngine
     is_push = isinstance(engine, PushEngine)
     kw = dict(exchange=engine.exchange)
@@ -949,7 +936,17 @@ def check_ledger(engine, tol: float = 0.5, where: str | None = None):
         # query_batch pricing) — pull engines carry B through
         # state_bytes instead (the correction below)
         kw["query_batch"] = int(getattr(engine, "batch", None) or 1)
-    ledger = engine.sg.memory_report(**kw)
+    return kw
+
+
+def priced_argument_bytes(engine) -> int:
+    """The ledger's price for the engine's resident ARGUMENT arrays —
+    ``memory_report`` total minus the per-iteration temporary terms,
+    plus the program-level state-width/extra-array corrections.  This
+    is the ``expected`` side of the ledger-drift comparison, shared
+    by ``check_ledger`` and the runtime observatory's per-replica
+    byte ledger (lux_tpu/memwatch.py)."""
+    ledger = engine.sg.memory_report(**report_kwargs(engine))
     expected = int(ledger["total_bytes"])
     # memory_analysis argument bytes cover resident ARGUMENT arrays
     # only — subtract the advisor's per-iteration temporary terms
@@ -975,6 +972,31 @@ def check_ledger(engine, tol: float = 0.5, where: str | None = None):
     if xa is not None:
         expected += sum(np.asarray(v).nbytes
                         for v in xa(engine.sg).values())
+    return expected
+
+
+def check_ledger(engine, tol: float = 0.5, where: str | None = None):
+    """Compile the engine's single step on the CURRENT backend and
+    compare XLA ``memory_analysis`` argument bytes against the priced
+    ledger ``sg.memory_report(...)``.  The ratio must stay within
+    [1/(1+tol), 1+tol] — see the module docstring for the tolerance
+    rationale (chunk/tile padding sits above the ledger's epad-based
+    lower bounds; only meaningful on graphs dense enough that edges
+    dominate padding)."""
+    where = where or type(engine).__name__
+    variants = engine.audit_programs()
+    jitted, args_thunk = variants["step"]
+    try:
+        compiled = jitted.lower(*args_thunk()).compile()
+        ma = compiled.memory_analysis()
+    except Exception as e:  # noqa: BLE001 — backend without AOT stats
+        return [Finding("ledger-drift", "warn", where,
+                        f"memory_analysis unavailable ({e}); ledger "
+                        f"cross-validation skipped")]
+    if ma is None or not getattr(ma, "argument_size_in_bytes", 0):
+        return []
+    measured = int(ma.argument_size_in_bytes)
+    expected = priced_argument_bytes(engine)
     ratio = measured / max(1, expected)
     if not (1.0 / (1.0 + tol) <= ratio <= 1.0 + tol):
         return [Finding(

@@ -115,9 +115,12 @@ def chained_crc32(data: bytes, prev: int = 0) -> int:
 
 
 def save(path: str, state, meta: dict | None = None,
-         rotate: bool = True) -> None:
+         rotate: bool = True) -> int:
     """Atomically write a checkpoint: ``state`` is a pytree of arrays
     (list/tuple/dict nesting), ``meta`` a JSON-serializable dict.
+    Returns the staged byte total (the host-assembled global view —
+    the transient consumer the round-22 memory ledger prices as
+    ``checkpoint_staging``; 0 on non-writer processes).
 
     A per-leaf CRC32 rides alongside the payload (``load`` verifies
     it), and with ``rotate`` (the default) an existing file at
@@ -130,7 +133,8 @@ def save(path: str, state, meta: dict | None = None,
         # the global view above was assembled COLLECTIVELY (all
         # processes participate); one writer per shared checkpoint
         # dir — every process resumes from the same file
-        return
+        return 0
+    staged = sum(int(leaf.nbytes) for leaf in leaves)
     payload = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
     crcs = [_leaf_crc(leaf) for leaf in leaves]
     d = os.path.dirname(os.path.abspath(path)) or "."
@@ -152,7 +156,7 @@ def save(path: str, state, meta: dict | None = None,
         try:
             dfd = os.open(d, os.O_RDONLY)
         except OSError:
-            return
+            return staged
         try:
             os.fsync(dfd)
         finally:
@@ -161,6 +165,7 @@ def save(path: str, state, meta: dict | None = None,
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    return staged
 
 
 def load(path: str, verify: bool = True):
@@ -308,15 +313,20 @@ def _timed_save(path, state, meta):
     traces and event logs)."""
     import time
 
-    from lux_tpu import telemetry
+    from lux_tpu import memwatch, telemetry
     from lux_tpu.profiling import annotation
 
     t0 = time.perf_counter()
     with annotation("lux_checkpoint_save"):
-        save(path, state, meta)
+        staged = save(path, state, meta)
+    # the staged global view is a real transient memory consumer —
+    # the round-22 unified byte ledger prices it at its last
+    # observed size (memwatch.consumer_terms)
+    memwatch.note_staging(staged)
     telemetry.current().emit(
         "checkpoint_save", iter=int(meta.get("iter", 0)),
         engine=meta.get("kind"), path=path,
+        staged_bytes=int(staged),
         seconds=round(time.perf_counter() - t0, 6))
 
 

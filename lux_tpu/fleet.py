@@ -115,6 +115,13 @@ SHED_RETRIES = "retries"
 # typed AdmissionError discipline as a query (never silently dropped,
 # never blocking the serving loop)
 SHED_DELTA_FULL = "delta_full"
+# round 22 (memory observatory, lux_tpu/memwatch.py): admitting B
+# more columns is priced in BYTES (batch state + answer-cache
+# headroom on top of the replica's unified ledger) and shed typed
+# when the projection crosses the per-replica budget — the same
+# projected-resource pattern as the deadline check, applied to the
+# resource ROADMAP item 3 names as the wall
+SHED_MEMORY = "memory"
 
 # routing health score: beat age (s) + BURN_WEIGHT x the replica's
 # rolling SLO-burn fraction — a replica burning its whole SLO budget
@@ -138,12 +145,17 @@ class AdmissionError(RuntimeError):
 
     def __init__(self, qid: int, kind: str, tenant: str, reason: str,
                  projected_wait_s: float | None = None,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 projected_bytes: int | None = None,
+                 budget_bytes: int | None = None):
         msg = (f"query {qid} [{kind}] from tenant {tenant!r} shed: "
                f"{reason}")
         if projected_wait_s is not None:
             msg += (f" (projected wait {projected_wait_s:.3f}s vs "
                     f"deadline {deadline_s}s)")
+        if projected_bytes is not None:
+            msg += (f" (projected {projected_bytes} bytes vs budget "
+                    f"{budget_bytes} bytes)")
         super().__init__(msg)
         self.qid = int(qid)
         self.kind = kind
@@ -151,6 +163,8 @@ class AdmissionError(RuntimeError):
         self.reason = reason
         self.projected_wait_s = projected_wait_s
         self.deadline_s = deadline_s
+        self.projected_bytes = projected_bytes
+        self.budget_bytes = budget_bytes
 
 
 class _InProcessReplica:
@@ -295,7 +309,10 @@ class FleetServer:
                  retry: resilience.RetryPolicy | None = None,
                  fault: faults_mod.ReplicaKillPlan | None = None,
                  replica_deadline_s: float = 3.0, live=None,
-                 cache: bool = False):
+                 cache: bool = False,
+                 mem_budget_bytes: int | None = None,
+                 mem_horizon_s: float = 5.0,
+                 mem_clock=time.monotonic):
         if replicas < 1:
             raise ValueError(f"fleet needs >= 1 replica, got "
                              f"{replicas}")
@@ -351,6 +368,15 @@ class FleetServer:
         self.retry = retry or resilience.RetryPolicy(
             retries=3, backoff_s=0.02, max_backoff_s=0.5)
         self.fault = fault
+        # round-22 memory observatory: per-replica byte budget (None
+        # = unbudgeted — no memory admission, no forecaster) + the
+        # boundary-fed occupancy trails (built lazily per replica at
+        # its first boundary; fake-clock-injectable for tests)
+        self.mem_budget_bytes = (None if mem_budget_bytes is None
+                                 else int(mem_budget_bytes))
+        self.mem_horizon_s = float(mem_horizon_s)
+        self.mem_clock = mem_clock
+        self._mem_trails: dict = {}
 
         import threading
         # RLock: admission (submitter threads) and retirement /
@@ -469,12 +495,45 @@ class FleetServer:
                                **self.opts)
 
     def _boundary(self, rep, runner) -> None:
-        """Per-replica segment-boundary hook: beat the board, then
-        fire the chaos plan (whose raise propagates out of the drain
-        as a mid-drain death)."""
+        """Per-replica segment-boundary hook: beat the board, sample
+        the memory trail (budgeted fleets only — the forecaster's
+        mem_pressure warning must land BEFORE any memory shed or
+        DeltaFullError in the event trail), then fire the chaos plan
+        (whose raise propagates out of the drain as a mid-drain
+        death)."""
         self.board.beat(rep.name, status="up", kind=runner.kind)
+        if self.mem_budget_bytes is not None:
+            self.mem_trail(rep.name).sample(
+                where=f"{runner.kind}:boundary")
         if self.fault is not None:
             self.fault.fire(rep.name)
+
+    def mem_trail(self, name: str):
+        """The named replica's boundary-fed occupancy trail
+        (memwatch.MemoryTrail, built lazily; budget + forecaster
+        attached when the fleet carries ``mem_budget_bytes``).  The
+        trail's bytes source is the replica's UNIFIED ledger —
+        static engine terms + the shared dynamic consumers — priced
+        by host arithmetic only (no compile, no device traffic: the
+        boundary hook contract)."""
+        from lux_tpu import memwatch
+
+        if name not in self._mem_trails:
+            rep = next(r for r in self._replicas if r.name == name)
+            self._mem_trails[name] = memwatch.MemoryTrail(
+                bytes_fn=lambda: self._replica_bytes(rep),
+                metrics=self.metrics or None, replica=name,
+                budget_bytes=self.mem_budget_bytes,
+                horizon_s=self.mem_horizon_s, clock=self.mem_clock)
+        return self._mem_trails[name]
+
+    def _replica_bytes(self, rep) -> int:
+        """One replica's unified-ledger total right now (memwatch
+        pillar 2): its built runners' static terms + the tier-shared
+        cache/live/staging consumers."""
+        from lux_tpu import memwatch
+
+        return memwatch.replica_ledger(self, rep).total_bytes
 
     def set_fault(self, plan) -> None:
         """Arm (or disarm with None) a faults.ReplicaKillPlan — bench
@@ -499,6 +558,18 @@ class FleetServer:
         return min(cands, key=lambda r: (round(self._score(r, kind),
                                                6),
                                          r.pending_total(), r.index))
+
+    def routing_target(self, kind: str) -> str | None:
+        """The replica name the NEXT query of ``kind`` would route
+        to (None when none is healthy).  Chaos drills arm their kill
+        plans on this: routing is a positive-feedback loop — the
+        picked replica drains, which refreshes its beat, which keeps
+        it the pick — so a plan armed on any FIXED index is a coin
+        flip on millisecond beat timing inside warm(), and the
+        losing side is a drill whose kill never fires (the round-22
+        serve-chaos fix; tests/test_memwatch.py pins it)."""
+        rep = self._pick(kind)
+        return None if rep is None else rep.name
 
     def _health_gauges(self) -> None:
         if self.metrics is None:
@@ -542,10 +613,15 @@ class FleetServer:
 
     def _shed(self, req: Request, reason: str, *,
               projected: float | None = None,
+              projected_bytes: int | None = None,
               raise_: bool = True):
         err = AdmissionError(req.qid, req.kind, req.tenant, reason,
                              projected_wait_s=projected,
-                             deadline_s=req.deadline_s)
+                             deadline_s=req.deadline_s,
+                             projected_bytes=projected_bytes,
+                             budget_bytes=(self.mem_budget_bytes
+                                           if projected_bytes
+                                           is not None else None))
         with self._lock:
             self.shed_records.append(err)
             if req.qid in self._qreq:   # late shed of an admitted req
@@ -559,6 +635,9 @@ class FleetServer:
                                  reason=reason).inc()
         extra = {} if projected is None else {
             "projected_wait_s": round(projected, 6)}
+        if projected_bytes is not None:
+            extra["projected_bytes"] = int(projected_bytes)
+            extra["budget_bytes"] = self.mem_budget_bytes
         _emit("query_shed", qid=req.qid, query_kind=req.kind,
               tenant=req.tenant, priority=req.priority,
               reason=reason, **extra)
@@ -581,6 +660,34 @@ class FleetServer:
             p = self._projected_wait(req.kind)
             if p > req.deadline_s:
                 self._shed(req, SHED_DEADLINE, projected=p)
+        if self.mem_budget_bytes is not None:
+            b = self._projected_bytes(req.kind)
+            if b is not None and b > self.mem_budget_bytes:
+                self._shed(req, SHED_MEMORY, projected_bytes=b)
+
+    def _projected_bytes(self, kind: str) -> int | None:
+        """Projected resident bytes of the routing target AFTER
+        admitting this query's batch (memwatch pillar 3): the
+        replica's unified-ledger total + batch x (column state +
+        answer-cache headroom).  None when no replica is routable
+        (the no_capacity check upstream already shed) or the target
+        replica has not built the kind's engine yet (a cold replica
+        cannot be priced per column — cold admission stays
+        optimistic, exactly like _projected_wait)."""
+        from lux_tpu import memwatch
+
+        rep = self._pick(kind)
+        if rep is None or rep.remote:
+            return None
+        runner = rep._runners.get(kind)
+        if runner is None:
+            return None
+        return memwatch.projected_admission_bytes(
+            self._replica_bytes(rep), batch=self.batch,
+            column_bytes=memwatch.column_state_bytes(runner.eng),
+            answer_bytes=(0 if self.cache is None
+                          else self.g.nv
+                          * memwatch.ANSWER_BYTES_PER_VERTEX))
 
     def _admission_epoch(self, kind: str) -> int | None:
         """READ the epoch a query of ``kind`` would pin (cache
@@ -1264,8 +1371,11 @@ def main(argv=None) -> int:
                               retries=3, backoff_s=0.01,
                               max_backoff_s=0.05, jitter_seed=0))
         if args.kill_boundary >= 0 and args.replicas > 1:
+            # arm the replica routing WILL pick (routing_target):
+            # a fixed index is a coin flip on beat timing, and the
+            # losing side is a kill that never fires (round 22)
             flt.set_fault(faults_mod.ReplicaKillPlan(
-                {flt.replica_names[-1]: args.kill_boundary}))
+                {flt.routing_target(kinds[0]): args.kill_boundary}))
         for i in range(n):
             flt.submit(kinds[i % len(kinds)],
                        source=int(rng.integers(0, g.nv)))

@@ -172,6 +172,17 @@ _OP_BY_REC = {REC_EDGE: "append", REC_DELETE: "delete",
 # half-written slot — the torn-read-free-by-construction invariant
 EPOCH_SENTINEL = np.int32(np.iinfo(np.int32).max)
 
+# nominal host prices for the pointer-structured live consumers
+# (memory_terms, round 22): CPython has no portable exact size for a
+# list-of-tuples or a Counter entry, so the unified ledger prices the
+# DOCUMENTED nominal per entry — a 5-tuple history op (~tuple header
+# + 5 boxed fields + list slot) and a Counter entry (~dict slot +
+# key 2-tuple + two boxed ints).  What matters observably is the
+# O(count) growth these make visible, not malloc jitter; the NumPy
+# oracle re-derives the same formula bitwise.
+HISTORY_ENTRY_BYTES = 112
+MULTISET_ENTRY_BYTES = 96
+
 
 class LiveGraphError(RuntimeError):
     """Base of the live-graph subsystem's typed failures."""
@@ -254,6 +265,7 @@ class MutationLog:
         self.nv = int(nv)
         self.capacity = int(capacity)
         self.version = int(version)
+        self.records = 0        # records appended THROUGH this handle
         if _resume is None:
             header = luxfmt.pack_wal_header(self.nv, self.capacity,
                                             version=self.version)
@@ -289,6 +301,17 @@ class MutationLog:
         self._f.flush()
         os.fsync(self._f.fileno())
         self._crc = int(np.frombuffer(record, luxfmt.V_DTYPE)[5])
+        self.records += 1
+
+    def buffer_bytes(self) -> int:
+        """Bytes the open append handle accounts for in the unified
+        byte ledger (lux_tpu/memwatch.py, round 22): the header plus
+        every record appended through THIS handle — the page-cache /
+        stream-buffer footprint of the append path.  Per-record fsync
+        keeps the userspace buffer empty, so this is an upper bound
+        on dirty bytes and exact on what the handle wrote."""
+        return (luxfmt.WAL_HEADER_SIZE
+                + self.records * luxfmt.WAL_RECORD_SIZE)
 
     def pack_edge(self, epoch: int, src: int, dst: int,
                   wbits: int) -> bytes:
@@ -796,6 +819,41 @@ class LiveGraph:
 
     def occupancy(self) -> float:
         return self.count / self.capacity
+
+    def memory_terms(self) -> dict:
+        """The live graph's host/device byte terms for the unified
+        per-replica ledger (lux_tpu/memwatch.py, round 22) — the
+        consumers rounds 20-21 built but never priced.  Every term is
+        a deterministic integer so the ledger's NumPy oracle can
+        re-derive it independently and match bitwise:
+
+        - ``live_delta``: the five preallocated delta-block arrays
+          (src/dst/w/kind/epoch, 20 B per capacity slot) — actual
+          ``nbytes``, priced at construction not occupancy, because
+          the allocation IS capacity-sized.
+        - ``live_history``: the full publish history list, nominal
+          HISTORY_ENTRY_BYTES per op (a 5-tuple + list slot; host
+          pointer structures have no exact portable size, so the
+          ledger prices the documented nominal — O(total mutations)
+          growth is the thing to see, not malloc jitter).
+        - ``live_multiset``: the lazily-built (src, dst) -> count
+          Counter, nominal MULTISET_ENTRY_BYTES per distinct edge,
+          ZERO until the first anti-monotone mutation builds it —
+          the step change is visible in the trail.
+        - ``live_wal``: the open append handle's written bytes
+          (MutationLog.buffer_bytes), 0 without a WAL."""
+        delta = (self.d_src.nbytes + self.d_dst.nbytes
+                 + self.d_w.nbytes + self.d_kind.nbytes
+                 + self.d_epoch.nbytes)
+        return {
+            "live_delta": int(delta),
+            "live_history": len(self._history) * HISTORY_ENTRY_BYTES,
+            "live_multiset": (0 if self._edge_counts is None
+                              else len(self._edge_counts)
+                              * MULTISET_ENTRY_BYTES),
+            "live_wal": (0 if self._wal is None
+                         else self._wal.buffer_bytes()),
+        }
 
     # -- pins (snapshot isolation vs compaction) -----------------------
 

@@ -132,6 +132,16 @@ class Gauge:
     def set(self, v: float) -> None:
         self.value = float(v)
 
+    def set_max(self, v: float) -> None:
+        """Ratchet: keep the LARGEST value ever set — the watermark
+        idiom (peak resident bytes, lux_tpu/memwatch.py round 22).
+        Lock-protected like inc/dec: two boundary threads racing a
+        plain read-compare-set could regress the peak."""
+        v = float(v)
+        with self._lock:
+            if v > self.value:
+                self.value = v
+
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
             self.value += n

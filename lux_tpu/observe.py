@@ -975,6 +975,19 @@ DEBTS = (
          "inside one jit) + in-place re-seed is the open lever, "
          "and the crossover wants measuring through the tunnel",
          "PERF_NOTES round 21 (mutation algebra)"),
+    Debt("hbm-watermark-on-device",
+         "the round-22 memory observatory's MEASURED leg "
+         "(lux_tpu/memwatch.py): every CPU/tunnel sample wears grade "
+         "'modeled' because no visible backend exposes "
+         "device.memory_stats(); on a session that does, run one "
+         "BASELINE ledger config, read the real per-device "
+         "peak_bytes_in_use watermark and verdict it against the "
+         "unified byte ledger — the first measured-grade "
+         "watermark-vs-ledger drift datapoint (and the XLA "
+         "temp/padding overhead figure the modeled tolerance only "
+         "bounds)",
+         "PERF_NOTES round 22 (memory observatory)", platform="tpu",
+         auto="_debt_hbm_watermark"),
 )
 
 
@@ -1114,6 +1127,41 @@ def collect_debts(fp: Fingerprint, ledger: PerfLedger | None,
             collected.append(payload)
         telemetry.current().emit("debt_collected", debt=d.id)
     return collected, skipped
+
+
+def _debt_hbm_watermark(fp: Fingerprint, clock=time.perf_counter):
+    """The measured-watermark debt: one BASELINE ledger config run
+    on a backend that exposes device.memory_stats(), its real peak
+    watermark verdicted against the unified byte ledger
+    (memwatch.drift_verdict, grade ``measured``).  Declines on
+    CPU/tunnel sessions — a modeled number recorded under this debt
+    would be exactly the grade-masquerade the observatory's grade
+    labels exist to prevent."""
+    from lux_tpu import audit, memwatch
+
+    if memwatch.device_memory_stats() is None:
+        return ("gated: backend exposes no memory_stats "
+                "(CPU/tunnel session) — the measured watermark "
+                "needs a real device")
+    cfgs = [(label, build) for label, build, led
+            in audit.matrix_configs() if led]
+    if not cfgs:
+        return "gated: no ledger-grade matrix config on this session"
+    label, build = cfgs[0]
+    eng = build()
+    ledger = memwatch.MemoryLedger.for_engine(eng, label)
+    trail = memwatch.MemoryTrail(clock=clock)
+    jitted, args_thunk = eng.audit_programs()["step"]
+    import jax
+    out = jitted(*args_thunk())
+    jax.block_until_ready(out)
+    s = trail.sample(where=f"debt:{label}")
+    if s.grade != memwatch.GRADE_MEASURED:
+        return "gated: memory_stats vanished between probe and sample"
+    v = memwatch.drift_verdict(s.peak_bytes, ledger.total_bytes,
+                               grade=s.grade, where=label)
+    return {"debt": "hbm-watermark-on-device", "config": label,
+            **v}
 
 
 def _debt_ici_bandwidth_probe(fp: Fingerprint,

@@ -132,10 +132,13 @@ def _next_n(segment, remaining: int) -> int:
 
 def run_segments(eng, state, num_iters: int, segment,
                  on_segment: Callable | None = None,
-                 start_iter: int = 0):
+                 start_iter: int = 0, mem=None):
     """Run a pull engine in slices (``segment``: int size or
     DurationBudget).  ``on_segment(state, done_iters)`` runs after
-    each slice and may return a replacement state.
+    each slice and may return a replacement state.  ``mem`` is a
+    memwatch.MemoryTrail sampled at every segment boundary (the
+    round-22 occupancy trail — O(1) host work, outside the fused
+    loop by construction).
 
     With telemetry active (lux_tpu/telemetry.py): each slice emits a
     ``segment`` event with its fenced seconds, and with iter-stats the
@@ -189,6 +192,8 @@ def run_segments(eng, state, num_iters: int, segment,
             tel.emit("segment", engine="pull", n=n, done=done,
                      seconds=round(dt, 6))
         seg_idx += 1
+        if mem is not None:
+            mem.sample(where=f"segment:{done}")
         if on_segment is not None:
             res = on_segment(state, done)
             if res is not None:
@@ -204,7 +209,7 @@ def run_segments(eng, state, num_iters: int, segment,
 def converge_segments(eng, label, active, segment,
                       max_iters: int | None = None,
                       on_segment: Callable | None = None,
-                      start_iter: int = 0):
+                      start_iter: int = 0, mem=None):
     """Run a push engine to convergence in slices (``segment``: int
     size or DurationBudget).
 
@@ -212,7 +217,8 @@ def converge_segments(eng, label, active, segment,
     each slice (may raise to abort, or return a replacement
     ``(label, active)``).  Convergence is detected from the active
     mask, never from iteration counts (delta-stepping counts relax
-    steps only).  Returns (label, active, total_iters).
+    steps only).  Returns (label, active, total_iters).  ``mem`` is
+    a memwatch.MemoryTrail sampled at every boundary (round 22).
 
     With telemetry active: each slice emits a ``segment`` event, and
     with iter-stats the slice runs ``eng.converge_stats`` — frontier/
@@ -267,6 +273,8 @@ def converge_segments(eng, label, active, segment,
         tel.emit("segment", engine="push", iters=it, total=total,
                  active=cnt, seconds=round(dt, 6))
         seg_idx += 1
+        if mem is not None:
+            mem.sample(where=f"segment:{total}")
         if on_segment is not None:
             res = on_segment(label, active, total, cnt)
             if res is not None:

@@ -405,12 +405,33 @@ class AnswerCache:
         self.bytes = 0              # sum of cached answer nbytes
         self.hits = 0
         self.misses = 0
+        # round-22 observatory rule (scripts/lint_lux.py
+        # budget-gauge): a consumer with a byte BUDGET must publish a
+        # byte GAUGE — a cap nobody can watch approaching is how the
+        # cache stayed unpriced through rounds 20-21
+        self._gauge = None
+
+    def set_metrics(self, registry, replica: str | None = None):
+        """Mirror the exact internal byte ledger into a registry
+        gauge (``serve_cache_bytes``); updated inside put/_pop under
+        the cache lock, so the gauge can never lag the ledger."""
+        labels = {} if replica is None else {"replica": replica}
+        self._gauge = (None if registry is None
+                       else registry.gauge("serve_cache_bytes",
+                                           **labels))
+        if self._gauge is not None:
+            self._gauge.set(self.bytes)
+
+    def _sync_gauge(self) -> None:
+        if self._gauge is not None:
+            self._gauge.set(self.bytes)
 
     def _pop(self, key) -> None:
         """Drop one entry, keeping the byte ledger exact (caller
         holds the lock)."""
         ent = self._d.pop(key)
         self.bytes -= ent.answer.nbytes
+        self._sync_gauge()
 
     @classmethod
     def from_slo(cls, slo_ms: dict | None) -> "AnswerCache":
@@ -477,6 +498,7 @@ class AnswerCache:
             self._d[key] = ent
             self._d.move_to_end(key)     # LRU: replace renews too
             self.bytes += ent.answer.nbytes
+            self._sync_gauge()
             while len(self._d) > 1 \
                     and (len(self._d) > self.max_entries
                          or self.bytes > self.max_bytes):
@@ -540,6 +562,10 @@ class _RunnerBase:
         # of drain() as a mid-drain replica death)
         self.replica: str | None = None
         self.on_boundary: Callable | None = None
+        # memory observatory (round 22, lux_tpu/memwatch.py): the
+        # boundary sampler rides the SAME hook cadence — O(1) host
+        # work per segment boundary, never inside the fused loop
+        self.mem = None
         # rolling SLO window: True per retirement = violation
         import collections
         self._slo_window = collections.deque(maxlen=SLO_WINDOW)
@@ -784,6 +810,8 @@ class PushBatchRunner(_RunnerBase):
         def hook(label, active, total, cnt):
             if self.on_boundary is not None:
                 self.on_boundary(self)
+            if self.mem is not None:
+                self.mem.sample(where=f"{self.kind}:boundary")
             for s in self.slots:
                 if s is not None:
                     s.segments += 1
@@ -971,6 +999,8 @@ class PullBatchRunner(_RunnerBase):
             nonlocal prev
             if self.on_boundary is not None:
                 self.on_boundary(self)
+            if self.mem is not None:
+                self.mem.sample(where=f"{self.kind}:boundary")
             for s in self.slots:
                 if s is not None:
                     s.segments += 1
@@ -1115,7 +1145,7 @@ class Server:
                  slo_ms: dict | None = None, metrics=None,
                  snapshot_every_s: float = 1.0, on_boundary=None,
                  replica: str | None = None, live=None,
-                 cache: bool | AnswerCache = False):
+                 cache: bool | AnswerCache = False, mem=None):
         self.g = g
         # live-graph serving (round 20, lux_tpu/livegraph.py):
         # ``live`` mutates under the queries — submit pins each
@@ -1142,6 +1172,10 @@ class Server:
         # the replica board (and fire kill plans) at every boundary
         self.on_boundary = on_boundary
         self.replica = replica
+        # round-22 memory observatory: a memwatch.MemoryTrail the
+        # runners sample at every segment boundary (assignable after
+        # construction too — runners are built lazily on first use)
+        self.mem = mem
         self.batch = int(batch)
         self.opts = dict(num_parts=num_parts, mesh=mesh,
                          exchange=exchange, health=health)
@@ -1161,6 +1195,8 @@ class Server:
             self.metrics = metrics_mod.Registry()
         else:
             self.metrics = metrics
+        if self.cache is not None:
+            self.cache.set_metrics(self.metrics, replica)
         self.snapshot_every_s = float(snapshot_every_s)
         self._last_snapshot = 0.0
         self._collectors: dict[str, BatchCollector] = {}
@@ -1191,6 +1227,7 @@ class Server:
                     seg_iters=self.seg_iters, **mkw, **self.opts)
             self._runners[kind].on_boundary = self.on_boundary
             self._runners[kind].replica = self.replica
+            self._runners[kind].mem = self.mem
         return self._runners[kind]
 
     def set_metrics(self, registry) -> None:
@@ -1204,6 +1241,8 @@ class Server:
             coll.metrics = registry
         for runner in self._runners.values():
             runner.metrics = registry
+        if self.cache is not None:
+            self.cache.set_metrics(registry, self.replica)
 
     def emit_metrics_snapshot(self, **extra):
         """Publish a ``metrics_snapshot`` telemetry event for this

@@ -74,6 +74,7 @@ import contextvars
 import dataclasses
 import json
 import os
+import threading
 import time
 
 SCHEMA = 1
@@ -531,6 +532,30 @@ class Telemetry:
 _NULL = Telemetry()
 _current: contextvars.ContextVar[Telemetry] = contextvars.ContextVar(
     "lux_tpu_telemetry", default=_NULL)
+
+# per-kind occurrence counters behind emit_sampled (process-global:
+# the sampler kinds it throttles are process-wide trails)
+_SAMPLED: dict = {}
+_SAMPLED_LOCK = threading.Lock()
+
+
+def emit_sampled(kind: str, every: int = 1, **fields):
+    """Throttled ``current().emit`` for high-frequency observability
+    kinds (round 22: the memory sampler fires at EVERY segment
+    boundary, and a long converge would otherwise swamp the event log
+    with ``mem_sample`` lines).  Emits occurrence 0, every, 2*every,
+    ... of ``kind`` and drops the rest; each emitted event carries
+    ``sampled_skipped`` (events suppressed since the last emitted
+    one) so a reader can tell throttling from a silent sampler.
+    ``every=1`` is a plain emit with ``sampled_skipped=0``."""
+    every = max(1, int(every))
+    with _SAMPLED_LOCK:
+        n = _SAMPLED.get(kind, 0)
+        _SAMPLED[kind] = n + 1
+    if n % every:
+        return None
+    return current().emit(kind, sampled_skipped=min(n, every - 1),
+                          **fields)
 
 
 def current() -> Telemetry:

@@ -318,6 +318,20 @@ def _run_spans(run, us, trk: _Track, te: list):
                         te.extend(_collective_spans(
                             comm, i, ph, s, d, trk.pid, tid))
                     cur += d
+        elif kind == "mem_sample":
+            # round-22 memory observatory: the occupancy trail draws
+            # as a Chrome COUNTER track ("C" phase) — live bytes +
+            # the peak watermark as stacked series, one counter row
+            # per replica when the sample is labeled
+            cname = "memory"
+            if ev.get("replica"):
+                cname = f"memory:{ev['replica']}"
+            te.append({"name": cname, "ph": "C", "ts": ts,
+                       "pid": trk.pid,
+                       "args": {"live_bytes":
+                                int(ev.get("live_bytes", 0)),
+                                "peak_bytes":
+                                int(ev.get("peak_bytes", 0))}})
         elif kind in RUN_BOUNDARIES:
             pass                       # represented by the run span
         else:
@@ -778,6 +792,12 @@ class FlightRecorder:
         self.last_calibration: dict | None = None
         self.placement: dict = {}
         self.dumps = 0
+        # round-22 memory observatory: the occupancy trail survives
+        # the main ring's churn — a fatal's postmortem always shows
+        # the memory history even when chatty per-query events have
+        # already rotated the samples out of the ring
+        self.mem_trail: collections.deque = collections.deque(
+            maxlen=64)
 
     def record(self, ev: dict) -> None:
         self.ring.append(ev)
@@ -801,6 +821,8 @@ class FlightRecorder:
         elif k == "replace":
             if _num(ev.get("to_ndev")):
                 self.placement["ndev"] = ev["to_ndev"]
+        elif k in ("mem_sample", "mem_watermark", "mem_pressure"):
+            self.mem_trail.append(ev)
 
     def snapshot(self, reason=None, classification=None) -> dict:
         counts: dict = {}
@@ -813,6 +835,7 @@ class FlightRecorder:
                 "health": self.last_health,
                 "calibration": self.last_calibration,
                 "counts": counts,
+                "mem_trail": list(self.mem_trail) or None,
                 "events": list(self.ring)}
 
     def dump(self, reason=None, classification=None) -> str:

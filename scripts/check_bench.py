@@ -333,6 +333,7 @@ def check_line(obj: dict, *, legacy_ok: bool):
 
     errs += check_audit_field(name, obj)
     errs += check_comm_field(name, obj)
+    errs += check_mem_field(name, obj)
 
     if "calibration" not in obj:
         (warns if legacy_ok else errs).append(
@@ -692,6 +693,31 @@ def check_serve_live_fields(name: str, obj: dict) -> list[str]:
             if algebra["deletions"] is None
             or algebra["reweights"] is None
             else algebra["deletions"] + algebra["reweights"])
+    # round-22: the headline line is weighted (the reweight leg of
+    # the mutation algebra was previously exercised only by tests —
+    # a headline carrying reweights=0 measures half the algebra).
+    # ``weighted`` is optional (pre-round-22 artifacts omit it) but
+    # present it must agree with the reweight counter both ways:
+    # a reweight needs a weight array to rewrite, and a weighted
+    # live line that never reweights is the regression this field
+    # exists to catch.
+    wtd = obj.get("weighted")
+    if "weighted" in obj and not isinstance(wtd, bool):
+        errs.append(f"{name}: weighted={wtd!r} must be a bool")
+        wtd = None
+    if wtd is False and algebra["reweights"] is not None \
+            and algebra["reweights"] > 0:
+        errs.append(
+            f"{name}: reweights={algebra['reweights']} on an "
+            f"UNWEIGHTED line — a reweight rewrites an edge's "
+            f"weight; with no weight array the counter cannot have "
+            f"moved (lux_tpu/livegraph.py)")
+    if wtd is True and algebra["reweights"] == 0:
+        errs.append(
+            f"{name}: weighted=True with reweights=0 — the weighted "
+            f"headline exists to exercise the reweight leg of the "
+            f"mutation algebra; a weighted run that never reweights "
+            f"is the round-22 regression this field guards against")
     if algebra["reseeds"] is not None and anti is not None \
             and algebra["reseeds"] > 0 and anti == 0:
         errs.append(
@@ -1075,6 +1101,92 @@ def check_comm_field(name: str, obj: dict) -> list[str]:
                 f"{name}: comm.comm_bytes_per_edge={bpe} disagrees "
                 f"with bytes_per_iter * ndev / ne = {want:.6f} — the "
                 f"per-edge claim contradicts the per-iteration bill")
+    return errs
+
+
+MEM_GRADES = ("measured", "modeled")
+
+
+def check_mem_field(name: str, obj: dict) -> list[str]:
+    """Round-22 memory digest (bench.py, lux_tpu/memwatch.py):
+    optional (pre-round-22 artifacts omit it); present it must be a
+    clean watermark-vs-ledger verdict.  Rejects: a null digest (the
+    observatory never ran, so the line's bytes are unaccounted), a
+    drifting digest (errors > 0 — the measured peak disagrees with
+    the unified byte ledger beyond tolerance, so the run's memory
+    cannot be accounted), an unknown grade, a ratio that contradicts
+    its own errors=0 claim, and byte counts that are not ints."""
+    if "mem" not in obj:
+        return []
+    m = obj["mem"]
+    if m is None:
+        return [f"{name}: mem digest is null — the memory "
+                f"observatory never ran, so the line's bytes are "
+                f"unaccounted (lux_tpu/memwatch.py)"]
+    if not isinstance(m, dict):
+        return [f"{name}: mem must be null or a dict, got {m!r}"]
+    errs = []
+    me = m.get("errors")
+    if not isinstance(me, int) or isinstance(me, bool) or me < 0:
+        errs.append(f"{name}: mem.errors={me!r} must be an int >= 0")
+        return errs
+    if me:
+        errs.append(
+            f"{name}: mem digest from a DRIFTING build (errors={me}"
+            f"{': ' + str(m.get('error')) if m.get('error') else ''}) "
+            f"— a metric whose measured peak disagrees with its own "
+            f"byte ledger cannot stand (lux_tpu/memwatch.py)")
+        return errs
+    if m.get("error"):
+        # digest construction failed; _mem_build records the message
+        # with errors=1, so errors=0 alongside an error string is a
+        # self-contradiction
+        errs.append(f"{name}: mem.error={m.get('error')!r} with "
+                    f"errors=0 — a failed digest cannot claim a "
+                    f"clean bill")
+        return errs
+    grade = m.get("grade")
+    if grade not in MEM_GRADES:
+        errs.append(f"{name}: mem.grade={grade!r} not one of "
+                    f"{MEM_GRADES}")
+    skipped = m.get("skipped")
+    if "skipped" in m and not isinstance(skipped, str):
+        errs.append(f"{name}: mem.skipped={skipped!r} must be a "
+                    f"string (the withheld-verdict reason)")
+    if "skipped" in m and not m.get("warnings"):
+        errs.append(f"{name}: mem digest skipped "
+                    f"({skipped!r}) with warnings=0 — a withheld "
+                    f"verdict must count as a warning")
+    lb = m.get("ledger_bytes")
+    if not isinstance(lb, int) or isinstance(lb, bool) or lb < 0:
+        errs.append(f"{name}: mem.ledger_bytes={lb!r} must be an "
+                    f"int >= 0")
+    # a skipped digest (backend without AOT stats, or a shape under
+    # the check floor) withholds the verdict: peak/ratio may be
+    # absent or out-of-tolerance and the warning count says why
+    pk = m.get("peak_bytes")
+    if "skipped" not in m and (not isinstance(pk, int)
+                               or isinstance(pk, bool) or pk < 0):
+        errs.append(f"{name}: mem.peak_bytes={pk!r} must be an "
+                    f"int >= 0")
+    tol = m.get("tol")
+    if not _is_num(tol) or tol <= 0:
+        errs.append(f"{name}: mem.tol={tol!r} must be a finite "
+                    f"number > 0")
+        tol = None
+    ratio = m.get("ratio")
+    if "skipped" not in m and (not _is_num(ratio) or ratio < 0):
+        errs.append(f"{name}: mem.ratio={ratio!r} must be a finite "
+                    f"number >= 0")
+        ratio = None
+    if _is_num(ratio) and tol is not None and "skipped" not in m \
+            and not (1.0 / (1.0 + tol) - 1e-9 <= ratio
+                     <= 1.0 + tol + 1e-9):
+        errs.append(
+            f"{name}: mem.ratio={ratio} outside [1/(1+tol), 1+tol] "
+            f"for tol={tol} with errors=0 — the digest contradicts "
+            f"its own clean verdict (lux_tpu/memwatch.py drift "
+            f"tolerance)")
     return errs
 
 

@@ -116,7 +116,8 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "replica_up", "replica_lost", "failover", "query_shed",
          "brownout", "comm_ledger", "link_calibration",
          "mutation", "epoch_advance", "compact_start", "compact_done",
-         "wal_truncate", "wal_replay", "reseed", "compact_scheduled"}
+         "wal_truncate", "wal_replay", "reseed", "compact_scheduled",
+         "mem_sample", "mem_watermark", "mem_pressure"}
 
 # round 19 (communication observatory, lux_tpu/comms.py): the
 # collective primitives a comm_ledger breakdown may name — matching
@@ -133,6 +134,12 @@ QUERY_SHED_REQUIRED = ("qid", "query_kind", "reason")
 # economics that justified it, or the decision cannot be audited
 COMPACT_SCHEDULED_REQUIRED = ("occupancy", "threshold", "delta_count",
                               "drag_ns", "drag_source", "reason")
+
+# round 22 (memory observatory, lux_tpu/memwatch.py): a mem_pressure
+# without these cannot justify the forecast it claims — the
+# burn-rate/time-to-full decision contract
+MEM_PRESSURE_REQUIRED = ("reason", "live_bytes", "budget_bytes",
+                         "burn")
 
 # a failover without these cannot name the transition it claims
 FAILOVER_REQUIRED = ("qid", "from_replica", "to_replica")
@@ -840,6 +847,14 @@ def render_run(run, out=sys.stdout) -> list[str]:
     # wal_replay, which can restore a crashed publisher's pending
     # anti ops) — the only trails a reseed may follow
     anti_published: set = set()
+    # round 22 (memory observatory, lux_tpu/memwatch.py): replica
+    # keys (None = unlabelled trail) that have published at least one
+    # occupancy sample.  A mem_pressure — or a query_shed with the
+    # typed ``memory`` reason — with NO preceding mem_sample /
+    # mem_watermark anywhere in the run is a forecast with no
+    # evidence: the decision claims a burn rate no sample fed
+    mem_sampled: set = set()
+    mem_peak, mem_pressures = 0, 0
 
     def _saw_epoch(path, e):
         max_epoch_seen[path] = max(max_epoch_seen.get(path, 0), e)
@@ -861,6 +876,33 @@ def render_run(run, out=sys.stdout) -> list[str]:
                             f"delete/reweight publish (or wal_replay) "
                             f"on its log — anti-monotone revalidation "
                             f"with nothing to revalidate")
+        elif k in ("mem_sample", "mem_watermark"):
+            mem_sampled.add(ev.get("replica"))
+            pk = ev.get("peak_bytes")
+            if _is_num(pk):
+                mem_peak = max(mem_peak, pk)
+        elif k == "mem_pressure":
+            mem_pressures += 1
+            missing = [f for f in MEM_PRESSURE_REQUIRED if f not in ev]
+            if missing:
+                errs.append(f"{title}: mem_pressure missing "
+                            f"field(s) {missing} — a forecast that "
+                            f"cannot justify itself")
+            if ev.get("replica") not in mem_sampled \
+                    and None not in mem_sampled:
+                errs.append(f"{title}: mem_pressure (reason="
+                            f"{ev.get('reason')!r}, replica="
+                            f"{ev.get('replica')!r}) with no "
+                            f"preceding mem_sample/mem_watermark — "
+                            f"the forecaster claims a burn rate no "
+                            f"occupancy sample ever fed")
+        elif k == "query_shed" and ev.get("reason") == "memory" \
+                and not mem_sampled:
+            errs.append(f"{title}: memory-reason query_shed qid="
+                        f"{ev.get('qid')} with no preceding "
+                        f"occupancy sample — an admission decision "
+                        f"priced against a byte trail that was "
+                        f"never observed")
         elif k == "compact_scheduled":
             missing = [f for f in COMPACT_SCHEDULED_REQUIRED
                        if f not in ev]
@@ -896,6 +938,13 @@ def render_run(run, out=sys.stdout) -> list[str]:
             if _is_int(e):
                 _saw_epoch(ev.get("path"), e)
             anti_published.add(ev.get("path"))
+    if mem_sampled or mem_pressures:
+        n_s = len(by.get("mem_sample", []))
+        n_w = len(by.get("mem_watermark", []))
+        print(f"  memory: {n_s} sample(s), {n_w} watermark(s), "
+              f"peak {mem_peak} bytes"
+              + (f", {mem_pressures} PRESSURE signal(s)"
+                 if mem_pressures else ""), file=out)
     if muts:
         edges = sum(m.get("edges", 0) for m in muts
                     if _is_int(m.get("edges")))
@@ -1083,6 +1132,23 @@ def render_flight(path: str, out=sys.stdout) -> list[str]:
         print(f"  calibration: {cal.get('platform')} "
               f"grade={cal.get('grade')} "
               f"deviation={cal.get('deviation')}", file=out)
+    # round 22: the memory trail at the moment of death — the flight
+    # recorder keeps the last mem_sample/mem_watermark/mem_pressure
+    # events so an OOM postmortem can read the occupancy ramp
+    mt = doc.get("mem_trail")
+    if mt:
+        last = mt[-1] if isinstance(mt[-1], dict) else {}
+        print(f"  memory trail: {len(mt)} sample(s), last "
+              f"live={last.get('live_bytes', '-')} "
+              f"peak={last.get('peak_bytes', '-')} "
+              f"({last.get('grade', '-')})", file=out)
+        for ev in mt[-4:]:
+            if isinstance(ev, dict) and ev.get("kind") == \
+                    "mem_pressure":
+                print(f"    PRESSURE reason={ev.get('reason')} "
+                      f"live={ev.get('live_bytes')} "
+                      f"budget={ev.get('budget_bytes')} "
+                      f"burn={ev.get('burn')}", file=out)
     evs = doc["events"]
     counts = doc.get("counts") or {}
     print(f"  ring: {len(evs)} event(s) "

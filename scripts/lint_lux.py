@@ -486,6 +486,55 @@ def check_decremental_oracle(path, tree, lines):
 
 
 # ---------------------------------------------------------------------
+# check: byte-budgeted consumers register a gauge
+
+
+def check_budget_gauge(path, tree, lines):
+    """Round 22 (memory observatory): a memory-consumer class with a
+    byte budget — any class whose ``__init__`` assigns
+    ``self.max_bytes`` — must register a metrics gauge (reference a
+    ``.gauge(`` call somewhere in the class) so its live occupancy is
+    observable.  A budgeted consumer with no gauge is a byte ceiling
+    the observatory cannot see approaching: the ledger can price it
+    but no trail can watch it fill (lux_tpu/memwatch.py; the
+    AnswerCache serve_cache_bytes gauge is the template).  Runs
+    TREE-WIDE like the decremental rule — consumers live in serve.py
+    / livegraph.py, not one directory."""
+    findings = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        if init is None:
+            continue
+        budgeted = any(
+            isinstance(t, ast.Attribute) and t.attr == "max_bytes"
+            and isinstance(t.value, ast.Name) and t.value.id == "self"
+            for n in ast.walk(init) if isinstance(n, ast.Assign)
+            for t in n.targets)
+        if not budgeted:
+            continue
+        if _suppressed(lines, cls.lineno, "budget-gauge"):
+            continue
+        has_gauge = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "gauge"
+            for n in ast.walk(cls))
+        if not has_gauge:
+            findings.append(Finding(
+                path, cls.lineno, "budget-gauge",
+                f"{cls.name} budgets bytes (self.max_bytes) but "
+                f"registers no metrics gauge — a byte ceiling the "
+                f"memory observatory cannot watch fill "
+                f"(lux_tpu/memwatch.py round 22; see "
+                f"AnswerCache.set_metrics for the convention)"))
+    return findings
+
+
+# ---------------------------------------------------------------------
 # check: citation presence
 
 
@@ -736,6 +785,9 @@ def lint_file(path: str):
     # decremental rule runs TREE-WIDE: the deletion-capable builders
     # live in lux_tpu/livegraph.py, not under apps/
     findings += check_decremental_oracle(path, tree, lines)
+    # budget-gauge rule runs TREE-WIDE too: byte-budgeted consumers
+    # live in serve.py / livegraph.py, not one directory
+    findings += check_budget_gauge(path, tree, lines)
     if "/lux_tpu/engine/" in norm or "/lux_tpu/ops/" in norm:
         findings += check_citation(path, tree, lines)
     if "/lux_tpu/engine/" in norm:

@@ -862,3 +862,99 @@ def test_null_comm_digest_rejected(tmp_path):
     r = run_check(p)
     assert r.returncode == 1
     assert "comm digest is null" in r.stderr
+
+
+# ---------------------------------------------------------------------
+# round-22 memory digest (lux_tpu/memwatch.py, bench.py _mem_build)
+
+GOOD_MEM = {"where": "PushEngine", "grade": "modeled",
+            "peak_bytes": 1048576, "ledger_bytes": 1000000,
+            "ratio": 1.0486, "tol": 0.5, "errors": 0, "warnings": 0}
+
+
+def _with_mem(pop=(), **over):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["mem"] = {k: v for k, v in dict(GOOD_MEM, **over).items()
+                if k not in pop}
+    return d
+
+
+def test_mem_digest_accepted(tmp_path):
+    """A clean watermark-vs-ledger verdict passes strict mode; an
+    explicitly-skipped digest (backend without AOT stats, or a
+    padding-dominated shape under the check floor) passes with its
+    warning; lines without the field (pre-round-22) still pass."""
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(_with_mem()) + "\n")
+    r = run_check(p)
+    assert r.returncode == 0, r.stderr
+    skipped = _with_mem(pop=("peak_bytes", "ratio"), warnings=1,
+                        skipped="memory_analysis unavailable: axon")
+    p.write_text(json.dumps(skipped) + "\n")
+    assert run_check(p).returncode == 0
+    measured = _with_mem(grade="measured")
+    p.write_text(json.dumps(measured) + "\n")
+    assert run_check(p).returncode == 0
+    d = json.loads(json.dumps(GOOD_LINE))
+    p.write_text(json.dumps(d) + "\n")
+    assert run_check(p).returncode == 0
+
+
+@pytest.mark.parametrize("over,needle", [
+    # a drifting build can never publish
+    ({"errors": 1, "error": "MemoryDriftError: ratio 2.07"},
+     "DRIFTING"),
+    # errors=0 alongside an error string is a self-contradiction
+    ({"error": "boom"}, "cannot claim a clean bill"),
+    ({"grade": "guessed"}, "mem.grade"),
+    ({"peak_bytes": -1}, "mem.peak_bytes"),
+    ({"ledger_bytes": "big"}, "mem.ledger_bytes"),
+    ({"tol": 0}, "mem.tol"),
+    ({"ratio": -2.0}, "mem.ratio"),
+    # a ratio outside tolerance contradicts its own errors=0 claim
+    ({"ratio": 3.0}, "contradicts its own clean verdict"),
+    ({"ratio": 0.1}, "contradicts its own clean verdict"),
+    # a withheld verdict must count as a warning
+    ({"skipped": "below check floor", "warnings": 0},
+     "must count as a warning"),
+])
+def test_bad_mem_digests_fail(tmp_path, over, needle):
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(_with_mem(**over)) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert needle in r.stderr
+
+
+def test_null_mem_digest_rejected(tmp_path):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["mem"] = None
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(d) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert "mem digest is null" in r.stderr
+
+
+# round-22 weighted serve-live schema extension
+
+def test_serve_live_weighted_line_passes(tmp_path):
+    obj = json.loads(json.dumps(SERVE_LIVE_LINE))
+    obj["weighted"] = True        # reweights=2 in the fixture
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda o: o.update(weighted=False), "UNWEIGHTED line"),
+    (lambda o: o.update(weighted=True, reweights=0),
+     "weighted headline"),
+    (lambda o: o.update(weighted="yes"), "must be a bool"),
+])
+def test_bad_weighted_serve_live_lines_fail(tmp_path, mutate,
+                                            needle):
+    obj = json.loads(json.dumps(SERVE_LIVE_LINE))
+    mutate(obj)
+    r = _audit_one(tmp_path, obj)
+    assert r.returncode == 1, "audit passed a bad weighted line"
+    assert needle in r.stderr, r.stderr

@@ -1,0 +1,499 @@
+"""lux_tpu/memwatch.py: the round-22 memory observatory.
+
+Acceptance (ISSUE 17): the unified per-replica byte ledger is proved
+against an independent NumPy oracle bitwise; a synthetic overdrift
+raises the typed MemoryDriftError; a byte-budgeted FleetServer sheds
+with the typed ``memory`` reason BEFORE any allocation failure, with
+the forecaster's mem_pressure preceding the shed in the audited event
+trail and every admitted answer oracle-correct; events_summary FAILS
+a mem_pressure/OOM trail that carries no preceding occupancy sample;
+`python -m lux_tpu.memwatch` (the repo-wide acceptance command) runs
+green on CPU, tier-1-gated like `python -m lux_tpu.comms`; and the
+round-22 serve-chaos regression (a kill plan armed on a replica the
+routing loop starves never fires) stays fixed via
+fleet.routing_target.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lux_tpu import audit, faults, fleet, livegraph, memwatch, \
+    metrics, resilience, serve, telemetry
+from lux_tpu.apps import sssp as sssp_app
+from lux_tpu.convert import uniform_random_edges
+from lux_tpu.graph import Graph
+
+REPO = Path(__file__).resolve().parent.parent
+SUMMARY = REPO / "scripts" / "events_summary.py"
+
+NV, NE, SEED = 256, 2048, 7
+
+
+@pytest.fixture(scope="module")
+def g():
+    src, dst = uniform_random_edges(NV, NE, seed=SEED)
+    return Graph.from_edges(src, dst, NV)
+
+
+def fast_retry():
+    return resilience.RetryPolicy(retries=3, backoff_s=0.01,
+                                  max_backoff_s=0.05, jitter_seed=0)
+
+
+def make_fleet(g, tmp_path, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("batch", 2)
+    kw.setdefault("num_parts", 2)
+    kw.setdefault("retry", fast_retry())
+    kw.setdefault("board_path", str(tmp_path / "board"))
+    return fleet.FleetServer(g, **kw)
+
+
+# ---------------------------------------------------------------------
+# pillar 2: the unified ledger vs an independent NumPy oracle
+
+
+class TestLedgerOracle:
+    def test_engine_ledger_bitwise_oracle(self, g):
+        """Every term of the engine ledger re-derived independently
+        from memory_report / program attributes matches bitwise, the
+        total is the bitwise sum, and the argument-side quantity
+        equals audit.priced_argument_bytes — the one number the
+        compile-time drift check prices."""
+        eng = sssp_app.build_engine(g, num_parts=2)
+        led = memwatch.MemoryLedger.for_engine(eng, "oracle")
+        P = eng.sg.num_parts
+        rep = eng.sg.memory_report(**audit.report_kwargs(eng))
+        # the decomposition identity memory_report promises
+        assert rep["total_bytes"] == P * sum(
+            rep["terms_per_part"].values())
+        want = {f"graph_{k}": P * int(v)
+                for k, v in rep["terms_per_part"].items() if v}
+        sb = getattr(eng.program, "state_bytes", None)
+        if sb:
+            want["program_state"] = P * eng.sg.vpad * (sb - 4)
+        xa = getattr(eng.program, "extra_arrays", None)
+        if xa is not None:
+            want["program_extra"] = sum(
+                np.asarray(v).nbytes for v in xa(eng.sg).values())
+        assert led.terms == want
+        assert led.total_bytes == sum(want.values())
+        assert led.argument_bytes() \
+            == audit.priced_argument_bytes(eng)
+
+    def test_consumer_terms_bitwise_oracle(self, g, tmp_path):
+        """The dynamic consumer terms — AnswerCache bytes, live
+        delta/history/multiset/WAL, checkpoint staging — each
+        re-derived from the raw objects, bitwise."""
+        cache = serve.AnswerCache(max_bytes=1 << 20)
+        a1 = np.arange(NV, dtype=np.float32)
+        a2 = np.arange(NV, dtype=np.int32)
+        cache.put("sssp", serve.Request(qid=0, kind="sssp", source=3),
+                  a1, 4, 0, now=0.0)
+        cache.put("components",
+                  serve.Request(qid=1, kind="components", source=5),
+                  a2, 4, 0, now=0.0)
+        lv = livegraph.LiveGraph(g, capacity=64,
+                                 wal_path=str(tmp_path / "w.wal"))
+        try:
+            lv.append_edges([1, 2, 3], [4, 5, 6])
+            lv.delete_edges([1], [4])      # builds the multiset
+            memwatch.note_staging(12345)
+            terms = memwatch.consumer_terms(cache=cache, live=lv)
+            assert terms["cache"] == cache.bytes
+            assert terms["live_delta"] == (
+                lv.d_src.nbytes + lv.d_dst.nbytes + lv.d_w.nbytes
+                + lv.d_kind.nbytes + lv.d_epoch.nbytes)
+            assert terms["live_history"] == \
+                len(lv._history) * livegraph.HISTORY_ENTRY_BYTES
+            assert terms["live_multiset"] == \
+                len(lv._edge_counts) * livegraph.MULTISET_ENTRY_BYTES
+            assert terms["live_multiset"] > 0
+            assert terms["live_wal"] == lv._wal.buffer_bytes()
+            assert terms["live_wal"] > 0
+            assert terms["checkpoint_staging"] == 12345
+            led = memwatch.MemoryLedger(terms, "consumers")
+            assert led.total_bytes == sum(terms.values())
+        finally:
+            memwatch.note_staging(0)
+            lv.close()
+
+    def test_cache_byte_ledger_tracks_put_and_evict(self):
+        """The AnswerCache's internal byte ledger moves exactly with
+        put/evict and the registry gauge mirrors it."""
+        cache = serve.AnswerCache(max_bytes=4096)
+        reg = metrics.Registry()
+        cache.set_metrics(reg)
+        a = np.zeros(256, np.float32)      # 1024 B payload
+
+        def put(source):
+            cache.put("sssp",
+                      serve.Request(qid=source, kind="sssp",
+                                    source=source),
+                      a, 4, 0, now=0.0)
+
+        put(1)
+        assert cache.bytes == a.nbytes
+        put(2)
+        assert cache.bytes == 2 * a.nbytes
+        # overflow evicts until under budget — the ledger never lies
+        for s in range(3, 10):
+            put(s)
+        assert cache.bytes <= 4096
+        g_ = reg.gauge("serve_cache_bytes")
+        assert g_.value == cache.bytes
+
+
+# ---------------------------------------------------------------------
+# pillar 2: drift verdicts
+
+
+class TestDrift:
+    def test_overdrift_raises_typed_error(self):
+        led = memwatch.MemoryLedger({"graph_edge": 1_000_000}, "syn")
+        with pytest.raises(memwatch.MemoryDriftError) as ei:
+            memwatch.check_drift(4_000_000, led, grade="modeled",
+                                 where="syn", mode="error")
+        e = ei.value
+        assert e.check == "mem-drift"
+        assert e.measured == 4_000_000
+        assert e.ledger == 1_000_000
+        assert e.ratio == pytest.approx(4.0)
+        assert resilience.classify(e) is not None
+
+    def test_underdrift_raises_too(self):
+        """A measured peak far UNDER the ledger is the same lie in
+        the other direction (the ledger prices ghosts)."""
+        led = memwatch.MemoryLedger({"graph_edge": 4_000_000}, "syn")
+        with pytest.raises(memwatch.MemoryDriftError):
+            memwatch.check_drift(1_000_000, led, grade="modeled",
+                                 where="syn", mode="error")
+
+    def test_within_tolerance_is_clean(self):
+        led = memwatch.MemoryLedger({"graph_edge": 1_000_000}, "syn")
+        v = memwatch.check_drift(1_200_000, led, grade="measured",
+                                 where="syn", mode="error")
+        assert v["errors"] == 0
+        assert v["grade"] == "measured"
+
+    def test_warn_mode_warns_instead(self):
+        led = memwatch.MemoryLedger({"graph_edge": 1_000_000}, "syn")
+        with pytest.warns(UserWarning, match="unified ledger"):
+            v = memwatch.check_drift(4_000_000, led, grade="modeled",
+                                     where="syn", mode="warn")
+        assert v["errors"] == 1
+
+    def test_engine_verdict_cpu_is_accounted(self):
+        """On CPU the AOT memory_analysis path produces a clean
+        modeled verdict (or an explicitly-skipped digest — never a
+        silent number) for a drift-checkable matrix config.  Tiny
+        shapes are padding-dominated and NOT drift-checkable: only
+        ledger-flag configs carry the guarantee (audit.check_ledger's
+        rule; `python -m lux_tpu.memwatch` sweeps them all)."""
+        label, build, _ = next(
+            c for c in audit.matrix_configs() if c[2])
+        v = memwatch.engine_verdict(build(), mode="error",
+                                    where=label)
+        assert v["grade"] == "modeled"
+        assert v["errors"] == 0
+        assert "skipped" not in v or v["warnings"] >= 1
+
+
+# ---------------------------------------------------------------------
+# pillar 3: the forecaster (pure policy, fake clock)
+
+
+class TestForecaster:
+    def test_ramp_fires_time_to_full_before_full(self):
+        f = memwatch.MemoryForecaster(1000, horizon_s=5.0)
+        d = f.record(100, t=0.0)
+        assert d["action"] == "ok" and not d["fired"]
+        d = f.record(200, t=1.0)       # 100 B/s, 800 B head: ttf 8 s
+        assert d["action"] == "ok" and d["reason"] == "headroom"
+        d = f.record(400, t=2.0)       # 150 B/s, 600 B head: ttf 4 s
+        assert d["action"] == "pressure"
+        assert d["reason"] == "time_to_full"
+        assert d["fired"] and f.pressures == 1
+        assert d["time_to_full_s"] == pytest.approx(4.0)
+        assert d["burn"] > 1.0         # budget gone within a horizon
+        # still pressed: no re-fire (one event per crossing)
+        d = f.record(600, t=3.0)
+        assert d["action"] == "pressure" and not d["fired"]
+        assert f.pressures == 1
+
+    def test_over_budget_reason_and_hysteresis(self):
+        f = memwatch.MemoryForecaster(1000, horizon_s=1.0)
+        f.record(500, t=0.0)
+        d = f.record(1200, t=1.0)
+        assert d["action"] == "pressure"
+        assert d["reason"] == "over_budget"
+        assert d["time_to_full_s"] == 0.0
+        assert d["fired"]
+        # recovery re-arms the latch; a second crossing fires again
+        d = f.record(100, t=2.0)
+        assert d["action"] == "ok" and not f.pressed
+        d = f.record(1100, t=3.0)
+        assert d["fired"] and f.pressures == 2
+
+    def test_flat_trail_never_fires(self):
+        f = memwatch.MemoryForecaster(1000, horizon_s=5.0)
+        for i in range(6):
+            d = f.record(400, t=float(i))
+        assert d["action"] == "ok"
+        assert d["time_to_full_s"] is None     # inf: flat
+        assert f.pressures == 0
+
+
+# ---------------------------------------------------------------------
+# pillar 3: memory-aware admission on the fleet
+
+
+class TestMemoryAdmission:
+    def test_tiny_budget_sheds_typed(self, g, tmp_path):
+        flt = make_fleet(g, tmp_path, mem_budget_bytes=1,
+                         mem_clock=lambda: 0.0)
+        flt.warm(["sssp"])
+        with pytest.raises(fleet.AdmissionError) as ei:
+            flt.submit("sssp", source=3)
+        e = ei.value
+        assert e.reason == fleet.SHED_MEMORY
+        assert e.projected_bytes is not None and e.projected_bytes > 1
+        assert e.budget_bytes == 1
+        assert "projected" in str(e) and "budget" in str(e)
+
+    def test_generous_budget_admits_and_serves(self, g, tmp_path):
+        flt = make_fleet(g, tmp_path, mem_budget_bytes=1 << 40)
+        flt.warm(["sssp"])
+        qid = flt.submit("sssp", source=3)
+        rs = flt.run()
+        assert qid in {r.qid for r in rs}
+        assert serve._check_answers(g, rs) == 0
+
+    def test_cold_replica_is_not_priced(self, g, tmp_path):
+        """Before warm no runner exists: cold admission stays
+        optimistic (exactly like _projected_wait) — the budget only
+        bites once the target replica has an engine to price."""
+        flt = make_fleet(g, tmp_path, mem_budget_bytes=1)
+        assert flt._projected_bytes("sssp") is None
+
+    def test_pressure_precedes_shed_in_audited_trail(self, g,
+                                                     tmp_path):
+        """THE round-22 chaos-leg acceptance: a budgeted fleet under
+        admission load with a growing consumer (the shared
+        AnswerCache) emits the forecaster's mem_pressure BEFORE the
+        first typed memory shed, the event trail passes the
+        events_summary order audit, and every ADMITTED answer is
+        oracle-correct."""
+        # probe run: measure the projected admission bytes and the
+        # per-retirement cache growth on an identical throwaway tier
+        probe = make_fleet(g, tmp_path / "probe", cache=True)
+        probe.warm(["sssp"])
+        p0 = probe._projected_bytes("sssp")
+        assert p0 is not None
+        b0 = probe.cache.bytes
+        probe.submit("sssp", source=11)
+        probe.run()
+        grow = probe.cache.bytes - b0
+        assert grow > 0
+        # budget: admits until the cache has grown ~3 retirements'
+        # worth, then the projection crosses and admission sheds.
+        # horizon huge: the first positive burn rate the boundary
+        # sampler sees trips time_to_full immediately — the pressure
+        # signal must land before the shed can.
+        budget = p0 + 3 * grow
+        ev = telemetry.EventLog(str(tmp_path / "ev.jsonl"))
+        with telemetry.use(events=ev):
+            flt = make_fleet(g, tmp_path, cache=True,
+                             mem_budget_bytes=budget,
+                             mem_horizon_s=1e9)
+            flt.warm(["sssp"])
+            admitted, shed = 0, 0
+            for s in range(1, 25):
+                try:
+                    flt.submit("sssp", source=s)
+                    admitted += 1
+                except fleet.AdmissionError as e:
+                    assert e.reason == fleet.SHED_MEMORY
+                    shed += 1
+                rs = flt.run()
+                assert serve._check_answers(g, rs) == 0
+        ev.close()
+        assert shed >= 1, "budget never bit — test is vacuous"
+        assert admitted >= 1, "nothing admitted — budget too tight"
+        kinds = [json.loads(ln)["kind"]
+                 for ln in Path(ev.path).read_text().splitlines()]
+        events = [json.loads(ln)
+                  for ln in Path(ev.path).read_text().splitlines()]
+        assert "mem_sample" in kinds or "mem_watermark" in kinds
+        assert "mem_pressure" in kinds, (
+            "forecaster never fired despite the ramp to the budget")
+        first_pressure = kinds.index("mem_pressure")
+        first_mem_shed = next(
+            i for i, e in enumerate(events)
+            if e["kind"] == "query_shed"
+            and e.get("reason") == fleet.SHED_MEMORY)
+        assert first_pressure < first_mem_shed, (
+            "forecaster fired AFTER admission already shed — the "
+            "early-warning contract is inverted")
+        shed_ev = events[first_mem_shed]
+        assert shed_ev.get("projected_bytes", 0) > budget
+        assert shed_ev.get("budget_bytes") == budget
+        # the order-sensitive events_summary audit accepts the trail
+        r = subprocess.run(
+            [sys.executable, str(SUMMARY), ev.path],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert "PRESSURE signal" in r.stdout
+
+
+# ---------------------------------------------------------------------
+# the events_summary order audit (negative side)
+
+
+class TestEventsAudit:
+    def _run(self, tmp_path, events):
+        evp = tmp_path / "ev.jsonl"
+        evp.write_text("".join(json.dumps(e) + "\n" for e in events))
+        return subprocess.run(
+            [sys.executable, str(SUMMARY), str(evp)],
+            capture_output=True, text=True)
+
+    def test_pressure_without_samples_fails(self, tmp_path):
+        r = self._run(tmp_path, [
+            {"t": 1.0, "tm": 1.0, "kind": "mem_pressure",
+             "reason": "time_to_full", "live_bytes": 900,
+             "budget_bytes": 1000, "burn": 2.0}])
+        assert r.returncode == 1
+        assert "no preceding mem_sample" in r.stderr
+
+    def test_pressure_after_sample_passes(self, tmp_path):
+        r = self._run(tmp_path, [
+            {"t": 1.0, "tm": 1.0, "kind": "mem_sample",
+             "grade": "modeled", "live_bytes": 500,
+             "peak_bytes": 500},
+            {"t": 2.0, "tm": 2.0, "kind": "mem_pressure",
+             "reason": "time_to_full", "live_bytes": 900,
+             "budget_bytes": 1000, "burn": 2.0}])
+        assert r.returncode == 0, r.stderr
+        assert "PRESSURE signal" in r.stdout
+
+    def test_pressure_missing_economics_fails(self, tmp_path):
+        r = self._run(tmp_path, [
+            {"t": 1.0, "tm": 1.0, "kind": "mem_sample",
+             "grade": "modeled", "live_bytes": 500,
+             "peak_bytes": 500},
+            {"t": 2.0, "tm": 2.0, "kind": "mem_pressure",
+             "reason": "time_to_full"}])
+        assert r.returncode == 1
+        assert "cannot justify itself" in r.stderr
+
+    def test_memory_shed_without_samples_fails(self, tmp_path):
+        r = self._run(tmp_path, [
+            {"t": 1.0, "tm": 1.0, "kind": "query_shed", "qid": 7,
+             "query_kind": "sssp", "reason": "memory",
+             "projected_bytes": 999, "budget_bytes": 100}])
+        assert r.returncode == 1
+        assert "never observed" in r.stderr
+
+
+# ---------------------------------------------------------------------
+# the round-22 serve-chaos regression (satellite a)
+
+
+class TestChaosRoutingRegression:
+    def test_kill_armed_on_routing_target_fires(self, g, tmp_path):
+        """Routing is a positive-feedback loop (drain -> fresh beat
+        -> picked again): a kill plan armed on the replica
+        fleet.routing_target names MUST fire and fail over.  The
+        seed armed a fixed index and silently measured a fault-free
+        run whenever beat timing inside warm() handed the load to
+        the other replica."""
+        flt = make_fleet(g, tmp_path)
+        flt.warm(["sssp"])
+        victim = flt.routing_target("sssp")
+        assert victim in flt.replica_names
+        plan = faults.ReplicaKillPlan({victim: 1})
+        flt.set_fault(plan)
+        for s in range(1, 9):
+            flt.submit("sssp", source=s)
+        rs = flt.run()
+        assert plan.fired, (
+            "kill plan armed on the routing target never fired — "
+            "the round-22 serve-chaos regression is back")
+        assert flt.failovers >= 1
+        assert len(rs) == 8
+        assert serve._check_answers(g, rs) == 0
+
+
+# ---------------------------------------------------------------------
+# the weighted serve-live bench leg (satellite b)
+
+
+class TestServeLiveBench:
+    def test_weighted_line_through_check_bench(self, tmp_path):
+        """bench.py -config serve-live produces a WEIGHTED line —
+        reweights >= 1 so the headline finally measures the round-21
+        reweight leg — carrying the round-22 mem digest, and
+        scripts/check_bench.py ACCEPTS it (weighted schema + mem
+        field included)."""
+        import argparse
+
+        import bench
+
+        args = argparse.Namespace(
+            scale=8, ef=8, ni=20, np=2, pair=0, min_fill=None,
+            min_fill_dot=None, repeats=1, verbose=False,
+            health=False, audit="warn", serve_queries=24,
+            serve_batch=2, serve_kinds="sssp,components,pagerank",
+            slo_ms="sssp=30000,components=30000,pagerank=30000",
+            rates="150", batch="1", shape="rmat", reorder="none",
+            serve_replicas=2, kill_boundary=1, delta_capacity=24)
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            idx0 = len(ev.events)
+            import bench as _b
+            name, samples, extra, _rerun = _b.run_config(
+                "serve-live@150", args)
+            tel = _b.config_telemetry(ev, idx0, None)
+        assert name == "serve_live_rmat8"
+        assert extra["weighted"] is True
+        assert extra["reweights"] >= 1
+        assert extra["deletions"] >= 1 and extra["reseeds"] >= 1
+        mem = extra["mem"]
+        assert mem["errors"] == 0
+        assert mem["grade"] in ("measured", "modeled")
+        assert mem["consumer_bytes"] > 0    # cache/live/WAL priced
+        value = round(float(np.median(samples)), 4)
+        line = {"metric": f"{name}_qps_per_chip", "value": value,
+                "unit": "qps", "vs_baseline": value,
+                "samples": [round(s, 4) for s in samples],
+                "attempts": len(samples), "discarded": [],
+                "telemetry": tel, **extra}
+        p = tmp_path / "bench.jsonl"
+        p.write_text(json.dumps(line) + "\n")
+        r = subprocess.run(
+            [sys.executable,
+             str(REPO / "scripts" / "check_bench.py"),
+             "-legacy-ok", str(p)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+
+
+# ---------------------------------------------------------------------
+# the repo-wide acceptance command (tier-1 gate, like lux_tpu.comms)
+
+
+class TestAcceptanceCommand:
+    def test_memwatch_cli_green(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "lux_tpu.memwatch"],
+            capture_output=True, text=True, cwd=str(REPO),
+            timeout=900)
+        assert r.returncode == 0, (r.stdout or "") + (r.stderr or "")
+        assert "memwatch: all configs green" in r.stdout
+        assert "DRIFT" not in r.stdout
