@@ -125,6 +125,19 @@ DEFAULT_SHAPE = {"pagerank": (21, 16), "cc": (20, 16),
                  # row fill on both lines (scripts/check_bench.py
                  # validates the fields)
                  "gather-ab": (21, 16),
+                 # MXU-vs-VPU reduce A/B (round 23, ops/tiled.py):
+                 # `-config mxu-ab` runs the B=8 personalized-
+                 # pagerank program (wide payload — the regime where
+                 # the one-hot contraction amortizes, scalemodel.
+                 # mxu_break_even_wide) BOTH ways on one degree-
+                 # sorted community graph; each line carries the
+                 # resolved mode + the modeled per-row reduce rates
+                 # for both paths (scripts/check_bench.py validates
+                 # mode-vs-name and the mxu/vpu pairing).  Community
+                 # + degree sort keeps chunk rows dense (fill >= 23)
+                 # so the per-row toll, not sparse-tail padding, is
+                 # what the pair isolates.
+                 "mxu-ab": (16, 64),
                  # serving-tier SLO lines (round 17, lux_tpu/serve.py
                  # + scripts/loadgen.py): `-config serve-slo` expands
                  # over -rates into one open-loop load step per
@@ -818,6 +831,76 @@ def run_config(config, args):
                 [s / 1e9 for s in samples], extra,
                 lambda: rerun() / 1e9)
 
+    if config.startswith("mxu-ab"):
+        # MXU-vs-VPU reduce A/B (round 23, ops/tiled.py):
+        # "mxu-ab@mxu" / "mxu-ab@vpu" name one reduce path each; both
+        # sides run the SAME degree-sorted community graph and the
+        # SAME B=8-column personalized-pagerank program (the wide
+        # payload is where the one-hot contraction amortizes its
+        # ~160 ns materialization toll — scalemodel.
+        # mxu_break_even_wide), so the pair isolates the chunk-row
+        # reduce and nothing else.  Every line records the engine's
+        # RESOLVED mode plus the scalemodel per-row rates for BOTH
+        # paths (the modeled step-change); scripts/check_bench.py
+        # validates mode-vs-name and rejects an mxu line whose
+        # paired vpu baseline is missing from the artifact.  The
+        # real-TPU run is debt mxu-core-ab (lux_tpu/observe.py).
+        from lux_tpu import scalemodel
+        from lux_tpu.apps import pagerank
+        from lux_tpu.convert import community_graph
+        from lux_tpu.graph import ShardedGraph, degree_relabel
+        from lux_tpu.ops.pagegather import plan_paged_stats
+
+        _, _, mode = config.partition("@")
+        mode = mode or "mxu"
+        if mode not in ("mxu", "vpu"):
+            raise ValueError(f"mxu-ab side must be mxu|vpu, "
+                             f"got {mode!r}")
+        scale = args.scale or DEFAULT_SHAPE["mxu-ab"][0]
+        ef = args.ef or DEFAULT_SHAPE["mxu-ab"][1]
+        t0 = time.perf_counter()
+        g = community_graph(scale=scale, edge_factor=ef)
+        if args.verbose:
+            print(f"# community graph built: nv={g.nv} ne={g.ne}"
+                  f" ({time.perf_counter() - t0:.1f}s)",
+                  file=sys.stderr)
+        g2, _perm = degree_relabel(g)
+        sg = ShardedGraph.build(g2, args.np, vpad_align=128)
+        # fixed-seed sources: every side (and every round) serves the
+        # same query set; B=8 matches the flagship auto-engagement
+        # audit config (ppr_np2_batched)
+        B = 8
+        rng = np.random.default_rng(23)
+        sources = sorted(int(x) for x in
+                         rng.choice(g2.nv, size=B, replace=False))
+        eng = pagerank.build_engine(g2, num_parts=args.np, sg=sg,
+                                    sources=sources,
+                                    use_mxu=(mode == "mxu"),
+                                    health=args.health)
+        stats = plan_paged_stats(sg)
+        kind = getattr(eng.program, "reduce", "sum")
+        extra = {"np": args.np, "scale": scale, "ef": ef,
+                 "relabel": True, "pair_threshold": None,
+                 "batch": B, "shape": "community",
+                 "mxu": mode, "use_mxu": bool(eng.use_mxu),
+                 "exchange": eng.exchange, "reduce_kind": kind,
+                 # the modeled per-chunk-row rates for BOTH paths —
+                 # identical on the paired lines by construction, so
+                 # the pair's measured ratio is read against ONE
+                 # prediction (scalemodel round 23)
+                 "mxu_row_ns": round(scalemodel.mxu_reduce_row_ns(
+                     wide=B, kind=kind), 2),
+                 "vpu_row_ns": round(scalemodel.vpu_reduce_row_ns(
+                     wide=B), 2),
+                 "page_fill": round(float(stats["padded_fill"]), 2)}
+        _audit_build(eng, args, extra)
+        samples, rerun = bench_fused(eng, g.ne, args.ni, args.verbose,
+                                     args.repeats)
+        extra["ne"] = int(g.ne)
+        return (f"ppr_{mode}_comm{scale}",
+                [s / 1e9 for s in samples], extra,
+                lambda: rerun() / 1e9)
+
     if config.startswith(("ksssp-batch", "ppr-batch")):
         # query-batched configs (ROADMAP item 2): "<base>@B" names
         # one sweep point — handled BEFORE the generic shape lookup
@@ -1297,6 +1380,11 @@ def main() -> int:
             if not rates or any(r <= 0 for r in rates):
                 ap.error("-rates must be positive offered qps")
             expanded += [f"{c}@{r:g}" for r in rates]
+        elif c == "mxu-ab":
+            # mxu first (the headline of the A/B); the vpu side is
+            # its paired baseline — check_bench rejects an mxu line
+            # that arrives without the pair in the same artifact
+            expanded += ["mxu-ab@mxu", "mxu-ab@vpu"]
         elif c == "gather-ab":
             # one line per side, paged first (the headline of the
             # A/B); both carry the plan's page stats.  A reorder run

@@ -60,6 +60,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  pair_min_fill: int | str | None = None,
                  pair_stream: bool | None = None,
                  starts=None, gather: str = "flat",
+                 use_mxu: bool | str = "auto",
                  health: bool = False,
                  audit: str | None = None) -> PullEngine:
     """pair_threshold routes dense tile pairs through the blocked-
@@ -85,7 +86,8 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill,
                       pair_stream=pair_stream, tile_e=tile_e,
-                      gather=gather, health=health, audit=audit)
+                      gather=gather, use_mxu=use_mxu,
+                      health=health, audit=audit)
 
 
 def run(g: Graph, num_iters: int, num_parts: int = 1, mesh=None):

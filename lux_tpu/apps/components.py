@@ -75,6 +75,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
+                 use_mxu: bool | str = "auto",
                  health: bool = False,
                  sources=None,
                  audit: str | None = None) -> PushEngine:
@@ -103,7 +104,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                       gather=gather, enable_sparse=enable_sparse,
                       owner_tile_e=owner_tile_e,
                       owner_minmax_fused=owner_minmax_fused,
-                      health=health, audit=audit)
+                      use_mxu=use_mxu, health=health, audit=audit)
 
 
 def run(g: Graph, num_parts: int = 1, mesh=None, max_iters=None,

@@ -131,6 +131,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                  exchange: str = "auto",
                  gather: str = "flat",
                  owner_tile_e: int | None = None,
+                 use_mxu: bool | str = "auto",
                  health: bool = False,
                  sources=None, resets=None,
                  audit: str | None = None) -> PullEngine:
@@ -169,7 +170,7 @@ def build_engine(g: Graph, num_parts: int = 1, mesh=None,
                       pair_threshold=pair_threshold,
                       pair_min_fill=pair_min_fill, tile_e=tile_e,
                       exchange=exchange, gather=gather,
-                      owner_tile_e=owner_tile_e,
+                      owner_tile_e=owner_tile_e, use_mxu=use_mxu,
                       health=health, audit=audit)
 
 

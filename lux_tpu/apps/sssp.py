@@ -130,6 +130,7 @@ def build_engine(g: Graph, start_vertex: int | None = 0,
                  enable_sparse: bool = True,
                  owner_tile_e: int | None = None,
                  owner_minmax_fused: bool = False,
+                 use_mxu: bool | str = "auto",
                  health: bool = False,
                  sources=None,
                  audit: str | None = None) -> PushEngine:
@@ -173,7 +174,7 @@ def build_engine(g: Graph, start_vertex: int | None = 0,
                       enable_sparse=enable_sparse,
                       owner_tile_e=owner_tile_e,
                       owner_minmax_fused=owner_minmax_fused,
-                      health=health, audit=audit)
+                      use_mxu=use_mxu, health=health, audit=audit)
 
 
 def run(g: Graph, start_vertex: int = 0, num_parts: int = 1, mesh=None,

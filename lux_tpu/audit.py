@@ -936,6 +936,15 @@ def report_kwargs(engine) -> dict:
         # query_batch pricing) — pull engines carry B through
         # state_bytes instead (the correction below)
         kw["query_batch"] = int(getattr(engine, "batch", None) or 1)
+    if getattr(engine, "use_mxu", False):
+        # the MXU one-hot reduce materializes the [C, E, W] int8
+        # lane matrix (round 23) — price it at the engine's actual
+        # chunk width so a use_mxu build's ledger stays honest
+        kw["use_mxu"] = True
+        lay = getattr(engine, "tiles", None) \
+            or getattr(engine, "owner", None)
+        if lay is not None and getattr(lay, "E", None):
+            kw["mxu_tile_e"] = int(lay.E)
     return kw
 
 
@@ -954,7 +963,8 @@ def priced_argument_bytes(engine) -> int:
     # drift comparison is apples to apples
     for tk in ("pair_temp_bytes_per_part",
                "page_buffer_bytes_per_part",
-               "page_temp_bytes_per_part"):
+               "page_temp_bytes_per_part",
+               "mxu_temp_bytes_per_part"):
         expected -= engine.sg.num_parts * int(ledger.get(tk, 0))
     # the ledger prices scalar f32 state; K-vector programs carry
     # state_bytes per vertex — correct the vertex term so colfilter's
@@ -1200,6 +1210,35 @@ def matrix_configs(ledger: bool = True):
                     lambda: _live(lambda: components.build_engine(
                         g, num_parts=2)),
                     False))
+
+    # MXU compute core (round 23, ops/tiled use_mxu): the one-hot
+    # contraction programs must hold the SAME static guarantees as
+    # the VPU formulations — gather budget 1 (the tournament's
+    # route-back is a matmul, never a second table gather), dtype
+    # discipline (int8 one-hot, int32 vote accumulators, uint32
+    # order encodings — all <= 4 B), and identity-init (the frontier
+    # MXU path's delta scatter-ADD is zero-initialized = the sum
+    # identity, NO pragma).  ppr_np2_batched above already audits the
+    # AUTO-engaged MXU path (B=8 >= the scalemodel break-even);
+    # these force it onto the kinds/exchanges auto leaves on the VPU.
+    configs.append(("pagerank_np2_mxu",
+                    lambda: pagerank.build_engine(g, num_parts=2,
+                                                  use_mxu=True),
+                    False))
+    configs.append(("sssp_np2_mxu",
+                    lambda: sssp.build_engine(g, 0, num_parts=2,
+                                              use_mxu=True),
+                    False))
+    configs.append(("cc_np2_mxu_dense",
+                    lambda: components.build_engine(
+                        g, num_parts=2, enable_sparse=False,
+                        use_mxu=True),
+                    False))
+    configs.append(("pagerank_np4_owner_mxu",
+                    lambda: pagerank.build_engine(g, num_parts=4,
+                                                  exchange="owner",
+                                                  use_mxu=True),
+                    False))
     if ledger:
         gd = graphs["dense"]
         gdw = graphs["dense_w"]
@@ -1228,6 +1267,14 @@ def matrix_configs(ledger: bool = True):
                         lambda: pagerank.build_engine(
                             gd, num_parts=2, gather="paged"),
                         True))
+        # MXU ledger: the priced mxu_temp one-hot term must keep a
+        # forced use_mxu build inside the drift tolerance (the
+        # [C, E, W] int8 matrix is a TEMPORARY — subtracted for the
+        # argument-bytes comparison, named for the runtime ledger)
+        configs.append(("pagerank_np2_mxu_ledger",
+                        lambda: pagerank.build_engine(
+                            gd, num_parts=2, use_mxu=True),
+                        True))
     if mesh is not None:
         configs.append(("pagerank_mesh2_gather",
                         lambda: pagerank.build_engine(g, num_parts=2,
@@ -1252,6 +1299,14 @@ def matrix_configs(ledger: bool = True):
         configs.append(("sssp_mesh2_sparse",
                         lambda: sssp.build_engine(g, 0, num_parts=2,
                                                   mesh=mesh),
+                        False))
+        # forced-MXU mesh config: the contraction core must leave
+        # the collective schedule untouched (the one-hot matmuls are
+        # purely part-local; only the reduce formulation changes)
+        configs.append(("sssp_mesh2_mxu",
+                        lambda: sssp.build_engine(g, 0, num_parts=2,
+                                                  mesh=mesh,
+                                                  use_mxu=True),
                         False))
         # batched mesh configs: the single-gather hold AND the owner
         # collective schedule (psum_scatter / all_to_all) at B > 1

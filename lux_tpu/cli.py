@@ -147,6 +147,21 @@ def _common(ap: argparse.ArgumentParser):
                          "page-aware reorder, lux_tpu/reorder.py).  "
                          "Mutually exclusive with -pair (both are "
                          "row-granular delivery layouts)")
+    ap.add_argument("-mxu", default="auto",
+                    choices=["auto", "mxu", "vpu"],
+                    help="per-chunk reduce formulation (ops/tiled."
+                         "chunk_partials): 'mxu' forces the one-hot "
+                         "contraction core (round 23 — sum as one "
+                         "int8 matmul, min/max as the bit-serial "
+                         "tournament, the segmented combine as "
+                         "blocked scan-as-matmul), 'vpu' forces the "
+                         "fused masked broadcast-reduce, 'auto' "
+                         "(default) engages the MXU when the "
+                         "program's K x B payload width amortizes "
+                         "the one-hot toll (scalemodel."
+                         "mxu_break_even_wide: wide >= 2 for sum — "
+                         "batched/K-dim programs — never for "
+                         "min/max)")
     ap.add_argument("-min-fill", type=_min_fill_arg, default=None,
                     dest="min_fill", metavar="F",
                     help="with -pair: drop pair rows that would "
@@ -428,6 +443,12 @@ def _finish_run(tel, elapsed, iters):
                               for k, v in st.summary().items()})
 
 
+def _mxu_arg(args):
+    """-mxu auto|mxu|vpu -> the engines' use_mxu value."""
+    m = getattr(args, "mxu", "auto")
+    return {"auto": "auto", "mxu": True, "vpu": False}[m]
+
+
 def _warn_exchange_ignored(args):
     """colfilter's dot path has its own dst-free delivery; -exchange
     does not apply there."""
@@ -605,6 +626,7 @@ def cmd_pagerank(argv):
                                          pair_min_fill=args.min_fill,
                                          exchange=args.exchange,
                                          gather=args.gather,
+                                         use_mxu=_mxu_arg(args),
                                          health=args.health,
                                          sources=sources,
                                          audit=args.audit)
@@ -710,6 +732,7 @@ def _push_app(argv, prog_name):
                     exchange=args.exchange,
                     gather=args.gather,
                     enable_sparse=bool(args.sparse),
+                    use_mxu=_mxu_arg(args),
                     sources=sources,
                     health=args.health, audit=args.audit)
         else:
@@ -721,6 +744,7 @@ def _push_app(argv, prog_name):
                     exchange=args.exchange,
                     gather=args.gather,
                     enable_sparse=bool(args.sparse),
+                    use_mxu=_mxu_arg(args),
                     sources=sources,
                     health=args.health, audit=args.audit)
         eng = make_eng(mesh)
@@ -801,6 +825,7 @@ def cmd_colfilter(argv):
                                           pair_threshold=args.pair,
                                           pair_min_fill=args.min_fill,
                                           gather=args.gather,
+                                          use_mxu=_mxu_arg(args),
                                           health=args.health,
                                           audit=args.audit)
 

@@ -31,6 +31,38 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# Block length for the MXU cumsum-as-matmul in expand_frontier: one
+# int8 lower-triangular [B, B] matrix (64 KB) contracted per block,
+# same sizing rationale as ops/tiled.MXU_SCAN_BLOCK.
+FRONTIER_MXU_BLOCK = 256
+
+
+def _cumsum_matmul(x, block: int = FRONTIER_MXU_BLOCK):
+    """Inclusive cumsum of an int32 [N] vector as blocked lower-
+    triangular matmuls (the tiled scan-as-matmul recurrence with one
+    global segment): per block ``T @ x_b + carry`` where T[i, j] =
+    (i >= j) is built on device from iota.  Bitwise-equal to
+    jnp.cumsum for int32 (integer matmul is exact)."""
+    N = x.shape[0]
+    nB = -(-N // block)
+    Np = nB * block
+    if Np != N:
+        x = jnp.concatenate(
+            [x, jnp.zeros((Np - N,), x.dtype)], axis=0)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    T = (ii >= jj).astype(jnp.int8)
+
+    def step(carry, x_b):
+        inner = jnp.einsum("ij,j->i", T, x_b,
+                           preferred_element_type=x.dtype)
+        out = inner + carry
+        return out[-1], out
+
+    _, blocks = jax.lax.scan(step, jnp.zeros((), x.dtype),
+                             x.reshape(nB, block))
+    return blocks.reshape(Np)[:N]
+
 
 def compact_mask(mask, labels, capacity: int):
     """Dense bool mask [vpad] -> padded queue.
@@ -56,7 +88,7 @@ def compact_mask(mask, labels, capacity: int):
 
 
 def expand_frontier(ids, vals, src_ids, src_off, nv: int,
-                    edge_budget: int):
+                    edge_budget: int, use_mxu: bool = False):
     """Map a gathered queue to its out-edge slots in this part.
 
     ids     int32 [Q]   vertex GLOBAL ids (graph numbering), nv=invalid
@@ -95,13 +127,33 @@ def expand_frontier(ids, vals, src_ids, src_off, nv: int,
         # collides.)
         marks = jnp.zeros((edge_budget + 1,), jnp.int32)
         qidx = jnp.arange(Q, dtype=jnp.int32) + 1
-        # audit: allow(identity-init) — 0 deliberately marks "no item
-        # starts here": values are 1-based queue indices >= 1, and the
-        # cummax - 1 below maps an untouched 0 back to no-owner (an
-        # int32-min init would overflow that - 1).
-        marks = marks.at[jnp.minimum(start, edge_budget)].max(
-            jnp.where(deg > 0, qidx, 0))
-        owner = jax.lax.cummax(marks[:edge_budget]) - 1      # [EB]
+        if use_mxu:
+            # MXU form: because deg > 0 items have strictly increasing
+            # starts AND increasing qidx, the running max of scattered
+            # qidx equals the running SUM of scattered qidx-DELTAS
+            # (delta = qidx - previous deg>0 item's qidx telescopes,
+            # so every prefix sum lands exactly on the most recent
+            # item's qidx — including the clamped edge_budget slot,
+            # where colliding overflow deltas telescope to the last
+            # overflow qidx).  Scatter-ADD into a zero-filled buffer
+            # IS the identity init (0 = sum identity), so the
+            # identity-init audit passes this path without a pragma;
+            # the cumsum then runs as blocked triangular matmuls.
+            qm = jnp.where(deg > 0, qidx, 0)
+            run = jax.lax.cummax(qm)                 # cheap [Q] op
+            prev = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), run[:-1]], axis=0)
+            delta = jnp.where(deg > 0, qidx - prev, 0)
+            marks = marks.at[jnp.minimum(start, edge_budget)].add(delta)
+            owner = _cumsum_matmul(marks[:edge_budget]) - 1  # [EB]
+        else:
+            # audit: allow(identity-init) — 0 deliberately marks "no
+            # item starts here": values are 1-based queue indices
+            # >= 1, and the cummax - 1 below maps an untouched 0 back
+            # to no-owner (an int32-min init would overflow that - 1).
+            marks = marks.at[jnp.minimum(start, edge_budget)].max(
+                jnp.where(deg > 0, qidx, 0))
+            owner = jax.lax.cummax(marks[:edge_budget]) - 1  # [EB]
         owner = jnp.maximum(owner, 0)
         slot = jnp.arange(edge_budget, dtype=off.dtype)
         in_range = slot < jnp.minimum(total, edge_budget)

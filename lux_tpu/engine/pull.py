@@ -108,6 +108,37 @@ def resolve_exchange(exchange: str, sg: ShardedGraph, program,
     return exchange
 
 
+def mxu_wide_of(program) -> int:
+    """K x B payload width of a program's state — the free MXU minor
+    dimension the round-23 one-hot reduce amortizes its toll over
+    (scalemodel.mxu_break_even_wide).  K from state_bytes (itemsize x
+    trailing dims, the _dot_kdim convention), B from the query batch;
+    both multiply."""
+    sb = getattr(program, "state_bytes", None)
+    if sb is not None:
+        # state_bytes covers the FULL trailing row — colfilter's 4*K,
+        # batched pagerank's itemsize*B — so it already is K x B
+        return max(1, sb // 4)
+    return int(getattr(program, "batch", None) or 1)
+
+
+def resolve_use_mxu(use_mxu, program) -> bool:
+    """``use_mxu="auto"`` (engine default) engages the MXU one-hot
+    reduce when the program's K x B payload width amortizes the
+    one-hot materialization toll (scalemodel.resolve_use_mxu: sum
+    engages at width >= 2 — ppr's B=8 batch and colfilter's K=20 do,
+    scalar f32 flagships stay on the fused VPU path bit-for-bit;
+    min/max never auto-engage, the tournament is for the measured
+    A/B).  True/False force the path for A/B benches and tests."""
+    if isinstance(use_mxu, bool):
+        return use_mxu
+    if use_mxu != "auto":
+        raise ValueError(f"unknown use_mxu {use_mxu!r}")
+    from lux_tpu import scalemodel
+    kind = getattr(program, "reduce", "sum")
+    return scalemodel.resolve_use_mxu(kind, mxu_wide_of(program))
+
+
 def common_graph_arrays(sg: ShardedGraph, dev):
     """deg + nvp, the apply-epilogue arrays every layout needs.  The
     valid-vertex mask is DERIVED on device from the per-part counts
@@ -174,7 +205,7 @@ class PullEngine(AuditableEngine):
 
     def __init__(self, sg: ShardedGraph, program: PullProgram, mesh=None,
                  layout: str = "tiled", tile_w: int = 128,
-                 tile_e: int = 512, use_mxu: bool = False,
+                 tile_e: int = 512, use_mxu: bool | str = "auto",
                  reduce_method: str = "auto",
                  pair_threshold: int | None = None,
                  pair_min_fill: int | str | None = None,
@@ -255,7 +286,7 @@ class PullEngine(AuditableEngine):
         self.sg = sg
         self.program = program
         self.mesh = mesh
-        self.use_mxu = use_mxu
+        self.use_mxu = resolve_use_mxu(use_mxu, program)
         # health=True: run()/segmented drivers use the watchdog loop
         # variants (run_health / run_until_health, compiled lazily);
         # False leaves every watchdog-free program untouched
@@ -579,7 +610,8 @@ class PullEngine(AuditableEngine):
             lambda vals, w: prog.edge_value(vals, None, w),
             self.reduce_method, use_mxu=self.use_mxu)
         red = combine_partials(partials, lay, g["chunk_start"],
-                               g["last_chunk"], sg.vpad, prog.reduce)
+                               g["last_chunk"], sg.vpad, prog.reduce,
+                               use_mxu=self.use_mxu)
         return self._combine_pairs(flat_state, red, g)
 
     def _part_step(self, flat_state, old_p, g):
@@ -659,7 +691,8 @@ class PullEngine(AuditableEngine):
                 pad_c(wgt).reshape(nB, B, E))
         partials = jax.lax.map(block, args).reshape(Cp, W, Kdim)[:C]
         red = combine_chunks(partials, lay, g["chunk_start"],
-                             g["last_chunk"], prog.reduce)
+                             g["last_chunk"], prog.reduce,
+                             use_mxu=self.use_mxu)
         red = red.reshape(n_tiles * W, Kdim)[:sg.vpad]
         if self.pairs is not None:
             from lux_tpu.ops.pairs import (pair_partial_dot,

@@ -101,7 +101,8 @@ class PushEngine(AuditableEngine):
 
     def __init__(self, sg: ShardedGraph, program: PushProgram, mesh=None,
                  layout: str = "tiled", tile_w: int = 128,
-                 tile_e: int = 512, enable_sparse: bool = True,
+                 tile_e: int = 512, use_mxu: bool | str = "auto",
+                 enable_sparse: bool = True,
                  sparse_threshold: int = 16,
                  edge_budget: int | None = None,
                  delta: float | None = None,
@@ -124,7 +125,8 @@ class PushEngine(AuditableEngine):
         from lux_tpu.engine.pull import (_check_local_parts,
                                          build_graph_arrays,
                                          resolve_exchange,
-                                         resolve_reduce_method)
+                                         resolve_reduce_method,
+                                         resolve_use_mxu)
         _check_local_parts(sg, mesh, pair_threshold)
         # query-batched labels [vpad, B] (program.batch = B): dense
         # masked iterations only — columns retire independently
@@ -176,6 +178,10 @@ class PushEngine(AuditableEngine):
         self.stats_cap = int(stats_cap or DEFAULT_STATS_CAP)
         self.sparse_threshold = sparse_threshold
         self.reduce_method = resolve_reduce_method(reduce_method)
+        # MXU one-hot reduce (round 23, ops/tiled): auto-resolved from
+        # the program's K x B payload width; the sparse frontier's
+        # CSR-expand rides the same flag (fr.expand_frontier use_mxu)
+        self.use_mxu = resolve_use_mxu(use_mxu, program)
         # Paged two-level gather for the DENSE iterations
         # (ops/pagegather.py): page-binned rows + the Pallas lane
         # shuffle replace the per-edge masked-label gather; the
@@ -389,10 +395,11 @@ class PushEngine(AuditableEngine):
                                            streamed_chunk_partials)
             partials = streamed_chunk_partials(
                 flat_l, g["src_slot"], g["rel_dst"], g.get("weight"),
-                lay, prog.reduce, msg, self.reduce_method)
+                lay, prog.reduce, msg, self.reduce_method,
+                use_mxu=self.use_mxu)
             red = combine_partials(partials, lay, g["chunk_start"],
                                    g["last_chunk"], sg.vpad,
-                                   prog.reduce)
+                                   prog.reduce, use_mxu=self.use_mxu)
         elif lay is None:
             red = segment_reduce(cand, g["dst_local"], sg.vpad + 1,
                                  prog.reduce)[:sg.vpad]
@@ -400,6 +407,7 @@ class PushEngine(AuditableEngine):
             red = tiled_segment_reduce(
                 cand, lay, g["chunk_start"], g["last_chunk"],
                 g["rel_dst"], sg.vpad, prog.reduce,
+                use_mxu=self.use_mxu,
                 method=("pallas"
                         if self.reduce_method.startswith("pallas")
                         else "xla"),
@@ -521,7 +529,7 @@ class PushEngine(AuditableEngine):
                     acc = owner_contribs(
                         self.owner, masked, g,
                         prog.reduce, msg, msg_dtype, sg.num_parts,
-                        self.reduce_method,
+                        self.reduce_method, use_mxu=self.use_mxu,
                         varying_axis=PARTS_AXIS if on_mesh else None)
                 red = owner_exchange(
                     acc, prog.reduce,
@@ -579,7 +587,8 @@ class PushEngine(AuditableEngine):
         #    in its partition, through its compressed src-sorted view.
         def relax_part(lab, sids, soff, ssd, ssw):
             edge_idx, src_val, in_range, _total, off = fr.expand_frontier(
-                all_gids, all_vals, sids, soff, nv, EB)
+                all_gids, all_vals, sids, soff, nv, EB,
+                use_mxu=self.use_mxu)
             dst = jnp.take(ssd, edge_idx, axis=0)
             w = jnp.take(ssw, edge_idx, axis=0) if ssw is not None \
                 else None

@@ -734,7 +734,9 @@ class ShardedGraph:
                       pairs=None, pair_kdim: int = 1,
                       pair_stream: bool | None = None,
                       page_plan=None,
-                      query_batch: int = 1) -> dict:
+                      query_batch: int = 1,
+                      use_mxu: bool = False,
+                      mxu_tile_e: int = 512) -> dict:
         """HBM bytes for the engine edge layouts per part — the
         analogue of the reference's startup memory advisor (reference
         pagerank.cc:60-85).  (The flat oracle layout ships int32
@@ -768,6 +770,19 @@ class ShardedGraph:
         big-scale push run (round-4 VERDICT).  The source-index pad S
         uses the cached src-sort when available, else the min(nv-ish,
         epad) upper bound.
+
+        use_mxu prices the MXU one-hot reduce's live intermediate
+        (round 23, ops/tiled.chunk_partials): unlike the fused VPU
+        masked reduce, the contraction MATERIALIZES the [C, E, W]
+        int8 lane-membership matrix — one byte per (edge, lane) over
+        W = 128 lanes, bounded by the streamed block
+        (ops/tiled.STREAM_BLOCK_CHUNKS x ``mxu_tile_e`` edges) when
+        block streaming engages.  Reported as ``mxu_temp`` and
+        subtracted by the ledger-drift audit like the other
+        per-iteration temporaries (audit.priced_argument_bytes) —
+        the term exists so a use_mxu=True build's ledger stays
+        honest, per the round-22 rule that every resident consumer
+        is named.
 
         query_batch prices the QUERY-BATCHED state table (ROADMAP
         item 2, engine/program.py ``batch``): B > 1 makes the vertex
@@ -880,6 +895,14 @@ class ShardedGraph:
             vert_bytes = self.vpad * (5 * query_batch + 4)
         owner_msg = (self.vpad * 4 * query_batch
                      if exchange == "owner" else 0)
+        mxu_temp = 0
+        if use_mxu and page_plan is None:
+            from lux_tpu.ops.tiled import STREAM_BLOCK_CHUNKS
+            # [C, E, 128] int8 one-hot, one byte per (edge, lane);
+            # the streamed block bound caps the live chunks
+            live_edges = min(self.epad,
+                             STREAM_BLOCK_CHUNKS * int(mxu_tile_e))
+            mxu_temp = live_edges * 128
         # named per-part decomposition (round 22, lux_tpu/memwatch.py):
         # the unified runtime byte ledger folds these terms alongside
         # the serving/live consumers, and its NumPy oracle re-derives
@@ -892,6 +915,7 @@ class ShardedGraph:
             "pair_temp": pair_temp,
             "page_buffer": page_buf,
             "page_temp": page_temp,
+            "mxu_temp": mxu_temp,
             "vertex": vert_bytes,
         }
         per_part = sum(terms.values())
@@ -904,6 +928,7 @@ class ShardedGraph:
             "pair_temp_bytes_per_part": pair_temp,
             "page_buffer_bytes_per_part": page_buf,
             "page_temp_bytes_per_part": page_temp,
+            "mxu_temp_bytes_per_part": mxu_temp,
             "vertex_bytes_per_part": vert_bytes,
             "owner_msg_bytes_per_part": owner_msg,
             "terms_per_part": terms,

@@ -105,7 +105,7 @@ ANSWER_BYTES_PER_VERTEX = 8
 # argument arrays — subtracted for the memory_analysis comparison
 # (audit.check_ledger's subtraction, same term set)
 TEMP_TERMS = ("graph_pair_temp", "graph_page_buffer",
-              "graph_page_temp")
+              "graph_page_temp", "graph_mxu_temp")
 
 
 class MemoryDriftError(RuntimeError):

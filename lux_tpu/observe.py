@@ -554,12 +554,20 @@ def _engine_model(eng, scale: float,
     dot = getattr(eng.program, "edge_value_from_dot", None) is not None
     pp = getattr(eng, "page_plan", None)
     paged = pp is not None
+    # MXU reduce pricing (round 23): the engine's RESOLVED use_mxu
+    # flag and its K x B payload width — with it the "reduce" phase
+    # gets a modeled figure instead of None (unmodeled), so decompose
+    # grades the contraction's drift like every other phase
+    from lux_tpu.engine.pull import mxu_wide_of
     return scalemodel.phase_model(
         engine=_engine_kind(eng), exchange=eng.exchange,
         ne=int(eng.sg.ne), nv=int(eng.sg.nv), kdim=kdim,
         pair_coverage=cov, pair_row_inflation=row_infl,
         chunk_inflation=chunk_infl,
         state_bytes_per_vertex=int(state_bytes), dot=dot, scale=scale,
+        use_mxu=bool(getattr(eng, "use_mxu", False)),
+        mxu_wide=mxu_wide_of(eng.program),
+        reduce_kind=getattr(eng.program, "reduce", "sum"),
         paged=paged,
         page_ratio=float(pp.stats["page_ratio"]) if paged else 0.0,
         page_fill=float(pp.stats.get("padded_fill",
@@ -988,6 +996,18 @@ DEBTS = (
          "bounds)",
          "PERF_NOTES round 22 (memory observatory)", platform="tpu",
          auto="_debt_hbm_watermark"),
+    Debt("mxu-core-ab",
+         "on-device MXU-vs-VPU compare-reduce A/B at the pinned "
+         "probe shapes (round 23, ops/tiled.py): the one-hot "
+         "contraction sum + the bit-serial tournament max vs the "
+         "fused VPU masked reduce at a wide=8 payload — the "
+         "scalemodel constants behind use_mxu='auto' and the bench "
+         "mxu-ab pair (ONEHOT_TILE_NS, MXU_TILE_NS) are "
+         "primitive-derived, and a CPU einsum says nothing about "
+         "the systolic array; the measured per-row step-change and "
+         "the sum-vs-tournament gap both want a live MXU",
+         "PERF_NOTES round 23 (MXU compute core)", platform="tpu",
+         auto="_debt_mxu_core_ab"),
 )
 
 
@@ -1098,6 +1118,50 @@ def _debt_reorder_fill_ab(fp: Fingerprint, clock=time.perf_counter):
             "auto_resolves": resolve_gather(
                 "auto", st, 4 * sg.num_parts * sg.vpad),
             "reorder_s": round(clock() - t0, 2)}
+    return out
+
+
+def _debt_mxu_core_ab(fp: Fingerprint, clock=time.perf_counter):
+    """The round-23 MXU A/B at the pinned probe shapes: the SAME
+    [rows, 128, 8] wide payload reduced by (a) the fused VPU masked
+    reduce and (b) the MXU path — one-hot contraction for sum, the
+    bit-serial tournament for max — ns per chunk row for both plus
+    the speedup, next to the scalemodel rates the bench mxu-ab pair
+    is read against.  Runs on any backend (the CPU figures are the
+    honest-negative baseline; only a tunnel session prices the
+    systolic array, hence platform='tpu' on the debt)."""
+    import jax.numpy as jnp
+
+    from lux_tpu.ops.tiled import chunk_partials
+    from lux_tpu.scalemodel import mxu_reduce_row_ns, vpu_reduce_row_ns
+
+    rows, wide = PROBE_PAGE_ROWS, 8
+    rng = np.random.default_rng(23)
+    vals = jnp.asarray(rng.random((rows, 128, wide), np.float32))
+    rel = jnp.asarray(rng.integers(0, 128, (rows, 128)).astype(np.int8))
+
+    out = {"debt": "mxu-core-ab", "rows": rows, "wide": wide,
+           "kinds": {}}
+    for kind in ("sum", "max"):
+        rec = {}
+        for label, um in (("vpu", False), ("mxu", True)):
+            def step(carry, _um=um, _kind=kind):
+                v, r = carry
+                s = jnp.sum(chunk_partials(v, r, 128, _kind,
+                                           use_mxu=_um))
+                return s, (v + s * 1e-30, r)
+
+            samples, _ = loop_bench(step, (vals, rel), PROBE_LOOP_K,
+                                    repeats=3, clock=clock)
+            m, mad = median_mad(samples)
+            rec[f"{label}_row_ns"] = round(m / rows * 1e9, 3)
+            rec[f"{label}_mad_ns"] = round(mad / rows * 1e9, 3)
+        rec["speedup"] = round(
+            rec["vpu_row_ns"] / max(rec["mxu_row_ns"], 1e-12), 3)
+        rec["modeled_vpu_row_ns"] = round(vpu_reduce_row_ns(wide), 2)
+        rec["modeled_mxu_row_ns"] = round(
+            mxu_reduce_row_ns(wide, kind), 2)
+        out["kinds"][kind] = rec
     return out
 
 

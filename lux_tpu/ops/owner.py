@@ -540,4 +540,5 @@ def owner_part_tiles(lay: OwnerLayout, state_s, src, rel, weight, cs,
             msgs = jax.lax.optimization_barrier(msgs)
             partials = chunk_partials(msgs, rel, lay.W, kind,
                                       use_mxu=use_mxu)
-    return combine_chunks(partials, lay, cs, lc, kind)     # [G, W, ...]
+    return combine_chunks(partials, lay, cs, lc, kind,
+                          use_mxu=use_mxu)                 # [G, W, ...]

@@ -171,21 +171,187 @@ def combine_op(kind: str):
 _combine = combine_op
 
 
+class MXUUnsupportedError(ValueError):
+    """A (kind, dtype) combination the MXU contraction port does not
+    cover.  After the round-23 port the one-hot paths serve sum, min
+    and max over every <= 32-bit int/uint/float payload; what remains
+    genuinely unsupported is named here so callers (and the auto
+    resolver) can fall back to the VPU formulation deliberately
+    instead of tripping an anonymous ValueError."""
+
+    def __init__(self, kind: str, dtype, why: str):
+        self.kind = kind
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        super().__init__(
+            f"MXU one-hot path does not support kind={kind!r} on "
+            f"dtype {self.dtype}: {why}")
+
+
+def _lane_onehot(rel_dst, W: int):
+    """int8 lane-membership matrix [..., E, W]: row e is one-hot at
+    rel_dst[..., e] and ALL-ZERO for pad lanes (rel == -1 matches no
+    lane) — int8 is the narrowest operand dtype the mixed-dtype MXU
+    contraction accepts (`preferred_element_type` keeps the
+    accumulator in the payload dtype), 4x narrower than the payload-
+    dtype one-hot the round-5 sum path materialized."""
+    return (rel_dst[..., None] ==
+            jnp.arange(W, dtype=rel_dst.dtype)).astype(jnp.int8)
+
+
+# Order-preserving bit encodings for the compare-reduce tournament:
+# map the payload to uint bit patterns whose UNSIGNED order matches
+# the payload order, so min/max become bitwise votes MSB-first.
+_MXU_SIGN32 = jnp.uint32(0x80000000)
+
+
+def _order_bits(dtype) -> int:
+    """Tournament rounds for a payload dtype (bits of its order
+    encoding); raises the typed error for unsupported combos."""
+    dt = np.dtype(dtype)
+    if dt.kind in "iu":
+        return dt.itemsize * 8
+    if dt.kind == "f":
+        if dt.itemsize > 4:
+            raise MXUUnsupportedError(
+                "min/max", dt, "f64 violates the 4-byte dtype "
+                "discipline (no order encoding fits uint32)")
+        return dt.itemsize * 8
+    raise MXUUnsupportedError(
+        "min/max", dt, "no order-preserving bit encoding (only "
+        "int/uint/float payloads reduce by comparison)")
+
+
+def _order_encode(x):
+    """Payload -> uint32 whose unsigned order matches the payload
+    order.  Ints: two's-complement bias.  Floats: the IEEE-754
+    sign-magnitude fold (negative -> flip all bits, else set the sign
+    bit) — a TOTAL order agreeing with < on non-NaN values; -0.0
+    sorts below +0.0 and NaN payloads are out of contract (the repo's
+    oracles never produce them)."""
+    dt = np.dtype(x.dtype)
+    bits = _order_bits(dt)
+    if dt.kind == "u":
+        return x.astype(jnp.uint32)
+    if dt.kind == "i":
+        if dt.itemsize == 4:
+            return jax.lax.bitcast_convert_type(
+                x, jnp.uint32) ^ _MXU_SIGN32
+        # narrow ints: bias into [0, 2^bits) in int32, then reinterpret
+        lo = int(np.iinfo(dt).min)
+        return (x.astype(jnp.int32) - lo).astype(jnp.uint32)
+    # floats: fold via the same-width uint, then widen
+    udt = {2: jnp.uint16, 4: jnp.uint32}[dt.itemsize]
+    u = jax.lax.bitcast_convert_type(x, udt).astype(jnp.uint32)
+    sign = jnp.uint32(1) << (bits - 1)
+    mask = (jnp.uint32(0xFFFFFFFF) >> (32 - bits))
+    return jnp.where((u & sign) != 0, (~u) & mask, u | sign)
+
+
+def _order_decode(m, dtype):
+    """Inverse of _order_encode (m uint32 -> payload dtype)."""
+    dt = np.dtype(dtype)
+    bits = _order_bits(dt)
+    if dt.kind == "u":
+        return m.astype(dt)
+    if dt.kind == "i":
+        if dt.itemsize == 4:
+            return jax.lax.bitcast_convert_type(m ^ _MXU_SIGN32,
+                                                jnp.int32)
+        lo = int(np.iinfo(dt).min)
+        return (m.astype(jnp.int32) + lo).astype(dt)
+    sign = jnp.uint32(1) << (bits - 1)
+    mask = (jnp.uint32(0xFFFFFFFF) >> (32 - bits))
+    u = jnp.where((m & sign) != 0, m ^ sign, (~m) & mask)
+    udt = {2: jnp.uint16, 4: jnp.uint32}[dt.itemsize]
+    return jax.lax.bitcast_convert_type(u.astype(udt), dt)
+
+
+def _mxu_compare_reduce(vals, rel_dst, W: int, kind: str):
+    """min/max per-chunk reduction as one-hot MXU contractions: a
+    radix tournament over the payload's order encoding, MSB first.
+    Per bitplane, two contractions against the SHARED int8 one-hot
+    lane-membership matrix: a vote (does any still-candidate lane of
+    this dst slot carry the bit?) and the transposed route-back that
+    narrows each lane's candidacy to the slot's winning prefix — the
+    same forward/transpose pairing as the pair path's one-hot
+    gradient matmul (ops/pairs.pair_partial_dot).  Bitwise-equal to
+    the VPU masked reduce for integer payloads; floats inherit the
+    encoding's total order (-0.0/+0.0 ties resolve deterministically
+    instead of by reduction order).  K/B trailing payload axes ride
+    as free minor dims of every contraction.
+
+    Padding contract: pad lanes (rel == -1) have all-zero one-hot
+    rows, so they never vote; slots no live lane maps to keep an
+    occupancy of 0 and are filled with the reduce identity — padding
+    contributes the identity, per the one-identity convention."""
+    if kind not in ("min", "max"):
+        raise MXUUnsupportedError(kind, vals.dtype,
+                                  "unknown compare-reduce kind")
+    bits = _order_bits(vals.dtype)
+    onehot = _lane_onehot(rel_dst, W)              # [C, E, W] int8
+    m = _order_encode(vals)                        # [C, E, ...] uint32
+    if kind == "min":
+        # min = bitwise complement of max in the order domain
+        m = (~m) & (jnp.uint32(0xFFFFFFFF) >> (32 - bits))
+    C, E = m.shape[:2]
+    trail = m.shape[2:]
+    occ = jnp.einsum("ce,cew->cw", jnp.ones((C, E), jnp.int8), onehot,
+                     preferred_element_type=jnp.int32) > 0   # [C, W]
+    cand0 = jnp.ones(m.shape, jnp.bool_)
+    res0 = jnp.zeros((C, W) + trail, jnp.uint32)
+
+    def bitplane(i, carry):
+        cand, res = carry
+        b = (bits - 1 - i).astype(jnp.uint32)
+        bit = (jnp.right_shift(m, b) & jnp.uint32(1)).astype(jnp.int32)
+        t = jnp.where(cand, bit, 0).astype(jnp.int8)
+        cnt = jnp.einsum("ce...,cew->cw...", t, onehot,
+                         preferred_element_type=jnp.int32)
+        has = cnt > 0                                # [C, W, ...]
+        res = res | jnp.left_shift(has.astype(jnp.uint32), b)
+        back = jnp.einsum("cw...,cew->ce...", has.astype(jnp.int8),
+                          onehot, preferred_element_type=jnp.int32)
+        cand = cand & (back == bit)
+        return cand, res
+
+    _, res = jax.lax.fori_loop(0, bits, bitplane, (cand0, res0))
+    if kind == "min":
+        res = (~res) & (jnp.uint32(0xFFFFFFFF) >> (32 - bits))
+    out = _order_decode(res, vals.dtype)
+    ident = identity_for(kind, vals.dtype)
+    occb = occ.reshape(occ.shape + (1,) * len(trail))
+    return jnp.where(occb, out, ident)
+
+
 def chunk_partials(vals, rel_dst, W: int, kind: str, use_mxu: bool = False):
     """Per-chunk reduction [C, E, ...] -> [C, W, ...].
 
-    use_mxu=True (sum only) contracts against a one-hot matrix on the
-    MXU — profitable for wide vector payloads (e.g. colfilter's K=20
-    factors); the default masked broadcast-reduce stays on the VPU and
-    fuses without materializing the [C, E, W] intermediate.
+    use_mxu=True contracts against an int8 one-hot lane-membership
+    matrix on the MXU: sum is one mixed-dtype contraction
+    (`preferred_element_type` pins the accumulator to the payload
+    dtype, keeping the dtype-discipline audit green); min/max run the
+    radix tournament (_mxu_compare_reduce) — bitwise-equal to the VPU
+    path for integer payloads, total-order-equal for floats.  The
+    default masked broadcast-reduce stays on the VPU and fuses without
+    materializing the [C, E, W] intermediate; the MXU path holds the
+    one-hot live ([C, E, W] int8 — priced by graph.memory_report's
+    ``mxu_temp`` term and amortized by the streamed block bound).
     """
     if use_mxu:
-        if kind != "sum":
-            raise ValueError("MXU one-hot path only supports 'sum'")
-        onehot = (rel_dst[..., None] ==
-                  jnp.arange(W, dtype=rel_dst.dtype)).astype(vals.dtype)
-        # [C, E, ...] x [C, E, W] -> [C, W, ...]
-        return jnp.einsum("ce...,cew->cw...", vals, onehot)
+        dt = np.dtype(vals.dtype)
+        if dt.kind not in "iuf" or dt.itemsize > 4:
+            raise MXUUnsupportedError(
+                kind, dt, "payload has no MXU contraction (only "
+                "<= 32-bit int/uint/float states)")
+        if kind == "sum":
+            onehot = _lane_onehot(rel_dst, W)
+            # [C, E, ...] x [C, E, W] -> [C, W, ...]; pad lanes have
+            # all-zero one-hot rows = the sum identity
+            return jnp.einsum("ce...,cew->cw...", vals, onehot,
+                              preferred_element_type=vals.dtype)
+        if kind in ("min", "max"):
+            return _mxu_compare_reduce(vals, rel_dst, W, kind)
+        raise MXUUnsupportedError(kind, dt, "unknown reduce kind")
     ident = identity_for(kind, vals.dtype)
     match = rel_dst[..., None] == jnp.arange(W, dtype=rel_dst.dtype)
     if vals.ndim > 2:                       # vector payload [C, E, K]
@@ -230,15 +396,25 @@ def _segscan(partials, flags, kind):
 
 
 def combine_chunks(partials, layout: TiledLayout, chunk_start, last_chunk,
-                   kind: str):
+                   kind: str, use_mxu: bool = False):
     """Segmented combine of per-chunk partials [C, W, ...] into tile
     results [n_tiles, W, ...]; chunk_start/last_chunk are this part's
-    rows of the layout arrays (device)."""
+    rows of the layout arrays (device).
+
+    use_mxu=True routes the sum-kind scan through _segscan_matmul (the
+    TCU-paper scan-as-matmul recurrence); min/max segmented scans stay
+    on the VPU — a prefix scan's candidacy is per-OUTPUT-position, so
+    the bit-serial tournament that serves chunk_partials has no
+    matmul form here (each row of the segment matrix would need its
+    own vote), and the flag-reset associative scan is already
+    O(C log C) compares."""
     if layout.needs_scan:
         C = partials.shape[0]
-        flags = chunk_start.reshape(
-            chunk_start.shape + (1,) * (partials.ndim - 1))
-        if C <= SCAN_BLOCKED_ABOVE:
+        if use_mxu and kind == "sum":
+            partials = _segscan_matmul(partials, chunk_start)
+        elif C <= SCAN_BLOCKED_ABOVE:
+            flags = chunk_start.reshape(
+                chunk_start.shape + (1,) * (partials.ndim - 1))
             partials = _segscan(partials, flags, kind)
         else:
             partials = _segscan_blocked(partials, chunk_start, kind)
@@ -283,6 +459,63 @@ def _segscan_blocked(partials, chunk_start, kind,
         absorb = jnp.cumsum(f_b.astype(jnp.int32)) == 0
         ab = absorb.reshape(absorb.shape + (1,) * len(trail))
         out = jnp.where(ab, comb(carry, inner), inner)
+        return out[-1], out
+
+    carry0 = jnp.full(trail, ident, partials.dtype)
+    _, blocks = jax.lax.scan(
+        step, carry0,
+        (partials.reshape((nB, block) + trail),
+         chunk_start.reshape(nB, block)))
+    return blocks.reshape((Cp,) + trail)[:C]
+
+
+# Block length for the scan-as-matmul segmented combine: the int8
+# segment matrix is block^2 bytes (64 KB at 256) and one einsum row
+# is a 256-wide MXU contraction — small enough to stay resident,
+# large enough to amortize the lax.scan step (the blocked-memory
+# contract above is preserved: live memory is one block's [B, B]
+# matrix + the [C, W] output, never an O(log C) tree).
+MXU_SCAN_BLOCK = 256
+
+
+def _segscan_matmul(partials, chunk_start, block: int | None = None):
+    """Segmented inclusive SUM scan along axis 0 as blocked matrix
+    products (TCU scan-as-matmul, PAPERS.md): per block the lower-
+    triangular same-segment matrix T[i, j] = (i >= j) & (seg i == seg
+    j) is built ON DEVICE from cumsum(flags) (no baked constant — the
+    413 const-bytes audit stays green) and one int8 contraction
+    produces every prefix in the block; the carry folds into rows
+    before the block's first flag exactly as _segscan_blocked.
+    Sum-only: min/max have no matmul recurrence (see combine_chunks).
+    Bitwise-equal to the flag-reset scan for integer payloads."""
+    if block is None:
+        block = MXU_SCAN_BLOCK
+    C = partials.shape[0]
+    trail = partials.shape[1:]
+    nB = _ceil_div(C, block)
+    Cp = nB * block
+    ident = identity_for("sum", partials.dtype)
+    if Cp != C:
+        # pad chunks are isolated identity segments, as in
+        # _segscan_blocked
+        partials = jnp.concatenate(
+            [partials, jnp.full((Cp - C,) + trail, ident,
+                                partials.dtype)], axis=0)
+        chunk_start = jnp.concatenate(
+            [chunk_start, jnp.ones(Cp - C, bool)], axis=0)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+
+    def step(carry, x):
+        p_b, f_b = x
+        sid = jnp.cumsum(f_b.astype(jnp.int32))
+        T = ((ii >= jj) &
+             (sid[:, None] == sid[None, :])).astype(jnp.int8)
+        inner = jnp.einsum("ij,j...->i...", T, p_b,
+                           preferred_element_type=p_b.dtype)
+        absorb = sid == 0       # no flag at-or-before: continue carry
+        ab = absorb.reshape(absorb.shape + (1,) * len(trail))
+        out = jnp.where(ab, carry + inner, inner)
         return out[-1], out
 
     carry0 = jnp.full(trail, ident, partials.dtype)
@@ -565,12 +798,13 @@ def streamed_chunk_combined(flat_state, src_slot, rel_dst, weight,
 
 
 def combine_partials(partials, layout: TiledLayout, chunk_start,
-                     last_chunk, vpad: int, kind: str):
+                     last_chunk, vpad: int, kind: str,
+                     use_mxu: bool = False):
     """Per-chunk partials [C, W, ...] -> flat [vpad, ...] (the shared
     tail of tiled_segment_reduce, also used by the streamed engines
     that produce partials block-wise)."""
     tiles = combine_chunks(partials, layout, chunk_start, last_chunk,
-                           kind)
+                           kind, use_mxu=use_mxu)
     flatshape = (layout.n_tiles * layout.W,) + tiles.shape[2:]
     return tiles.reshape(flatshape)[:vpad]
 
@@ -596,4 +830,4 @@ def tiled_segment_reduce(vals, layout: TiledLayout, chunk_start,
         partials = chunk_partials(vals, rel_dst, layout.W, kind,
                                   use_mxu=use_mxu)
     return combine_partials(partials, layout, chunk_start, last_chunk,
-                            vpad, kind)
+                            vpad, kind, use_mxu=use_mxu)

@@ -255,6 +255,82 @@ def pagemajor_break_even_vfill(page_ratio: float = 1.0,
                             / margin))
 
 
+# MXU compute core (round 23, ops/tiled.chunk_partials use_mxu): the
+# per-chunk reduce as one-hot contractions.  The VPU masked reduce
+# FUSES (no [C, E, W] intermediate, tiled.py) but runs its
+# compare-select machinery once per PAYLOAD SLICE — the wide (K x B)
+# payload multiplies the whole row cost.  The MXU path pays a fixed
+# per-row toll to MATERIALIZE the [E, W] int8 one-hot (the pair row's
+# fetch-shaped cost — modeled at the measured 150 ns pair-row
+# machinery + its 0.19 ns/B int8 store, NOT yet measured on device:
+# observe.DEBTS "mxu-core-ab"), after which each payload slice is one
+# 128x128 int8 systolic pass (~2 ns at the MXU int8 rate).  min/max
+# replay that contraction 2x per ORDER BIT (vote + candidacy
+# route-back, tiled._mxu_compare_reduce), which is why compare kinds
+# essentially never auto-engage — the resolver is deliberately
+# honest about that.
+ONEHOT_TILE_NS = 160.0   # materialize + load one [128, W] int8 one-hot
+MXU_TILE_NS = 2.0        # one 128x128 int8 contraction, per wide slice
+
+
+def mxu_reduce_rounds(kind: str, nbits: int = 32) -> int:
+    """Contractions per chunk row for a reduce kind: sum is ONE
+    one-hot matmul; min/max run the bit-serial tournament — one vote
+    + one route-back contraction per bit of the order encoding."""
+    if kind == "sum":
+        return 1
+    if kind in ("min", "max"):
+        return 2 * nbits
+    raise ValueError(f"unknown reduce kind {kind!r}")
+
+
+def vpu_reduce_row_ns(wide: int = 1) -> float:
+    """Modeled VPU masked-reduce cost of one 128-lane chunk row: the
+    measured VROW_REDUCE_NS compare-reduce machinery, once per payload
+    slice (the broadcast-select-reduce runs over every K x B lane)."""
+    if wide < 1:
+        raise ValueError(f"wide must be >= 1, got {wide}")
+    return VROW_REDUCE_NS * wide
+
+
+def mxu_reduce_row_ns(wide: int = 1, kind: str = "sum",
+                      nbits: int = 32) -> float:
+    """Modeled MXU one-hot cost of one 128-lane chunk row: the fixed
+    one-hot materialization + one int8 contraction per payload slice
+    per tournament round.  The wide (K x B) payload rides as a free
+    MXU minor dimension — only the ~2 ns systolic term scales with
+    it, not the 160 ns toll."""
+    if wide < 1:
+        raise ValueError(f"wide must be >= 1, got {wide}")
+    return ONEHOT_TILE_NS + MXU_TILE_NS * wide * mxu_reduce_rounds(
+        kind, nbits)
+
+
+def mxu_break_even_wide(kind: str = "sum", nbits: int = 32) -> int:
+    """Smallest K x B payload width at which the MXU one-hot reduce
+    beats the fused VPU masked reduce for a kind.  sum: width 2 (the
+    one-hot toll needs one extra payload slice to amortize — scalar
+    sum stays VPU, so f32 scalar flagships keep their bitwise
+    behavior).  min/max: the 2 x nbits tournament rounds outrun the
+    VPU's per-slice saving at every width (1 << 30 = never) — those
+    paths exist for the measured A/B and the pull-kind revalidators,
+    not the auto default."""
+    import math
+    per_slice_margin = VROW_REDUCE_NS \
+        - MXU_TILE_NS * mxu_reduce_rounds(kind, nbits)
+    if per_slice_margin <= 0:
+        return 1 << 30
+    return max(1, math.ceil(ONEHOT_TILE_NS / per_slice_margin))
+
+
+def resolve_use_mxu(kind: str, wide: int = 1, nbits: int = 32) -> bool:
+    """The ``use_mxu="auto"`` resolution: engage the MXU reduce when
+    the payload is wide enough to amortize the one-hot toll.  wide is
+    the product of the program's vector K and query batch B (both are
+    free minor dims of the contraction)."""
+    return wide >= mxu_break_even_wide(kind, nbits)
+
+
 # Query batching (ROADMAP item 2, engine/program.py ``batch``): the
 # dense iteration's ONE table gather fetches a [B]-wide CONTIGUOUS
 # state row per edge instead of one element — the fetch is
@@ -458,7 +534,11 @@ def phase_model(*, engine: str, exchange: str, ne: int, nv: int,
                 page_fill: float = 128.0,
                 page_scale: float | None = None,
                 page_mode: str = "paged",
-                page_g_fill: float = 128.0) -> dict:
+                page_g_fill: float = 128.0,
+                use_mxu: bool = False,
+                mxu_wide: int = 1,
+                reduce_kind: str = "sum",
+                state_nbits: int = 32) -> dict:
     """Per-PHASE predicted nanoseconds for ONE engine iteration — the
     model side of the observatory's measured-vs-model drift check
     (lux_tpu/observe.py).  Keys match the engines' ``timed_phases``
@@ -486,7 +566,12 @@ def phase_model(*, engine: str, exchange: str, ne: int, nv: int,
     - apply/update       per-vertex epilogue (STATE_NS_PER_VERTEX)
     - exchange           all_gather materialization: free on one chip
                          (a reshape), ICI-priced per mesh chip
-    - reduce             no isolated measured constant: None
+    - reduce             VPU: no isolated measured constant (None);
+                         with ``use_mxu`` the one-hot contraction IS
+                         modeled (mxu_reduce_row_ns over the chunk
+                         rows at ``mxu_wide`` = K x B payload slices)
+                         — the per-phase A/B the round-23 port owes
+                         observe.decompose
     """
     if engine not in ("pull", "push"):
         raise ValueError(f"unknown engine {engine!r}")
@@ -538,8 +623,15 @@ def phase_model(*, engine: str, exchange: str, ne: int, nv: int,
         else:
             key = "relax" if engine == "push" else "gather"
             model[key] = deliver + pair_ns
-            model["reduce"] = None
-            model[f"{key}_reduce"] = deliver + pair_ns
+            if use_mxu:
+                rows = ne * chunk_inflation / 128.0
+                reduce_ns = rows * mxu_reduce_row_ns(
+                    mxu_wide, reduce_kind, state_nbits) * scale
+                model["reduce"] = reduce_ns
+                model[f"{key}_reduce"] = deliver + pair_ns + reduce_ns
+            else:
+                model["reduce"] = None
+                model[f"{key}_reduce"] = deliver + pair_ns
     model["update" if engine == "push" else "apply"] = apply_ns
     return model
 

@@ -167,6 +167,17 @@ GATHER_AB_METRIC = re.compile(
     r"^pagerank_(paged|flat|pagemajor)_(?:(native|hillclimb)_)?"
     r"(rmat|comm)(\d+)_gteps_per_chip$")
 REORDER_METHODS = ("none", "native", "hillclimb")
+# round-23 MXU-vs-VPU reduce A/B lines (bench.py -config mxu-ab,
+# ops/tiled.py): the metric name carries the reduce path, the line
+# carries mxu (the mode of record), use_mxu (the engine's RESOLVED
+# flag — a name/mode/flag disagreement is the mode-vs-name
+# contradiction class), the scalemodel per-row rates for BOTH paths
+# (the modeled step-change the measured pair is read against) and
+# the plan fill.  An mxu line is only publishable NEXT TO its paired
+# vpu baseline (check_mxu_pairs) — a lone MXU number has no
+# step-change to show.
+MXU_AB_METRIC = re.compile(
+    r"^ppr_(mxu|vpu)_comm(\d+)_gteps_per_chip$")
 # round-17 serving SLO lines (bench.py -config serve-slo +
 # scripts/loadgen.py): one open-loop Poisson load step per line, the
 # value is the MEASURED achieved qps.  The line must carry the whole
@@ -358,6 +369,9 @@ def check_line(obj: dict, *, legacy_ok: bool):
                                     m.group(1) if m else None,
                                     (m.group(2) or "none") if m
                                     else None)
+    m = MXU_AB_METRIC.match(name)
+    if m or "mxu" in obj:
+        errs += check_mxu_fields(name, obj, m.group(1) if m else None)
     if SERVE_SLO_METRIC.match(name) or SERVE_CHAOS_METRIC.match(name) \
             or "offered_qps" in obj:
         errs += check_serve_slo_fields(name, obj)
@@ -523,6 +537,57 @@ def check_gather_fields(name: str, obj: dict,
         errs.append(f"{name}: page_fill={pf!r} must be a finite "
                     f"number in (0, 128] (live lanes per padded "
                     f"128-lane delivery row)")
+    return errs
+
+
+def check_mxu_fields(name: str, obj: dict,
+                     name_mode: str | None) -> list[str]:
+    """Round-23 MXU A/B lines (see MXU_AB_METRIC): ``mxu`` must be
+    mxu|vpu and match the metric name, ``use_mxu`` must be the
+    matching resolved boolean (the engine flag of record — a vpu line
+    claiming use_mxu=true ran the wrong path), and BOTH modeled
+    per-chunk-row rates (``mxu_row_ns``/``vpu_row_ns``,
+    lux_tpu/scalemodel.py) must be present, finite > 0 and DISTINCT:
+    the pair exists to show a step-change, and identical models mean
+    the line was stamped without resolving the payload width."""
+    errs = []
+    mode = obj.get("mxu")
+    if mode not in ("mxu", "vpu"):
+        errs.append(f"{name}: mxu={mode!r} must be 'mxu' or 'vpu'")
+        return errs
+    if name_mode is not None and mode != name_mode:
+        errs.append(f"{name}: mxu={mode!r} contradicts the metric "
+                    f"name's _{name_mode}_")
+    um = obj.get("use_mxu")
+    if not isinstance(um, bool):
+        errs.append(f"{name}: use_mxu={um!r} must be a bool (the "
+                    f"engine's resolved flag)")
+    elif um != (mode == "mxu"):
+        errs.append(f"{name}: use_mxu={um} contradicts mxu={mode!r} "
+                    f"— the engine ran the other reduce path")
+    kind = obj.get("reduce_kind")
+    if kind not in ("sum", "min", "max"):
+        errs.append(f"{name}: reduce_kind={kind!r} must be "
+                    f"sum|min|max")
+    rates = {}
+    for k in ("mxu_row_ns", "vpu_row_ns"):
+        v = obj.get(k)
+        if not _is_num(v) or v <= 0:
+            errs.append(f"{name}: {k}={v!r} must be a finite number "
+                        f"> 0 (the scalemodel per-chunk-row rate)")
+        else:
+            rates[k] = v
+    if len(rates) == 2 and abs(
+            rates["mxu_row_ns"] - rates["vpu_row_ns"]) < 1e-9:
+        errs.append(f"{name}: mxu_row_ns == vpu_row_ns "
+                    f"({rates['mxu_row_ns']}) — the modeled pair "
+                    f"shows no step-change; the payload width was "
+                    f"not resolved")
+    pf = obj.get("page_fill")
+    if not _is_num(pf) or not 0.0 < pf <= 128.0:
+        errs.append(f"{name}: page_fill={pf!r} must be a finite "
+                    f"number in (0, 128] (live lanes per padded "
+                    f"128-lane row — the A/B's dense-fill evidence)")
     return errs
 
 
@@ -1421,6 +1486,48 @@ def check_reorder_pairs(lines) -> list[str]:
     return errs
 
 
+def check_mxu_pairs(lines) -> list[str]:
+    """Cross-line audit of the round-23 MXU A/B (bench.py -config
+    mxu-ab always emits both sides): an mxu line may only publish
+    NEXT TO its paired vpu baseline — same scale and num_parts, in
+    the same artifact — and the pair must carry IDENTICAL modeled
+    rates (both sides stamp the rates for both paths from one
+    payload width, so a disagreement means the lines are not the
+    same experiment).  A lone MXU number has no step-change to show
+    and is rejected, the same pairing rule as the reorder A/B."""
+    errs = []
+    by_key = {}
+    for where, obj in lines:
+        m = MXU_AB_METRIC.match(obj.get("metric", ""))
+        if not m:
+            continue
+        key = (m.group(2), obj.get("np"))
+        by_key.setdefault(key, {}).setdefault(m.group(1), []).append(
+            (where, obj.get("metric"), obj))
+    for key, by_mode in by_key.items():
+        for where, name, obj in by_mode.get("mxu", []):
+            base = by_mode.get("vpu", [])
+            if not base:
+                errs.append(
+                    f"({where}): {name}: mxu line has NO paired vpu "
+                    f"baseline (same comm scale + np) in the "
+                    f"artifact — a lone MXU number has no "
+                    f"step-change to show")
+                continue
+            for _bw, bname, bobj in base:
+                for k in ("mxu_row_ns", "vpu_row_ns"):
+                    a, b = obj.get(k), bobj.get(k)
+                    if _is_num(a) and _is_num(b) \
+                            and abs(a - b) > 1e-9:
+                        errs.append(
+                            f"({where}): {name}: {k}={a} disagrees "
+                            f"with its paired baseline {bname} "
+                            f"({b}) — the sides modeled different "
+                            f"payload widths; the pair is not one "
+                            f"experiment")
+    return errs
+
+
 def check_file(path: str, *, legacy_ok: bool):
     errs, warns, n = [], [], 0
     try:
@@ -1438,6 +1545,7 @@ def check_file(path: str, *, legacy_ok: bool):
         errs += [f"{path} ({where}): {m}" for m in e]
         warns += [f"{path} ({where}): {m}" for m in w]
     errs += [f"{path} {m}" for m in check_reorder_pairs(lines)]
+    errs += [f"{path} {m}" for m in check_mxu_pairs(lines)]
     return errs, warns, n
 
 

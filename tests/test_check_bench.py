@@ -958,3 +958,100 @@ def test_bad_weighted_serve_live_lines_fail(tmp_path, mutate,
     r = _audit_one(tmp_path, obj)
     assert r.returncode == 1, "audit passed a bad weighted line"
     assert needle in r.stderr, r.stderr
+
+
+# ---------------------------------------------------------------------
+# round-23 MXU A/B lines (bench.py -config mxu-ab, ops/tiled.py)
+
+
+def _mxu_line(mode="mxu", scale=16, np_=1, mxu_ns=176.0,
+              vpu_ns=1008.0):
+    d = json.loads(json.dumps(GOOD_LINE))
+    d["metric"] = f"ppr_{mode}_comm{scale}_gteps_per_chip"
+    d["np"] = np_
+    d["batch"] = 8
+    d["query_gteps"] = round(8 * d["value"], 4)
+    d["per_query_edge_ns"] = round(1.0 / d["query_gteps"], 4)
+    d["mxu"] = mode
+    d["use_mxu"] = mode == "mxu"
+    d["reduce_kind"] = "sum"
+    d["mxu_row_ns"] = mxu_ns
+    d["vpu_row_ns"] = vpu_ns
+    d["page_fill"] = 41.4
+    return d
+
+
+def _mxu_pair(**kw):
+    return [_mxu_line("mxu", **kw), _mxu_line("vpu", **kw)]
+
+
+def test_mxu_pair_passes(tmp_path):
+    p = tmp_path / "bench.jsonl"
+    p.write_text("".join(json.dumps(d) + "\n" for d in _mxu_pair()))
+    r = run_check(p)
+    assert r.returncode == 0, r.stderr
+
+
+def test_lone_mxu_line_rejected(tmp_path):
+    """An mxu line may only publish next to its paired vpu baseline —
+    a lone MXU number has no step-change to show.  The vpu side
+    stands alone fine (it IS a baseline)."""
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(_mxu_line("mxu")) + "\n")
+    r = run_check(p)
+    assert r.returncode == 1
+    assert "NO paired vpu baseline" in r.stderr
+    p.write_text(json.dumps(_mxu_line("vpu")) + "\n")
+    assert run_check(p).returncode == 0
+
+
+def test_mxu_pair_cross_scale_or_np_not_paired(tmp_path):
+    """Scale and num_parts are the pairing identity: a vpu line at a
+    different shape is NOT the mxu line's baseline."""
+    lines = [_mxu_line("mxu", scale=16), _mxu_line("vpu", scale=18)]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    r = run_check(p)
+    assert r.returncode == 1 and "NO paired vpu baseline" in r.stderr
+    lines = [_mxu_line("mxu", np_=1), _mxu_line("vpu", np_=2)]
+    p.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    r = run_check(p)
+    assert r.returncode == 1 and "NO paired vpu baseline" in r.stderr
+
+
+def test_mxu_pair_model_disagreement_rejected(tmp_path):
+    """Both sides stamp the modeled rates from ONE payload width; a
+    disagreement means the lines are not the same experiment."""
+    lines = [_mxu_line("mxu", mxu_ns=176.0),
+             _mxu_line("vpu", mxu_ns=180.0)]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    r = run_check(p)
+    assert r.returncode == 1
+    assert "not one experiment" in r.stderr
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda o: o.update(mxu="tensor"), "must be 'mxu' or 'vpu'"),
+    # mode contradicting the metric name
+    (lambda o: o.update(mxu="vpu", use_mxu=False),
+     "contradicts the metric name's _mxu_"),
+    # resolved engine flag contradicting the mode of record
+    (lambda o: o.update(use_mxu=False),
+     "the engine ran the other reduce path"),
+    (lambda o: o.update(use_mxu="yes"), "must be a bool"),
+    (lambda o: o.update(reduce_kind="prod"), "reduce_kind"),
+    (lambda o: o.update(mxu_row_ns=0), "mxu_row_ns"),
+    (lambda o: o.pop("vpu_row_ns"), "vpu_row_ns"),
+    # identical models = the payload width was never resolved
+    (lambda o: o.update(mxu_row_ns=1008.0), "no step-change"),
+    (lambda o: o.update(page_fill=0.0), "page_fill"),
+])
+def test_bad_mxu_fields_fail(tmp_path, mutate, needle):
+    lines = _mxu_pair()
+    mutate(lines[0])
+    p = tmp_path / "bench.jsonl"
+    p.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    r = run_check(p)
+    assert r.returncode == 1, "audit passed a bad mxu line"
+    assert needle in r.stderr, r.stderr

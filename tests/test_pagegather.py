@@ -361,7 +361,10 @@ def test_batched_paged_bitwise():
                     gather="paged")
     a = ef.unpad(ef.run(ef.init_state(), 4))
     b = ep.unpad(ep.run(ep.init_state(), 4))
-    np.testing.assert_allclose(b, a, rtol=1e-6)
+    # B=3 engages the auto MXU sum on both engines (round 23); the
+    # paged and flat layouts contract lanes in different orders, so
+    # float sums agree to tolerance, not bitwise (PERF_NOTES r23).
+    np.testing.assert_allclose(b, a, rtol=5e-6)
 
 
 def test_paged_stats_and_health_variants():
