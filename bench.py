@@ -154,11 +154,16 @@ DEFAULT_SHAPE = {"pagerank": (21, 16), "cc": (20, 16),
                  # -serve-replicas replicas with a ReplicaKillPlan
                  # armed post-warm; each line extends the serve-slo
                  # record with replicas/failovers/shed/shed_fraction
-                 # (scripts/check_bench.py rejects the
-                 # contradictions: shed_fraction outside [0,1],
-                 # failovers with replicas=1, SLO accounting over
-                 # shed queries).  The real-TPU drill is debt
-                 # serve-chaos-on-device.
+                 # plus (round 24, self-healing) respawns/
+                 # quarantines/mttr_s/journal_replayed — the fleet
+                 # runs with a durable admission journal and the
+                 # resurrection supervisor armed (scripts/
+                 # check_bench.py rejects the contradictions:
+                 # shed_fraction outside [0,1], failovers or
+                 # respawns with replicas=1, SLO accounting over
+                 # shed queries, mttr without a fired kill,
+                 # journal_replayed > submitted).  The real-TPU
+                 # drill is debt serve-chaos-on-device.
                  "serve-chaos": (12, 8),
                  # live-graph serving lines (round 20,
                  # lux_tpu/livegraph.py): `-config serve-live` runs
@@ -388,13 +393,26 @@ def run_serve_load(config, args, *, chaos: bool):
             raise ValueError(
                 "serve-chaos needs -serve-replicas >= 2: there is "
                 "no surviving replica to fail over to with one")
+        import tempfile
+        # round 24: the chaos line exercises the SELF-HEALING tier —
+        # admissions journaled durably (the line reports how many a
+        # recovery would replay: 0 on a drained run) and the killed
+        # replica resurrected under backoff with canary-gated
+        # routing re-entry (respawns/quarantines/mttr_s ride the
+        # line; check_bench rejects the contradictions)
+        jpath = os.path.join(tempfile.mkdtemp(prefix="lux_chaos_j_"),
+                             "admissions.journal")
         srv = fleet.FleetServer(
             g, replicas=args.serve_replicas, batch=args.serve_batch,
             num_parts=args.np, seg_iters=2, slo_ms=slo,
             health=args.health,
             retry=resilience.RetryPolicy(retries=3, backoff_s=0.01,
                                          max_backoff_s=0.1,
-                                         jitter_seed=0))
+                                         jitter_seed=0),
+            journal_path=jpath, heal=True,
+            respawn_retry=resilience.RetryPolicy(
+                retries=3, backoff_s=0.01, max_backoff_s=0.1,
+                jitter_seed=1))
         runner_of = srv._replicas[0].runner
         extra["replicas"] = args.serve_replicas
     else:
@@ -466,6 +484,13 @@ def run_serve_load(config, args, *, chaos: bool):
             "serve-chaos kill plan never fired (or nothing failed "
             "over) — the chaos line would be measuring a fault-free "
             "run")
+    if chaos and srv.respawns + srv.quarantines < 1:
+        # heal-armed run() does not return until every lost replica
+        # resurrected or quarantined, so a fired kill with neither
+        # means the healing tier silently did not engage
+        raise RuntimeError(
+            "serve-chaos kill fired but the healing supervisor "
+            "neither respawned nor quarantined the replica")
     if args.verbose:
         loadgen.render_table([rep], out=sys.stderr)
     extra.update(offered_qps=round(rep.offered_qps, 4),
@@ -480,7 +505,19 @@ def run_serve_load(config, args, *, chaos: bool):
                      shed=int(rep.shed),
                      shed_fraction=round(rep.shed
                                          / max(1, rep.submitted), 4),
-                     slo_accounted=rep.slo_accounted)
+                     slo_accounted=rep.slo_accounted,
+                     # round-24 healing gauges: resurrections that
+                     # re-entered routing (canary-gated), typed
+                     # quarantines, repair time (first loss -> pool
+                     # whole; None when the pool never re-completed),
+                     # and how many admitted-unretired queries a
+                     # crash recovery would re-dispatch NOW (a
+                     # drained run retired everything: 0)
+                     respawns=int(srv.respawns),
+                     quarantines=int(srv.quarantines),
+                     mttr_s=(None if srv.mttr_s is None
+                             else round(srv.mttr_s, 4)),
+                     journal_replayed=int(srv.journal_replayed))
     prefix = "serve_chaos" if chaos else "serve_slo"
     name = f"{prefix}_q{_rate_token(rate)}_rmat{scale}"
     return (name, [rep.achieved_qps], extra,

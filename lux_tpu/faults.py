@@ -93,6 +93,23 @@ RESEED_CRASH = "reseed_crash"    # crash MID-RE-SEED: between the
 #                                  answer was produced from the
 #                                  half-re-seeded state)
 
+# round 24 (self-healing fleet, lux_tpu/fleet.py + journal.py): the
+# whole-fleet and flapping-replica classes the resurrection /
+# recovery paths must survive
+FLEET_CRASH = "fleet_crash"      # the ENTIRE fleet dies at the named
+#                                  replica's Nth boundary, coordinator
+#                                  included (in-process: a typed
+#                                  InjectedFleetCrash that propagates
+#                                  out of FleetServer.run; hard_kill:
+#                                  os._exit) — recovery restarts from
+#                                  the admission journal + mutation WAL
+REPLICA_FLAP = "replica_flap"    # kill the SAME replica at every
+#                                  boundary from the scheduled index on
+#                                  (re-fires, unlike every other plan
+#                                  action): each resurrection dies
+#                                  again until flap detection trips the
+#                                  typed quarantine (fleet.py)
+
 
 # exit code of a hard_kill WORKER_KILL: distinguishable from a crash
 # (nonzero, outside the shell/signal ranges) in the harness's asserts
@@ -124,6 +141,22 @@ class InjectedWorkerKill(RuntimeError):
     def __init__(self, msg: str, lost_devices=()):
         super().__init__(msg)
         self.lost_devices = tuple(int(d) for d in lost_devices)
+
+
+class InjectedFleetCrash(BaseException):
+    """Synthetic whole-fleet death (round 24): the coordinator AND
+    every replica die at once — nothing survives to fail over to, so
+    this is NOT retryable within the process and deliberately
+    subclasses BaseException: no except-Exception recovery path in
+    the dispatcher may swallow it (a real power loss is not
+    swallowed either).  The only legitimate continuation is
+    ``FleetServer.recover`` over the durable state (admission
+    journal + mutation WAL + checkpoints).  Carries ``replica`` —
+    the replica whose boundary the crash fired at."""
+
+    def __init__(self, msg: str, replica: str = ""):
+        super().__init__(msg)
+        self.replica = replica
 
 
 @dataclasses.dataclass
@@ -265,11 +298,28 @@ class ReplicaKillPlan:
     board's beat staleness can detect) or DEVICE_LOSS
     (InjectedDeviceLoss).  A fired entry never re-fires (the
     boundary counter advances past it), so a drained fleet always
-    terminates; ``fired`` records what happened, for assertions."""
+    terminates; ``fired`` records what happened, for assertions.
+
+    Round 24 adds the self-healing drill actions: FLEET_CRASH (the
+    whole fleet dies at the named replica's boundary — the typed
+    InjectedFleetCrash propagates out of FleetServer.run, or
+    ``hard_kill`` really exits; recovery is FleetServer.recover over
+    the journals) and REPLICA_FLAP, the ONE re-firing action: the
+    named replica dies at EVERY boundary from the scheduled index on
+    (capped by ``flap_count`` firings, None = unbounded), so each
+    resurrection dies again until the fleet's flap detection trips
+    the typed quarantine — which stops the replica's boundaries and
+    therefore terminates the plan.  Arm every schedule via
+    ``FleetServer.routing_target`` per the round-22 rule (routing is
+    a positive-feedback loop; a fixed replica index is a coin
+    flip)."""
 
     schedule: dict
     action: str = WORKER_KILL
     hard_kill: bool = False
+    # REPLICA_FLAP only: stop re-firing after this many kills (None =
+    # keep killing until quarantine stops the boundaries)
+    flap_count: int | None = None
     boundaries: dict = dataclasses.field(default_factory=dict,
                                          init=False)
     fired: list = dataclasses.field(default_factory=list, init=False)
@@ -278,10 +328,12 @@ class ReplicaKillPlan:
         # validate at CONSTRUCTION: a typo'd action discovered at
         # the scheduled boundary would crash the run mid-measurement
         # instead of failing the plan before anything was spent
-        if self.action not in (WORKER_KILL, DEVICE_LOSS):
+        if self.action not in (WORKER_KILL, DEVICE_LOSS, FLEET_CRASH,
+                               REPLICA_FLAP):
             raise ValueError(
-                f"ReplicaKillPlan action must be WORKER_KILL or "
-                f"DEVICE_LOSS, got {self.action!r}")
+                f"ReplicaKillPlan action must be WORKER_KILL, "
+                f"DEVICE_LOSS, FLEET_CRASH, or REPLICA_FLAP, got "
+                f"{self.action!r}")
 
     def fire(self, replica: str) -> None:
         import os
@@ -289,9 +341,38 @@ class ReplicaKillPlan:
         i = int(self.boundaries.get(replica, 0))
         self.boundaries[replica] = i + 1
         due = self.schedule.get(replica)
-        if due is None or i != int(due):
+        if due is None:
+            return
+        if self.action == REPLICA_FLAP:
+            # the one re-firing action: every boundary AT/PAST the
+            # scheduled index kills again, so a resurrected replica
+            # dies at its first post-canary boundary — exactly the
+            # flapping pattern quarantine detection exists for
+            if i < int(due):
+                return
+            shots = sum(1 for r, _, _ in self.fired if r == replica)
+            if self.flap_count is not None and shots >= self.flap_count:
+                return
+            self.fired.append((replica, i, self.action))
+            raise InjectedWorkerKill(
+                f"injected replica flap on serving replica "
+                f"{replica!r} at its boundary {i} (death "
+                f"{shots + 1}): coordination service heartbeat to "
+                f"the replica timed out", ())
+        if i != int(due):
             return
         self.fired.append((replica, i, self.action))
+        if self.action == FLEET_CRASH:
+            if self.hard_kill:
+                # the REAL whole-fleet death: coordinator exits with
+                # every replica's state — only the fsync'd journals
+                # survive
+                os._exit(HARD_KILL_CODE)
+            raise InjectedFleetCrash(
+                f"injected fleet crash at replica {replica!r} "
+                f"boundary {i}: coordinator and all replicas died — "
+                f"recover from the admission journal + mutation WAL",
+                replica)
         if self.action == DEVICE_LOSS:
             raise InjectedDeviceLoss(
                 f"injected device loss on serving replica "

@@ -73,10 +73,38 @@ substrate (round 17), and the continuous-batching runners themselves.
   fleet gauges (``fleet_replicas_healthy``, ``fleet_brownout_level``)
   ride the shared metrics registry.
 
+- **Self-healing** (round 24): three layers on top of failover, so a
+  fleet recovers CAPACITY and even a whole-process crash, not just
+  in-flight queries.  (1) The durable ADMISSION JOURNAL
+  (``journal_path=``, lux_tpu/journal.py — the MutationLog's
+  CRC-chained LUXJ sidecar): every admit is fsynced to disk BEFORE it
+  queues and every retirement (answer or late shed) is journaled at
+  the exactly-once gate, so :meth:`FleetServer.recover` can restart a
+  crashed fleet — replay the journal (torn tail truncated like the
+  WAL's), seed the persisted qid dedup, and re-dispatch every
+  admitted-unretired query at its ORIGINAL admission epoch
+  (bitwise-equal answers for the integer apps; the only recovery
+  sheds are the typed ``reset_unavailable`` / ``epoch_folded``).
+  Recovery ordering is WAL replay -> generation adoption -> journal
+  re-dispatch (ARCHITECTURE.md "Self-healing fleet").  (2) REPLICA
+  RESURRECTION (``heal=True``): the run loop's supervisor respawns
+  lost in-process replicas under ``respawn_retry`` decorrelated-
+  jitter backoff; N deaths of one name inside ``flap_window_s``
+  (resilience.FlapDetector) trip a typed QUARANTINE instead, and
+  routing re-entry is gated on an ORACLE-CHECKED CANARY query (a
+  wrong-computing replica is strictly worse than a dead one) — the
+  brownout level decays as replicas rejoin, and ``fleet_mttr_seconds``
+  records first-loss -> pool-whole.  (3) The WHOLE-FLEET KILL drill
+  (faults.FLEET_CRASH / REPLICA_FLAP, tests/test_fleet.py) proves
+  zero lost admitted queries, zero duplicate retirements, and
+  oracle-equal answers at pre-crash epochs across a full
+  crash-restart.
+
 Bench: ``bench.py -config serve-chaos`` drives a FleetServer under an
 open-loop load with an armed kill plan and emits serve-slo lines
-extended with shed_fraction/failovers/replicas
-(scripts/check_bench.py rejects the contradictions); the real-TPU
+extended with shed_fraction/failovers/replicas plus the round-24
+healing gauges (respawns/quarantines/mttr_s/journal_replayed;
+scripts/check_bench.py rejects the contradictions); the real-TPU
 kill-under-load drill is carried as debt ``serve-chaos-on-device``
 (lux_tpu/observe.py).  Smoke: ``python -m lux_tpu.fleet`` drains an
 oversubscribed mixed load across 2 replicas with replica 1 killed
@@ -95,6 +123,7 @@ import numpy as np
 
 from lux_tpu import faults as faults_mod
 from lux_tpu import heartbeat as heartbeat_mod
+from lux_tpu import journal as journal_mod
 from lux_tpu import resilience
 from lux_tpu import serve as serve_mod
 from lux_tpu.serve import (KINDS, DEFAULT_SEG_ITERS, PriorityCollector,
@@ -122,6 +151,16 @@ SHED_DELTA_FULL = "delta_full"
 # projected-resource pattern as the deadline check, applied to the
 # resource ROADMAP item 3 names as the wall
 SHED_MEMORY = "memory"
+# round 24 (self-healing fleet): RECOVERY-only shed reasons.  A
+# journalled admit whose reset vector the recovering caller did not
+# re-supply (the journal stores only the digest — a reset vector is
+# nv floats and cannot live in a fixed record), and an admission
+# epoch the recovered generation can no longer REPRODUCE (a durable
+# compaction folded past it before the crash) — both are closed
+# TYPED at recovery, never silently dropped: the journal gets a
+# RETIRE(shed) record and the trail a query_shed event
+SHED_RESET_UNAVAILABLE = "reset_unavailable"
+SHED_EPOCH_FOLDED = "epoch_folded"
 
 # routing health score: beat age (s) + BURN_WEIGHT x the replica's
 # rolling SLO-burn fraction — a replica burning its whole SLO budget
@@ -312,7 +351,13 @@ class FleetServer:
                  cache: bool = False,
                  mem_budget_bytes: int | None = None,
                  mem_horizon_s: float = 5.0,
-                 mem_clock=time.monotonic):
+                 mem_clock=time.monotonic,
+                 journal_path: str | None = None,
+                 heal: bool = False,
+                 respawn_retry: resilience.RetryPolicy | None = None,
+                 flap_threshold: int = 3,
+                 flap_window_s: float = 60.0,
+                 heal_clock=time.monotonic):
         if replicas < 1:
             raise ValueError(f"fleet needs >= 1 replica, got "
                              f"{replicas}")
@@ -401,6 +446,32 @@ class FleetServer:
         self.dup_dropped = 0
         self.shed_records: list[AdmissionError] = []
         self._brownout = 0
+        # round-24 self-healing state.  The admission journal makes
+        # every admit durable BEFORE it queues (and every retirement
+        # durable at the exactly-once gate), so FleetServer.recover
+        # can re-dispatch a crashed fleet's admitted-unretired
+        # queries at their original epochs; ``heal`` arms the
+        # resurrection supervisor (respawn under decorrelated-jitter
+        # backoff, flap -> quarantine, canary-gated routing
+        # re-entry).
+        self.journal = (None if journal_path is None else
+                        journal_mod.AdmissionJournal(journal_path,
+                                                     nv=g.nv))
+        self._journaled: set[int] = set()
+        self.heal = bool(heal)
+        self.respawn_retry = respawn_retry or self.retry
+        self.heal_clock = heal_clock
+        self.flap = resilience.FlapDetector(
+            threshold=int(flap_threshold),
+            window_s=float(flap_window_s), clock=heal_clock)
+        self.respawns = 0
+        self.quarantines = 0
+        self.journal_replayed = 0
+        self.mttr_s: float | None = None
+        self._respawn_at: dict[str, float] = {}
+        self._respawn_attempts: dict[str, int] = {}
+        self._canaries: set[int] = set()
+        self._t_degraded: float | None = None
         for i in range(int(replicas)):
             self._add_inproc_replica()
 
@@ -630,6 +701,13 @@ class FleetServer:
                     0, self._tenant_load.get(req.tenant, 1) - 1)
                 if self.live is not None:
                     self.live.release()
+            if (self.journal is not None
+                    and req.qid in self._journaled):
+                # a late shed RETIRES the journal entry (cause
+                # "shed"): recover() must not resurrect a query the
+                # fleet already rejected with a typed AdmissionError
+                self.journal.append_retire(req.qid, "shed")
+                self._journaled.discard(req.qid)
         if self.metrics is not None:
             self.metrics.counter("fleet_shed_total", kind=req.kind,
                                  reason=reason).inc()
@@ -806,6 +884,19 @@ class FleetServer:
                 if self.live is not None:
                     self.live.release()
                 raise
+            if self.journal is not None:
+                # durable BEFORE visible: the admit record reaches
+                # the platter (write+flush+fsync) before the query
+                # enters a routing queue, so a crash can lose an
+                # un-acknowledged submit but never an acknowledged
+                # one — recover() re-dispatches exactly this set
+                try:
+                    self.journal.append_admit(req)
+                except BaseException:
+                    if self.live is not None:
+                        self.live.release()
+                    raise
+                self._journaled.add(qid)
             self._qreq[qid] = req
             self._tenant_load[req.tenant] = \
                 self._tenant_load.get(req.tenant, 0) + 1
@@ -871,6 +962,14 @@ class FleetServer:
                     # exactly-once: the pop above is the dedup gate,
                     # so a replayed answer can never double-release
                     self.live.release()
+            if (self.journal is not None
+                    and resp.qid in self._journaled):
+                # the _retired gate above makes this exactly-once on
+                # disk too: a replayed answer returns False before
+                # reaching here, so no qid retires twice in the
+                # journal (retire_dup is rot, not replay)
+                self.journal.append_retire(resp.qid, "answered")
+                self._journaled.discard(resp.qid)
         if self.metrics is not None and not resp.cached:
             # cache hits retire in ~0s and never touch an engine —
             # feeding them into the service-time histogram would
@@ -926,12 +1025,59 @@ class FleetServer:
                 coll.metrics = None
                 inflight += coll.collect(len(coll))
         inflight = [r for r in inflight if r.qid not in self._retired]
+        # a dead replica's CANARY dies with it: the probe exists to
+        # exercise THAT replica's engine — failing it over to a
+        # survivor would answer a question nobody asked and pollute
+        # run()'s responses with throwaway qids
+        canaries = [r for r in inflight if r.qid in self._canaries]
+        inflight = [r for r in inflight
+                    if r.qid not in self._canaries]
+        with self._lock:
+            for r in canaries:
+                self._retired.add(r.qid)
+                if self._qreq.pop(r.qid, None) is not None:
+                    self._tenant_load[r.tenant] = max(
+                        0, self._tenant_load.get(r.tenant, 1) - 1)
+                    if self.live is not None:
+                        self.live.release()
+                self._canaries.discard(r.qid)
         _emit("replica_lost", replica=rep.name,
               error=type(err).__name__, message=str(err)[:200],
               inflight=len(inflight))
         if self.metrics is not None:
             self.metrics.counter("fleet_replica_lost_total").inc()
-        level = sum(1 for r in self._replicas if r.state == "lost")
+        # self-healing bookkeeping BEFORE the failovers below: MTTR
+        # counts from the first detection that degraded the fleet,
+        # and the flap verdict decides whether this death schedules
+        # a resurrection or trips the quarantine
+        if self._t_degraded is None:
+            self._t_degraded = float(self.heal_clock())
+        deaths = self.flap.record(rep.name)
+        if not rep.remote:
+            # the verdict applies whether healing is automatic
+            # (run-loop _heal) or manual (resurrect()): a flapping
+            # name must stop consuming respawns either way
+            if deaths >= self.flap.threshold:
+                self._quarantine(rep, reason="flap", deaths=deaths)
+            else:
+                k = self._respawn_attempts.get(rep.name, 0)
+                self._respawn_at[rep.name] = (
+                    float(self.heal_clock())
+                    + self.respawn_retry.delay_s(k))
+        self._set_brownout()
+        self._health_gauges()
+        t_detect = time.monotonic()
+        for req in sorted(inflight, key=lambda r: r.t_enqueue):
+            self._failover(req, rep, t_detect=t_detect)
+
+    def _set_brownout(self) -> None:
+        """Recompute the brownout level from the CURRENT pool state
+        — one level per replica not serving (lost or quarantined) —
+        and emit the level-change event both ways: resurrection
+        DECAYS the level as replicas rejoin (down to 0 when the pool
+        is whole again), the round-24 contract the original
+        lost-count-only computation could never express."""
+        level = sum(1 for r in self._replicas if r.state != "up")
         if level != self._brownout:
             self._brownout = level
             total = max(1, len(self._replicas))
@@ -939,10 +1085,180 @@ class FleetServer:
                   capacity_frac=round(len(self._healthy()) / total,
                                       4),
                   min_priority=self.brownout_min_priority)
+
+    def _quarantine(self, rep, reason: str, deaths: int = 0) -> None:
+        """Typed removal from the resurrection loop: the replica is
+        neither routed to nor respawned until an operator replaces
+        it.  ``reason`` is "flap" (threshold deaths inside the flap
+        window) or "canary" (the warm-up probe answered WRONG — a
+        replica that computes incorrect answers is strictly worse
+        than a dead one)."""
+        rep.state = "quarantined"
+        self.quarantines += 1
+        self._respawn_at.pop(rep.name, None)
+        _emit("replica_quarantine", replica=rep.name, reason=reason,
+              deaths=int(deaths),
+              window_s=round(self.flap.window_s, 3))
+        if self.metrics is not None:
+            self.metrics.counter("fleet_quarantines_total").inc()
+        self._set_brownout()
         self._health_gauges()
-        t_detect = time.monotonic()
-        for req in sorted(inflight, key=lambda r: r.t_enqueue):
-            self._failover(req, rep, t_detect=t_detect)
+
+    # -- resurrection (round 24) ---------------------------------------
+
+    def _heal(self) -> None:
+        """Non-blocking supervisor tick (run-loop hook): respawn
+        every lost in-process replica whose decorrelated-jitter
+        backoff has expired.  Quarantined replicas are never
+        touched."""
+        if not self.heal:
+            return
+        now = float(self.heal_clock())
+        for rep in list(self._replicas):
+            if rep.state != "lost" or rep.remote:
+                continue
+            due = self._respawn_at.get(rep.name)
+            if due is not None and now >= due:
+                self._respawn(rep)
+
+    def resurrect(self, wait: bool = True) -> list[str]:
+        """Drive resurrection to QUIESCENCE outside a serve loop:
+        respawn every lost in-process replica (waiting out each
+        backoff when ``wait``), repeating while the respawns
+        themselves die (the flap pattern), until every replica is
+        either up or quarantined.  Returns the names that re-entered
+        routing.  Works with ``heal=False`` too — manual healing
+        between drains."""
+        out: list[str] = []
+        while True:
+            targets = [r for r in self._replicas
+                       if r.state == "lost" and not r.remote]
+            if not targets:
+                break
+            for rep in targets:
+                now = float(self.heal_clock())
+                due = self._respawn_at.get(rep.name)
+                if due is None:
+                    k = self._respawn_attempts.get(rep.name, 0)
+                    due = now + self.respawn_retry.delay_s(k)
+                    self._respawn_at[rep.name] = due
+                if due > now:
+                    if not wait:
+                        return out
+                    self.respawn_retry.sleep(due - now)
+                if self._respawn(rep):
+                    out.append(rep.name)
+        return out
+
+    def _respawn(self, rep) -> bool:
+        """One resurrection attempt: replace the dead replica with a
+        fresh runner set under the SAME name/index, warm it up — the
+        canary recompiles its engine over the CURRENT base
+        (generation adoption: runners build from ``self.g``, which
+        refresh_live keeps at ``live.base``; in-process replicas
+        share the live handle, so the published delta needs no
+        catch-up) — and gate routing re-entry on the canary
+        answering its NumPy oracle exactly.  Returns True when the
+        replica re-entered routing."""
+        name = rep.name
+        k = self._respawn_attempts.get(name, 0)
+        self._respawn_attempts[name] = k + 1
+        self._respawn_at.pop(name, None)
+        new = _InProcessReplica(self, name, rep.index)
+        new.state = "warming"       # invisible to _pick until canary
+        self._replicas[rep.index] = new
+        # the old replica's memory-trail closure prices dead runners
+        self._mem_trails.pop(name, None)
+        self.board.beat(name, status="warming", boundary=0)
+        ok = self._run_canary(new)
+        if new.state != "warming":
+            # died mid-warm-up: _mark_lost already recorded the
+            # death, and its flap verdict re-scheduled or
+            # quarantined — nothing more to do here
+            return False
+        if not ok:
+            # a replica that computes WRONG answers is strictly
+            # worse than a dead one — never route to it
+            self._quarantine(new, reason="canary",
+                             deaths=self.flap.deaths(name))
+            return False
+        new.state = "up"
+        self.respawns += 1
+        self._respawn_attempts[name] = 0    # healthy: fresh incident
+        _emit("replica_respawn", replica=name, attempt=k + 1,
+              backoff_s=round(self.respawn_retry.delay_s(k), 4),
+              canary_ok=True)
+        if self.metrics is not None:
+            self.metrics.counter("fleet_respawns_total").inc()
+        self.board.beat(name, status="up", boundary=0)
+        self._set_brownout()
+        self._health_gauges()
+        if (self._t_degraded is not None
+                and all(r.state == "up" for r in self._replicas)):
+            # MTTR: first loss detection -> pool whole again
+            self.mttr_s = (float(self.heal_clock())
+                           - self._t_degraded)
+            self._t_degraded = None
+            if self.metrics is not None:
+                self.metrics.gauge("fleet_mttr_seconds").set(
+                    round(self.mttr_s, 6))
+        return True
+
+    def _run_canary(self, rep, kind: str = "components") -> bool:
+        """Oracle-checked warm-up probe: one throwaway query
+        assigned DIRECTLY to the warming replica (like warm(), no
+        routing — the probe must exercise THIS replica's engine).
+        True iff the replica stayed up through the drain and the
+        answer matches its NumPy oracle — live fleets at the
+        canary's own admission epoch (check_live_answers), static
+        fleets against the base graph.  The default kind is
+        components: integer-labeled (bitwise comparison) and
+        weight-agnostic, so one canary rule covers weighted and
+        unweighted fleets."""
+        with self._lock:
+            qid = self._next_qid
+            self._next_qid += 1
+        req = Request(qid=qid, kind=kind, source=0,
+                      t_enqueue=time.monotonic(),
+                      epoch=serve_mod.admit_query(self.live, kind),
+                      no_cache=True)
+        with self._lock:
+            self._qreq[qid] = req
+            self._tenant_load[req.tenant] = \
+                self._tenant_load.get(req.tenant, 0) + 1
+            self._canaries.add(qid)
+        # the canary is a PROBE, not traffic: suppress the runner's
+        # SLO/latency metrics for its drain (slo_accounted over
+        # loadgen traffic must not count warm-up probes), exactly
+        # like _mark_lost suppresses a dead collector's wait metrics
+        runner = rep.runner(kind)
+        coll = rep.collector(kind)
+        saved = (runner.metrics, coll.metrics)
+        runner.metrics = coll.metrics = None
+        self._assign(rep, req)
+        resps: list[Response] = []
+        try:
+            while rep.state == "warming" and rep.pending(kind):
+                resps += self._drain_inproc(rep, kind)
+        finally:
+            runner.metrics, coll.metrics = saved
+        self._canaries.discard(qid)
+        canary = next((r for r in resps if r.qid == qid), None)
+        if rep.state != "warming" or canary is None:
+            _emit("canary", replica=rep.name, qid=qid,
+                  query_kind=kind, ok=False, reason="died")
+            return False
+        if self.live is not None:
+            from lux_tpu import livegraph
+            bad = livegraph.check_live_answers(self.live, [canary],
+                                               self.weighted)
+        else:
+            bad = serve_mod._check_answers(self.g, [canary])
+        ok = bad == 0
+        _emit("canary", replica=rep.name, qid=qid, query_kind=kind,
+              ok=ok,
+              **({} if ok else {"reason": "oracle_mismatch"}))
+        return ok
 
     def _failover(self, req: Request, from_rep,
                   t_detect: float | None = None) -> None:
@@ -1112,10 +1428,22 @@ class FleetServer:
                 out += got
                 progressed = True
             self._check_remote_health()
+            if self.heal:
+                self._heal()
             for kind in list(self._queues):
                 q = self._queues[kind]
                 if len(q):
                     if not self._healthy():
+                        if self.heal and any(
+                                r.state == "lost" and not r.remote
+                                for r in self._replicas):
+                            # a resurrection is scheduled: HOLD the
+                            # queue instead of mass-shedding — the
+                            # respawn either succeeds (queries route
+                            # again) or the flap verdict quarantines
+                            # the name (loop falls through to the
+                            # shed below once nothing is lost)
+                            continue
                         for req in q.collect(len(q)):
                             self._shed(req, SHED_NO_CAPACITY,
                                        raise_=False)
@@ -1140,7 +1468,15 @@ class FleetServer:
                         out += self._drain_inproc(rep, kind)
                         progressed = True
             if not self._pending_any():
-                break
+                if not (self.heal and any(
+                        r.state == "lost" and not r.remote
+                        for r in self._replicas)):
+                    break
+                # heal-armed run() also restores the POOL before
+                # returning: every lost in-process replica either
+                # resurrects (canary-gated) or quarantines — so the
+                # caller's next submit sees the healed capacity and
+                # mttr_s is final, not still counting
             if not progressed:
                 time.sleep(REMOTE_POLL_S)
         self._health_gauges()
@@ -1178,6 +1514,117 @@ class FleetServer:
         for rep in self._replicas:
             if rep.remote:
                 rep.stop()
+        if self.journal is not None:
+            self.journal.close()
+
+    # -- whole-fleet crash recovery (round 24) --------------------------
+
+    @classmethod
+    def recover(cls, g, journal_path: str, /, *, resets=None,
+                live=None, **kw) -> "FleetServer":
+        """Restart a crashed fleet from its durable admission
+        journal: replay the journal (truncating a torn tail in
+        place, exactly like MutationLog.replay), seed the
+        exactly-once retirement set from the persisted retire
+        records, and RE-DISPATCH every admitted-unretired query so
+        the next run() answers it at its ORIGINAL admission epoch
+        (live fleets: ``livegraph.graph_at`` through the runners'
+        epoch plumbing — bitwise-equal for integer apps).
+
+        Recovery ordering is load-bearing (ARCHITECTURE.md
+        "Self-healing fleet"): the caller replays the mutation WAL
+        FIRST (``LiveGraph.recover``) and passes the recovered
+        handle as ``live`` with ``g = live.base`` — journal
+        re-dispatch needs the generation adopted before any epoch
+        reproducibility verdict.
+
+        Re-dispatch is unconditional (the queries already passed
+        admission, durably) except for two typed, journal-retired
+        sheds: ``reset_unavailable`` — a pagerank reset query whose
+        vector is not in ``resets`` (a qid-keyed mapping; the
+        journal persists only an 8-byte blake2b digest, and a
+        mismatching vector is the same shed: recovery must never
+        silently answer a DIFFERENT query than the one admitted) —
+        and ``epoch_folded`` — a live fleet whose recovered base
+        already folded past the record's admission epoch, so a
+        bitwise answer at that epoch is unreachable.  Deadlines
+        restart from re-dispatch (the crash consumed wall-clock the
+        query never got).
+
+        Remaining constructor keywords pass through ``**kw`` —
+        ``journal_path`` must NOT be among them (the journal is
+        resumed, not re-created; a second recover() on the same path
+        replays the same open set minus what retired since)."""
+        if "journal_path" in kw:
+            raise ValueError(
+                "recover() resumes the journal at journal_path; do "
+                "not also pass journal_path= (that would O_EXCL-"
+                "create over the evidence)")
+        opens, retired, torn, jrnl = journal_mod.AdmissionJournal \
+            .replay(journal_path, nv=g.nv)
+        flt = cls(g, live=live, **kw)
+        flt.journal = jrnl
+        with flt._lock:
+            flt._retired.update(retired)
+            seen = [rec.qid for rec in opens] + list(retired)
+            if seen:
+                flt._next_qid = max(seen) + 1
+        flt.journal_replayed = len(opens)
+        _emit("journal_replay", path=journal_path,
+              replayed=len(opens), retired=len(retired),
+              torn_bytes=torn)
+        if flt.metrics is not None:
+            flt.metrics.counter("fleet_journal_replayed_total").inc(
+                len(opens))
+        resets = dict(resets or {})
+        for rec in opens:
+            reset = None
+            if rec.digest is not None:
+                reset = resets.get(rec.qid)
+                if reset is not None:
+                    reset = np.asarray(reset, np.float32)
+                ok = (reset is not None
+                      and journal_mod.reset_digest(reset)
+                      == rec.digest)
+                if not ok:
+                    req = Request(qid=rec.qid, kind=rec.kind,
+                                  source=None, reset=reset,
+                                  t_enqueue=time.monotonic(),
+                                  tenant=rec.tenant,
+                                  priority=rec.priority,
+                                  deadline_s=rec.deadline_s,
+                                  epoch=None)
+                    flt._journaled.add(rec.qid)
+                    flt._shed(req, SHED_RESET_UNAVAILABLE,
+                              raise_=False)
+                    continue
+            req = Request(qid=rec.qid, kind=rec.kind,
+                          source=rec.source, reset=reset,
+                          t_enqueue=time.monotonic(),
+                          tenant=rec.tenant, priority=rec.priority,
+                          deadline_s=rec.deadline_s, epoch=rec.epoch)
+            if live is not None:
+                if not serve_mod._epoch_reproducible(live, req):
+                    flt._journaled.add(rec.qid)
+                    flt._shed(req, SHED_EPOCH_FOLDED, raise_=False)
+                    continue
+                # take a fresh admission-ledger entry for the
+                # re-dispatch (released at the exactly-once
+                # retirement like any admit); the query still
+                # ANSWERS at its original journaled epoch — the
+                # entry only keeps the generation serveable
+                live.admit(serve_mod._engine_family(rec.kind))
+            with flt._lock:
+                flt._journaled.add(rec.qid)
+                flt._qreq[rec.qid] = req
+                flt._tenant_load[req.tenant] = \
+                    flt._tenant_load.get(req.tenant, 0) + 1
+                flt._queue(rec.kind).put(req)
+            _emit("query_enqueue", qid=rec.qid, query_kind=rec.kind,
+                  source=req.source, tenant=req.tenant,
+                  priority=req.priority,
+                  queued=len(flt._queue(rec.kind)), recovered=True)
+        return flt
 
 
 class _PendingView:

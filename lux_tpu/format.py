@@ -55,7 +55,8 @@ class GraphFormatError(ValueError):
     row_ptrs_monotone, row_ptrs_total, col_idx_range,
     degrees_length, degrees_consistent, partition_starts,
     partition_edges, perm_header, perm_length, perm_bijection,
-    wal_header, wal_version, wal_capacity)."""
+    wal_header, wal_version, wal_capacity,
+    journal_header, journal_version)."""
 
     def __init__(self, path: str, check: str, detail: str):
         super().__init__(f"{path}: invalid graph [{check}] — {detail}")
@@ -406,6 +407,73 @@ def read_wal_header(path: str, nv: int | None = None,
             f"log written for nv={hnv} but the graph has nv={nv} — "
             f"mutation log from a different graph?")
     return hnv, cap, ver
+
+
+# ---------------------------------------------------------------------
+# admission-journal header (round 24, self-healing fleet)
+#
+# The serving tier (lux_tpu/fleet.py) journals every ADMITTED query
+# into a CRC-chained append-only log so a whole-fleet crash cannot
+# silently lose admitted-but-unretired work — the same durability bar
+# the mutation WAL meets for graph state.  The on-disk knowledge lives
+# here beside the WAL's: a 16-byte header (magic "LUXJ" + uint32
+# version + uint32 nv + uint32 reserved) followed by fixed 48-byte
+# records whose chained CRC32 lux_tpu/journal.AdmissionJournal owns
+# (ADMIT records open an entry; RETIRE records close it — pairing is
+# validated at rest by the scan and by scripts/fsck_lux.py).  The nv
+# in the header binds the journal to its graph: recovered queries
+# carry source ids and admission epochs that are meaningless against
+# a different graph.
+
+JOURNAL_MAGIC = b"LUXJ"
+JOURNAL_VERSION = 1
+JOURNAL_KNOWN_VERSIONS = (1,)
+JOURNAL_HEADER_SIZE = 16
+JOURNAL_RECORD_SIZE = 48
+JOURNAL_SUFFIX = ".journal"
+
+
+def journal_sidecar_path(lux_path: str) -> str:
+    return lux_path + JOURNAL_SUFFIX
+
+
+def pack_journal_header(nv: int,
+                        version: int = JOURNAL_VERSION) -> bytes:
+    if version not in JOURNAL_KNOWN_VERSIONS:
+        raise ValueError(f"unknown journal version {version} "
+                         f"(known: {JOURNAL_KNOWN_VERSIONS})")
+    return JOURNAL_MAGIC + np.array(
+        [version, nv, 0], V_DTYPE).tobytes()
+
+
+def read_journal_header(path: str, nv: int | None = None,
+                        head: bytes | None = None):
+    """Read + VALIDATE an admission-journal header; returns (nv,
+    version).  ``nv`` (when given) must match the header's — a journal
+    copied from a different graph raises instead of re-dispatching
+    queries against sources/epochs it was never admitted for."""
+    if head is None:
+        with open(path, "rb") as f:
+            head = f.read(JOURNAL_HEADER_SIZE)
+    if len(head) != JOURNAL_HEADER_SIZE or head[:4] != JOURNAL_MAGIC:
+        raise GraphFormatError(
+            path, "journal_header",
+            f"bad magic/length {head[:4]!r} ({len(head)} bytes) — an "
+            f"admission journal starts with {JOURNAL_MAGIC!r} and a "
+            f"{JOURNAL_HEADER_SIZE}-byte header")
+    ver, hnv, _rsvd = (int(x) for x in
+                       np.frombuffer(head, V_DTYPE, count=3, offset=4))
+    if ver not in JOURNAL_KNOWN_VERSIONS:
+        raise GraphFormatError(
+            path, "journal_version",
+            f"journal version {ver}, this build reads "
+            f"{JOURNAL_KNOWN_VERSIONS}")
+    if nv is not None and hnv != nv:
+        raise GraphFormatError(
+            path, "journal_header",
+            f"journal written for nv={hnv} but the graph has nv={nv} "
+            f"— admission journal from a different graph?")
+    return hnv, ver
 
 
 def write_lux(path: str, row_ptrs, col_idx, weights=None, degrees=None):

@@ -225,6 +225,42 @@ class RetryPolicy:
 
 
 @dataclasses.dataclass
+class FlapDetector:
+    """Deaths-in-a-window flap detection (round 24, the self-healing
+    fleet's quarantine trigger).  A replica that keeps dying right
+    after resurrection is burning respawn/recompile/canary work and
+    churning the routing table — past ``threshold`` deaths inside
+    ``window_s`` the supervisor should stop resurrecting it and
+    quarantine typed (lux_tpu/fleet.py) instead of flapping forever.
+    ``clock`` is injectable so tests drive the window
+    deterministically."""
+
+    threshold: int = 3
+    window_s: float = 60.0
+    clock: Callable[[], float] = time.monotonic
+    _deaths: dict = dataclasses.field(default_factory=dict, init=False,
+                                      repr=False, compare=False)
+
+    def record(self, name: str) -> int:
+        """Record one death of ``name`` now; returns the death count
+        inside the rolling window (>= threshold means flapping)."""
+        now = float(self.clock())
+        ds = [t for t in self._deaths.get(name, ())
+              if now - t <= self.window_s]
+        ds.append(now)
+        self._deaths[name] = ds
+        return len(ds)
+
+    def deaths(self, name: str) -> int:
+        now = float(self.clock())
+        return sum(1 for t in self._deaths.get(name, ())
+                   if now - t <= self.window_s)
+
+    def flapping(self, name: str) -> bool:
+        return self.deaths(name) >= self.threshold
+
+
+@dataclasses.dataclass
 class RunReport:
     """What the supervisor did: for logs and bench JSON lines."""
 

@@ -104,10 +104,14 @@ Checked per metric line:
 - serve-chaos lines (round 18, bench.py -config serve-chaos +
   lux_tpu/fleet.py): the serve-slo record under an injected replica
   kill, extended with replicas/failovers/shed/shed_fraction/
-  slo_accounted; rejected on shed_fraction outside [0, 1] (or
-  disagreeing with shed/submitted), failovers with replicas=1,
-  served+shed != submitted, or slo_accounted > served (an SLO
-  fraction computed over shed queries).
+  slo_accounted plus the round-24 self-healing gauges respawns/
+  quarantines/mttr_s/journal_replayed; rejected on shed_fraction
+  outside [0, 1] (or disagreeing with shed/submitted), failovers or
+  respawns with replicas=1, served+shed != submitted, slo_accounted
+  > served (an SLO fraction computed over shed queries), mttr_s
+  with neither failovers nor respawns (repair time without an
+  outage), or journal_replayed > submitted (a recovery claiming
+  queries the load never offered).
 
 - comm (round 19, lux_tpu/comms.py): the per-collective byte-ledger
   digest engine metric lines now carry — {errors, ndev, exchange,
@@ -198,7 +202,10 @@ SERVE_SLO_METRIC = re.compile(
 # served + shed != submitted (admitted and shed must partition the
 # offered load), and slo_accounted > served (the SLO fraction was
 # computed over shed queries — the accounting covers ADMITTED
-# retirements only).
+# retirements only).  Round 24 adds the self-healing gauges
+# (respawns/quarantines/mttr_s/journal_replayed) and their rejects:
+# respawns with replicas = 1, mttr_s without any failover or
+# respawn, journal_replayed > submitted.
 SERVE_CHAOS_METRIC = re.compile(
     r"^serve_chaos_q([0-9pm]+)_rmat(\d+)_qps_per_chip$")
 # round-20 live-graph serving lines (bench.py -config serve-live +
@@ -716,6 +723,53 @@ def check_serve_chaos_fields(name: str, obj: dict) -> list[str]:
             f"{name}: slo_accounted={acc} > served={served} — the "
             f"SLO good fraction was computed over shed queries; SLO "
             f"accounting covers ADMITTED retirements only")
+    # round-24 self-healing gauges: respawns/quarantines/mttr_s/
+    # journal_replayed ride every chaos line (the fleet runs with
+    # the resurrection supervisor + durable admission journal armed)
+    missing24 = [k for k in ("respawns", "quarantines", "mttr_s",
+                             "journal_replayed") if k not in obj]
+    if missing24:
+        errs.append(f"{name}: serve-chaos line missing the "
+                    f"self-healing record {missing24}")
+    resp = obj.get("respawns")
+    if resp is not None and (not _int(resp) or resp < 0):
+        errs.append(f"{name}: respawns={resp!r} must be an int >= 0")
+        resp = None
+    if resp is not None and resp > 0 and reps == 1:
+        errs.append(
+            f"{name}: respawns={resp} with replicas=1 — a "
+            f"single-replica fleet that lost its only member had "
+            f"nothing serving to detect the loss mid-drain, and the "
+            f"line claims resurrections without a surviving "
+            f"supervisor; the topology contradicts the record")
+    quar = obj.get("quarantines")
+    if quar is not None and (not _int(quar) or quar < 0):
+        errs.append(f"{name}: quarantines={quar!r} must be an int "
+                    f">= 0")
+        quar = None
+    mttr = obj.get("mttr_s")
+    if mttr is not None and (not _is_num(mttr) or mttr < 0):
+        errs.append(f"{name}: mttr_s={mttr!r} must be null or a "
+                    f"finite number >= 0")
+        mttr = None
+    if mttr is not None and fo is not None and fo == 0 \
+            and resp is not None and resp == 0:
+        errs.append(
+            f"{name}: mttr_s={mttr} with failovers=0 and "
+            f"respawns=0 — repair time without any recorded loss or "
+            f"repair; nothing was killed, so there is no outage to "
+            f"time")
+    jr = obj.get("journal_replayed")
+    if jr is not None and (not _int(jr) or jr < 0):
+        errs.append(f"{name}: journal_replayed={jr!r} must be an "
+                    f"int >= 0")
+        jr = None
+    if jr is not None and _int(submitted) and jr > submitted:
+        errs.append(
+            f"{name}: journal_replayed={jr} > submitted="
+            f"{submitted} — a recovery cannot re-dispatch more "
+            f"admitted-unretired queries than were ever submitted; "
+            f"the journal claims queries the load never offered")
     return errs
 
 

@@ -89,6 +89,17 @@ human shape — and audits it while doing so:
   the crashing publisher and the recovering process are never the
   same pid).
 
+- round 24 (self-healing fleet, lux_tpu/fleet.py + journal.py): the
+  respawn / quarantine / canary trail renders, as do the admission-
+  journal truncate/replay records, and the ORDERED audits hold: a
+  ``replica_respawn`` without a preceding ``replica_lost`` of that
+  name FAILS (a resurrection of a replica that never died), as does
+  one without a PASSING ``canary`` since the loss (a replica whose
+  oracle probe failed — or never ran — re-entered routing), a
+  malformed ``canary``/``replica_quarantine`` record, and a
+  recovered re-dispatch (``query_enqueue`` with ``recovered``) with
+  no preceding ``journal_replay`` naming the journal it came from.
+
 Usage:
     python scripts/events_summary.py FILE [FILE...]
     python scripts/events_summary.py -flight FLIGHT.json
@@ -117,7 +128,9 @@ KNOWN = {"run_start", "config_start", "header", "timed_run",
          "brownout", "comm_ledger", "link_calibration",
          "mutation", "epoch_advance", "compact_start", "compact_done",
          "wal_truncate", "wal_replay", "reseed", "compact_scheduled",
-         "mem_sample", "mem_watermark", "mem_pressure"}
+         "mem_sample", "mem_watermark", "mem_pressure",
+         "replica_respawn", "replica_quarantine", "canary",
+         "journal_truncate", "journal_replay"}
 
 # round 19 (communication observatory, lux_tpu/comms.py): the
 # collective primitives a comm_ledger breakdown may name — matching
@@ -725,8 +738,17 @@ def render_run(run, out=sys.stdout) -> list[str]:
     for q in by.get("query_done", []):
         if "qid" in q:
             done_count[q["qid"]] = done_count.get(q["qid"], 0) + 1
+    # round 24: a journal re-dispatch (query_enqueue recovered=true)
+    # legitimately RE-ANSWERS a query whose pre-crash answer was
+    # computed but never acknowledged — the crash interposed between
+    # the runner's retire and the fleet's delivery, so the client
+    # saw it at most once.  ONE extra query_done per recovered qid
+    # is that at-least-once-compute seam; a third is still a dup.
+    recovered_qids = {e.get("qid")
+                      for e in by.get("query_enqueue", [])
+                      if e.get("recovered")}
     for qid, n in sorted(done_count.items()):
-        if n > 1:
+        if n > 1 and not (qid in recovered_qids and n == 2):
             errs.append(f"{title}: qid={qid} retired {n} times — "
                         f"exactly-once retirement violated")
     sheds = []          # WELL-FORMED sheds only: a malformed record
@@ -847,6 +869,15 @@ def render_run(run, out=sys.stdout) -> list[str]:
     # wal_replay, which can restore a crashed publisher's pending
     # anti ops) — the only trails a reseed may follow
     anti_published: set = set()
+    # round 24 (self-healing fleet, lux_tpu/fleet.py + journal.py):
+    # ordered respawn-trail state — a resurrection must FOLLOW a
+    # loss of that name AND a passing canary (routing a replica
+    # whose canary failed — or that never ran one — is serving wrong
+    # or unproven answers), and a recovered re-dispatch
+    # (query_enqueue recovered=true) must follow its journal_replay
+    heal_lost: set = set()
+    canary_passed: set = set()
+    saw_journal_replay = False
     # round 22 (memory observatory, lux_tpu/memwatch.py): replica
     # keys (None = unlabelled trail) that have published at least one
     # occupancy sample.  A mem_pressure — or a query_shed with the
@@ -938,6 +969,51 @@ def render_run(run, out=sys.stdout) -> list[str]:
             if _is_int(e):
                 _saw_epoch(ev.get("path"), e)
             anti_published.add(ev.get("path"))
+        elif k == "replica_lost":
+            if ev.get("replica"):
+                heal_lost.add(ev["replica"])
+                # a fresh death invalidates any earlier canary pass
+                canary_passed.discard(ev["replica"])
+        elif k == "canary":
+            r_ = ev.get("replica")
+            if not r_ or not isinstance(ev.get("ok"), bool):
+                errs.append(f"{title}: canary without its "
+                            f"replica/ok verdict: {ev!r}"[:200])
+            elif ev["ok"]:
+                canary_passed.add(r_)
+            else:
+                canary_passed.discard(r_)
+        elif k == "replica_respawn":
+            r_ = ev.get("replica")
+            if not r_:
+                errs.append(f"{title}: replica_respawn without its "
+                            f"replica: {ev!r}"[:200])
+            else:
+                if r_ not in heal_lost:
+                    errs.append(
+                        f"{title}: replica_respawn {r_!r} without a "
+                        f"preceding replica_lost — a resurrection "
+                        f"of a replica that never died")
+                if r_ not in canary_passed:
+                    errs.append(
+                        f"{title}: replica_respawn {r_!r} without a "
+                        f"passing canary since its loss — the "
+                        f"replica re-entered routing unproven (or "
+                        f"with a FAILED canary): wrong answers "
+                        f"could route")
+        elif k == "replica_quarantine":
+            if not ev.get("replica") or not ev.get("reason"):
+                errs.append(f"{title}: replica_quarantine without "
+                            f"its replica/reason: {ev!r}"[:200])
+        elif k == "journal_replay":
+            saw_journal_replay = True
+        elif k == "query_enqueue" and ev.get("recovered"):
+            if not saw_journal_replay:
+                errs.append(
+                    f"{title}: recovered query_enqueue qid="
+                    f"{ev.get('qid')} with no preceding "
+                    f"journal_replay — a re-dispatch that cannot "
+                    f"name the journal it recovered from")
     if mem_sampled or mem_pressures:
         n_s = len(by.get("mem_sample", []))
         n_w = len(by.get("mem_watermark", []))
@@ -995,6 +1071,33 @@ def render_run(run, out=sys.stdout) -> list[str]:
               f"epoch {wr.get('epoch')} generation "
               f"{wr.get('generation')} delta {wr.get('delta_count')} "
               f"(truncated {wr.get('truncated_bytes')} B)", file=out)
+    # round 24 (self-healing fleet): the respawn / quarantine /
+    # canary trail and the admission-journal recovery records
+    respawns_ = by.get("replica_respawn", [])
+    quars_ = by.get("replica_quarantine", [])
+    canaries_ = by.get("canary", [])
+    if respawns_ or quars_ or canaries_:
+        npass = sum(1 for c in canaries_ if c.get("ok") is True)
+        qmix = {}
+        for q_ in quars_:
+            r_ = q_.get("reason", "?")
+            qmix[r_] = qmix.get(r_, 0) + 1
+        qnote = ("" if not qmix else " ("
+                 + ", ".join(f"{n} {r}"
+                             for r, n in sorted(qmix.items())) + ")")
+        print(f"  self-healing: {len(respawns_)} respawn(s), "
+              f"{len(quars_)} quarantine(s){qnote}, canaries "
+              f"{npass}/{len(canaries_)} passed", file=out)
+    for jt in by.get("journal_truncate", []):
+        print(f"  admission journal torn tail truncated: "
+              f"{jt.get('torn_bytes')} byte(s), {jt.get('open')} "
+              f"open / {jt.get('retired')} retired record(s) "
+              f"({jt.get('path')})", file=out)
+    for jr_ in by.get("journal_replay", []):
+        print(f"  admission journal replay: {jr_.get('replayed')} "
+              f"re-dispatched, {jr_.get('retired')} already retired "
+              f"(torn {jr_.get('torn_bytes')} B) ({jr_.get('path')})",
+              file=out)
     cached = [q for q in qdone if q.get("cached")]
     if cached:
         n_live = sum(1 for q in qdone if "epoch" in q)

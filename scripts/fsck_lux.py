@@ -25,6 +25,15 @@ recoverable torn tail (a crash mid-append) is REPORTED but clean —
 ``MutationLog.replay`` truncates it deterministically; hard
 corruption (typed ``MutationLogError``) fails the file.
 
+Round 24 (self-healing serving): the serving tier's ADMISSION
+JOURNAL (lux_tpu/journal.py, LUXJ) gets the same treatment —
+``.journal`` files on the command line and the ``<graph>.journal``
+sidecar beside any checked ``.lux``: header magic / version /
+nv-vs-graph, the CRC chain, known record kinds, qid monotonicity,
+and ADMIT/RETIRE pairing at rest.  Torn tail recoverable; a
+full-size bad-CRC record is rot (typed ``AdmissionJournalError``)
+and fails the file — the MutationLog contract, mirrored.
+
 Usage:
     python scripts/fsck_lux.py [-weighted | -unweighted] FILE...
 
@@ -32,9 +41,10 @@ Weightedness is inferred from the file size by default (pass
 -weighted/-unweighted for the ambiguous nv*4 == ne*w case).
 
 Exit status: 0 every file clean, 1 any .lux structural failure,
-2 any mutation-log failure (the typed-MutationLogError class — wrong
-graph, broken chain, non-monotone epochs; matches the apps'
-``-validate`` exit-2 convention for integrity refusals).
+2 any mutation-log or admission-journal failure (the typed
+MutationLogError / AdmissionJournalError class — wrong graph, broken
+chain, non-monotone epochs/qids; matches the apps' ``-validate``
+exit-2 convention for integrity refusals).
 """
 
 from __future__ import annotations
@@ -98,6 +108,37 @@ def fsck_wal(path: str, nv: int | None = None) -> str | None:
     return None
 
 
+def fsck_journal(path: str, nv: int | None = None) -> str | None:
+    """Verify one admission journal at rest (lux_tpu/journal.py LUXJ
+    sidecar, round 24): header, CRC chain, record kinds, qid
+    monotonicity, ADMIT/RETIRE pairing (a RETIRE must name an open
+    ADMIT, no qid retires twice) — through ``AdmissionJournal.scan``,
+    the SAME pass ``FleetServer.recover`` replays through, so the
+    checker and recovery can never disagree on validity.  Mirrors
+    the MutationLog contract: a strict-prefix torn tail (a crash
+    mid-append) is REPORTED but clean — recovery truncates it
+    deterministically; a full-size bad-CRC record is rot and fails
+    the file.  Returns None when clean, the failure message
+    otherwise."""
+    from lux_tpu.journal import AdmissionJournal, AdmissionJournalError
+
+    try:
+        opens, retired, hnv, torn = AdmissionJournal.scan(path, nv=nv)
+        _hnv2, ver = luxfmt.read_journal_header(path, nv=nv)
+    except AdmissionJournalError as e:
+        return f"[{e.check}] {e.detail}"
+    except luxfmt.GraphFormatError as e:
+        return f"[{e.check}] {e.detail}"
+    except (OSError, ValueError) as e:
+        return f"[journal unreadable] {type(e).__name__}: {e}"
+    tornmsg = f" TORN-TAIL={torn}B (recoverable)" if torn else ""
+    shed = sum(1 for c in retired.values() if c == "shed")
+    print(f"{path}: OK journal v{ver} nv={hnv} "
+          f"open={len(opens)} retired={len(retired)} shed={shed}"
+          f"{tornmsg}")
+    return None
+
+
 def fsck(path: str, weighted: bool | None) -> str | None:
     """Returns None when clean, the failure message otherwise."""
     try:
@@ -152,6 +193,12 @@ def main(argv=None) -> int:
                 bad_wal += 1
                 print(f"ERROR: {path}: {err}", file=sys.stderr)
             continue
+        if path.endswith(luxfmt.JOURNAL_SUFFIX):
+            err = fsck_journal(path)
+            if err is not None:
+                bad_wal += 1
+                print(f"ERROR: {path}: {err}", file=sys.stderr)
+            continue
         err = fsck(path, weighted)
         if err is not None:
             bad_lux += 1
@@ -168,6 +215,18 @@ def main(argv=None) -> int:
             if err is not None:
                 bad_wal += 1
                 print(f"ERROR: {wal}: {err}", file=sys.stderr)
+        # an admission-journal sidecar (round 24, serving-tier crash
+        # recovery) is likewise checked AGAINST its graph: a journal
+        # for a different nv fails at rest, never as re-dispatched
+        # queries against the wrong graph
+        jrn = luxfmt.journal_sidecar_path(path)
+        if os.path.exists(jrn):
+            checked += 1
+            hdr = luxfmt.peek_lux(path, weighted=weighted)
+            err = fsck_journal(jrn, nv=hdr.nv)
+            if err is not None:
+                bad_wal += 1
+                print(f"ERROR: {jrn}: {err}", file=sys.stderr)
     bad = bad_lux + bad_wal
     if bad:
         print(f"fsck_lux: {bad} of {checked} file(s) FAILED",

@@ -51,6 +51,19 @@ be seen from a jaxpr (CLAUDE.md "Conventions"):
                 a metrics call in a traced loop body either bakes a
                 host callback into the fused program or silently
                 records nothing per iteration.
+  chaos-coverage
+                Every fault-plan ACTION constant in lux_tpu/faults.py
+                (a module-level ALL-CAPS name bound to a string
+                literal — ``WORKER_KILL = "worker_kill"``, ...) must
+                be exercised by at least one file under tests/: some
+                test must reference the constant's name or its string
+                value.  A fault action nobody drills is a recovery
+                path that ships untested — the exact failure mode
+                faults.py exists to prevent (round 24: the
+                FLEET_CRASH / REPLICA_FLAP self-healing drills ride
+                this gate).  Pragma-suppressible on the assignment
+                line for actions that are deliberately
+                library-internal.
   collective-scope
                 No collective-primitive call (``jax.lax.ppermute``,
                 ``all_to_all``, ``psum_scatter``/``reduce_scatter``,
@@ -608,6 +621,43 @@ def check_part_stats_oracle(path, tree, lines):
 
 
 # ---------------------------------------------------------------------
+# check: every faults.py plan action is drilled by some test
+
+ACTION_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+
+def check_chaos_coverage(path, tree, lines):
+    """Every fault-plan action constant (module-level ALL-CAPS name
+    bound to a string literal in lux_tpu/faults.py) must appear — by
+    constant name or by string value — in at least one tests/ file.
+    An undrilled fault action is an untested recovery path (see
+    module docstring)."""
+    findings = []
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and ACTION_NAME_RE.match(node.targets[0].id)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue
+        name, value = node.targets[0].id, node.value.value
+        if _suppressed(lines, node.lineno, "chaos-coverage"):
+            continue
+        covered = any(name in txt or value in txt
+                      for txt in _test_texts())
+        if not covered:
+            findings.append(Finding(
+                path, node.lineno, "chaos-coverage",
+                f"fault action {name} = {value!r} is drilled by no "
+                f"file under tests/ — a fault action nobody injects "
+                f"is a recovery path that ships untested (faults.py's "
+                f"whole purpose); add a drill or suppress with a "
+                f"justification"))
+    return findings
+
+
+# ---------------------------------------------------------------------
 # check: no metrics calls in engine device code / fused-loop bodies
 
 # callable POSITIONAL slots per loop primitive (fori_loop(lo, hi,
@@ -792,6 +842,8 @@ def lint_file(path: str):
         findings += check_citation(path, tree, lines)
     if "/lux_tpu/engine/" in norm:
         findings += check_part_stats_oracle(path, tree, lines)
+    if norm.endswith("/lux_tpu/faults.py"):
+        findings += check_chaos_coverage(path, tree, lines)
     return findings
 
 

@@ -535,6 +535,44 @@ def test_lint_incremental_oracle_coverage(tmp_path):
     assert r.returncode == 0, r.stderr
 
 
+def test_lint_chaos_coverage(tmp_path):
+    """Round 24 (self-healing): every fault-plan action constant in
+    lux_tpu/faults.py must be drilled by some tests/ file — an action
+    nobody injects is a recovery path that ships untested.  A bogus
+    undrilled action is flagged, the pragma suppresses it, and a
+    really-drilled action (WORKER_KILL) passes."""
+    pkg = tmp_path / "lux_tpu"
+    pkg.mkdir(parents=True)
+    fake = pkg / "faults.py"
+    # build the undrilled name/value by concatenation — writing them
+    # as literals HERE would put them in tests/ and satisfy the scan
+    name = "BOGUS_" + "UNDRILLED"
+    value = "bogus_" + "undrilled_xyz"
+    fake.write_text(f'{name} = "{value}"\n')
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(fake)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "chaos-coverage" in r.stderr
+    assert value in r.stderr
+
+    fake.write_text(
+        "# audit: allow(chaos-coverage) — lint test fixture\n"
+        f'{name} = "{value}"\n')
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(fake)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+    # an action the suite actually drills (tests/test_fleet.py arms
+    # WORKER_KILL plans) is clean without any pragma
+    fake.write_text('WORKER_KILL = "worker_kill"\n')
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_lux.py"),
+         str(fake)], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+
 def test_unknown_audit_mode_is_typed_error():
     """A typo'd mode must not silently disable enforcement — both
     the engine param and audit_engine reject it."""

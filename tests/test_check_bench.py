@@ -576,6 +576,9 @@ SERVE_CHAOS_LINE = {
     "metric": "serve_chaos_q45_rmat12_qps_per_chip",
     "replicas": 2, "failovers": 3, "shed": 1,
     "shed_fraction": round(1 / 36, 4), "slo_accounted": 35,
+    # round 24: the self-healing record rides every chaos line
+    "respawns": 1, "quarantines": 0, "mttr_s": 0.42,
+    "journal_replayed": 2,
 }
 
 
@@ -600,6 +603,20 @@ def test_serve_chaos_line_passes_strict(tmp_path):
     (lambda o: o.update(replicas="two"), "replicas"),
     # the serve-slo contradictions stay armed on chaos lines
     (lambda o: o.update(p99_ms=9.0), "p99_ms=9.0 < p50_ms"),
+    # round 24: the self-healing record
+    (lambda o: o.pop("respawns"), "self-healing record"),
+    (lambda o: o.pop("journal_replayed"), "self-healing record"),
+    (lambda o: o.update(respawns=-1), "respawns"),
+    (lambda o: o.update(respawns=1, replicas=1, failovers=0,
+                        shed=0, shed_fraction=0.0, served=36,
+                        slo_accounted=36), "with replicas=1"),
+    (lambda o: o.update(quarantines=-1), "quarantines"),
+    (lambda o: o.update(mttr_s=-0.5), "mttr_s"),
+    (lambda o: o.update(mttr_s="fast"), "mttr_s"),
+    (lambda o: o.update(failovers=0, respawns=0, shed=0,
+                        shed_fraction=0.0, served=36,
+                        slo_accounted=36), "no outage to time"),
+    (lambda o: o.update(journal_replayed=99), "never offered"),
 ])
 def test_bad_serve_chaos_lines_fail(tmp_path, mutate, needle):
     obj = json.loads(json.dumps(SERVE_CHAOS_LINE))

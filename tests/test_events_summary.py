@@ -1024,3 +1024,153 @@ def test_compact_scheduled_missing_economics_fails(tmp_path):
     r = run_summary(p)
     assert r.returncode == 1
     assert "cannot justify itself" in r.stderr
+
+
+# ---------------------------------------------------------------------
+# round 24: the self-healing trail (respawn / quarantine / canary +
+# admission-journal recovery records)
+
+
+def _heal_extra():
+    """The healthy resurrection + journal-recovery tail grafted onto
+    the round-18 fleet trail: r1's loss is followed by a PASSING
+    canary, the respawn, the journal replay, and qid 1's recovered
+    re-dispatch (whose second query_done is the legitimate
+    at-least-once-compute seam)."""
+    base = {"pid": 1, "session": "s"}
+    return [
+        dict(base, t=1.60, tm=1.60, kind="canary", replica="r1",
+             qid=90, query_kind="components", ok=True),
+        dict(base, t=1.65, tm=1.65, kind="replica_respawn",
+             replica="r1", attempt=1, backoff_s=0.01,
+             canary_ok=True),
+        dict(base, t=1.68, tm=1.68, kind="journal_truncate",
+             path="/tmp/g.lux.journal", torn_bytes=24, open=1,
+             retired=1),
+        dict(base, t=1.70, tm=1.70, kind="journal_replay",
+             path="/tmp/g.lux.journal", replayed=1, retired=1,
+             torn_bytes=24),
+        dict(base, t=1.75, tm=1.75, kind="query_enqueue", qid=1,
+             query_kind="sssp", recovered=True),
+        dict(base, t=2.15, tm=2.15, kind="query_done", qid=1,
+             query_kind="sssp", iters=4, segments=2, latency_s=1.0,
+             wait_s=0.2, converged=True, replica="r0"),
+    ]
+
+
+def test_self_healing_trail_renders_clean(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run(extra=_heal_extra()))
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert ("self-healing: 1 respawn(s), 0 quarantine(s), "
+            "canaries 1/1 passed") in r.stdout
+    assert "admission journal torn tail truncated: 24 byte(s)" \
+        in r.stdout
+    assert ("admission journal replay: 1 re-dispatched, "
+            "1 already retired (torn 24 B)") in r.stdout
+
+
+def test_recovered_qid_two_dones_pass_three_fail(tmp_path):
+    """ONE extra query_done per recovered qid is the legitimate
+    at-least-once-compute seam (the crash interposed between the
+    runner's retire and delivery); a THIRD is still a duplicate."""
+    base = {"pid": 1, "session": "s"}
+    third = dict(base, t=2.2, tm=2.2, kind="query_done", qid=1,
+                 query_kind="sssp", iters=4, segments=2,
+                 latency_s=1.1, wait_s=0.2, converged=True,
+                 replica="r0")
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run(extra=_heal_extra() + [third]))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "qid=1 retired 3 times" in r.stderr
+
+
+def test_respawn_without_loss_fails(tmp_path):
+    """A resurrection of a replica that never died is a trail that
+    cannot be trusted — r0 was never lost."""
+    base = {"pid": 1, "session": "s"}
+    extra = [
+        dict(base, t=1.60, tm=1.60, kind="canary", replica="r0",
+             qid=90, query_kind="components", ok=True),
+        dict(base, t=1.65, tm=1.65, kind="replica_respawn",
+             replica="r0", attempt=1, backoff_s=0.01,
+             canary_ok=True),
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run(extra=extra))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "without a preceding replica_lost" in r.stderr
+
+
+def test_respawn_with_failed_canary_fails(tmp_path):
+    """Routing re-entry with a FAILED (or missing) canary since the
+    loss means unproven answers could route."""
+    base = {"pid": 1, "session": "s"}
+    extra = [
+        dict(base, t=1.60, tm=1.60, kind="canary", replica="r1",
+             qid=90, query_kind="components", ok=False,
+             reason="oracle_mismatch"),
+        dict(base, t=1.65, tm=1.65, kind="replica_respawn",
+             replica="r1", attempt=1, backoff_s=0.01,
+             canary_ok=True),
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run(extra=extra))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "without a passing canary since its loss" in r.stderr
+
+
+def test_recovered_enqueue_without_replay_fails(tmp_path):
+    base = {"pid": 1, "session": "s"}
+    extra = [
+        dict(base, t=1.75, tm=1.75, kind="query_enqueue", qid=1,
+             query_kind="sssp", recovered=True),
+        dict(base, t=2.15, tm=2.15, kind="query_done", qid=1,
+             query_kind="sssp", iters=4, segments=2, latency_s=1.0,
+             wait_s=0.2, converged=True, replica="r0"),
+    ]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run(extra=extra))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "recovered query_enqueue" in r.stderr
+    assert "no preceding journal_replay" in r.stderr
+
+
+def test_malformed_canary_fails(tmp_path):
+    base = {"pid": 1, "session": "s"}
+    extra = [dict(base, t=1.60, tm=1.60, kind="canary",
+                  replica="r1", qid=90, query_kind="components")]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run(extra=extra))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "canary without its" in r.stderr
+
+
+def test_malformed_quarantine_fails(tmp_path):
+    base = {"pid": 1, "session": "s"}
+    extra = [dict(base, t=1.60, tm=1.60, kind="replica_quarantine",
+                  replica="r1", deaths=3, window_s=60.0)]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run(extra=extra))
+    r = run_summary(p)
+    assert r.returncode == 1
+    assert "replica_quarantine without" in r.stderr
+
+
+def test_quarantine_renders_reason_mix(tmp_path):
+    base = {"pid": 1, "session": "s"}
+    extra = [dict(base, t=1.60, tm=1.60, kind="replica_quarantine",
+                  replica="r1", reason="flap", deaths=3,
+                  window_s=60.0)]
+    p = tmp_path / "ev.jsonl"
+    write_log(p, _fleet_run(extra=extra))
+    r = run_summary(p)
+    assert r.returncode == 0, r.stderr
+    assert ("self-healing: 0 respawn(s), 1 quarantine(s) (1 flap), "
+            "canaries 0/0 passed") in r.stdout

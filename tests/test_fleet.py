@@ -351,6 +351,11 @@ class TestServeChaosBench:
         assert extra["replicas"] == 2 and extra["failovers"] >= 1
         assert extra["served"] + extra["shed"] == extra["submitted"]
         assert extra["audit"]["errors"] == 0
+        # round 24: the self-healing record rides the line — the
+        # heal-armed drill respawned (or quarantined) the kill
+        assert extra["respawns"] + extra["quarantines"] >= 1
+        assert extra["journal_replayed"] >= 0
+        assert extra["mttr_s"] is None or extra["mttr_s"] >= 0.0
         value = round(float(np.median(samples)), 4)
         line = {"metric": f"{name}_qps_per_chip", "value": value,
                 "unit": "qps", "vs_baseline": value,
@@ -380,6 +385,351 @@ class TestServeChaosBench:
             serve_replicas=1, kill_boundary=1)
         with pytest.raises(ValueError, match="serve-replicas"):
             bench.run_config("serve-chaos@50", args)
+
+
+def heal_retry():
+    """Zero-delay, never-sleeping respawn backoff: resurrection tests
+    drive the supervisor deterministically without wall-clock."""
+    return resilience.RetryPolicy(retries=5, backoff_s=0.0,
+                                  max_backoff_s=0.0, jitter_seed=0,
+                                  sleep=lambda s: None)
+
+
+class TestSelfHealing:
+    """Round 24: durable admission journal, replica resurrection, and
+    THE whole-fleet kill drill (ISSUE 19)."""
+
+    def test_fleet_crash_recover_drill_mesh8(self, g, tmp_path):
+        """THE round-24 acceptance: a live journalled fleet on the
+        8-virtual-device mesh under an oversubscribed mixed-kind load
+        with mutations in the stream is killed ENTIRELY (coordinator
+        + every replica) mid-drain; restart from the mutation WAL +
+        admission journal re-answers every admitted-unretired query
+        at its ORIGINAL admission epoch — zero lost admitted queries,
+        zero duplicate retirements, oracle-equal answers, and the
+        event trail (journal-replay + recovered-enqueue audits armed)
+        renders clean."""
+        from lux_tpu.journal import AdmissionJournal
+        from lux_tpu.livegraph import LiveGraph, check_live_answers
+        from lux_tpu.parallel.mesh import make_mesh
+
+        kinds = ["sssp", "components", "pagerank"]
+        slo = {k: 60000.0 for k in kinds}
+        wal = str(tmp_path / "g.lux.wal")
+        jpath = str(tmp_path / "g.lux.journal")
+        path = tmp_path / "heal_ev.jsonl"
+        live = LiveGraph(g, capacity=64, wal_path=wal)
+        ev = telemetry.EventLog(str(path))
+        with telemetry.use(events=ev):
+            ev.emit("run_start", schema=telemetry.SCHEMA, app="fleet",
+                    file="<test>", mesh=8)
+            t0 = time.perf_counter()
+            flt = make_fleet(g, tmp_path, num_parts=8,
+                             mesh=make_mesh(8), slo_ms=slo,
+                             live=live, journal_path=jpath)
+            flt.warm(kinds)
+            flt.mutate([1, 2, 3], [4, 5, 6])        # epoch 1
+            rng = np.random.default_rng(7)
+            qids = [flt.submit(kinds[i % 3],
+                               source=int(rng.integers(g.nv)))
+                    for i in range(9)]
+            # a mutation MID-STREAM: later admits pin epoch 2, so
+            # recovery must reproduce TWO distinct epochs
+            flt.mutate([7, 8], [9, 10])             # epoch 2
+            qids += [flt.submit(kinds[i % 3],
+                                source=int(rng.integers(g.nv)))
+                     for i in range(3)]
+            reset = np.zeros(g.nv, np.float32)
+            reset[3] = 0.5
+            reset[17] = 0.5
+            ppq = flt.submit("pagerank", reset=reset)
+            qids.append(ppq)
+            # the whole fleet dies at the routed replica's 2nd loaded
+            # boundary (armed via routing_target — the round-22 rule)
+            target = flt.routing_target("sssp")
+            plan = faults.ReplicaKillPlan({target: 2},
+                                          action=faults.FLEET_CRASH)
+            flt.set_fault(plan)
+            with pytest.raises(faults.InjectedFleetCrash) as ei:
+                flt.run()
+            assert ei.value.replica == target
+            # process death: every in-memory handle is gone — only
+            # the fsync'd WAL + journal survive
+            flt.close()
+            live.close()
+
+            # recovery ORDERING (ARCHITECTURE.md "Self-healing
+            # fleet"): WAL replay adopts the generation FIRST, then
+            # the journal re-dispatches over it
+            live2 = LiveGraph.recover(g, wal)
+            flt2 = fleet.FleetServer.recover(
+                live2.base, jpath, live=live2,
+                resets={ppq: reset}, replicas=2, batch=2,
+                num_parts=8, mesh=make_mesh(8), slo_ms=slo,
+                retry=fast_retry(),
+                board_path=str(tmp_path / "board2"))
+            assert flt2.journal_replayed >= 1
+            rec = flt2.run()
+            ev.emit("run_done",
+                    seconds=round(time.perf_counter() - t0, 6),
+                    iters=len(rec))
+            flt2.close()
+        ev.close()
+
+        assert plan.fired and plan.fired[0][2] == faults.FLEET_CRASH
+        # the journal replay counter rode into the registry too
+        assert flt2.metrics.counter(
+            "fleet_journal_replayed_total").value \
+            == flt2.journal_replayed
+        # zero duplicate retirements across the restart
+        rqids = [r.qid for r in rec]
+        assert len(set(rqids)) == len(rqids)
+        assert flt2.dup_dropped == 0
+        # every recovered answer equals its oracle AT ITS ADMISSION
+        # epoch (bitwise for the integer apps); the reset query is
+        # checked against the reference at ITS epoch by hand
+        # (check_live_answers covers one-hot sources only)
+        assert check_live_answers(
+            live2, [r for r in rec if r.qid != ppq]) == 0
+        ppr = next((r for r in rec if r.qid == ppq), None)
+        if ppr is not None:     # not retired before the crash
+            from lux_tpu.apps import pagerank
+            g_e = live2.graph_at(ppr.epoch or 0)
+            ref = pagerank.reference_pagerank_batched(
+                g_e, reset[:, None], max(1, ppr.iters))[:, 0]
+            np.testing.assert_allclose(ppr.answer, ref, atol=5e-5)
+        # ZERO lost admitted queries: after the recovered drain the
+        # journal holds no open entry, and every admitted qid closed
+        # exactly once (pre-crash answers + recovered answers + typed
+        # sheds partition the admitted set)
+        opens, retired, _, torn = AdmissionJournal.scan(jpath,
+                                                        nv=g.nv)
+        assert opens == [] and torn == 0
+        assert set(retired) == set(qids)
+        shed_qids = {e.qid for e in flt2.shed_records}
+        for qid in qids:
+            pre = qid not in {r.qid for r in rec} \
+                and qid not in shed_qids
+            assert retired[qid] == ("answered" if qid in rqids or pre
+                                    else "shed")
+        # the trail renders + audits clean, journal replay included
+        r = subprocess.run([sys.executable, str(SUMMARY), str(path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "admission journal replay:" in r.stdout
+        live2.close()
+
+    def test_respawn_canary_gated_mttr(self, g, tmp_path):
+        """Resurrection: a heal-armed fleet loses a replica
+        mid-drain, respawns it under the (zero-delay) backoff, the
+        canary passes, routing re-enters, brownout decays to 0, and
+        MTTR is gauged — all before run() returns."""
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            flt = make_fleet(g, tmp_path, heal=True,
+                             respawn_retry=heal_retry())
+            qids = [flt.submit("sssp", source=s)
+                    for s in (1, 5, 9, 13)]
+            target = flt.routing_target("sssp")
+            flt.set_fault(faults.ReplicaKillPlan({target: 1}))
+            rs = flt.run()
+        assert sorted(r.qid for r in rs) == qids
+        assert _check_answers(g, rs) == 0
+        assert flt.failovers >= 1
+        assert flt.respawns == 1 and flt.quarantines == 0
+        assert [r.state for r in flt._replicas] == ["up", "up"]
+        assert flt._brownout == 0
+        assert flt.mttr_s is not None and flt.mttr_s >= 0.0
+        assert flt.metrics.gauge("fleet_mttr_seconds").value >= 0.0
+        assert flt.metrics.counter("fleet_respawns_total").value == 1
+        # the canary gated re-entry and the trail shows the order:
+        # lost BEFORE respawn, with a passing canary between
+        canaries = [e for e in ev.events if e["kind"] == "canary"]
+        assert canaries and canaries[-1]["ok"] is True
+        assert canaries[-1]["replica"] == target
+        resp = [e for e in ev.events
+                if e["kind"] == "replica_respawn"]
+        assert len(resp) == 1 and resp[0]["replica"] == target
+        assert resp[0]["canary_ok"] is True
+        order = [e["kind"] for e in ev.events
+                 if e["kind"] in ("replica_lost", "canary",
+                                  "replica_respawn")]
+        assert order.index("replica_lost") \
+            < order.index("canary") < order.index("replica_respawn")
+        # the canary probe is NOT traffic: its throwaway qid never
+        # reaches the caller's responses
+        assert not (set(r.qid for r in rs)
+                    & {e["qid"] for e in canaries})
+
+    def test_replica_flap_trips_quarantine(self, g, tmp_path):
+        """REPLICA_FLAP (the one re-firing action): the respawned
+        replica dies again at every boundary until flap detection
+        trips the typed quarantine — the survivor still answers every
+        admitted query, and the brownout HOLDS (a quarantined replica
+        is not coming back)."""
+        ev = telemetry.EventLog()
+        with telemetry.use(events=ev):
+            flt = make_fleet(g, tmp_path, heal=True,
+                             respawn_retry=heal_retry(),
+                             flap_threshold=3, flap_window_s=60.0)
+            qids = [flt.submit("components", source=s)
+                    for s in (2, 7, 11)]
+            target = flt.routing_target("components")
+            plan = faults.ReplicaKillPlan(
+                {target: 1}, action=faults.REPLICA_FLAP)
+            flt.set_fault(plan)
+            rs = flt.run()
+        assert sorted(r.qid for r in rs) == qids
+        assert _check_answers(g, rs) == 0
+        assert flt.quarantines == 1 and flt.respawns == 0
+        assert sorted(r.state for r in flt._replicas) == \
+            ["quarantined", "up"]
+        assert flt._brownout == 1
+        assert flt.mttr_s is None       # the pool never healed whole
+        assert flt.flap.deaths(target) >= flt.flap.threshold
+        kills = [f for f in plan.fired if f[0] == target]
+        assert len(kills) >= flt.flap.threshold     # it re-fired
+        q = [e for e in ev.events
+             if e["kind"] == "replica_quarantine"]
+        assert len(q) == 1 and q[0]["replica"] == target
+        assert q[0]["reason"] == "flap"
+        assert q[0]["deaths"] >= 3
+        assert flt.metrics.counter(
+            "fleet_quarantines_total").value == 1
+
+    def test_manual_resurrect_heal_off(self, g, tmp_path):
+        """resurrect() heals between drains with heal=False: the
+        supervisor never runs inside run(), but the operator can
+        drive the same respawn/canary path to quiescence by hand."""
+        flt = make_fleet(g, tmp_path, respawn_retry=heal_retry())
+        qids = [flt.submit("sssp", source=s) for s in (3, 8)]
+        target = flt.routing_target("sssp")
+        flt.set_fault(faults.ReplicaKillPlan({target: 1}))
+        rs = flt.run()
+        assert sorted(r.qid for r in rs) == qids
+        assert flt.respawns == 0        # heal=False: nothing auto
+        assert any(r.state == "lost" for r in flt._replicas)
+        assert flt._brownout == 1
+        flt.set_fault(None)
+        assert flt.resurrect() == [target]
+        assert all(r.state == "up" for r in flt._replicas)
+        assert flt._brownout == 0 and flt.respawns == 1
+        q2 = flt.submit("sssp", source=40)
+        (r2,) = flt.run()
+        assert r2.qid == q2 and _check_answers(g, [r2]) == 0
+
+    @pytest.mark.parametrize("verdict", ["missing", "wrong", "right"])
+    def test_recover_reset_digest_verdicts(self, g, tmp_path,
+                                           verdict):
+        """A journalled reset query re-dispatches ONLY when recovery
+        re-supplies the vector matching the persisted digest; a
+        missing or mismatching vector closes the entry as a typed
+        reset_unavailable shed (never a silent drop, never a
+        DIFFERENT query than the one admitted)."""
+        from lux_tpu.journal import AdmissionJournal
+
+        jpath = str(tmp_path / "g.journal")
+        reset = np.zeros(g.nv, np.float32)
+        reset[4] = 0.75
+        reset[11] = 0.25
+        flt = make_fleet(g, tmp_path, journal_path=jpath)
+        sq = flt.submit("sssp", source=6)
+        pq = flt.submit("pagerank", reset=reset)
+        flt.close()                     # crash: nothing drained
+
+        resets = {"missing": None,
+                  "wrong": {pq: np.roll(reset, 1)},
+                  "right": {pq: reset}}[verdict]
+        flt2 = fleet.FleetServer.recover(
+            g, jpath, resets=resets, replicas=2, batch=2,
+            num_parts=2, retry=fast_retry(),
+            board_path=str(tmp_path / "board2"))
+        assert flt2.journal_replayed == 2
+        rs = flt2.run()
+        flt2.close()
+        assert _check_answers(
+            g, [r for r in rs if r.qid == sq]) == 0
+        if verdict == "right":
+            assert sorted(r.qid for r in rs) == [sq, pq]
+            (ppr,) = [r for r in rs if r.qid == pq]
+            from lux_tpu.apps import pagerank
+            ref = pagerank.reference_pagerank_batched(
+                g, reset[:, None], max(1, ppr.iters))[:, 0]
+            np.testing.assert_allclose(ppr.answer, ref, atol=5e-5)
+            want = {sq: "answered", pq: "answered"}
+        else:
+            assert [r.qid for r in rs] == [sq]
+            (err,) = [e for e in flt2.shed_records if e.qid == pq]
+            assert err.reason == fleet.SHED_RESET_UNAVAILABLE
+            want = {sq: "answered", pq: "shed"}
+        opens, retired, _, _ = AdmissionJournal.scan(jpath, nv=g.nv)
+        assert opens == [] and retired == want
+
+    def test_recover_epoch_folded_sheds_typed(self, g, tmp_path):
+        """A recovered base that durably compacted PAST a record's
+        admission epoch cannot answer it bitwise at that epoch — the
+        entry closes as a typed epoch_folded shed with a journal
+        RETIRE(shed) record."""
+        from lux_tpu.journal import AdmissionJournal
+        from lux_tpu.livegraph import LiveGraph
+
+        wal = str(tmp_path / "g.lux.wal")
+        jpath = str(tmp_path / "g.lux.journal")
+        live = LiveGraph(g, capacity=32, wal_path=wal)
+        flt = make_fleet(g, tmp_path, live=live, journal_path=jpath)
+        flt.mutate([1, 2], [3, 4])          # epoch 1
+        qid = flt.submit("sssp", source=5)  # admitted AT epoch 1
+        flt.close()
+        live.close()                        # crash
+
+        live2 = LiveGraph.recover(g, wal)
+        live2.append_edges([5], [6])        # epoch 2
+        assert live2.compact(force=True) is not None
+        assert live2.base_epoch == 2        # epoch 1 folded away
+        flt2 = fleet.FleetServer.recover(
+            live2.base, jpath, live=live2, replicas=2, batch=2,
+            num_parts=2, retry=fast_retry(),
+            board_path=str(tmp_path / "board2"))
+        assert flt2.journal_replayed == 1
+        assert flt2.run() == []
+        (err,) = flt2.shed_records
+        assert err.qid == qid
+        assert err.reason == fleet.SHED_EPOCH_FOLDED
+        flt2.close()
+        opens, retired, _, _ = AdmissionJournal.scan(jpath, nv=g.nv)
+        assert opens == [] and retired == {qid: "shed"}
+        live2.close()
+
+    def test_double_recover_is_exactly_once(self, g, tmp_path):
+        """Retirement is exactly-once ACROSS restarts: a second
+        recover over a fully-retired journal replays nothing,
+        answers nothing, and the qid space continues monotonically
+        past everything the journal has seen."""
+        jpath = str(tmp_path / "g.journal")
+        flt = make_fleet(g, tmp_path, journal_path=jpath)
+        qids = [flt.submit("sssp", source=s) for s in (1, 9)]
+        flt.close()                         # crash before any drain
+        flt2 = fleet.FleetServer.recover(
+            g, jpath, replicas=2, batch=2, num_parts=2,
+            retry=fast_retry(), board_path=str(tmp_path / "b2"))
+        assert flt2.journal_replayed == 2
+        rs = flt2.run()
+        assert sorted(r.qid for r in rs) == qids
+        flt2.close()
+        flt3 = fleet.FleetServer.recover(
+            g, jpath, replicas=2, batch=2, num_parts=2,
+            retry=fast_retry(), board_path=str(tmp_path / "b3"))
+        assert flt3.journal_replayed == 0
+        assert flt3.run() == []
+        assert flt3.submit("sssp", source=2) > max(qids)
+        assert len(flt3.run()) == 1
+        flt3.close()
+
+    def test_recover_rejects_journal_path_kw(self, g, tmp_path):
+        with pytest.raises(ValueError, match="journal_path"):
+            fleet.FleetServer.recover(
+                g, str(tmp_path / "j.journal"),
+                journal_path=str(tmp_path / "j.journal"))
 
 
 class TestBoard:

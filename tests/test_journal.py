@@ -1,0 +1,351 @@
+"""lux_tpu/journal.py: the durable admission journal (round 24).
+
+The corruption suite mirrors tests/test_livegraph.py's
+TestMutationLog contract record for record: bitwise roundtrip, the
+recoverable torn tail (truncated by replay, never re-dispatched),
+typed refusal of everything that cannot be a torn append (broken CRC
+chain, unknown record kinds, duplicate/unmatched/double retirement,
+backwards qids, a foreign graph's header), plus the fsck legs and
+the reset-digest rule (the journal stores 8 bytes of blake2b, never
+the vector).
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lux_tpu import format as luxfmt
+from lux_tpu.convert import uniform_random_edges
+from lux_tpu.graph import Graph
+from lux_tpu.journal import (AdmissionJournal, AdmissionJournalError,
+                             reset_digest)
+from lux_tpu.serve import Request
+
+REPO = Path(__file__).resolve().parent.parent
+FSCK = REPO / "scripts" / "fsck_lux.py"
+
+NV = 64
+
+
+@pytest.fixture(scope="module")
+def g():
+    src, dst = uniform_random_edges(NV, 256, seed=9)
+    return Graph.from_edges(src, dst, NV)
+
+
+def _req(qid, kind="sssp", **kw):
+    kw.setdefault("source", 3)
+    return Request(qid=qid, kind=kind, t_enqueue=0.0, **kw)
+
+
+def _fresh(tmp_path, name="a.journal"):
+    path = str(tmp_path / name)
+    return path, AdmissionJournal(path, nv=NV)
+
+
+class TestJournalRoundtrip:
+    def test_roundtrip_bitwise(self, tmp_path):
+        """Every ADMIT field survives the 48-byte record exactly:
+        source/reset, epoch/static, deadline, negative priority,
+        tenant bytes, and the retirement causes."""
+        path, j = _fresh(tmp_path)
+        reset = np.zeros(NV, np.float32)
+        reset[5] = 1.0
+        reqs = [
+            _req(0),
+            _req(1, kind="components", source=7, epoch=4,
+                 tenant="paid", priority=-2, deadline_s=1.5),
+            _req(2, kind="pagerank", source=None, reset=reset,
+                 tenant="free", priority=9),
+        ]
+        for r in reqs:
+            j.append_admit(r)
+        j.append_retire(0, "answered")
+        j.append_retire(2, "shed")
+        j.close()
+
+        opens, retired, hnv, torn = AdmissionJournal.scan(path, nv=NV)
+        assert (hnv, torn) == (NV, 0)
+        assert retired == {0: "answered", 2: "shed"}
+        (rec,) = opens
+        assert rec.qid == 1 and rec.kind == "components"
+        assert rec.source == 7 and rec.epoch == 4
+        assert rec.tenant == "paid" and rec.priority == -2
+        assert rec.deadline_s == pytest.approx(1.5)
+        assert rec.digest is None
+
+    def test_reset_query_persists_digest_only(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        reset = np.linspace(0, 1, NV).astype(np.float32)
+        j.append_admit(_req(0, kind="pagerank", source=None,
+                            reset=reset))
+        j.close()
+        (rec,), _, _, _ = AdmissionJournal.scan(path, nv=NV)
+        assert rec.source is None
+        assert rec.digest == reset_digest(reset)
+        assert len(rec.digest) == 8
+        # a different vector fingerprints differently — recovery's
+        # mismatch shed hangs off this inequality
+        other = reset.copy()
+        other[0] += 1.0
+        assert reset_digest(other) != rec.digest
+
+    def test_tiny_deadline_never_collapses_to_none(self, tmp_path):
+        """Deadlines round UP to >= 1 ms: a 0.1 ms deadline must not
+        decode as the no-deadline sentinel (0)."""
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0, deadline_s=1e-4))
+        j.close()
+        (rec,), _, _, _ = AdmissionJournal.scan(path, nv=NV)
+        assert rec.deadline_s == pytest.approx(0.001)
+
+    def test_buffer_bytes_tracks_appends(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        assert j.buffer_bytes() == luxfmt.JOURNAL_HEADER_SIZE
+        j.append_admit(_req(0))
+        j.append_retire(0)
+        assert j.buffer_bytes() == (luxfmt.JOURNAL_HEADER_SIZE
+                                    + 2 * luxfmt.JOURNAL_RECORD_SIZE)
+        j.close()
+
+    def test_existing_journal_refused_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        j.close()
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal(path, nv=NV)
+        assert ei.value.check == "journal_exists"
+        assert "recover" in ei.value.detail
+
+    def test_oversize_tenant_refused_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        with pytest.raises(AdmissionJournalError) as ei:
+            j.append_admit(_req(0, tenant="enterprise-gold"))
+        assert ei.value.check == "tenant_size"
+        j.close()
+        # the refused append left NOTHING on disk — the journal is
+        # still clean and appendable
+        opens, retired, _, torn = AdmissionJournal.scan(path, nv=NV)
+        assert (opens, retired, torn) == ([], {}, 0)
+
+
+class TestJournalTornTail:
+    def test_torn_tail_reported_then_truncated(self, tmp_path):
+        """A strict-prefix torn append (power loss mid-write) is the
+        RECOVERABLE class: scan reports it, replay truncates it and
+        resumes the chain — and the resumed handle's appends
+        re-validate."""
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0))
+        j.write_torn(j.pack_admit(_req(1)))
+        j.close()
+        opens, retired, _, torn = AdmissionJournal.scan(path, nv=NV)
+        assert len(opens) == 1 and 0 < torn < \
+            luxfmt.JOURNAL_RECORD_SIZE
+        opens, retired, torn2, j2 = AdmissionJournal.replay(
+            path, nv=NV)
+        assert torn2 == torn and [r.qid for r in opens] == [0]
+        # the torn record was never acknowledged: qid 1 may be
+        # re-issued, and the resumed chain stays valid
+        j2.append_retire(0, "answered")
+        j2.append_admit(_req(1))
+        j2.close()
+        opens, retired, _, torn = AdmissionJournal.scan(path, nv=NV)
+        assert [r.qid for r in opens] == [1]
+        assert retired == {0: "answered"} and torn == 0
+
+    def test_full_record_bad_crc_tail_is_rot(self, tmp_path):
+        """A FULL-SIZE record failing the chain CRC is corruption of
+        a possibly-fsync-acknowledged append — typed refusal, never a
+        torn-tail truncation (the MutationLog contract, mirrored)."""
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0))
+        j.close()
+        with open(path, "ab") as f:
+            f.write(b"\x7f" * luxfmt.JOURNAL_RECORD_SIZE)
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "crc_chain"
+        assert "possibly-acknowledged" in ei.value.detail
+
+    def test_midfile_corruption_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        for qid in range(3):
+            j.append_admit(_req(qid))
+        j.close()
+        off = luxfmt.JOURNAL_HEADER_SIZE + luxfmt.JOURNAL_RECORD_SIZE
+        with open(path, "r+b") as f:
+            f.seek(off + 4)
+            b = f.read(1)
+            f.seek(off + 4)
+            f.write(bytes([b[0] ^ 0x01]))
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "crc_chain"
+        assert "mid-file" in ei.value.detail
+
+
+class TestJournalPairing:
+    """ADMIT/RETIRE pairing at rest: the records are appended through
+    the journal's own sealer (so every CRC is VALID) — the pairing
+    audits must catch the semantic corruption the chain cannot."""
+
+    def test_admit_dup_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0))
+        j._append(j.pack_admit(_req(0)))
+        j.close()
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "admit_dup"
+
+    def test_readmit_after_retire_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0))
+        j.append_retire(0, "answered")
+        j._append(j.pack_admit(_req(0)))
+        j.close()
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "admit_dup"
+
+    def test_qid_order_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(5))
+        j._append(j.pack_admit(_req(3)))
+        j.close()
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "qid_order"
+
+    def test_retire_unmatched_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0))
+        j._append(j.pack_retire(9, "answered"))
+        j.close()
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "retire_unmatched"
+
+    def test_retire_dup_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0))
+        j.append_retire(0, "answered")
+        j._append(j.pack_retire(0, "answered"))
+        j.close()
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "retire_dup"
+
+    def test_unknown_record_kind_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        words = np.zeros(11, luxfmt.V_DTYPE)
+        words[0] = 9
+        j._append(j._seal(words))
+        j.close()
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "record_kind"
+
+    def test_unknown_retire_cause_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0))
+        words = np.zeros(11, luxfmt.V_DTYPE)
+        words[0] = 2            # RETIRE
+        words[1] = 0
+        words[2] = 7            # no such cause
+        j._append(j._seal(words))
+        j.close()
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "record_kind"
+
+    def test_unknown_query_kind_code_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        words = np.zeros(11, luxfmt.V_DTYPE)
+        words[0] = 1            # ADMIT
+        words[1] = 0
+        words[2] = 200          # no such serve.KINDS index
+        j._append(j._seal(words))
+        j.close()
+        with pytest.raises(AdmissionJournalError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "record_kind"
+
+
+class TestJournalHeader:
+    def test_foreign_graph_header_typed(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0))
+        j.close()
+        with pytest.raises(luxfmt.GraphFormatError) as ei:
+            AdmissionJournal.scan(path, nv=NV + 1)
+        assert ei.value.check == "journal_header"
+        assert "different graph" in ei.value.detail
+
+    def test_not_a_journal_typed(self, tmp_path):
+        path = str(tmp_path / "x.journal")
+        Path(path).write_bytes(b"LUXG" + b"\x00" * 12)
+        with pytest.raises(luxfmt.GraphFormatError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "journal_header"
+
+    def test_unknown_version_typed(self, tmp_path):
+        path = str(tmp_path / "x.journal")
+        head = bytearray(luxfmt.pack_journal_header(NV))
+        head[4:8] = (99).to_bytes(4, "little")
+        Path(path).write_bytes(bytes(head))
+        with pytest.raises(luxfmt.GraphFormatError) as ei:
+            AdmissionJournal.scan(path, nv=NV)
+        assert ei.value.check == "journal_version"
+
+
+class TestFsckJournal:
+    def _fsck(self, *paths):
+        return subprocess.run(
+            [sys.executable, str(FSCK), *map(str, paths)],
+            capture_output=True, text=True)
+
+    def test_clean_and_torn_pass_corrupt_exits_2(self, tmp_path):
+        path, j = _fresh(tmp_path)
+        j.append_admit(_req(0))
+        j.append_admit(_req(1))
+        j.append_retire(0, "shed")
+        j.write_torn(j.pack_admit(_req(2)))
+        j.close()
+        r = self._fsck(path)
+        assert r.returncode == 0, r.stderr
+        assert "OK journal v1" in r.stdout
+        assert "open=1 retired=1 shed=1" in r.stdout
+        assert "TORN-TAIL" in r.stdout and "recoverable" in r.stdout
+        # rot the tail up to a full record: exit 2 (the typed
+        # integrity-refusal convention)
+        with open(path, "ab") as f:
+            f.write(b"\x7f" * luxfmt.JOURNAL_RECORD_SIZE)
+        r = self._fsck(path)
+        assert r.returncode == 2
+        assert "crc_chain" in r.stderr
+
+    def test_sidecar_checked_against_its_graph(self, g, tmp_path):
+        """A <graph>.lux.journal sidecar beside a checked .lux is
+        verified AGAINST that graph — a journal for a different nv
+        fails at rest, never as re-dispatched queries against the
+        wrong graph."""
+        lux = str(tmp_path / "g.lux")
+        luxfmt.write_lux(lux, g.row_ptrs, g.col_idx)
+        side = luxfmt.journal_sidecar_path(lux)
+        j = AdmissionJournal(side, nv=g.nv)
+        j.append_admit(_req(0))
+        j.close()
+        r = self._fsck(lux)
+        assert r.returncode == 0, r.stderr
+        assert "OK journal" in r.stdout
+        # now a FOREIGN journal (wrong nv) under the sidecar name
+        Path(side).unlink()
+        j = AdmissionJournal(side, nv=g.nv + 3)
+        j.close()
+        r = self._fsck(lux)
+        assert r.returncode == 2
+        assert "different graph" in r.stderr

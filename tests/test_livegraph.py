@@ -496,6 +496,7 @@ class TestLiveGraph:
         wal = str(tmp_path / "g.lux.wal")
         lg = LiveGraph(g, capacity=512, wal_path=wal)
         stop = threading.Event()
+        drained = threading.Event()
         appended = []
 
         def ingest():
@@ -506,7 +507,11 @@ class TestLiveGraph:
                 try:
                     lg.append_edges([s], [d])
                 except DeltaFullError:
-                    time.sleep(0.001)
+                    # wait for the compactor's signal instead of a
+                    # wall-clock sleep (flaky under CI load); the
+                    # timeout is liveness only, not pacing
+                    drained.clear()
+                    drained.wait(0.1)
                     continue
                 appended.append((s, d))
 
@@ -517,7 +522,9 @@ class TestLiveGraph:
         while compactions < 4 and time.monotonic() < deadline:
             if lg.compact(force=True) is not None:
                 compactions += 1
+                drained.set()
         stop.set()
+        drained.set()
         th.join()
         assert compactions >= 2 and len(appended) > 0
         # every acknowledged edge is in new-base-or-delta
@@ -1772,9 +1779,22 @@ class TestLiveChaosAcceptance:
             def mutator():
                 # stream until the load ends, leaving headroom under
                 # the threshold so the post-load top-up controls the
-                # exact trigger point
+                # exact trigger point.  Pace by OBSERVED drain
+                # progress (new query_start/query_done events in the
+                # in-memory trail — append-only, len() is a safe
+                # probe) rather than a wall-clock sleep: under CI
+                # load a timed cadence either starves the stream or
+                # outruns the drain.  stop.wait is a poll tick only.
+                seen = len(ev.events)
                 while not stop.is_set() and live.occupancy() < 0.4:
-                    time.sleep(0.02)
+                    now = len(ev.events)
+                    progressed = any(
+                        e.get("kind") in ("query_start", "query_done")
+                        for e in ev.events[seen:now])
+                    seen = now
+                    if not progressed:
+                        stop.wait(0.005)
+                        continue
                     try:
                         flt.mutate(mrng.integers(g.nv, size=4),
                                    mrng.integers(g.nv, size=4))
