@@ -304,6 +304,9 @@ class _SubprocessReplica:
             fd, tmp = tempfile.mkstemp(dir=self.spool,
                                        suffix=".rst.tmp")
             with os.fdopen(fd, "wb") as f:
+                # lockcheck: allow(durable-before-visible) same-host
+                # IPC spool, not a durability record: a torn/lost
+                # reset is re-dispatched from the admission journal
                 np.save(f, np.asarray(req.reset, np.float32))
             os.replace(tmp, os.path.join(
                 self.inbox, f"q{req.qid:08d}.reset.npy"))
@@ -1242,7 +1245,8 @@ class FleetServer:
                 resps += self._drain_inproc(rep, kind)
         finally:
             runner.metrics, coll.metrics = saved
-        self._canaries.discard(qid)
+        with self._lock:
+            self._canaries.discard(qid)
         canary = next((r for r in resps if r.qid == qid), None)
         if rep.state != "warming" or canary is None:
             _emit("canary", replica=rep.name, qid=qid,
@@ -1262,14 +1266,17 @@ class FleetServer:
 
     def _failover(self, req: Request, from_rep,
                   t_detect: float | None = None) -> None:
-        if req.qid in self._retired:
+        with self._lock:
             # the replayed-query guard: a query whose retirement
-            # raced the loss detection must not run twice
-            self.dup_dropped += 1
-            if self.metrics is not None:
-                self.metrics.counter("fleet_dup_dropped_total",
-                                     kind=req.kind).inc()
-            return
+            # raced the loss detection must not run twice — checked
+            # AND counted under the lock (a lock-free check here is
+            # the stamp-then-admit window, lockcheck toctou-gate)
+            if req.qid in self._retired:
+                self.dup_dropped += 1
+                if self.metrics is not None:
+                    self.metrics.counter("fleet_dup_dropped_total",
+                                         kind=req.kind).inc()
+                return
         k = self._attempts.get(req.qid, 0)
         self._attempts[req.qid] = k + 1
         if k >= self.retry.retries:
@@ -1329,14 +1336,18 @@ class FleetServer:
                 qid = int(meta["qid"])
                 req = rep.inflight.pop(qid, None) \
                     or self._qreq.get(qid)
-                if qid in self._retired or req is None:
+                with self._lock:
                     # a late answer from a replica we already failed
-                    # over: the exactly-once guard drops it
-                    self.dup_dropped += 1
-                    if self.metrics is not None:
-                        self.metrics.counter(
-                            "fleet_dup_dropped_total",
-                            kind=meta.get("kind", "?")).inc()
+                    # over: the exactly-once guard drops it — gate
+                    # and counter share one acquisition (toctou)
+                    dup = qid in self._retired or req is None
+                    if dup:
+                        self.dup_dropped += 1
+                        if self.metrics is not None:
+                            self.metrics.counter(
+                                "fleet_dup_dropped_total",
+                                kind=meta.get("kind", "?")).inc()
+                if dup:
                     continue
                 out.append(self._accept_remote(rep, req, meta,
                                                answer))
@@ -1751,6 +1762,10 @@ def _worker_main(spec_path: str) -> int:
             fd, tmp = tempfile.mkstemp(dir=spec["dir"],
                                        suffix=".npy.tmp")
             with os.fdopen(fd, "wb") as fh:
+                # lockcheck: allow(durable-before-visible) same-host
+                # answer spool, not a durability record: a lost
+                # answer re-runs from the journal; fsync per answer
+                # would serialize the drain on disk latency
                 np.save(fh, r.answer)
             os.replace(tmp, base + ".npy")
             meta = {"qid": fq, "kind": r.kind, "source": r.source,

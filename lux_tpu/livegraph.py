@@ -907,8 +907,14 @@ class LiveGraph:
         anti epoch - 1) until a re-seed-bearing fold publishes it.
         Answers stay exact at their admitted epoch; anti-monotone
         mutations cost admission FRESHNESS, never correctness."""
-        if self._anti:
-            return min(t[0] for t in self._anti) - 1
+        # snapshot FIRST: checking self._anti and then iterating it
+        # races compact()'s under-lock clear — a fold landing between
+        # the truthiness gate and the min() raised ValueError on the
+        # emptied list (found by lockcheck snapshot-iteration,
+        # regression: tests/test_lockcheck.py)
+        anti = list(self._anti)
+        if anti:
+            return min(t[0] for t in anti) - 1
         return self.epoch
 
     def graph_at(self, epoch: int) -> Graph:
@@ -919,7 +925,9 @@ class LiveGraph:
             raise ValueError(f"epoch {epoch} outside [0, "
                              f"{self.epoch}]")
         if epoch not in self._graph_cache:
-            hist = [h for h in self._history if h[4] <= epoch]
+            # list() snapshot: _publish appends under the lock while
+            # oracle threads replay history lock-free
+            hist = [h for h in list(self._history) if h[4] <= epoch]
             self._graph_cache[epoch] = _apply_ops(
                 self.origin, hist, self.weighted)
         return self._graph_cache[epoch]
@@ -988,6 +996,10 @@ class LiveGraph:
             cached = (weakref.ref(sg), self.d_src, n, src_slot,
                       dst_slot, self.d_w.copy(), self.d_kind.copy(),
                       self.d_epoch.copy())
+            # lockcheck: allow(guarded-field) idempotent cache fill
+            # (last-writer-wins over immutable published slots);
+            # compact()'s under-lock clear targets a generation the
+            # engines must refresh_live() past before serving anyway
             self._slot_cache[key] = cached
         return cached[3], cached[4], cached[5], cached[6], cached[7]
 
@@ -1134,7 +1146,7 @@ class LiveGraph:
 
         if col_epoch is None:
             col_epoch = self.epoch
-        anti_min = min((t[0] for t in self._anti), default=None)
+        anti_min = min((t[0] for t in list(self._anti)), default=None)
         if np.ndim(col_epoch) == 0:
             if anti_min is not None and anti_min <= int(col_epoch):
                 return self._revalidate_anti(eng, label, active,
@@ -1192,7 +1204,8 @@ class LiveGraph:
                 f"graph_at({target}).nv={g_new.nv}")
         src, dst = g_new.edge_arrays()
         cone = np.zeros(g_new.nv, dtype=bool)
-        touched = [d for (e, _op, _s, d) in self._anti if e <= target]
+        touched = [d for (e, _op, _s, d) in list(self._anti)
+                   if e <= target]
         cone[np.asarray(touched, np.int64)] = True
         while True:
             add = np.zeros(g_new.nv, dtype=bool)

@@ -130,6 +130,9 @@ class Gauge:
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
+        # lockcheck: allow(guarded-field) last-value store is ONE
+        # GIL-atomic assignment; set_max/inc/dec lock because they
+        # read-modify-write
         self.value = float(v)
 
     def set_max(self, v: float) -> None:

@@ -808,6 +808,111 @@ def check_bench_fence(path, tree, lines):
 # driver
 
 
+EVENT_EMIT_NAMES = {"emit", "_emit", "emit_sampled"}
+
+_KNOWN_EVENTS_CACHE = None
+
+
+def _known_events() -> set:
+    """events_summary.py's KNOWN set, parsed statically (no import:
+    the linter stays dependency-free)."""
+    global _KNOWN_EVENTS_CACHE
+    if _KNOWN_EVENTS_CACHE is None:
+        path = os.path.join(REPO, "scripts", "events_summary.py")
+        known = set()
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == "KNOWN":
+                    known = set(ast.literal_eval(node.value))
+        except (OSError, SyntaxError, ValueError):
+            pass
+        _KNOWN_EVENTS_CACHE = known
+    return _KNOWN_EVENTS_CACHE
+
+
+def check_event_names(path, tree, lines):
+    """event-name: every string LITERAL passed to a telemetry
+    ``emit(...)`` / ``_emit(...)`` / ``emit_sampled(...)`` must be
+    in events_summary.py's KNOWN set.  Without this, a new emitter
+    fails the runtime events audit only when its event first FIRES
+    — often a chaos leg nobody runs locally.  Adding the name to
+    KNOWN (with its schema note) is the fix; a deliberate
+    out-of-catalogue event carries ``# audit: allow(event-name)``
+    with justification."""
+    known = _known_events()
+    if not known:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name not in EVENT_EMIT_NAMES:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        if arg.value in known:
+            continue
+        if _suppressed(lines, node.lineno, "event-name"):
+            continue
+        findings.append(Finding(
+            path, node.lineno, "event-name",
+            f"emit({arg.value!r}) is not in events_summary.KNOWN "
+            f"— add the event name to the KNOWN catalogue so the "
+            f"runtime audit recognizes it before it first fires"))
+    return findings
+
+
+DOC_COMMAND_RE = re.compile(
+    r"python\s+-m\s+(lux_tpu(?:\.[A-Za-z_][A-Za-z0-9_]*)+)")
+
+
+def check_doc_commands(repo: str = REPO):
+    """command-drift: every ``python -m lux_tpu.<mod>`` cited in
+    CLAUDE.md / ARCHITECTURE.md must resolve to a module with an
+    ``if __name__ == "__main__"`` entry (or a package __main__.py)
+    — the docs can no longer name a smoke that doesn't exist."""
+    findings = []
+    for doc in ("CLAUDE.md", "ARCHITECTURE.md"):
+        p = os.path.join(repo, doc)
+        if not os.path.isfile(p):
+            continue
+        with open(p) as f:
+            doc_lines = f.read().splitlines()
+        for i, line in enumerate(doc_lines, 1):
+            for m in DOC_COMMAND_RE.finditer(line):
+                dotted = m.group(1)
+                base = os.path.join(repo, *dotted.split("."))
+                mod_py = base + ".py"
+                pkg_main = os.path.join(base, "__main__.py")
+                if os.path.isfile(pkg_main):
+                    continue
+                if not os.path.isfile(mod_py):
+                    msg = (f"cites `python -m {dotted}` but no such "
+                           f"module exists")
+                else:
+                    with open(mod_py) as f:
+                        src = f.read()
+                    if "__main__" in src:
+                        continue
+                    msg = (f"cites `python -m {dotted}` but "
+                           f"{os.path.relpath(mod_py, repo)} has no "
+                           f"`if __name__ == \"__main__\"` entry")
+                if _suppressed(doc_lines, i, "command-drift"):
+                    continue
+                findings.append(Finding(p, i, "command-drift", msg))
+    return findings
+
+
 def lint_file(path: str):
     with open(path) as f:
         src = f.read()
@@ -821,9 +926,13 @@ def lint_file(path: str):
     if "/scripts/" in norm:
         # benchmark scripts get ONLY the fencing gate — they are
         # exploratory by design and exempt from the library-tree
-        # conventions (jit closures, oracles, citations)
-        return check_bench_fence(path, tree, lines)
+        # conventions (jit closures, oracles, citations) — plus the
+        # event-name catalogue check (their emits feed the same
+        # runtime audit)
+        return (check_bench_fence(path, tree, lines)
+                + check_event_names(path, tree, lines))
     findings = check_jit_closures(path, tree, lines)
+    findings += check_event_names(path, tree, lines)
     findings += check_hot_path_metrics(
         path, tree, lines,
         whole_file=("/lux_tpu/engine/" in norm
@@ -879,6 +988,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     findings = lint_paths(args.paths)
+    # repo-level doc checks run regardless of the path selection:
+    # the cited-command catalogue lives in CLAUDE.md/ARCHITECTURE.md
+    findings += check_doc_commands()
     for f in findings:
         print(str(f), file=sys.stderr)
     if findings:

@@ -1,0 +1,464 @@
+"""lux_tpu/lockcheck.py: the host-concurrency & durability static
+analyzer (ISSUE 20, round 25).
+
+Per-check deliberately-violating synthetic fixtures asserting the
+NAMED ``LockCheckError(check=...)``, reproductions of the three
+historical CHANGES.md bug shapes (the PR-15/20 compact() lock-window
+double-loss, the PR-16 stamp-then-admit TOCTOU, the non-atomic
+checkpoint publish) proven detected by check name, the PR-15
+fifth-review refresh_live/run/compact three-way deadlock as the
+lock-order fixture, clean-pattern fixtures guarding against false
+positives (the caller-holds-the-lock idiom, list() snapshots, the
+write→fsync→publish checkpoint), pragma suppression, the repo-wide
+green gate, and the regression test for the real race lockcheck
+surfaced in livegraph.view_epoch (truthiness gate then min() over a
+list compact() clears under the lock)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from lux_tpu import lockcheck
+from lux_tpu.lockcheck import LockCheckError
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _findings(tmp_path, src, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return lockcheck.analyze_paths([str(p)])
+
+
+def _assert_raises_check(tmp_path, src, check):
+    p = tmp_path / "fixture.py"
+    p.write_text(src)
+    with pytest.raises(LockCheckError) as ei:
+        lockcheck.run_lockcheck([str(p)], mode="error")
+    assert ei.value.check == check
+    assert any(f.check == check for f in ei.value.findings)
+    return ei.value
+
+
+# ---------------------------------------------------------------------
+# one violating synthetic per check class
+
+
+def test_guarded_field_violation(tmp_path):
+    err = _assert_raises_check(tmp_path, """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def reset(self):
+        self.total = 0
+""", "guarded-field")
+    assert "Counter.total" in str(err)
+
+
+def test_lock_order_cycle(tmp_path):
+    # the PR-15 fifth-review shape: WAL fold -> server refresh_live,
+    # server run -> live admit, live compact -> WAL fold — a
+    # three-way lock cycle, deadlocked by three threads entering at
+    # different points (CHANGES.md round 20 review trail)
+    err = _assert_raises_check(tmp_path, """
+import threading
+
+class Wal:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fold(self, srv):
+        with self._lock:
+            srv.refresh_live()
+
+class Server:
+    def __init__(self, live):
+        self._lock = threading.Lock()
+        self.live = live
+
+    def refresh_live(self):
+        with self._lock:
+            pass
+
+    def run(self):
+        with self._lock:
+            self.live.admit()
+
+class Live:
+    def __init__(self, wal):
+        self._lock = threading.Lock()
+        self.wal = wal
+
+    def admit(self):
+        with self._lock:
+            pass
+
+    def compact(self):
+        with self._lock:
+            self.wal.fold(None)
+""", "lock-order")
+    msg = str(err)
+    for name in ("Wal._lock", "Server._lock", "Live._lock"):
+        assert name in msg
+
+
+def test_durable_before_visible_return(tmp_path):
+    _assert_raises_check(tmp_path, """
+def append_record(path, payload):
+    f = open(path, "ab")
+    f.write(payload)
+    f.close()
+    return True
+""", "durable-before-visible")
+
+
+def test_snapshot_iteration_violation(tmp_path):
+    _assert_raises_check(tmp_path, """
+import threading
+
+class Board:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = []
+
+    def add(self, r):
+        with self._lock:
+            self.rows.append(r)
+
+    def total(self):
+        n = 0
+        for r in self.rows:
+            n += r
+        return n
+""", "snapshot-iteration")
+
+
+def test_toctou_gate_violation(tmp_path):
+    _assert_raises_check(tmp_path, """
+import threading
+
+class Budget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.used = 0
+
+    def charge(self, n):
+        with self._lock:
+            self.used += n
+
+    def try_charge(self, n, cap):
+        if self.used + n <= cap:
+            with self._lock:
+                self.used += n
+            return True
+        return False
+""", "toctou-gate")
+
+
+# ---------------------------------------------------------------------
+# the three historical CHANGES.md bug shapes, detected by name
+
+
+def test_historical_compact_lock_window(tmp_path):
+    # PR-15/20: compact() released the lock mid-fold; a concurrent
+    # append's published slot was silently dropped by the
+    # fresh-delta swap (lost TWICE over, with its WAL record landing
+    # before the epoch START marker) — the guarded-field class
+    fnd = _findings(tmp_path, """
+import threading
+
+class MutLog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.slots = []
+        self.epoch = 0
+
+    def append(self, op):
+        with self._lock:
+            self.slots.append(op)
+            self.epoch += 1
+
+    def compact(self):
+        with self._lock:
+            folded = list(self.slots)
+        fresh = [op for op in folded if op is not None]
+        self.slots = fresh
+        self.epoch += 1
+""")
+    hits = [f for f in fnd if f.check == "guarded-field"]
+    assert hits, fnd
+    assert any("compact" in f.message for f in hits)
+
+
+def test_historical_stamp_then_admit(tmp_path):
+    # PR-16: the epoch was stamped in one step and the query
+    # admitted in another — a concurrent mutate+compact slipped
+    # through the window and folded the stamped view away — the
+    # toctou-gate class (livegraph.LiveGraph.admit is the
+    # one-acquisition fix)
+    fnd = _findings(tmp_path, """
+import threading
+
+class LiveView:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.epoch = 0
+        self.anti_epoch = None
+        self.pins = {}
+
+    def mutate(self):
+        with self._lock:
+            self.epoch += 1
+
+    def admit(self, qid):
+        stamp = self.epoch
+        if self.anti_epoch is None or stamp < self.epoch + 1:
+            with self._lock:
+                self.pins[qid] = stamp
+""")
+    hits = [f for f in fnd if f.check == "toctou-gate"]
+    assert hits, fnd
+    assert any("admit" in f.message for f in hits)
+
+
+def test_historical_nonatomic_checkpoint_publish(tmp_path):
+    # the checkpoint contract: write-tmp -> fsync -> rename; a
+    # publish with bytes still in the page cache can surface a torn
+    # checkpoint after a crash — the durable-before-visible class
+    fnd = _findings(tmp_path, """
+import os
+
+def save_checkpoint(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+""")
+    hits = [f for f in fnd if f.check == "durable-before-visible"]
+    assert hits, fnd
+    assert any("os.replace" in f.message for f in hits)
+
+
+def test_atomic_checkpoint_is_clean(tmp_path):
+    # the FIXED shape (checkpoint.save): fsync before the publish
+    assert _findings(tmp_path, """
+import os
+
+def save_checkpoint(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+""") == []
+
+
+def test_spool_json_must_be_last(tmp_path):
+    # fleet._worker_main contract: the json's presence marks a
+    # complete answer pair, so it is written LAST
+    fnd = _findings(tmp_path, """
+import json
+import os
+
+def spool_answer(base, payload):
+    with open(base + ".json.tmp", "w") as f:
+        json.dump({"ok": True}, f)
+    os.replace(base + ".json.tmp", base + ".json")
+    with open(base + ".npy.tmp", "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(base + ".npy.tmp", base + ".npy")
+""")
+    hits = [f for f in fnd if f.check == "durable-before-visible"]
+    assert hits, fnd
+    assert any("LAST" in f.message for f in hits)
+
+
+# ---------------------------------------------------------------------
+# clean patterns must stay clean (false-positive guards)
+
+
+def test_clean_patterns_pass(tmp_path):
+    assert _findings(tmp_path, """
+import os
+import threading
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.rows = []
+
+    def _bump(self):
+        # private helper: every call site holds the lock — the
+        # caller-holds-the-lock idiom (inferred, no pragma needed)
+        self.total += 1
+
+    def add(self, r):
+        with self._lock:
+            self.rows.append(r)
+            self._bump()
+
+    def drain(self):
+        with self._lock:
+            out = list(self.rows)
+            self.rows.clear()
+            self._bump()
+        return out
+
+    def peek(self):
+        # list() snapshot sanctions the lock-free iteration
+        return [r for r in list(self.rows)]
+
+    def try_add(self, r, cap):
+        with self._lock:
+            if len(self.rows) < cap:
+                self.rows.append(r)
+                return True
+        return False
+
+    @classmethod
+    def recover(cls, rows):
+        # construction phase: thread-confined until published
+        t = cls()
+        t.total = len(rows)
+        return t
+
+
+def append_record(path, payload):
+    with open(path, "ab") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    return True
+""") == []
+
+
+def test_pragma_suppresses_finding(tmp_path):
+    assert _findings(tmp_path, """
+import threading
+
+class Gauge:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def inc(self, v):
+        with self._lock:
+            self.value += v
+
+    def set(self, v):
+        # lockcheck: allow(guarded-field) single GIL-atomic store
+        self.value = float(v)
+""") == []
+
+
+def test_pragma_is_check_specific(tmp_path):
+    # a pragma for the WRONG check must not suppress
+    fnd = _findings(tmp_path, """
+import threading
+
+class Gauge:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def inc(self, v):
+        with self._lock:
+            self.value += v
+
+    def set(self, v):
+        # lockcheck: allow(snapshot-iteration) wrong check name
+        self.value = float(v)
+""")
+    assert any(f.check == "guarded-field" for f in fnd)
+
+
+def test_run_lockcheck_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        lockcheck.run_lockcheck([], mode="bogus")
+
+
+# ---------------------------------------------------------------------
+# the real race lockcheck surfaced (satellite 1 regression test)
+
+
+class _VanishingAnti(list):
+    """Simulates compact() clearing ``_anti`` under the lock between
+    view_epoch's truthiness gate and its min() iteration: truthy at
+    the gate, already empty when iterated."""
+
+    def __bool__(self):
+        return True
+
+    def __iter__(self):
+        return iter(())
+
+
+def test_view_epoch_snapshot_regression():
+    # pre-fix view_epoch did `if self._anti: min(t[0] for t in
+    # self._anti)` — a compact() landing between the two raised
+    # ValueError on the emptied list; the list() snapshot fix
+    # returns the published epoch instead
+    from lux_tpu.convert import uniform_random_edges
+    from lux_tpu.graph import Graph
+    from lux_tpu.livegraph import LiveGraph
+
+    src, dst = uniform_random_edges(64, 256, seed=3)
+    g = Graph.from_edges(src, dst, 64)
+    lg = LiveGraph(g, capacity=8)
+    lg._anti = _VanishingAnti()
+    assert lg.view_epoch("push") == lg.epoch
+    assert lg.view_epoch("pull") == lg.epoch
+
+
+# ---------------------------------------------------------------------
+# repo-wide gates
+
+
+def test_lockcheck_repo_clean():
+    assert lockcheck.run_lockcheck(mode="findings") == []
+
+
+def test_lockcheck_cli_green():
+    proc = subprocess.run(
+        [sys.executable, "-m", "lux_tpu.lockcheck"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_lockcheck_cli_red_on_violation(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text("""
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def a(self):
+        with self._lock:
+            self.n += 1
+
+    def b(self):
+        self.n = 0
+""")
+    proc = subprocess.run(
+        [sys.executable, "-m", "lux_tpu.lockcheck", str(p)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "guarded-field" in proc.stderr
